@@ -1,0 +1,70 @@
+"""Benchmark entry: prints ONE JSON line with the north-star metric.
+
+Metric (BASELINE.md): item-pairs/sec = ObservedCooccurrences / Duration on a
+Zipfian basket stream, device backend. ``vs_baseline`` compares against the
+first recorded CPU-oracle-backend run of this same framework (the reference
+publishes no numbers — BASELINE.md "Published reference numbers: None").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def run(backend: str, users, items, ts, num_items: int, window_ms: int):
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.metrics import OBSERVED_COOCCURRENCES
+
+    cfg = Config(window_size=window_ms, seed=0xC0FFEE, item_cut=500,
+                 user_cut=500, backend=Backend(backend), num_items=num_items)
+    job = CooccurrenceJob(cfg)
+    start = time.monotonic()
+    job.add_batch(users, items, ts)
+    job.finish()
+    elapsed = time.monotonic() - start
+    pairs = job.counters.get(OBSERVED_COOCCURRENCES)
+    return pairs, elapsed
+
+
+def main() -> None:
+    # Default to CPU JAX when no real accelerator platform is reachable; the
+    # driver's TPU environment leaves JAX_PLATFORMS as configured.
+    from tpu_cooccurrence.io.synthetic import zipfian_interactions
+
+    n_events = int(os.environ.get("BENCH_EVENTS", 200_000))
+    n_items = int(os.environ.get("BENCH_ITEMS", 20_000))
+    users, items, ts = zipfian_interactions(
+        n_events, n_items=n_items, n_users=5_000, alpha=1.1, seed=3,
+        events_per_ms=200)
+
+    pairs, elapsed = run("device", users, items, ts,
+                         num_items=n_items, window_ms=100)
+    pairs_per_sec = pairs / max(elapsed, 1e-9)
+
+    # Baseline: the exact host (oracle) backend on the same stream, cached
+    # in .bench_baseline.json on first run.
+    baseline_path = os.path.join(os.path.dirname(__file__), ".bench_baseline.json")
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f)["pairs_per_sec"]
+    else:
+        b_pairs, b_elapsed = run("oracle", users, items, ts,
+                                 num_items=n_items, window_ms=100)
+        baseline = b_pairs / max(b_elapsed, 1e-9)
+        with open(baseline_path, "w") as f:
+            json.dump({"pairs_per_sec": baseline}, f)
+
+    print(json.dumps({
+        "metric": "item-pairs/sec (Zipfian basket stream, device backend)",
+        "value": round(pairs_per_sec, 1),
+        "unit": "pairs/s",
+        "vs_baseline": round(pairs_per_sec / max(baseline, 1e-9), 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,24 @@
+"""Test harness: force CPU JAX with an 8-device virtual mesh.
+
+Multi-chip sharding (`shard_map`/`psum`) is tested without real TPUs via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4).
+
+Note: the surrounding environment may pre-import jax and register an
+accelerator plugin via sitecustomize before pytest starts, so setting
+``JAX_PLATFORMS`` in ``os.environ`` here is not enough — we also override
+the already-imported config. Backend clients are created lazily on first
+use, so doing this in conftest (before any test touches jax) is safe.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

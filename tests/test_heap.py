@@ -1,0 +1,67 @@
+"""Top-K heap oracle tests, mirroring the reference's heap tests
+(``IntDoublePriorityQueueTest.java``)."""
+
+import numpy as np
+
+from tpu_cooccurrence.oracle.heap import TopKHeap
+
+
+def test_add_ascending_order():
+    q = TopKHeap(10)
+    for i in range(10):
+        q.add(i, float(i))
+    assert q.least_value() == 0
+    assert q.least_score() == 0.0
+
+
+def test_add_descending_order():
+    q = TopKHeap(10)
+    for i in reversed(range(10)):
+        q.add(i, float(i))
+    assert q.least_value() == 0
+    assert q.least_score() == 0.0
+
+
+def test_random_elements_against_sort_oracle():
+    # Reference: IntDoublePriorityQueueTest.java:37-75 (seed 0xC0FFEE).
+    rng = np.random.default_rng(0xC0FFEE)
+    n, k = 100, 10
+    scores = rng.random(n)
+    q = TopKHeap(k)
+    for i in range(n):
+        q.offer(i, float(scores[i]))
+    srt = np.sort(scores)
+    assert q.least_score() == srt[n - k]
+    top = sorted(s for _, s in q)
+    np.testing.assert_array_equal(top, srt[n - k:])
+
+
+def test_reset_and_reuse():
+    q = TopKHeap(10)
+    for i in range(3):
+        q.add(i, float(i))
+    assert q.size == 3
+    q.reset()
+    for i in range(10):
+        q.add(i, float(i))
+    assert q.size == 10
+    assert q.least_value() == 0
+    assert q.least_score() == 0.0
+
+
+def test_tie_keeps_earlier_insert():
+    # offer() replaces the min only on strictly greater score
+    # (ItemRowRescorerTwoInputStreamOperator.java:220).
+    q = TopKHeap(2)
+    q.offer(1, 5.0)
+    q.offer(2, 5.0)
+    q.offer(3, 5.0)  # tie with current min: must NOT displace
+    values = {v for v, _ in q}
+    assert values == {1, 2}
+
+
+def test_sorted_desc():
+    q = TopKHeap(3)
+    for v, s in [(7, 1.0), (8, 3.0), (9, 2.0)]:
+        q.offer(v, s)
+    assert q.sorted_desc() == [(8, 3.0), (9, 2.0), (7, 1.0)]

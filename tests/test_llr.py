@@ -1,0 +1,100 @@
+"""LLR kernel tests.
+
+Golden values are the Dunning-paper cases used by the reference test
+(``LogLikelihoodTest.java:13-16``): 270.72, 263.90, 48.94 at tolerance 0.1.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.oracle.reference import _llr_scalar
+from tpu_cooccurrence.ops import llr as llr_ops
+
+GOLDEN = [
+    ((110, 2442, 111, 29114), 270.72),
+    ((29, 13, 123, 31612), 263.90),
+    ((9, 12, 429, 31327), 48.94),
+]
+
+
+@pytest.mark.parametrize("cells,expected", GOLDEN)
+def test_golden_scalar_oracle(cells, expected):
+    assert _llr_scalar(*cells) == pytest.approx(expected, abs=0.1)
+
+
+@pytest.mark.parametrize("cells,expected", GOLDEN)
+def test_golden_numpy(cells, expected):
+    assert llr_ops.llr_np(*cells) == pytest.approx(expected, abs=0.1)
+
+
+@pytest.mark.parametrize("cells,expected", GOLDEN)
+def test_golden_jax_stable_f32(cells, expected):
+    vals = [np.float32(c) for c in cells]
+    out = float(llr_ops.llr_stable_jit(*vals))
+    assert out == pytest.approx(expected, abs=0.1)
+
+
+def test_zero_cells():
+    # Any zero cell must not produce NaN/inf (0*log 0 = 0 convention,
+    # LogLikelihood.java:59-61).
+    cases = [(0, 1, 2, 3), (1, 0, 2, 3), (1, 2, 0, 3), (1, 2, 3, 0),
+             (0, 0, 0, 0), (5, 0, 0, 0), (0, 5, 0, 0)]
+    for cells in cases:
+        ref = _llr_scalar(*cells)
+        assert np.isfinite(ref)
+        got = float(llr_ops.llr_stable_jit(*[np.float32(c) for c in cells]))
+        assert np.isfinite(got)
+        assert got == pytest.approx(ref, abs=1e-3, rel=1e-4)
+
+
+def test_independence_is_zero():
+    # Perfectly independent table: LLR == 0 exactly.
+    # rows (a+b, c+d), cols proportional: k11/k12 == k21/k22.
+    assert _llr_scalar(10, 20, 100, 200) == pytest.approx(0.0, abs=1e-9)
+    got = float(llr_ops.llr_stable_jit(*(np.float32(x) for x in (10, 20, 100, 200))))
+    assert got == pytest.approx(0.0, abs=1e-3)
+
+
+def test_stable_f32_matches_f64_oracle_at_scale():
+    """The reason llr_stable exists: float32 accuracy at ~1e10 counts where
+    the entropy form cancels catastrophically."""
+    rng = np.random.default_rng(0xC0FFEE)
+    n = 2000
+    k11 = rng.integers(1, 500, n)
+    r1 = k11 + rng.integers(0, 500_000, n)
+    r2 = rng.integers(0, 1_000_000, n)
+    k21 = np.minimum(rng.integers(0, 500_000, n), r2)
+    observed = np.int64(30_000_000_000)
+    k12 = r1 - k11
+    k22 = observed + k11 - k12 - k21
+    ref = llr_ops.llr_np(k11, k12, k21, k22)
+    got = np.asarray(
+        llr_ops.llr_stable_jit(
+            k11.astype(np.float32), k12.astype(np.float32),
+            k21.astype(np.float32), k22.astype(np.float32)))
+    # Absolute tolerance on scores that range up to ~1e4.
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-3)
+
+
+def test_entropy_f32_would_fail_at_scale():
+    """Documents why the entropy form is not used on device: in float32 it is
+    garbage at large counts (sanity check that our reformulation is actually
+    load-bearing)."""
+    import jax.numpy as jnp
+
+    cells = (200.0, 300_000.0, 400_000.0, 3e10)
+    ref = float(llr_ops.llr_np(*cells))
+    ent32 = float(llr_ops.llr_entropy(*(jnp.float32(c) for c in cells)))
+    stable32 = float(llr_ops.llr_stable(*(jnp.float32(c) for c in cells)))
+    assert abs(stable32 - ref) < 0.01 * max(1.0, abs(ref))
+    assert abs(ent32 - ref) > abs(stable32 - ref)
+
+
+def test_score_contingency_matches_reference_table():
+    """k12/k21/k22 construction mirrors
+    ItemRowRescorerTwoInputStreamOperator.java:230-241."""
+    k11, rs_i, rs_j, obs = 7, 20, 15, 100
+    expect = _llr_scalar(k11, rs_i - k11, rs_j - k11, obs + k11 - (rs_i - k11) - (rs_j - k11))
+    got = float(llr_ops.score_contingency(
+        np.float32(k11), np.float32(rs_i), np.float32(rs_j), np.float32(obs)))
+    assert got == pytest.approx(expect, rel=1e-5, abs=1e-4)

@@ -1,0 +1,204 @@
+"""Oracle pipeline tests: hand-computed tiny cases plus invariants.
+
+Running with ``development_mode=True`` activates the reference's invariant
+checks inside the oracle itself (row-sum-vs-materialized-row consistency,
+NaN detection, feedback sanity — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config
+from tpu_cooccurrence.metrics import (
+    ITEM_LATE_ELEMENTS,
+    OBSERVED_COOCCURRENCES,
+    RESCORED_ITEMS,
+    ROW_SUM_PROCESS_WINDOW,
+    USER_LATE_ELEMENTS,
+)
+from tpu_cooccurrence.oracle import OracleJob
+from tpu_cooccurrence.oracle.reference import _llr_scalar
+
+
+def make_config(**kw):
+    kw.setdefault("window_size", 10)
+    kw.setdefault("seed", 42)
+    kw.setdefault("development_mode", True)
+    kw.setdefault("backend", Backend.ORACLE)
+    return Config(**kw)
+
+
+def test_tiny_skip_cuts_hand_checked():
+    cfg = make_config(skip_cuts=True, top_k=10)
+    job = OracleJob(cfg)
+    # Window [0, 10): user 1 interacts with items 10 then 20.
+    job.process(1, 10, 1)
+    job.process(1, 20, 2)
+    # Window [10, 20): user 2 with item 10; user 1 with item 10 again.
+    job.process(2, 10, 12)
+    job.process(1, 10, 15)
+    job.finish()
+
+    # After window 1: C[10][20] = C[20][10] = 1; row sums 10:1, 20:1; obs 2.
+    # After window 2: user 1 history [10, 20] gains another 10 ->
+    #   pairs (10,10)x2, (10,20), (20,10); C[10][10]=2, C[10][20]=2,
+    #   C[20][10]=2; row sums 10:4, 20:2; observed 6.
+    assert job.item_rows[10] == {20: 2, 10: 2}
+    assert job.item_rows[20] == {10: 2}
+    assert job.global_row_sums[10] == 4
+    assert job.global_row_sums[20] == 2
+    assert job.observed_cooccurrences == 6
+    assert job.counters.get(OBSERVED_COOCCURRENCES) == 6
+    assert job.counters.get(ROW_SUM_PROCESS_WINDOW) == 6
+    # Window1 scores rows 10 and 20; window2 scores rows 10 and 20 again.
+    assert job.counters.get(RESCORED_ITEMS) == 4
+
+    # Check an actual LLR value end-to-end for row 10 -> other 20 at the end:
+    # k11=2, rowSum(10)=4 -> k12=2, rowSum(20)=2 -> k21=0, k22=6+2-2-0=6.
+    expect = _llr_scalar(2, 2, 0, 6)
+    final_row10 = dict(job.latest[10])
+    assert final_row10[20] == pytest.approx(expect, rel=1e-12)
+    # Diagonal entry (10,10) is a legitimate candidate (duplicate history).
+    assert 10 in final_row10
+
+
+def test_late_elements_dropped_and_counted():
+    cfg = make_config(skip_cuts=True)
+    job = OracleJob(cfg)
+    job.process(1, 10, 100)
+    job.process(1, 20, 50)  # ts < max_seen -> late (wm = 99)
+    job.finish()
+    assert job.counters.get(ITEM_LATE_ELEMENTS) == 1
+    assert job.counters.get(USER_LATE_ELEMENTS) == 1
+    # The late interaction must not appear anywhere.
+    assert 20 not in job.item_rows
+    assert job.user_history[1] == [10]
+
+
+def test_equal_timestamps_not_late():
+    cfg = make_config(skip_cuts=True)
+    job = OracleJob(cfg)
+    job.process(1, 10, 100)
+    job.process(1, 20, 100)  # equal ts: wm = 99 < 100 -> on time
+    job.finish()
+    assert job.counters.get(USER_LATE_ELEMENTS) == 0
+    assert job.item_rows[10] == {20: 1}
+
+
+def test_item_cut_tags_first_fmax():
+    cfg = make_config(item_cut=2, user_cut=500)
+    job = OracleJob(cfg)
+    # Three users hit item 99 in the same window; only first two sampled.
+    job.process(1, 99, 1)
+    job.process(2, 99, 2)
+    job.process(3, 99, 3)
+    job.finish()
+    assert job.item_interactions[99] == 2
+    # Unsampled interaction still counts toward user 3's reservoir denominator.
+    assert job.user_total[3] == 1
+    assert job.user_history[3] == []
+
+
+def test_item_cut_is_cumulative_across_windows():
+    cfg = make_config(item_cut=2)
+    job = OracleJob(cfg)
+    job.process(1, 99, 1)
+    job.process(2, 99, 12)
+    job.process(3, 99, 23)  # third acceptance attempt, over the cut
+    job.finish()
+    assert job.item_interactions[99] == 2
+    assert job.user_history[3] == []
+
+
+def test_reservoir_replace_and_reject_semantics():
+    """With user_cut=2, the third+ sampled interactions either replace a slot
+    (emitting balanced +/- deltas) or reject (feedback decrement). The
+    dev-mode row-sum invariant validates the delta bookkeeping on every
+    window."""
+    cfg = make_config(user_cut=2, item_cut=500, seed=7)
+    job = OracleJob(cfg)
+    ts = 1
+    for item in range(100, 140):
+        job.process(1, item, ts)
+        ts += 10  # one window each -> every interaction processed separately
+    job.finish()
+    assert len(job.user_history[1]) == 2
+    assert job.user_total[1] == 40
+    # Row sums must globally balance: observed == sum of all row sums and
+    # equals the sum over materialized rows.
+    total = sum(sum(r.values()) for r in job.item_rows.values())
+    assert total == job.observed_cooccurrences
+    assert sum(job.global_row_sums.values()) == job.observed_cooccurrences
+    # Feedback decrements: item counter never negative, and for 40 singleton
+    # items each was accepted at most once.
+    assert all(0 <= c <= 1 for c in job.item_interactions.values())
+
+
+def test_reservoir_matches_full_recount():
+    """Property test (SURVEY §4): incrementally maintained C equals a full
+    recount from the final user histories... only when no evictions occur.
+    With evictions, C reflects the historical pairing sequence; here we
+    choose user_cut large enough that the reservoir only appends, so the
+    delta-sum must equal the direct recount of sum_u outer(h_u) off-diag
+    (with multiplicity)."""
+    rng = np.random.default_rng(3)
+    cfg = make_config(user_cut=500, item_cut=500, window_size=5)
+    job = OracleJob(cfg)
+    events = []
+    ts = 0
+    for _ in range(300):
+        ts += int(rng.integers(0, 3))
+        events.append((int(rng.integers(0, 10)), int(rng.integers(0, 30)), ts))
+    for u, i, t in events:
+        job.process(u, i, t)
+    job.finish()
+
+    expect = {}
+    for _u, hist in job.user_history.items():
+        m = {}
+        for x in hist:
+            m[x] = m.get(x, 0) + 1
+        for x, cx in m.items():
+            for y, cy in m.items():
+                if x == y:
+                    if cx > 1:
+                        expect[(x, x)] = expect.get((x, x), 0) + cx * (cx - 1)
+                else:
+                    expect[(x, y)] = expect.get((x, y), 0) + cx * cy
+
+    got = {}
+    for i, row in job.item_rows.items():
+        for j, c in row.items():
+            if c != 0:
+                got[(i, j)] = c
+    assert got == expect
+
+
+def test_sampled_mode_respects_cuts_invariants():
+    rng = np.random.default_rng(11)
+    cfg = make_config(user_cut=3, item_cut=4, window_size=7, seed=123)
+    job = OracleJob(cfg)
+    ts = 0
+    for _ in range(500):
+        ts += int(rng.integers(0, 2))
+        job.process(int(rng.integers(0, 20)), int(rng.integers(0, 15)), ts)
+    job.finish()
+    for u, h in job.user_history.items():
+        assert len(h) <= 3
+    for i, c in job.item_interactions.items():
+        assert 0 <= c <= 4
+    assert sum(job.global_row_sums.values()) == job.observed_cooccurrences
+
+
+def test_results_stream_shape():
+    cfg = make_config(skip_cuts=True, top_k=2)
+    job = OracleJob(cfg)
+    job.process(1, 1, 1)
+    job.process(1, 2, 2)
+    job.process(1, 3, 3)
+    job.finish()
+    assert job.results, "expected emissions"
+    r = job.results[0]
+    assert r.timestamp == 9  # window [0,10) maxTimestamp
+    assert len(r.top_k) <= 2
+    scores = [s for _, s in r.top_k]
+    assert scores == sorted(scores, reverse=True)

@@ -1,0 +1,28 @@
+"""tpu-cooccurrence: a TPU-native streaming item-item co-occurrence framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of the reference Flink job
+(`uce/flink-cooccurrence`): event-time windowed ingestion of
+``(user, item, timestamp)`` streams, per-item/per-user interaction cuts with
+reservoir sampling and eviction deltas, an incrementally maintained item x item
+co-occurrence matrix with global row sums, log-likelihood-ratio rescoring, and
+per-item top-K output — architected TPU-first: windows are micro-batches,
+pair-count aggregation is a sharded scatter/segment-sum on device, LLR and
+top-K are vectorized XLA kernels, and multi-chip scale-out uses
+``shard_map``/``psum`` over an item-sharded mesh instead of a keyed shuffle.
+
+See ``SURVEY.md`` for the structural analysis of the reference this was built
+to, with file:line parity citations throughout the code.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Backend, Config, WindowUnit  # noqa: F401
+from .metrics import Counters  # noqa: F401
+
+__all__ = [
+    "Backend",
+    "Config",
+    "Counters",
+    "WindowUnit",
+    "__version__",
+]

@@ -1,0 +1,48 @@
+"""Command-line entry point.
+
+Mirrors the reference driver (``FlinkCooccurrences.java:36-182``): parse
+config, echo it, build and run the job over the file input, then log
+duration and the accumulator dump in the reference's format.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional, Sequence
+
+from .config import Config
+from .io.parse import batched_lines
+from .io.source import FileMonitorSource
+from .job import CooccurrenceJob
+
+LOG = logging.getLogger("tpu_cooccurrence")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        stream=sys.stderr,  # reference logs INFO to stderr (log4j.properties:1-6)
+        format="%(asctime)s %(levelname)s %(name)s - %(message)s",
+    )
+    config = Config.from_args(argv)
+    config.log_configuration(LOG)
+
+    job = CooccurrenceJob(config)
+    source = FileMonitorSource(
+        config.input, job.counters,
+        process_continuously=config.process_continuously)
+    job.run(batched_lines(source.lines()))
+
+    # Print the latest top-K per item to stdout (the reference's result
+    # stream ends in a no-op sink, FlinkCooccurrences.java:169-171; we make
+    # the results consumable instead).
+    for item in sorted(job.latest):
+        top = job.latest[item]
+        rendered = " ".join(f"{other}:{score:.4f}" for other, score in top)
+        print(f"{item}\t{rendered}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

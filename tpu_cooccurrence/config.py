@@ -1,0 +1,185 @@
+"""Run configuration.
+
+Mirrors every flag of the reference CLI (reference:
+``Configuration.java:56-199``) plus TPU-framework extensions (backend
+selection, device-matrix sizing, sharding, sliding windows, checkpointing).
+
+Defaults match the reference exactly: item cut 500, user cut 500, top-k 10,
+window unit milliseconds, buffer timeout 100 ms, seed from the clock
+(``Configuration.java:151-182``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import sys
+import time
+from typing import Optional, Sequence
+
+
+class WindowUnit(enum.Enum):
+    """Time unit for window sizes (reference: ``Configuration.java:157-179``)."""
+
+    MILLISECONDS = 1
+    SECONDS = 1_000
+    MINUTES = 60_000
+    HOURS = 3_600_000
+    DAYS = 86_400_000
+
+    @property
+    def millis(self) -> int:
+        return self.value
+
+    @classmethod
+    def parse(cls, s: str) -> "WindowUnit":
+        try:
+            return cls[s.upper()]
+        except KeyError:
+            raise ValueError(f"Unrecognized window unit {s}") from None
+
+
+class Backend(enum.Enum):
+    """Execution backend for the scoring/aggregation path.
+
+    ``ORACLE`` is the pure-Python/NumPy reference implementation (float64,
+    dict-based state) used as the correctness oracle; ``DEVICE`` is the
+    JAX/XLA path (CPU or TPU depending on available devices); ``SHARDED``
+    is the multi-chip ``shard_map`` path over a device mesh.
+    """
+
+    ORACLE = "oracle"
+    DEVICE = "device"
+    SHARDED = "sharded"
+
+
+def _parse_seed(value: str) -> int:
+    """Parse a decimal or ``0x``-prefixed hex seed (``Configuration.java:211-220``)."""
+    if value.startswith("0x") or value.startswith("0X"):
+        return int(value[2:], 16)
+    return int(value)
+
+
+@dataclasses.dataclass
+class Config:
+    """Configuration of a co-occurrence run.
+
+    Reference parity (``Configuration.java``):
+      input, skip_cuts, item_cut (fMax), user_cut (kMax), top_k,
+      window_size/window_unit, seed (hex-capable), buffer_timeout.
+    """
+
+    input: Optional[str] = None
+    skip_cuts: bool = False
+    item_cut: int = 500
+    user_cut: int = 500
+    top_k: int = 10
+    window_size: int = 0
+    window_unit: WindowUnit = WindowUnit.MILLISECONDS
+    seed: Optional[int] = None
+    buffer_timeout: int = 100  # retained for CLI parity; no-op (no net stack)
+
+    # --- TPU-framework extensions (no reference analogue) ---
+    backend: Backend = Backend.DEVICE
+    num_items: int = 0  # dense device vocab capacity; 0 = grow from data (host pre-scan)
+    num_shards: int = 1  # item-axis shards over the device mesh
+    window_slide: Optional[int] = None  # sliding windows; None = tumbling
+    max_pairs_per_step: int = 1 << 20  # COO padding bucket (recompile guard)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_windows: int = 0  # 0 = disabled
+    development_mode: bool = False  # invariant checks (FlinkCooccurrences.java:34)
+    process_continuously: bool = False  # PROCESS_ONCE vs PROCESS_CONTINUOUSLY
+
+    def __post_init__(self):
+        if self.seed is None:
+            self.seed = time.time_ns()  # reference: System.nanoTime()
+        if self.top_k <= 0:
+            raise ValueError(f"{self.top_k} is <= 0")
+
+    @property
+    def window_millis(self) -> int:
+        return self.window_size * self.window_unit.millis
+
+    @property
+    def slide_millis(self) -> Optional[int]:
+        if self.window_slide is None:
+            return None
+        return self.window_slide * self.window_unit.millis
+
+    def log_configuration(self, logger) -> None:
+        """Echo the config at startup (reference: ``Configuration.java:272-282``)."""
+        logger.info("input\t%s", self.input)
+        logger.info("skip cuts\t%s", self.skip_cuts)
+        logger.info("item cut (fMax)\t%s", self.item_cut)
+        logger.info("user cut (kMax)\t%s", self.user_cut)
+        logger.info("topK\t%s", self.top_k)
+        logger.info("windowSize\t%s", self.window_size)
+        logger.info("windowUnit\t%s", self.window_unit.name)
+        logger.info("seed\t%s", self.seed)
+        logger.info("buffer timeout\t%s", self.buffer_timeout)
+        logger.info("backend\t%s", self.backend.value)
+        logger.info("numItems\t%s", self.num_items)
+        logger.info("numShards\t%s", self.num_shards)
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None) -> "Config":
+        """CLI parsing mirroring the reference flags (``Configuration.java:56-199``)."""
+        p = argparse.ArgumentParser(
+            prog="tpu-cooccurrence",
+            description="TPU-native streaming item-item co-occurrence (LLR) recommender",
+        )
+        p.add_argument("-i", "--input", required=True,
+                       help="Input file/directory to consume (expected format 'user,item,timestamp')")
+        p.add_argument("-sc", "--skip-cuts", action="store_true", dest="skip_cuts",
+                       help="Skip the interaction cuts")
+        p.add_argument("-ic", "--item-cut", type=int, default=500, dest="item_cut",
+                       help="Item interaction cut (default: 500)")
+        p.add_argument("-uc", "--user-cut", type=int, default=500, dest="user_cut",
+                       help="User interaction cut (default: 500)")
+        p.add_argument("-k", "--top-k", type=int, default=10, dest="top_k",
+                       help="Top K (default: 10)")
+        p.add_argument("-ws", "--window-size", type=int, required=True, dest="window_size",
+                       help="Window size")
+        p.add_argument("-wu", "--window-unit", type=WindowUnit.parse,
+                       default=WindowUnit.MILLISECONDS, dest="window_unit",
+                       help="TimeUnit for the window (default: milliseconds)")
+        p.add_argument("-s", "--seed", type=_parse_seed, default=None,
+                       help="Seed for random number generator (decimal or 0x-hex)")
+        p.add_argument("-bt", "--buffer-timeout", type=int, default=100, dest="buffer_timeout",
+                       help="Buffer timeout (default: 100ms)")
+        # Extensions
+        p.add_argument("--backend", type=Backend, choices=list(Backend),
+                       default=Backend.DEVICE)
+        p.add_argument("--num-items", type=int, default=0, dest="num_items",
+                       help="Dense item-vocabulary capacity on device (0 = derive)")
+        p.add_argument("--num-shards", type=int, default=1, dest="num_shards",
+                       help="Item-axis shards over the device mesh")
+        p.add_argument("--window-slide", type=int, default=None, dest="window_slide",
+                       help="Slide (same unit as window) for sliding windows")
+        p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
+        p.add_argument("--checkpoint-every-windows", type=int, default=0,
+                       dest="checkpoint_every_windows")
+        p.add_argument("--development-mode", action="store_true", dest="development_mode")
+        p.add_argument("--process-continuously", action="store_true",
+                       dest="process_continuously")
+        ns = p.parse_args(argv)
+        return cls(**vars(ns))
+
+    def __str__(self) -> str:
+        return (
+            f"Config{{input={self.input}, skipCuts={self.skip_cuts}, "
+            f"fMax={self.item_cut}, kMax={self.user_cut}, topK={self.top_k}, "
+            f"windowSize={self.window_size}, windowUnit={self.window_unit.name}, "
+            f"seed=0x{self.seed:x}, bufferTimeout={self.buffer_timeout}, "
+            f"backend={self.backend.value}}}"
+        )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    """Standalone config smoke test (reference: ``Configuration.java:299-302``)."""
+    print(Config.from_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Interaction line parsing.
+
+The reference parses ``user,item,timestamp`` CSV lines with boxed
+``String.split`` per record (``FlinkCooccurrences.java:207-219``,
+``InteractionLineSplitter``). Here parsing is batched into NumPy int64
+arrays — the framework's record unit is a *batch*, not a record.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Tuple
+
+import numpy as np
+
+# Structured batch: parallel arrays (users, items, timestamps).
+InteractionBatch = Tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+def parse_lines(lines: Iterable[str]) -> InteractionBatch:
+    """Parse an iterable of ``user,item,ts`` lines into an interaction batch."""
+    users: List[int] = []
+    items: List[int] = []
+    tss: List[int] = []
+    for line in lines:
+        u, i, t = line.split(",")
+        users.append(int(u))
+        items.append(int(i))
+        tss.append(int(t))
+    return (
+        np.asarray(users, dtype=np.int64),
+        np.asarray(items, dtype=np.int64),
+        np.asarray(tss, dtype=np.int64),
+    )
+
+
+def batched_lines(lines: Iterable[str], batch_size: int = 65536) -> Iterator[InteractionBatch]:
+    """Group a line stream into fixed-size parsed batches."""
+    buf: List[str] = []
+    for line in lines:
+        buf.append(line)
+        if len(buf) >= batch_size:
+            yield parse_lines(buf)
+            buf.clear()
+    if buf:
+        yield parse_lines(buf)

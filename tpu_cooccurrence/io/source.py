@@ -1,0 +1,97 @@
+"""File ingestion: monitor a file or directory and stream interaction batches.
+
+TPU-native replacement for the reference's forked file-monitoring source
+(``ContinuousFileMonitoringFunction.java``) + unsplittable text format
+(``UnsplittableTextInputFormat.java``):
+
+  * a path (file or directory) is listed; files are forwarded **sorted by
+    modification time** (reference :239-257),
+  * each file is read whole, in line order — never split — preserving the
+    ascending-timestamp contract (``UnsplittableTextInputFormat.java:12-20``),
+  * ``PROCESS_ONCE`` reads the current snapshot and stops;
+    ``PROCESS_CONTINUOUSLY`` re-lists and forwards files whose modification
+    time is newer than the max seen (reference :204-236),
+  * the max modification time is checkpointable so a restored job does not
+    re-ingest (reference :380-392).
+
+No existence pre-check is done before listing — the reference deliberately
+removed it for object-store compatibility (:196-201); we surface listing
+errors directly instead.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Iterator, List, Optional, Tuple
+
+from ..metrics import Counters, SPLIT_READER_NUM_SPLITS
+
+
+class FileMonitorSource:
+    """Streams lines from a file or directory in modification-time order."""
+
+    def __init__(
+        self,
+        path: str,
+        counters: Optional[Counters] = None,
+        process_continuously: bool = False,
+        poll_interval_s: float = 1.0,
+    ) -> None:
+        self.path = path
+        self.counters = counters or Counters()
+        self.process_continuously = process_continuously
+        self.poll_interval_s = poll_interval_s
+        # Checkpointed monotone progress marker (reference:
+        # ContinuousFileMonitoringFunction.java:380-392).
+        self.global_modification_time: int = -1
+
+    # -- checkpoint hooks ------------------------------------------------
+
+    def checkpoint_state(self) -> dict:
+        return {"global_modification_time": self.global_modification_time}
+
+    def restore_state(self, state: dict) -> None:
+        self.global_modification_time = int(state["global_modification_time"])
+
+    # -- listing ---------------------------------------------------------
+
+    def _list_splits(self) -> List[Tuple[int, str]]:
+        """New files as (mtime_ns, path), sorted by modification time then
+        path (deterministic tiebreak), filtered to mtime > max seen."""
+        if os.path.isdir(self.path):
+            candidates = [
+                os.path.join(self.path, name)
+                for name in os.listdir(self.path)
+                if not name.startswith((".", "_"))
+            ]
+        else:
+            candidates = [self.path]
+        splits = []
+        for p in candidates:
+            if not os.path.isfile(p):
+                continue
+            mtime = os.stat(p).st_mtime_ns
+            if mtime > self.global_modification_time:
+                splits.append((mtime, p))
+        splits.sort()
+        return splits
+
+    # -- reading ---------------------------------------------------------
+
+    def lines(self) -> Iterator[str]:
+        """Yield all input lines, file by file, in order."""
+        while True:
+            splits = self._list_splits()
+            for mtime, p in splits:
+                self.counters.add(SPLIT_READER_NUM_SPLITS, 1)
+                if mtime > self.global_modification_time:
+                    self.global_modification_time = mtime
+                with open(p, "r") as f:
+                    for line in f:
+                        line = line.rstrip("\n")
+                        if line:
+                            yield line
+            if not self.process_continuously:
+                return
+            time.sleep(self.poll_interval_s)

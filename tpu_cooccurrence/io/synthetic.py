@@ -1,0 +1,111 @@
+"""Synthetic and public-dataset interaction streams for benchmarks.
+
+Provides the five BASELINE.md benchmark inputs: tiny text batch, the
+MovieLens / Instacart adapters (CSV on disk), and the Zipfian basket
+generator (1M items, alpha=1.1) — see SURVEY.md §6.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def zipfian_interactions(
+    n_events: int,
+    n_items: int = 1_000_000,
+    n_users: int = 100_000,
+    alpha: float = 1.1,
+    seed: int = 0,
+    events_per_ms: int = 100,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Zipfian basket stream: item popularity ~ Zipf(alpha), users uniform,
+    timestamps ascending at ``events_per_ms`` events per millisecond.
+
+    Returns (users, items, timestamps) int64 arrays.
+    """
+    rng = np.random.default_rng(seed)
+    # Bounded Zipf via inverse-CDF over a precomputed table (np.random.zipf
+    # is unbounded and slow for alpha near 1).
+    ranks = np.arange(1, n_items + 1, dtype=np.float64)
+    weights = ranks ** (-alpha)
+    cdf = np.cumsum(weights)
+    cdf /= cdf[-1]
+    u = rng.random(n_events)
+    items = np.searchsorted(cdf, u).astype(np.int64)
+    users = rng.integers(0, n_users, n_events, dtype=np.int64)
+    timestamps = (np.arange(n_events, dtype=np.int64) // events_per_ms)
+    return users, items, timestamps
+
+
+def word_cooccurrence_stream(
+    text: str,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Batch word co-occurrence on a text: each line is a 'user' (basket),
+    each token an 'item', timestamps = line index (benchmark config 1)."""
+    vocab = {}
+    users, items, tss = [], [], []
+    for line_no, line in enumerate(text.splitlines()):
+        for tok in line.split():
+            idx = vocab.setdefault(tok, len(vocab))
+            users.append(line_no)
+            items.append(idx)
+            tss.append(line_no)
+    return (
+        np.asarray(users, dtype=np.int64),
+        np.asarray(items, dtype=np.int64),
+        np.asarray(tss, dtype=np.int64),
+    )
+
+
+def movielens_interactions(
+    ratings_csv: str,
+    min_rating: float = 0.0,
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Adapter for MovieLens ``ratings.csv`` (userId,movieId,rating,timestamp).
+
+    Yields sorted-by-timestamp chunks as interaction batches (benchmark
+    configs 2 and 3). Handles both the 100K tab format (u.data) and the
+    25M CSV format.
+    """
+    is_udata = ratings_csv.endswith(".data")
+    delim = "\t" if is_udata else ","
+    skip = 0 if is_udata else 1
+    data = np.loadtxt(ratings_csv, delimiter=delim, skiprows=skip,
+                      dtype=np.float64)
+    users = data[:, 0].astype(np.int64)
+    items = data[:, 1].astype(np.int64)
+    ratings = data[:, 2]
+    ts = data[:, 3].astype(np.int64) * 1000  # seconds -> ms
+    keep = ratings >= min_rating
+    users, items, ts = users[keep], items[keep], ts[keep]
+    order = np.argsort(ts, kind="stable")
+    yield users[order], items[order], ts[order]
+
+
+def instacart_interactions(
+    orders_csv: str,
+    order_products_csv: str,
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Adapter for Instacart order-product baskets (benchmark config 5):
+    user = order's user_id, item = product_id, ts = order_number ordering."""
+    orders = np.loadtxt(orders_csv, delimiter=",", skiprows=1,
+                        usecols=(0, 1, 3), dtype=np.int64)  # order_id,user_id,order_number
+    order_user = {int(o): int(u) for o, u, _n in orders}
+    order_ts = {int(o): int(n) for o, _u, n in orders}
+    op = np.loadtxt(order_products_csv, delimiter=",", skiprows=1,
+                    usecols=(0, 1), dtype=np.int64)  # order_id,product_id
+    users = np.asarray([order_user[int(o)] for o in op[:, 0]], dtype=np.int64)
+    ts = np.asarray([order_ts[int(o)] for o in op[:, 0]], dtype=np.int64)
+    items = op[:, 1]
+    order = np.argsort(ts, kind="stable")
+    yield users[order], items[order], ts[order]
+
+
+def write_interactions_csv(path: str, users, items, timestamps) -> None:
+    """Write interactions in the reference's input format ``user,item,ts``."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arr = np.stack([users, items, timestamps], axis=1)
+    np.savetxt(path, arr, fmt="%d", delimiter=",")

@@ -1,0 +1,175 @@
+"""The production job: wires ingest -> windowing -> sampling -> scoring.
+
+TPU-native equivalent of the reference's topology builder + driver
+(``FlinkCooccurrences.java:36-182``): instead of a DataStream graph with
+keyed shuffles, the host streams micro-batches through the window engine and
+the vectorized cut operators, and each fired window becomes one device step
+(scatter-update + LLR + top-K). The feedback edge (reject -> item-counter
+decrement, reference's in-JVM ``BlockingQueueBroker`` hack) is a plain
+same-host update applied between window fires.
+
+Duration and the accumulator dump mirror the reference's end-of-run logging
+(``FlinkCooccurrences.java:173-181``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .config import Backend, Config
+from .metrics import (
+    Counters,
+    FEEDBACK_QUEUES,
+    ITEM_LATE_ELEMENTS,
+    USER_LATE_ELEMENTS,
+    USER_RECEIVED_ELEMENTS,
+)
+from .io.parse import InteractionBatch
+from .sampling.item_cut import ItemInteractionCut
+from .sampling.reservoir import PairDeltaBatch, UserReservoirSampler
+from .state.rescorer import HostRescorer, WindowTopK
+from .state.vocab import IdMap
+from .windowing.engine import WindowEngine
+
+LOG = logging.getLogger("tpu_cooccurrence")
+
+
+class CooccurrenceJob:
+    """Streaming co-occurrence job over a pluggable scoring backend."""
+
+    def __init__(self, config: Config, scorer=None) -> None:
+        if config.window_millis <= 0:
+            raise ValueError("window size must be positive")
+        if config.window_slide is not None:
+            # Sliding windows exist in windowing/assigners.py but are not yet
+            # wired into the sampling pipeline (the reference, too, only ever
+            # wires tumbling — FlinkCooccurrences.java:139,153 — and its
+            # operators reject multi-window assignment). Fail loudly rather
+            # than silently running tumbling.
+            raise NotImplementedError(
+                "--window-slide is not yet supported by the pipeline; "
+                "only tumbling windows are wired (as in the reference)")
+        self.config = config
+        self.counters = Counters()
+        self.engine = WindowEngine(config.window_millis)
+        self.item_vocab = IdMap()
+        self.user_vocab = IdMap()
+        self.item_cut = ItemInteractionCut(config.item_cut, capacity=1024)
+        self.sampler = UserReservoirSampler(
+            config.user_cut, config.seed, config.skip_cuts,
+            counters=self.counters)
+        self.scorer = scorer if scorer is not None else self._make_scorer()
+        # results: external item id -> [(external other, score) desc]
+        self.latest: Dict[int, List[Tuple[int, float]]] = {}
+        self.emissions = 0
+        self.windows_fired = 0
+        # One in-process feedback channel (the reference counts one queue
+        # handshake per subtask open,
+        # UserInteractionCounterOneInputStreamOperator.java:109).
+        if not config.skip_cuts:
+            self.counters.add(FEEDBACK_QUEUES, 1)
+
+    def _make_scorer(self):
+        backend = self.config.backend
+        if backend == Backend.ORACLE:
+            return HostRescorer(self.config.top_k, self.counters,
+                                self.config.development_mode)
+        if backend == Backend.DEVICE:
+            from .ops.device_scorer import DeviceScorer
+
+            num_items = self.config.num_items
+            if num_items <= 0:
+                raise ValueError(
+                    "device backend needs --num-items (dense vocab capacity)")
+            return DeviceScorer(num_items, self.config.top_k, self.counters,
+                                max_pairs_per_step=self.config.max_pairs_per_step)
+        if backend == Backend.SHARDED:
+            from .parallel.sharded import ShardedScorer
+
+            num_items = self.config.num_items
+            if num_items <= 0:
+                raise ValueError(
+                    "sharded backend needs --num-items (dense vocab capacity)")
+            return ShardedScorer(num_items, self.config.top_k,
+                                 num_shards=self.config.num_shards,
+                                 counters=self.counters)
+        raise ValueError(f"unknown backend {backend}")
+
+    # ------------------------------------------------------------------
+
+    def add_batch(self, users: np.ndarray, items: np.ndarray, ts: np.ndarray) -> None:
+        """Ingest one parsed interaction batch (stream order)."""
+        dense_items = self.item_vocab.map_batch(items)
+        if self.config.num_items and len(self.item_vocab) > self.config.num_items:
+            raise ValueError(
+                f"item vocabulary exceeded --num-items capacity "
+                f"({len(self.item_vocab)} > {self.config.num_items})")
+        dense_users = self.user_vocab.map_batch(users)
+        n_late = self.engine.add_batch(dense_users, dense_items, ts)
+        if n_late:
+            # The reference counts late drops at both cut operators
+            # (ItemInteractionCounter...:75-77, UserInteractionCounter...:121-123).
+            self.counters.add(ITEM_LATE_ELEMENTS, n_late)
+            self.counters.add(USER_LATE_ELEMENTS, n_late)
+        if self.config.development_mode:
+            self.counters.add(USER_RECEIVED_ELEMENTS, len(users) - n_late)
+        self._drain(final=False)
+
+    def finish(self) -> None:
+        """End of stream — Watermark(MAX_VALUE) fires everything."""
+        self._drain(final=True)
+
+    def run(self, batches: Iterable[InteractionBatch]) -> Dict[int, List[Tuple[int, float]]]:
+        start = time.monotonic_ns()
+        for users, items, ts in batches:
+            self.add_batch(users, items, ts)
+        self.finish()
+        duration_ms = (time.monotonic_ns() - start) // 1_000_000
+        # Reference end-of-run logging shape (FlinkCooccurrences.java:179-181).
+        LOG.info("Duration\t%d", duration_ms)
+        LOG.info("Accumulator results: %s", self.counters)
+        self.duration_ms = duration_ms
+        return self.latest
+
+    # ------------------------------------------------------------------
+
+    def _drain(self, final: bool) -> None:
+        for ts, users, items in self.engine.fire_ready(final=final):
+            self.windows_fired += 1
+            # Item cut (or pass-through when --skip-cuts).
+            if self.config.skip_cuts:
+                sampled = np.ones(len(items), dtype=bool)
+            else:
+                sampled = self.item_cut.fire(items)
+            # User reservoir.
+            pairs, feedback_items = self.sampler.fire(users, items, sampled)
+            # Feedback decrements before the next window fire
+            # (ItemInteractionCounterTwoInputStreamOperator.java:94-116).
+            if not self.config.skip_cuts and len(feedback_items):
+                self.item_cut.apply_feedback(
+                    feedback_items, self.config.development_mode, self.counters)
+            # Score on the backend.
+            window_out: WindowTopK = self.scorer.process_window(ts, pairs)
+            for dense_item, top in window_out:
+                ext_item = self.item_vocab.to_external(dense_item)
+                self.latest[ext_item] = [
+                    (self.item_vocab.to_external(j), s) for j, s in top]
+                self.emissions += 1
+            if (self.config.checkpoint_dir
+                    and self.config.checkpoint_every_windows > 0
+                    and self.windows_fired % self.config.checkpoint_every_windows == 0):
+                self.checkpoint()
+
+    def checkpoint(self, source=None) -> None:
+        from .state import checkpoint as ckpt
+
+        ckpt.save(self, self.config.checkpoint_dir, source=source)
+
+    def restore(self, source=None) -> None:
+        from .state import checkpoint as ckpt
+
+        ckpt.restore(self, self.config.checkpoint_dir, source=source)

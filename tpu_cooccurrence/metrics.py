@@ -1,0 +1,70 @@
+"""Counter registry mirroring the reference's Flink accumulators.
+
+The reference uses named Flink accumulators as its metric system and dumps
+them at job end (``FlinkCooccurrences.java:181``). Counter names are kept
+byte-identical so runs are comparable:
+
+  - ``ItemInteractionCounterLateElements``       (ItemInteractionCounterTwoInputStreamOperator.java:66)
+  - ``UserInteractionCounterLateElements``       (UserInteractionCounterOneInputStreamOperator.java:111)
+  - ``UserInteractionCounterObservedCooccurrences`` (:112)
+  - ``UserInteractionCounterFeedbackQueues``     (:109)
+  - ``ItemRowRescorerRescoredItems``             (ItemRowRescorerTwoInputStreamOperator.java:60)
+  - ``RowSumProcessWindowRowSum``                (RowSumAggregator.java:50)
+  - ``SplitReaderNumSplits``                     (ContinuousFileMonitoringFunction.java:277)
+
+plus development-mode-only counters (``FlinkCooccurrences.java:34`` gating).
+Of the dev-mode set, ``...FeedbackElements`` and ``...ReceivedElements`` are
+wired; the buffered-elements balance counters have no analogue here because
+the batch engine has no cross-operator buffers to balance (their invariant —
+every buffered element is eventually processed — holds structurally).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict
+
+
+class Counters:
+    """A flat named-counter registry (Flink accumulator analogue)."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, int] = defaultdict(int)
+
+    def add(self, name: str, delta: int = 1) -> None:
+        self._counters[name] += delta
+
+    def get(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(self._counters)
+
+    def merge(self, other: "Counters") -> None:
+        for name, value in other._counters.items():
+            self._counters[name] += value
+
+    def replace_all(self, values: Dict[str, int]) -> None:
+        """Overwrite all counters (checkpoint restore)."""
+        self._counters.clear()
+        self._counters.update(values)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}={v}" for k, v in sorted(self._counters.items()))
+        return f"{{{inner}}}"
+
+
+# Canonical counter names (kept identical to the reference accumulators).
+ITEM_LATE_ELEMENTS = "ItemInteractionCounterLateElements"
+ITEM_FEEDBACK_ELEMENTS = "ItemInteractionCounterFeedbackElements"  # dev-mode
+USER_LATE_ELEMENTS = "UserInteractionCounterLateElements"
+OBSERVED_COOCCURRENCES = "UserInteractionCounterObservedCooccurrences"
+FEEDBACK_QUEUES = "UserInteractionCounterFeedbackQueues"
+USER_RECEIVED_ELEMENTS = "UserInteractionCounterReceivedElements"  # dev-mode
+USER_BUFFERED_ELEMENTS = "UserInteractionCounterBufferedElements"  # dev-mode
+USER_ROW_SUMS = "UserInteractionCounterRowSums"  # dev-mode
+RESCORED_ITEMS = "ItemRowRescorerRescoredItems"
+RESCORER_BUFFERED_ITEM_ROWS = "ItemRowRescorerBufferedItemRows"  # dev-mode
+RESCORER_BUFFERED_ROW_SUM_UPDATES = "ItemRowRescorerBufferedRowSumUpdates"  # dev-mode
+ROW_SUM_PROCESS_WINDOW = "RowSumProcessWindowRowSum"
+SPLIT_READER_NUM_SPLITS = "SplitReaderNumSplits"

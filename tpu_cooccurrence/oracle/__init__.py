@@ -1,0 +1,3 @@
+"""Correctness-anchor oracle (pure Python/NumPy float64)."""
+from .heap import TopKHeap  # noqa: F401
+from .reference import OracleJob, TopKResult, window_start  # noqa: F401

@@ -1,0 +1,81 @@
+"""Top-K min-heap with the reference's exact selection semantics.
+
+Replicates the observable behavior of the reference's Lucene-style primitive
+heap (``IntDoublePriorityQueue.java:48-150``): bounded size K, O(1) access to
+the least score, ``add`` while below capacity, ``update`` (replace-min) only
+when the caller observed a strictly greater score — the strictness lives in
+the caller (``ItemRowRescorerTwoInputStreamOperator.java:218-226``), which we
+mirror in :meth:`offer`. Ties therefore keep the earlier-inserted element,
+exactly like the reference.
+
+This is *oracle* code (correctness anchor); the device path uses
+``jax.lax.top_k`` (see ``ops/device_scorer.py`` / ``parallel/sharded.py``)
+whose tie-breaking (lowest index among equals) can differ — tests compare
+score multisets.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Iterator, List, Tuple
+
+
+class TopKHeap:
+    """Bounded min-heap of ``(score, value)`` keeping the K largest scores."""
+
+    def __init__(self, max_size: int) -> None:
+        if max_size <= 0:
+            raise ValueError(f"{max_size} is <= 0")
+        self.max_size = max_size
+        # Entries are (score, seq, value); seq makes comparison total and
+        # implements "ties keep the earlier insert" when popping the min.
+        self._heap: List[Tuple[float, int, int]] = []
+        self._seq = 0
+
+    @property
+    def size(self) -> int:
+        return self._heap.__len__()
+
+    def least_score(self) -> float:
+        return self._heap[0][0]
+
+    def least_value(self) -> int:
+        return self._heap[0][2]
+
+    def reset(self) -> None:
+        """Cheap reuse between rows (reference: ``IntDoublePriorityQueue.java:120-122``)."""
+        self._heap.clear()
+        self._seq = 0
+
+    def offer(self, value: int, score: float) -> None:
+        """Insert following the rescorer's protocol (:218-226): fill to K,
+        then replace the min only on strictly greater score."""
+        if len(self._heap) < self.max_size:
+            self.add(value, score)
+        elif score > self.least_score():
+            self.update(value, score)
+
+    def add(self, value: int, score: float) -> None:
+        heapq.heappush(self._heap, (score, self._next_seq(), value))
+
+    def update(self, value: int, score: float) -> None:
+        """Replace the least element (reference: ``IntDoublePriorityQueue.java:146-150``)."""
+        heapq.heapreplace(self._heap, (score, self._next_seq(), value))
+
+    def _next_seq(self) -> int:
+        # The replace-min path never sees score ties (offer requires strictly
+        # greater), so any total order works; insertion order keeps pops
+        # deterministic.
+        self._seq += 1
+        return self._seq
+
+    def __iter__(self) -> Iterator[Tuple[int, float]]:
+        """Min-first, remainder unordered (reference iterator contract,
+        ``IntDoublePriorityQueue.java:216-242``)."""
+        for score, _, value in self._heap:
+            yield value, score
+
+    def sorted_desc(self) -> List[Tuple[int, float]]:
+        """Descending by score for display (reference:
+        ``IntDoublePriorityQueue.java:244-257`` ``sortBySoreDescending``)."""
+        return [(v, s) for s, _, v in sorted(self._heap, key=lambda e: (-e[0], e[1]))]

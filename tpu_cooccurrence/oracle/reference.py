@@ -1,0 +1,344 @@
+"""Record-at-a-time float64 oracle for the full co-occurrence pipeline.
+
+This module is the correctness anchor: a deliberately simple, dict-based,
+single-threaded implementation of exactly what the reference job computes —
+event-time tumbling windows with late-drop, the item interaction cut with
+rejection feedback, the per-user reservoir with eviction deltas, windowed
+row/row-sum aggregation, watermark-ordered global row-sum application, LLR
+rescoring, and per-item top-K. Every production backend (vectorized host
+sampler + JAX device scoring, sharded or not) is tested against it.
+
+Semantics are mirrored operator by operator:
+  * item cut           — ItemInteractionCounterTwoInputStreamOperator.java:119-143
+  * feedback decrement — :94-116 (applied here deterministically between
+                         window fires; the reference's in-JVM queue makes the
+                         exact arrival interleaving racy by design,
+                         FeedbackSource.java:38)
+  * user reservoir     — UserInteractionCounterOneInputStreamOperator.java:145-257
+  * non-sampled mode   — NonSampledUserInteractionCounterOneInputStreamOperator.java:113-165
+  * row aggregation    — ItemRowAggregator.java:15-57
+  * row-sum aggregation (zero-suppressed) — RowSumAggregator.java:53-71
+  * rescoring          — ItemRowRescorerTwoInputStreamOperator.java:116-241
+
+Known, documented deviations from the reference:
+  1. RNG: per-(user, draw) counter-based hash instead of one shared
+     java.util.Random (see ``sampling/rng.py``) — order/parallelism
+     independent.
+  2. Row deltas whose window emitted *no* row-sum update are still scored;
+     the reference would leave them buffered forever and fail at close
+     (``ItemRowRescorerTwoInputStreamOperator.java:116-139`` only drains
+     timestamps present in the row-sum buffer).
+  3. Counts are Python ints / int64 (the reference accumulates Java shorts
+     and simply ignores overflow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..config import Config
+from ..metrics import (
+    Counters,
+    FEEDBACK_QUEUES,
+    ITEM_LATE_ELEMENTS,
+    OBSERVED_COOCCURRENCES,
+    RESCORED_ITEMS,
+    ROW_SUM_PROCESS_WINDOW,
+    USER_LATE_ELEMENTS,
+)
+from ..sampling.rng import reservoir_draw_scalar
+from .heap import TopKHeap
+
+
+@dataclasses.dataclass
+class TopKResult:
+    """One rescoring emission: ``(timestamp, item, [(other, score) desc])``."""
+
+    timestamp: int
+    item: int
+    top_k: List[Tuple[int, float]]
+
+
+def window_start(ts: int, size_ms: int) -> int:
+    """Tumbling window start for an event timestamp (Flink semantics,
+    offset 0): ``ts - (ts mod size)``."""
+    return ts - (ts % size_ms)
+
+
+class OracleJob:
+    """The full pipeline, record-at-a-time.
+
+    Drive it with :meth:`process` / :meth:`finish`, or one-shot with
+    :meth:`run`. Emissions are appended to :attr:`results`; the latest
+    top-K per item is in :attr:`latest`.
+    """
+
+    def __init__(self, config: Config) -> None:
+        self.config = config
+        self.counters = Counters()
+        if not config.skip_cuts:
+            # One feedback channel per (single) subtask (reference :109).
+            self.counters.add(FEEDBACK_QUEUES, 1)
+        self.window_ms = config.window_millis
+
+        # --- watermarking (AscendingTimestampExtractor: wm = max_ts - 1) ---
+        self.max_ts_seen: Optional[int] = None
+
+        # --- window buffers: window_start -> list[(user, item, ts)] ---
+        self.window_buffers: Dict[int, List[Tuple[int, int, int]]] = defaultdict(list)
+
+        # --- item-cut state (ItemInteractionCounter...) ---
+        self.item_interactions: Dict[int, int] = defaultdict(int)
+
+        # --- user state (UserInteractionCounter...) ---
+        self.user_history: Dict[int, List[int]] = defaultdict(list)
+        self.user_interactions: Dict[int, int] = defaultdict(int)  # accepted (<= kMax)
+        self.user_total: Dict[int, int] = defaultdict(int)  # all seen (reservoir denom)
+        self.user_draws: Dict[int, int] = defaultdict(int)  # RNG draw counter
+
+        # --- rescorer state (plain maps, like the reference :33-37) ---
+        self.item_rows: Dict[int, Dict[int, int]] = defaultdict(dict)
+        self.global_row_sums: Dict[int, int] = defaultdict(int)
+        self.observed_cooccurrences = 0
+
+        self.results: List[TopKResult] = []
+        self.latest: Dict[int, List[Tuple[int, float]]] = {}
+        self._heap = TopKHeap(config.top_k)
+
+    # ------------------------------------------------------------------
+    # Ingest
+    # ------------------------------------------------------------------
+
+    def process(self, user: int, item: int, ts: int) -> None:
+        """Feed one interaction in stream order."""
+        wm = self.current_watermark()
+        if wm is not None and ts <= wm:
+            # Late-drop at both cut operators in the reference; one shared
+            # buffer here, so count it at both counters for parity.
+            self.counters.add(ITEM_LATE_ELEMENTS, 1)
+            self.counters.add(USER_LATE_ELEMENTS, 1)
+            return
+
+        if self.max_ts_seen is None or ts > self.max_ts_seen:
+            old_wm = self.current_watermark()
+            self.max_ts_seen = ts
+            new_wm = self.current_watermark()
+            self.window_buffers[window_start(ts, self.window_ms)].append((user, item, ts))
+            if new_wm is not None and new_wm != old_wm:
+                self._advance_watermark(new_wm)
+        else:
+            self.window_buffers[window_start(ts, self.window_ms)].append((user, item, ts))
+
+    def finish(self) -> None:
+        """End of stream: Watermark(MAX) fires all remaining windows
+        (reference shutdown path, SURVEY §3.5)."""
+        self._advance_watermark(float("inf"))
+
+    def run(self, interactions: Iterable[Tuple[int, int, int]]) -> List[TopKResult]:
+        for user, item, ts in interactions:
+            self.process(user, item, ts)
+        self.finish()
+        return self.results
+
+    def current_watermark(self) -> Optional[int]:
+        if self.max_ts_seen is None:
+            return None
+        return self.max_ts_seen - 1
+
+    # ------------------------------------------------------------------
+    # Window firing
+    # ------------------------------------------------------------------
+
+    def _advance_watermark(self, watermark) -> None:
+        """Fire all complete windows (max_ts <= watermark) in timestamp order."""
+        ready = sorted(
+            start for start in self.window_buffers
+            if start + self.window_ms - 1 <= watermark
+        )
+        for start in ready:
+            interactions = self.window_buffers.pop(start)
+            self._fire_window(start + self.window_ms - 1, interactions)
+
+    def _fire_window(self, ts: int, interactions: List[Tuple[int, int, int]]) -> None:
+        # 1. Item cut (or pass-through in skip-cuts mode).
+        if self.config.skip_cuts:
+            tagged = [(u, i, True) for (u, i, _t) in interactions]
+        else:
+            tagged = self._item_cut_fire(interactions)
+
+        # 2. User reservoir -> pair deltas + row-sum deltas (+ feedback).
+        pair_deltas, row_sum_deltas, feedback = self._user_fire(tagged)
+
+        # 3. Feedback decrements the item counters before the next window
+        #    (reference: ItemInteractionCounterTwoInputStreamOperator.java:94-116).
+        for item, inc in feedback:
+            if self.config.development_mode:
+                if self.item_interactions[item] == 0:
+                    raise AssertionError(
+                        f"Item interactions 0 for item {item}, but received decrement feedback.")
+                if inc != -1:
+                    raise AssertionError(f"Received unexpected feedback {inc}")
+            self.item_interactions[item] += inc
+
+        # 4. Windowed aggregation (ItemRowAggregator / RowSumAggregator).
+        row_delta_maps: Dict[int, Dict[int, int]] = defaultdict(dict)
+        for (i, j, inc) in pair_deltas:
+            row = row_delta_maps[i]
+            row[j] = row.get(j, 0) + inc
+        row_sum_updates: Dict[int, int] = defaultdict(int)
+        for (i, inc) in row_sum_deltas:
+            row_sum_updates[i] += inc
+        # Zero suppression (RowSumAggregator.java:66-70).
+        row_sum_updates = {i: s for i, s in row_sum_updates.items() if s != 0}
+        for s in row_sum_updates.values():
+            self.counters.add(ROW_SUM_PROCESS_WINDOW, s)
+
+        # 5. Rescoring: row sums applied before scoring this window's rows
+        #    (ItemRowRescorerTwoInputStreamOperator.java:116-142).
+        for i, s in row_sum_updates.items():
+            self.global_row_sums[i] += s
+            self.observed_cooccurrences += s
+        if row_delta_maps:
+            self._score_rows(ts, row_delta_maps)
+
+    # ------------------------------------------------------------------
+    # Operators
+    # ------------------------------------------------------------------
+
+    def _item_cut_fire(self, interactions) -> List[Tuple[int, int, bool]]:
+        """First fMax interactions per item are tagged sample=true
+        (ItemInteractionCounterTwoInputStreamOperator.java:129-139)."""
+        tagged = []
+        f_max = self.config.item_cut
+        for (user, item, _ts) in interactions:
+            if self.item_interactions[item] < f_max:
+                self.item_interactions[item] += 1
+                tagged.append((user, item, True))
+            else:
+                tagged.append((user, item, False))
+        return tagged
+
+    def _user_fire(self, tagged):
+        """Reservoir sampling with eviction deltas
+        (UserInteractionCounterOneInputStreamOperator.java:145-257).
+
+        Returns (pair_deltas [(i, j, +-1)...], row_sum_deltas [(i, d)...],
+        feedback [(item, -1)...]). Interactions are processed per user in
+        arrival order; RNG draws are keyed (seed, user, draw_index) so the
+        grouping order is irrelevant.
+        """
+        pair_deltas: List[Tuple[int, int, int]] = []
+        row_sum_deltas: List[Tuple[int, int]] = []
+        feedback: List[Tuple[int, int]] = []
+        k_max = self.config.user_cut
+        skip_cuts = self.config.skip_cuts
+
+        for (user, item, sample) in tagged:
+            self.user_total[user] += 1
+            if not sample:
+                continue
+            history = self.user_history[user]
+            if skip_cuts or self.user_interactions[user] < k_max:
+                # Append path (:167-205; non-sampled variant :113-165).
+                if not skip_cuts:
+                    self.user_interactions[user] += 1
+                size = len(history)
+                if size > 0:
+                    row_sum_deltas.append((item, size))
+                    for other in history:
+                        pair_deltas.append((item, other, 1))
+                        pair_deltas.append((other, item, 1))
+                        row_sum_deltas.append((other, 1))
+                    self.counters.add(OBSERVED_COOCCURRENCES, 2 * size)
+                history.append(item)
+            else:
+                draw = self.user_draws[user]
+                self.user_draws[user] += 1
+                k = reservoir_draw_scalar(
+                    self.config.seed, user, draw, self.user_total[user])
+                if k < k_max:
+                    # Replace path (:206-245): pair with all slots except k
+                    # (so never with the evicted item or itself-at-k).
+                    previous = history[k]
+                    row_sum_deltas.append((item, k_max - 1))
+                    row_sum_deltas.append((previous, -(k_max - 1)))
+                    for idx, other in enumerate(history):
+                        if idx == k:
+                            continue
+                        pair_deltas.append((item, other, 1))
+                        pair_deltas.append((previous, other, -1))
+                        # Partner row sums cancel: +1 + -1 = 0 (:236).
+                        pair_deltas.append((other, item, 1))
+                        pair_deltas.append((other, previous, -1))
+                    history[k] = item
+                else:
+                    # Reject path (:246-248): decrement feedback to item cut.
+                    feedback.append((item, -1))
+        return pair_deltas, row_sum_deltas, feedback
+
+    def _score_rows(self, ts: int, row_delta_maps: Dict[int, Dict[int, int]]) -> None:
+        """Merge deltas and LLR-score each updated row
+        (ItemRowRescorerTwoInputStreamOperator.java:158-228)."""
+        import math
+
+        for item in sorted(row_delta_maps):
+            delta = row_delta_maps[item]
+            self.counters.add(RESCORED_ITEMS, 1)
+            row = self.item_rows[item]
+            for j, inc in delta.items():
+                # addTo semantics: a zero-delta key still materializes an
+                # entry (see module docstring, deviation 2 nuance: we keep
+                # the entry but score only count != 0 below).
+                row[j] = row.get(j, 0) + inc
+
+            row_sum = self.global_row_sums.get(item, 0)
+
+            if self.config.development_mode:
+                actual = sum(row.values())
+                if actual != row_sum:
+                    raise AssertionError(
+                        f"Item row {row_sum} does not match actual row sum {actual}")
+
+            self._heap.reset()
+            for other, count in row.items():
+                if count == 0:
+                    continue
+                other_sum = self.global_row_sums.get(other, 0)
+                k11 = count
+                k12 = row_sum - k11
+                k21 = other_sum - k11
+                k22 = self.observed_cooccurrences + k11 - k12 - k21
+                score = _llr_scalar(k11, k12, k21, k22)
+                if self.config.development_mode and math.isnan(score):
+                    raise AssertionError(
+                        f"Score is NaN (item: {item}, otherItem: {other}, "
+                        f"cooccurrenceCount: {count}, itemRowSum: {row_sum}, "
+                        f"otherItemRowSum: {other_sum}, "
+                        f"observedCooccurrences: {self.observed_cooccurrences})")
+                self._heap.offer(other, score)
+
+            top = self._heap.sorted_desc()
+            self.results.append(TopKResult(ts, item, top))
+            self.latest[item] = top
+
+
+def _xlogx(x: float) -> float:
+    import math
+
+    return 0.0 if x == 0 else x * math.log(x)
+
+
+def _llr_scalar(k11: int, k12: int, k21: int, k22: int) -> float:
+    """Float64 scalar LLR, the reference's 9-log entropy form
+    (LogLikelihood.java:41-57) including the round-off clamp."""
+    row1 = k11 + k12
+    row2 = k21 + k22
+    all_ = _xlogx(row1 + row2)
+    row = all_ - _xlogx(row1) - _xlogx(row2)
+    col = all_ - _xlogx(k11 + k21) - _xlogx(k12 + k22)
+    matrix = all_ - _xlogx(k11) - _xlogx(k12) - _xlogx(k21) - _xlogx(k22)
+    if row + col < matrix:
+        return 0.0
+    return 2.0 * (row + col - matrix)

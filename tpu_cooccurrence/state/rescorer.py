@@ -1,0 +1,129 @@
+"""Host (exact, float64) rescoring backend.
+
+Dict-based materialized rows + global row sums + observed total, mirroring
+the reference rescorer's plain-Java-map state
+(``ItemRowRescorerTwoInputStreamOperator.java:33-37,59-69``) and its scoring
+loop (:158-228). Used as the ``oracle`` production backend and as the exact
+baseline the device backends are validated against.
+
+Row-sum updates are derived from the pair stream (segment-sum by source row
+— see ``sampling/reservoir.py`` fact 3) and applied *before* scoring the
+window's rows, preserving the reference's watermark ordering (:116-142).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from ..oracle.heap import TopKHeap
+from ..ops.llr import llr_np
+from ..sampling.reservoir import PairDeltaBatch
+
+# One window's emissions: [(item, [(other, score) desc]), ...]
+WindowTopK = List[Tuple[int, List[Tuple[int, float]]]]
+
+
+class HostRescorer:
+    def __init__(self, top_k: int, counters: Optional[Counters] = None,
+                 development_mode: bool = False) -> None:
+        self.top_k = top_k
+        self.counters = counters if counters is not None else Counters()
+        self.development_mode = development_mode
+        self.item_rows: Dict[int, Dict[int, int]] = {}
+        self.global_row_sums: Dict[int, int] = {}
+        self.observed: int = 0
+        self._heap = TopKHeap(top_k)
+
+    def process_window(self, ts: int, pairs: PairDeltaBatch) -> WindowTopK:
+        if len(pairs) == 0:
+            return []
+        src = pairs.src
+        dst = pairs.dst
+        delta = pairs.delta.astype(np.int64)
+
+        # Row-sum updates first (reference :116-142, :144-156).
+        rs_items, rs_inv = np.unique(src, return_inverse=True)
+        rs_sums = np.bincount(rs_inv, weights=delta).astype(np.int64)
+        for item, s in zip(rs_items.tolist(), rs_sums.tolist()):
+            if s != 0:  # zero suppression (RowSumAggregator.java:66-70)
+                self.counters.add(ROW_SUM_PROCESS_WINDOW, s)
+                self.global_row_sums[item] = self.global_row_sums.get(item, 0) + s
+                self.observed += s
+
+        # Aggregate pair deltas into per-row delta maps
+        # (ItemRowAggregator.java:26-31) and score each updated row.
+        order = np.argsort(src, kind="stable")
+        src_s, dst_s, delta_s = src[order], dst[order], delta[order]
+        boundaries = np.flatnonzero(src_s[1:] != src_s[:-1]) + 1
+        out: WindowTopK = []
+        for chunk_idx in np.split(np.arange(len(src_s)), boundaries):
+            item = int(src_s[chunk_idx[0]])
+            row = self.item_rows.setdefault(item, {})
+            for j, d in zip(dst_s[chunk_idx].tolist(), delta_s[chunk_idx].tolist()):
+                row[j] = row.get(j, 0) + d
+            out.append((item, self._score_row(item, row)))
+        return out
+
+    def _score_row(self, item: int, row: Dict[int, int]) -> List[Tuple[int, float]]:
+        self.counters.add(RESCORED_ITEMS, 1)
+        row_sum = self.global_row_sums.get(item, 0)
+        if self.development_mode:
+            actual = sum(row.values())
+            if actual != row_sum:
+                raise AssertionError(
+                    f"Item row {row_sum} does not match actual row sum {actual}")
+        others = np.fromiter((j for j, c in row.items() if c != 0), dtype=np.int64,
+                             count=sum(1 for c in row.values() if c != 0))
+        if len(others) == 0:
+            return []
+        k11 = np.fromiter((row[int(j)] for j in others), dtype=np.int64,
+                          count=len(others))
+        other_sums = np.fromiter(
+            (self.global_row_sums.get(int(j), 0) for j in others),
+            dtype=np.int64, count=len(others))
+        k12 = row_sum - k11
+        k21 = other_sums - k11
+        k22 = self.observed + k11 - k12 - k21
+        scores = llr_np(k11, k12, k21, k22)
+        if self.development_mode and np.any(np.isnan(scores)):
+            bad = int(np.flatnonzero(np.isnan(scores))[0])
+            raise AssertionError(
+                f"Score is NaN (item: {item}, otherItem: {int(others[bad])})")
+        self._heap.reset()
+        for j, s in zip(others.tolist(), scores.tolist()):
+            self._heap.offer(j, s)
+        return self._heap.sorted_desc()
+
+    # -- checkpoint ------------------------------------------------------
+
+    def checkpoint_state(self) -> dict:
+        items = sorted(self.item_rows)
+        flat_src, flat_dst, flat_cnt = [], [], []
+        for i in items:
+            for j, c in self.item_rows[i].items():
+                if c != 0:
+                    flat_src.append(i)
+                    flat_dst.append(j)
+                    flat_cnt.append(c)
+        rs_items = np.asarray(sorted(self.global_row_sums), dtype=np.int64)
+        return {
+            "rows_src": np.asarray(flat_src, dtype=np.int64),
+            "rows_dst": np.asarray(flat_dst, dtype=np.int64),
+            "rows_cnt": np.asarray(flat_cnt, dtype=np.int64),
+            "rs_items": rs_items,
+            "rs_sums": np.asarray(
+                [self.global_row_sums[int(i)] for i in rs_items], dtype=np.int64),
+            "observed": np.asarray([self.observed], dtype=np.int64),
+        }
+
+    def restore_state(self, st: dict) -> None:
+        self.item_rows = {}
+        for i, j, c in zip(st["rows_src"].tolist(), st["rows_dst"].tolist(),
+                           st["rows_cnt"].tolist()):
+            self.item_rows.setdefault(i, {})[j] = c
+        self.global_row_sums = dict(
+            zip(st["rs_items"].tolist(), st["rs_sums"].tolist()))
+        self.observed = int(st["observed"][0])

@@ -1,0 +1,73 @@
+"""External-id <-> dense-index mapping.
+
+The reference keys operators by raw integer ids via hash partitioning; the
+TPU path needs *dense* indices to address device arrays (the co-occurrence
+matrix row/col space). Ids are assigned in first-appearance order, which is
+deterministic for a fixed stream — this also makes the dense index a stable
+RNG key for the reservoir sampler.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+class IdMap:
+    """Grow-only external->dense id mapping with batch lookup."""
+
+    def __init__(self) -> None:
+        self._fwd: Dict[int, int] = {}
+        self._rev: list = []
+
+    def __len__(self) -> int:
+        return len(self._rev)
+
+    def map_batch(self, ids: np.ndarray) -> np.ndarray:
+        """Map a batch of external ids, assigning new dense ids as needed.
+
+        Dense ids are assigned in first-appearance order. Only the batch's
+        *unique* ids touch the Python dict; the expansion back to the full
+        batch is a vectorized take.
+        """
+        fwd = self._fwd
+        rev = self._rev
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        dense_uniq = np.empty(len(uniq), dtype=np.int64)
+        missing = []
+        for pos, ext in enumerate(uniq.tolist()):
+            dense = fwd.get(ext)
+            if dense is None:
+                missing.append(pos)
+            else:
+                dense_uniq[pos] = dense
+        if missing:
+            # np.unique sorts, but first-appearance order must win for
+            # determinism: assign new ids by first position in the batch.
+            first_pos = np.full(len(uniq), np.iinfo(np.int64).max, dtype=np.int64)
+            np.minimum.at(first_pos, inverse, np.arange(len(inverse), dtype=np.int64))
+            missing.sort(key=lambda u_idx: int(first_pos[u_idx]))
+            for u_idx in missing:
+                ext = int(uniq[u_idx])
+                dense = len(rev)
+                fwd[ext] = dense
+                rev.append(ext)
+                dense_uniq[u_idx] = dense
+        return dense_uniq[inverse]
+
+    def to_external(self, dense: int) -> int:
+        return self._rev[dense]
+
+    def to_external_batch(self, dense: np.ndarray) -> np.ndarray:
+        rev = np.asarray(self._rev, dtype=np.int64)
+        return rev[dense]
+
+    # -- checkpoint ------------------------------------------------------
+
+    def checkpoint_state(self) -> np.ndarray:
+        return np.asarray(self._rev, dtype=np.int64)
+
+    def restore_state(self, rev: np.ndarray) -> None:
+        self._rev = [int(x) for x in rev]
+        self._fwd = {ext: i for i, ext in enumerate(self._rev)}

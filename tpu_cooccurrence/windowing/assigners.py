@@ -1,0 +1,71 @@
+"""Event-time window assigners.
+
+Flink-subset replacement (SURVEY.md §1): tumbling windows are what the
+reference wires everywhere (``FlinkCooccurrences.java:139,153``; operators
+reject multi-window assignment, e.g.
+``UserInteractionCounterOneInputStreamOperator.java:126-128``). Sliding
+windows are a framework extension needed by benchmark config 3.
+
+A window is identified by its start; it covers ``[start, start + size)`` and
+its ``max_timestamp`` is ``start + size - 1`` (Flink ``TimeWindow`` semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TumblingWindows:
+    size_ms: int
+
+    def assign(self, ts: np.ndarray) -> np.ndarray:
+        """Vectorized window-start assignment (one window per event)."""
+        ts = np.asarray(ts, dtype=np.int64)
+        return (ts // self.size_ms) * self.size_ms
+
+    def assign_scalar(self, ts: int) -> List[int]:
+        return [int((ts // self.size_ms) * self.size_ms)]
+
+    def max_timestamp(self, start: int) -> int:
+        return start + self.size_ms - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SlidingWindows:
+    size_ms: int
+    slide_ms: int
+
+    def __post_init__(self):
+        if self.size_ms % self.slide_ms != 0:
+            raise ValueError(
+                f"window size {self.size_ms} must be a multiple of slide {self.slide_ms}")
+
+    @property
+    def windows_per_event(self) -> int:
+        return self.size_ms // self.slide_ms
+
+    def assign_scalar(self, ts: int) -> List[int]:
+        """All window starts containing ts, ascending."""
+        last_start = (ts // self.slide_ms) * self.slide_ms
+        starts = []
+        start = last_start - self.size_ms + self.slide_ms
+        while start <= last_start:
+            if start + self.size_ms > ts >= start:
+                starts.append(int(start))
+            start += self.slide_ms
+        return starts
+
+    def assign(self, ts: np.ndarray) -> np.ndarray:
+        """Vectorized: returns [n_events, windows_per_event] window starts."""
+        ts = np.asarray(ts, dtype=np.int64)
+        last = (ts // self.slide_ms) * self.slide_ms
+        offsets = (np.arange(self.windows_per_event, dtype=np.int64)
+                   * self.slide_ms)
+        return last[:, None] - offsets[None, :]
+
+    def max_timestamp(self, start: int) -> int:
+        return start + self.size_ms - 1
