@@ -1,0 +1,68 @@
+"""Config/CLI parsing tests (reference parity: ``Configuration.java:56-199``)."""
+
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config, WindowUnit
+
+
+def test_defaults_match_reference():
+    cfg = Config.from_args(["-i", "x.csv", "-ws", "5"])
+    assert cfg.item_cut == 500
+    assert cfg.user_cut == 500
+    assert cfg.top_k == 10
+    assert cfg.window_unit is WindowUnit.MILLISECONDS
+    assert cfg.buffer_timeout == 100
+    assert cfg.skip_cuts is False
+    assert cfg.seed is not None  # clock-derived like System.nanoTime()
+
+
+def test_hex_seed():
+    cfg = Config.from_args(["-i", "x", "-ws", "1", "-s", "0xC0FFEE"])
+    assert cfg.seed == 0xC0FFEE
+    cfg = Config.from_args(["-i", "x", "-ws", "1", "-s", "12345"])
+    assert cfg.seed == 12345
+
+
+def test_window_units():
+    for name, millis in [("SECONDS", 1000), ("minutes", 60_000),
+                         ("HOURS", 3_600_000), ("days", 86_400_000)]:
+        cfg = Config.from_args(["-i", "x", "-ws", "2", "-wu", name])
+        assert cfg.window_millis == 2 * millis
+
+
+def test_unknown_window_unit_rejected():
+    with pytest.raises(SystemExit):
+        Config.from_args(["-i", "x", "-ws", "1", "-wu", "FORTNIGHTS"])
+
+
+def test_input_required():
+    with pytest.raises(SystemExit):
+        Config.from_args(["-ws", "1"])
+
+
+def test_window_size_required():
+    with pytest.raises(SystemExit):
+        Config.from_args(["-i", "x"])
+
+
+def test_short_flags():
+    cfg = Config.from_args(["-i", "x", "-ws", "1", "-ic", "7", "-uc", "9",
+                            "-k", "3", "-sc", "-bt", "50"])
+    assert cfg.item_cut == 7
+    assert cfg.user_cut == 9
+    assert cfg.top_k == 3
+    assert cfg.skip_cuts is True
+    assert cfg.buffer_timeout == 50
+
+
+def test_top_k_positive_required():
+    # Reference: ItemRowRescorerTwoInputStreamOperator.java:52-54.
+    with pytest.raises(ValueError):
+        Config(input="x", window_size=1, top_k=0)
+
+
+def test_backend_parse():
+    cfg = Config.from_args(["-i", "x", "-ws", "1", "--backend", "sharded",
+                            "--num-shards", "4", "--num-items", "100"])
+    assert cfg.backend is Backend.SHARDED
+    assert cfg.num_shards == 4

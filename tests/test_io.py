@@ -1,0 +1,97 @@
+"""IO layer tests: file monitor ordering/process-once, parsing, generators."""
+
+import os
+import time
+
+import numpy as np
+
+from tpu_cooccurrence.io.parse import batched_lines, parse_lines
+from tpu_cooccurrence.io.source import FileMonitorSource
+from tpu_cooccurrence.io.synthetic import (
+    word_cooccurrence_stream,
+    write_interactions_csv,
+    zipfian_interactions,
+)
+from tpu_cooccurrence.metrics import Counters, SPLIT_READER_NUM_SPLITS
+
+
+def test_parse_lines():
+    u, i, t = parse_lines(["1,2,3", "4,5,6"])
+    np.testing.assert_array_equal(u, [1, 4])
+    np.testing.assert_array_equal(i, [2, 5])
+    np.testing.assert_array_equal(t, [3, 6])
+
+
+def test_batched_lines():
+    batches = list(batched_lines((f"{n},{n},{n}" for n in range(10)), batch_size=4))
+    assert [len(b[0]) for b in batches] == [4, 4, 2]
+
+
+def test_source_modification_time_order(tmp_path):
+    # Reference forwards splits sorted by modification time
+    # (ContinuousFileMonitoringFunction.java:239-257).
+    a = tmp_path / "a.csv"
+    b = tmp_path / "b.csv"
+    a.write_text("1,1,1\n")
+    b.write_text("2,2,2\n")
+    now = time.time()
+    os.utime(b, (now - 100, now - 100))  # b is older -> must come first
+    os.utime(a, (now, now))
+    counters = Counters()
+    src = FileMonitorSource(str(tmp_path), counters)
+    assert list(src.lines()) == ["2,2,2", "1,1,1"]
+    assert counters.get(SPLIT_READER_NUM_SPLITS) == 2
+
+
+def test_source_process_once_skips_consumed(tmp_path):
+    f = tmp_path / "a.csv"
+    f.write_text("1,1,1\n")
+    src = FileMonitorSource(str(f))
+    assert len(list(src.lines())) == 1
+    # Same mtime on second scan: nothing new.
+    assert list(src.lines()) == []
+
+
+def test_source_hidden_files_skipped(tmp_path):
+    (tmp_path / ".hidden").write_text("9,9,9\n")
+    (tmp_path / "_partial").write_text("8,8,8\n")
+    (tmp_path / "ok.csv").write_text("1,1,1\n")
+    src = FileMonitorSource(str(tmp_path))
+    assert list(src.lines()) == ["1,1,1"]
+
+
+def test_source_checkpoint_roundtrip(tmp_path):
+    f = tmp_path / "a.csv"
+    f.write_text("1,1,1\n")
+    src = FileMonitorSource(str(f))
+    list(src.lines())
+    state = src.checkpoint_state()
+    src2 = FileMonitorSource(str(f))
+    src2.restore_state(state)
+    assert list(src2.lines()) == []
+
+
+def test_zipfian_shapes_and_skew():
+    users, items, ts = zipfian_interactions(
+        10_000, n_items=1000, n_users=50, alpha=1.1, seed=1)
+    assert len(users) == len(items) == len(ts) == 10_000
+    assert (np.diff(ts) >= 0).all()
+    # Zipf: rank-0 item must dominate.
+    counts = np.bincount(items, minlength=1000)
+    assert counts[0] > counts[100:].max()
+
+
+def test_word_cooccurrence_stream():
+    users, items, ts = word_cooccurrence_stream("a b a\nc b\n")
+    # line 0: a b a -> user 0 three items; line 1: c b.
+    np.testing.assert_array_equal(users, [0, 0, 0, 1, 1])
+    np.testing.assert_array_equal(items, [0, 1, 0, 2, 1])
+
+
+def test_write_interactions_csv_roundtrip(tmp_path):
+    p = str(tmp_path / "x.csv")
+    write_interactions_csv(p, np.array([1, 2]), np.array([3, 4]),
+                           np.array([5, 6]))
+    u, i, t = parse_lines(open(p).read().splitlines())
+    np.testing.assert_array_equal(u, [1, 2])
+    np.testing.assert_array_equal(i, [3, 4])

@@ -1,0 +1,70 @@
+"""Window assigner + engine unit tests."""
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.windowing.assigners import SlidingWindows, TumblingWindows
+from tpu_cooccurrence.windowing.engine import WindowEngine
+
+
+def test_tumbling_assignment():
+    w = TumblingWindows(10)
+    np.testing.assert_array_equal(
+        w.assign(np.array([0, 9, 10, 19, 25])), [0, 0, 10, 10, 20])
+    assert w.max_timestamp(10) == 19
+    assert w.assign_scalar(15) == [10]
+
+
+def test_sliding_assignment_scalar():
+    w = SlidingWindows(10, 5)
+    # ts=12 is inside [10,20) and [5,15).
+    assert sorted(w.assign_scalar(12)) == [5, 10]
+    # ts=3 inside [0,10) and [-5,5).
+    assert sorted(w.assign_scalar(3)) == [-5, 0]
+
+
+def test_sliding_assignment_vectorized_matches_scalar():
+    w = SlidingWindows(12, 4)
+    ts = np.arange(0, 40)
+    batch = w.assign(ts)
+    assert batch.shape == (40, 3)
+    for pos, t in enumerate(ts.tolist()):
+        assert sorted(batch[pos].tolist()) == sorted(w.assign_scalar(t))
+
+
+def test_sliding_requires_divisible():
+    with pytest.raises(ValueError):
+        SlidingWindows(10, 3)
+
+
+def test_engine_fires_in_order_and_drops_late():
+    eng = WindowEngine(10)
+    users = np.array([1, 2, 3, 4], dtype=np.int64)
+    items = np.array([10, 20, 30, 40], dtype=np.int64)
+    ts = np.array([5, 25, 7, 15], dtype=np.int64)  # 7 and 15 late (wm=24)
+    n_late = eng.add_batch(users, items, ts)
+    assert n_late == 2
+    fired = list(eng.fire_ready())
+    # Windows [0,10) and [10,20) complete at wm=24, but [10,20) got no
+    # surviving elements; only [0,10) fires. [20,30) still open.
+    assert [f[0] for f in fired] == [9]
+    np.testing.assert_array_equal(fired[0][2], [10])  # item 10 in w0
+    fired_final = list(eng.fire_ready(final=True))
+    assert [f[0] for f in fired_final] == [29]
+    np.testing.assert_array_equal(fired_final[0][2], [20])
+
+
+def test_engine_equal_timestamps_kept():
+    eng = WindowEngine(10)
+    n_late = eng.add_batch(
+        np.array([1, 2]), np.array([10, 20]), np.array([5, 5], dtype=np.int64))
+    assert n_late == 0
+
+
+def test_engine_preserves_arrival_order_within_window():
+    eng = WindowEngine(100)
+    eng.add_batch(np.array([1, 1]), np.array([10, 20]),
+                  np.array([5, 6], dtype=np.int64))
+    eng.add_batch(np.array([1]), np.array([30]), np.array([7], dtype=np.int64))
+    (ts, users, items), = list(eng.fire_ready(final=True))
+    np.testing.assert_array_equal(items, [10, 20, 30])

@@ -1,0 +1,197 @@
+"""The five BASELINE.md benchmark configurations.
+
+| # | Config                                                        |
+|---|---------------------------------------------------------------|
+| 1 | batch word co-occurrence on tiny text file (local, CPU)       |
+| 2 | MovieLens-100K user->item baskets, tumbling count window      |
+| 3 | MovieLens-25M sessions, sliding time window + top-k           |
+| 4 | Zipfian synthetic basket stream (1M items, a=1.1), 8 shards   |
+| 5 | Instacart order-product baskets, incremental streaming update |
+
+Real dataset files are used when present (paths via env:
+``MOVIELENS_100K``, ``MOVIELENS_25M``, ``INSTACART_ORDERS``/
+``INSTACART_ORDER_PRODUCTS``); otherwise shape-matched synthetic stand-ins
+are generated (this environment has no network egress), and the report
+labels them as such.
+
+Metric: item-pairs/sec = ObservedCooccurrences / wall-clock (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Backend, Config
+from ..io import synthetic
+from ..job import CooccurrenceJob
+from ..metrics import OBSERVED_COOCCURRENCES
+
+TINY_TEXT = """the quick brown fox jumps over the lazy dog
+pack my box with five dozen liquor jugs
+how vexingly quick daft zebras jump
+the five boxing wizards jump quickly
+sphinx of black quartz judge my vow
+the quick onyx goblin jumps over the lazy dwarf
+"""
+
+
+@dataclasses.dataclass
+class BenchResult:
+    name: str
+    backend: str
+    events: int
+    pairs: int
+    seconds: float
+    synthetic_standin: bool
+
+    @property
+    def pairs_per_sec(self) -> float:
+        return self.pairs / max(self.seconds, 1e-9)
+
+    def as_dict(self) -> Dict:
+        return {
+            "name": self.name,
+            "backend": self.backend,
+            "events": self.events,
+            "pairs": self.pairs,
+            "seconds": round(self.seconds, 3),
+            "pairs_per_sec": round(self.pairs_per_sec, 1),
+            "synthetic_standin": self.synthetic_standin,
+        }
+
+
+def _run(name: str, cfg: Config, users, items, ts,
+         synthetic_standin: bool) -> BenchResult:
+    job = CooccurrenceJob(cfg)
+    start = time.monotonic()
+    job.add_batch(users, items, ts)
+    job.finish()
+    seconds = time.monotonic() - start
+    return BenchResult(name, cfg.backend.value, len(users),
+                       job.counters.get(OBSERVED_COOCCURRENCES), seconds,
+                       synthetic_standin)
+
+
+def config1_tiny_text(backend: Backend = Backend.DEVICE) -> BenchResult:
+    """Batch word co-occurrence on a tiny text (one window, skip-cuts)."""
+    users, items, ts = synthetic.word_cooccurrence_stream(TINY_TEXT * 50)
+    n_items = int(items.max()) + 1
+    cfg = Config(window_size=1_000_000, skip_cuts=True, seed=1,
+                 backend=backend, num_items=n_items)
+    return _run("tiny-text-batch", cfg, users, items, ts, False)
+
+
+def _movielens_100k() -> Tuple:
+    path = os.environ.get("MOVIELENS_100K", "")
+    if path and os.path.exists(path):
+        (users, items, ts), = synthetic.movielens_interactions(path)
+        return users, items, ts, False
+    # Stand-in: 100K events, 943 users, 1682 items, zipf-ish popularity.
+    users, items, ts = synthetic.zipfian_interactions(
+        100_000, n_items=1682, n_users=943, alpha=1.05, seed=100,
+        events_per_ms=5)
+    return users, items, ts, True
+
+
+def config2_ml100k(backend: Backend = Backend.DEVICE) -> BenchResult:
+    users, items, ts, standin = _movielens_100k()
+    cfg = Config(window_size=4000, seed=2, item_cut=500, user_cut=500,
+                 backend=backend, num_items=int(items.max()) + 1)
+    return _run("ml-100k-tumbling", cfg, users, items, ts, standin)
+
+
+def _movielens_25m(limit: Optional[int]) -> Tuple:
+    path = os.environ.get("MOVIELENS_25M", "")
+    if path and os.path.exists(path):
+        (users, items, ts), = synthetic.movielens_interactions(path)
+        if limit:
+            users, items, ts = users[:limit], items[:limit], ts[:limit]
+        return users, items, ts, False
+    n = limit or 2_000_000
+    users, items, ts = synthetic.zipfian_interactions(
+        n, n_items=62_000, n_users=162_000, alpha=1.05, seed=25,
+        events_per_ms=50)
+    return users, items, ts, True
+
+
+def config3_ml25m_sliding(backend: Backend = Backend.HYBRID,
+                          limit: Optional[int] = 500_000) -> BenchResult:
+    users, items, ts, standin = _movielens_25m(limit)
+    cfg = Config(window_size=4000, window_slide=1000, seed=3,
+                 item_cut=500, user_cut=500, backend=backend,
+                 num_items=int(items.max()) + 1)
+    return _run("ml-25m-sliding", cfg, users, items, ts, standin)
+
+
+def config4_zipfian_1m(backend: Backend = Backend.HYBRID,
+                            n_events: int = 1_000_000) -> BenchResult:
+    """1M-item Zipfian stream. Dense device state is infeasible at this
+    vocabulary, so the hybrid backend carries it."""
+    users, items, ts = synthetic.zipfian_interactions(
+        n_events, n_items=1_000_000, n_users=100_000, alpha=1.1, seed=4,
+        events_per_ms=200)
+    cfg = Config(window_size=100, seed=4, item_cut=500, user_cut=500,
+                 backend=backend)
+    return _run("zipfian-1M-items", cfg, users, items, ts, False)
+
+
+def _instacart() -> Tuple:
+    orders = os.environ.get("INSTACART_ORDERS", "")
+    order_products = os.environ.get("INSTACART_ORDER_PRODUCTS", "")
+    if orders and os.path.exists(orders) and os.path.exists(order_products):
+        (users, items, ts), = synthetic.instacart_interactions(
+            orders, order_products)
+        return users, items, ts, False
+    # Stand-in: basket-shaped stream — ~8 items per (user, ts) basket.
+    # (Scale via BENCH_BASKETS; persistent histories make the pair volume
+    # grow quadratically in per-user interactions.)
+    rng = np.random.default_rng(55)
+    n_baskets = int(os.environ.get("BENCH_BASKETS", 20_000))
+    sizes = rng.poisson(8, n_baskets).clip(1, 40)
+    users = np.repeat(rng.integers(0, 5_000, n_baskets), sizes)
+    ts = np.repeat(np.arange(n_baskets, dtype=np.int64) * 10, sizes)
+    n = int(sizes.sum())
+    ranks = np.arange(1, 50_000, dtype=np.float64)
+    w = ranks ** -1.05
+    cdf = np.cumsum(w) / w.sum()
+    items = np.searchsorted(cdf, rng.random(n)).astype(np.int64)
+    return users, items, ts, True
+
+
+def config5_instacart(backend: Backend = Backend.HYBRID) -> BenchResult:
+    users, items, ts, standin = _instacart()
+    cfg = Config(window_size=1000, seed=5, item_cut=500, user_cut=500,
+                 backend=backend)
+    return _run("instacart-incremental", cfg, users, items, ts, standin)
+
+
+ALL_CONFIGS: List[Tuple[str, Callable[[], BenchResult]]] = [
+    ("1-tiny-text", config1_tiny_text),
+    ("2-ml100k", config2_ml100k),
+    ("3-ml25m-sliding", config3_ml25m_sliding),
+    ("4-zipfian-1M", config4_zipfian_1m),
+    ("5-instacart", config5_instacart),
+]
+
+
+def run_all() -> List[BenchResult]:
+    results = []
+    for _name, fn in ALL_CONFIGS:
+        results.append(fn())
+    return results
+
+
+def main() -> None:
+    import json
+
+    for res in run_all():
+        print(json.dumps(res.as_dict()))
+
+
+if __name__ == "__main__":
+    main()

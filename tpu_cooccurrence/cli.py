@@ -32,7 +32,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     source = FileMonitorSource(
         config.input, job.counters,
         process_continuously=config.process_continuously)
-    job.run(batched_lines(source.lines()))
+    from .observability import xla_trace
+
+    with xla_trace(config.profile_dir):
+        job.run(batched_lines(source.lines()))
+
+    if config.development_mode:
+        for w in job.step_timer.slowest():
+            LOG.info("slow window ts=%d events=%d pairs=%d rows=%d "
+                     "sample=%.4fs score=%.4fs", w.timestamp, w.events,
+                     w.pairs, w.rows_scored, w.sample_seconds, w.score_seconds)
 
     # Print the latest top-K per item to stdout (the reference's result
     # stream ends in a no-op sink, FlinkCooccurrences.java:169-171; we make

@@ -52,6 +52,7 @@ class Backend(enum.Enum):
     ORACLE = "oracle"
     DEVICE = "device"
     SHARDED = "sharded"
+    HYBRID = "hybrid"  # host sparse rows + device batched scoring (big vocab)
 
 
 def _parse_seed(value: str) -> int:
@@ -88,6 +89,7 @@ class Config:
     max_pairs_per_step: int = 1 << 20  # COO padding bucket (recompile guard)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
+    profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
     development_mode: bool = False  # invariant checks (FlinkCooccurrences.java:34)
     process_continuously: bool = False  # PROCESS_ONCE vs PROCESS_CONTINUOUSLY
 
@@ -157,6 +159,8 @@ class Config:
                        help="Item-axis shards over the device mesh")
         p.add_argument("--window-slide", type=int, default=None, dest="window_slide",
                        help="Slide (same unit as window) for sliding windows")
+        p.add_argument("--profile-dir", default=None, dest="profile_dir",
+                       help="Write a jax.profiler trace for TensorBoard")
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")

@@ -31,6 +31,8 @@ from .metrics import (
 from .io.parse import InteractionBatch
 from .sampling.item_cut import ItemInteractionCut
 from .sampling.reservoir import PairDeltaBatch, UserReservoirSampler
+from .sampling.sliding import SlidingBasketSampler
+from .observability import StepTimer, WindowStats, clock
 from .state.rescorer import HostRescorer, WindowTopK
 from .state.vocab import IdMap
 from .windowing.engine import WindowEngine
@@ -44,33 +46,36 @@ class CooccurrenceJob:
     def __init__(self, config: Config, scorer=None) -> None:
         if config.window_millis <= 0:
             raise ValueError("window size must be positive")
-        if config.window_slide is not None:
-            # Sliding windows exist in windowing/assigners.py but are not yet
-            # wired into the sampling pipeline (the reference, too, only ever
-            # wires tumbling — FlinkCooccurrences.java:139,153 — and its
-            # operators reject multi-window assignment). Fail loudly rather
-            # than silently running tumbling.
-            raise NotImplementedError(
-                "--window-slide is not yet supported by the pipeline; "
-                "only tumbling windows are wired (as in the reference)")
         self.config = config
         self.counters = Counters()
-        self.engine = WindowEngine(config.window_millis)
+        # Sliding mode (framework extension; the reference is tumbling-only,
+        # FlinkCooccurrences.java:139,153) switches the sampler to stateless
+        # windowed-basket co-occurrence — see sampling/sliding.py for the
+        # documented semantics.
+        self.sliding = config.window_slide is not None
+        self.engine = WindowEngine(config.window_millis, config.slide_millis)
         self.item_vocab = IdMap()
         self.user_vocab = IdMap()
         self.item_cut = ItemInteractionCut(config.item_cut, capacity=1024)
-        self.sampler = UserReservoirSampler(
-            config.user_cut, config.seed, config.skip_cuts,
-            counters=self.counters)
+        if self.sliding:
+            self.sampler = SlidingBasketSampler(
+                config.item_cut, config.user_cut, config.skip_cuts,
+                counters=self.counters)
+        else:
+            self.sampler = UserReservoirSampler(
+                config.user_cut, config.seed, config.skip_cuts,
+                counters=self.counters)
         self.scorer = scorer if scorer is not None else self._make_scorer()
         # results: external item id -> [(external other, score) desc]
         self.latest: Dict[int, List[Tuple[int, float]]] = {}
         self.emissions = 0
         self.windows_fired = 0
+        self.step_timer = StepTimer()
         # One in-process feedback channel (the reference counts one queue
         # handshake per subtask open,
-        # UserInteractionCounterOneInputStreamOperator.java:109).
-        if not config.skip_cuts:
+        # UserInteractionCounterOneInputStreamOperator.java:109). Sliding
+        # mode has no feedback edge (per-window caps, no rejections).
+        if not config.skip_cuts and not self.sliding:
             self.counters.add(FEEDBACK_QUEUES, 1)
 
     def _make_scorer(self):
@@ -87,6 +92,11 @@ class CooccurrenceJob:
                     "device backend needs --num-items (dense vocab capacity)")
             return DeviceScorer(num_items, self.config.top_k, self.counters,
                                 max_pairs_per_step=self.config.max_pairs_per_step)
+        if backend == Backend.HYBRID:
+            from .state.hybrid_scorer import HybridScorer
+
+            return HybridScorer(self.config.top_k, self.counters,
+                                self.config.development_mode)
         if backend == Backend.SHARDED:
             from .parallel.sharded import ShardedScorer
 
@@ -132,6 +142,7 @@ class CooccurrenceJob:
         # Reference end-of-run logging shape (FlinkCooccurrences.java:179-181).
         LOG.info("Duration\t%d", duration_ms)
         LOG.info("Accumulator results: %s", self.counters)
+        LOG.info("Step timing: %s", self.step_timer.summary())
         self.duration_ms = duration_ms
         return self.latest
 
@@ -140,20 +151,30 @@ class CooccurrenceJob:
     def _drain(self, final: bool) -> None:
         for ts, users, items in self.engine.fire_ready(final=final):
             self.windows_fired += 1
-            # Item cut (or pass-through when --skip-cuts).
-            if self.config.skip_cuts:
-                sampled = np.ones(len(items), dtype=bool)
-            else:
-                sampled = self.item_cut.fire(items)
-            # User reservoir.
-            pairs, feedback_items = self.sampler.fire(users, items, sampled)
-            # Feedback decrements before the next window fire
-            # (ItemInteractionCounterTwoInputStreamOperator.java:94-116).
-            if not self.config.skip_cuts and len(feedback_items):
-                self.item_cut.apply_feedback(
-                    feedback_items, self.config.development_mode, self.counters)
+            with clock() as sample_clock:
+                if self.sliding:
+                    pairs = self.sampler.fire(users, items)
+                else:
+                    # Item cut (or pass-through when --skip-cuts).
+                    if self.config.skip_cuts:
+                        sampled = np.ones(len(items), dtype=bool)
+                    else:
+                        sampled = self.item_cut.fire(items)
+                    # User reservoir.
+                    pairs, feedback_items = self.sampler.fire(users, items, sampled)
+                    # Feedback decrements before the next window fire
+                    # (ItemInteractionCounterTwoInputStreamOperator.java:94-116).
+                    if not self.config.skip_cuts and len(feedback_items):
+                        self.item_cut.apply_feedback(
+                            feedback_items, self.config.development_mode, self.counters)
             # Score on the backend.
-            window_out: WindowTopK = self.scorer.process_window(ts, pairs)
+            with clock() as score_clock:
+                window_out: WindowTopK = self.scorer.process_window(ts, pairs)
+            self.step_timer.record(WindowStats(
+                timestamp=ts, events=len(items), pairs=len(pairs),
+                rows_scored=len(window_out),
+                sample_seconds=sample_clock.seconds,
+                score_seconds=score_clock.seconds))
             for dense_item, top in window_out:
                 ext_item = self.item_vocab.to_external(dense_item)
                 self.latest[ext_item] = [

@@ -1,0 +1,128 @@
+"""Native (C++) host kernels, loaded via ctypes with NumPy fallbacks.
+
+Build happens lazily on first import (g++ is assumed present, as in the
+target image); failures degrade gracefully to the pure-NumPy paths, so the
+framework never hard-depends on a compiler at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+LOG = logging.getLogger("tpu_cooccurrence.native")
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "reservoir_expand.cpp")
+_LIB = os.path.join(_HERE, "libreservoir_expand.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as exc:  # pragma: no cover - environment-dependent
+        LOG.info("native build unavailable (%s); using NumPy fallback", exc)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it on first call; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError as exc:  # pragma: no cover
+        LOG.info("native load failed (%s); using NumPy fallback", exc)
+        return None
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.expand_replacements.restype = ctypes.c_int64
+    lib.expand_replacements.argtypes = [
+        i64p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int64,
+        i64p, i64p, i32p]
+    lib.expand_appends.restype = ctypes.c_int64
+    lib.expand_appends.argtypes = [
+        i64p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int64,
+        i64p, i64p, i32p]
+    _lib = lib
+    return _lib
+
+
+def _ptr64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _ptr32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def expand_appends(hist: np.ndarray, users: np.ndarray, items: np.ndarray,
+                   slots: np.ndarray):
+    """Native append-pair expansion; returns (src, dst, delta) or None.
+
+    ``slots[e]`` is both the slot event ``e`` wrote and its partner count;
+    the caller must have written the new items into ``hist`` already (see
+    sampling/reservoir.py fact 1).
+    """
+    lib = get_lib()
+    if lib is None or len(users) == 0:
+        return None
+    n = len(users)
+    slots = np.ascontiguousarray(slots, dtype=np.int64)
+    cap = int(2 * slots.sum())
+    if cap == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z, np.zeros(0, dtype=np.int32)
+    src = np.empty(cap, dtype=np.int64)
+    dst = np.empty(cap, dtype=np.int64)
+    delta = np.empty(cap, dtype=np.int32)
+    users = np.ascontiguousarray(users, dtype=np.int64)
+    items = np.ascontiguousarray(items, dtype=np.int64)
+    assert hist.flags.c_contiguous
+    written = lib.expand_appends(
+        _ptr64(hist), hist.shape[1], _ptr64(users), _ptr64(items),
+        _ptr64(slots), n, _ptr64(src), _ptr64(dst), _ptr32(delta))
+    return src[:written], dst[:written], delta[:written]
+
+
+def expand_replacements(hist: np.ndarray, users: np.ndarray,
+                        items: np.ndarray, slots: np.ndarray):
+    """Native replacement expansion; returns (src, dst, delta) or None.
+
+    ``hist`` is the [U, k_max] int64 reservoir storage and is MUTATED
+    (slots written in event order), matching the NumPy path's semantics.
+    """
+    lib = get_lib()
+    if lib is None or len(users) == 0:
+        return None
+    k_max = hist.shape[1]
+    n = len(users)
+    cap = n * 4 * (k_max - 1)
+    src = np.empty(cap, dtype=np.int64)
+    dst = np.empty(cap, dtype=np.int64)
+    delta = np.empty(cap, dtype=np.int32)
+    users = np.ascontiguousarray(users, dtype=np.int64)
+    items = np.ascontiguousarray(items, dtype=np.int64)
+    slots = np.ascontiguousarray(slots, dtype=np.int64)
+    assert hist.flags.c_contiguous
+    written = lib.expand_replacements(
+        _ptr64(hist), k_max, _ptr64(users), _ptr64(items), _ptr64(slots),
+        n, _ptr64(src), _ptr64(dst), _ptr32(delta))
+    return src[:written], dst[:written], delta[:written]

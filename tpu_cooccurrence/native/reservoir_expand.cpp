@@ -1,0 +1,102 @@
+// Native pair-expansion kernels for the reservoir sampler hot path.
+//
+// The reference has no native layer (SURVEY §2.6: 100% Java; fastutil +
+// object reuse are its "fast path"), but its per-record emission loop
+// (UserInteractionCounterOneInputStreamOperator.java:206-245) is the
+// framework's host-side bottleneck once reservoirs are full: each
+// replacement emits 4*(kMax-1) pair deltas. This kernel performs the
+// sequential slot mutations and pair emission in C++ at memory speed;
+// Python falls back to a NumPy loop when the shared library is missing.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libreservoir_expand.so
+//        reservoir_expand.cpp   (see native/build.py)
+
+#include <cstdint>
+
+extern "C" {
+
+// Expand replacement events into pair deltas.
+//
+// hist        [n_users_cap * k_max] row-major reservoir storage (mutated!)
+// users/items/slots [n_repl] replacement events in processing order
+// out_src/out_dst/out_delta [n_repl * 4 * (k_max - 1)] preallocated outputs
+//
+// Emission order per event matches the vectorized spec: (item->others +1),
+// (prev->others -1), (others->item +1), (others->prev -1), `others` being
+// the k_max-1 slots excluding the replaced one, read *at event time*.
+// Returns the number of emitted entries.
+int64_t expand_replacements(
+    int64_t* hist, int64_t k_max,
+    const int64_t* users, const int64_t* items, const int64_t* slots,
+    int64_t n_repl,
+    int64_t* out_src, int64_t* out_dst, int32_t* out_delta) {
+  int64_t pos = 0;
+  const int64_t m = k_max - 1;
+  for (int64_t e = 0; e < n_repl; ++e) {
+    int64_t* row = hist + users[e] * k_max;
+    const int64_t item = items[e];
+    const int64_t slot = slots[e];
+    const int64_t prev = row[slot];
+
+    int64_t* src0 = out_src + pos;        // item -> others
+    int64_t* dst0 = out_dst + pos;
+    int32_t* del0 = out_delta + pos;
+    int64_t* src1 = src0 + m;             // prev -> others
+    int64_t* dst1 = dst0 + m;
+    int32_t* del1 = del0 + m;
+    int64_t* src2 = src1 + m;             // others -> item
+    int64_t* dst2 = dst1 + m;
+    int32_t* del2 = del1 + m;
+    int64_t* src3 = src2 + m;             // others -> prev
+    int64_t* dst3 = dst2 + m;
+    int32_t* del3 = del2 + m;
+
+    int64_t w = 0;
+    for (int64_t i = 0; i < k_max; ++i) {
+      if (i == slot) continue;
+      const int64_t other = row[i];
+      src0[w] = item;  dst0[w] = other; del0[w] = 1;
+      src1[w] = prev;  dst1[w] = other; del1[w] = -1;
+      src2[w] = other; dst2[w] = item;  del2[w] = 1;
+      src3[w] = other; dst3[w] = prev;  del3[w] = -1;
+      ++w;
+    }
+    row[slot] = item;
+    pos += 4 * m;
+  }
+  return pos;
+}
+
+// Expand append events into pair deltas (both directions).
+//
+// For append event e writing slot `slot_e`, partners are hist[u][0:slot_e]
+// *after* all appends are written (equivalent to event-time state; see
+// sampling/reservoir.py fact 1). Caller must have already written the new
+// items into their slots. Returns entries written.
+int64_t expand_appends(
+    const int64_t* hist, int64_t hist_cols,
+    const int64_t* users, const int64_t* items, const int64_t* slots,
+    int64_t n_app,
+    int64_t* out_src, int64_t* out_dst, int32_t* out_delta) {
+  int64_t pos = 0;
+  for (int64_t e = 0; e < n_app; ++e) {
+    const int64_t* row = hist + users[e] * hist_cols;
+    const int64_t item = items[e];
+    const int64_t n = slots[e];  // number of partners
+    int64_t* srcA = out_src + pos;
+    int64_t* dstA = out_dst + pos;
+    int32_t* delA = out_delta + pos;
+    int64_t* srcB = srcA + n;
+    int64_t* dstB = dstA + n;
+    int32_t* delB = delA + n;
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t other = row[i];
+      srcA[i] = item;  dstA[i] = other; delA[i] = 1;
+      srcB[i] = other; dstB[i] = item;  delB[i] = 1;
+    }
+    pos += 2 * n;
+  }
+  return pos;
+}
+
+}  // extern "C"

@@ -1,0 +1,94 @@
+"""Tracing / profiling / per-window instrumentation.
+
+The reference's observability is wall-clock duration + accumulators
+(SURVEY §5: ``FlinkCooccurrences.java:173-181``); Flink's own metrics UI
+provides the rest. The TPU build's upgrade: per-window step timing with
+stage breakdown (sampling vs scoring), retained as a ring buffer and
+summarizable, plus optional XLA profiler traces (``jax.profiler``) for
+TensorBoard.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import time
+from typing import Deque, Dict, Iterator, Optional
+
+
+@dataclasses.dataclass
+class WindowStats:
+    timestamp: int
+    events: int
+    pairs: int
+    rows_scored: int
+    sample_seconds: float
+    score_seconds: float
+
+    @property
+    def seconds(self) -> float:
+        return self.sample_seconds + self.score_seconds
+
+
+class StepTimer:
+    """Ring buffer of per-window stats with aggregate summary."""
+
+    def __init__(self, keep: int = 1024) -> None:
+        self.windows: Deque[WindowStats] = collections.deque(maxlen=keep)
+        self.total_windows = 0
+        self.total_events = 0
+        self.total_pairs = 0
+        self.total_sample_seconds = 0.0
+        self.total_score_seconds = 0.0
+
+    def record(self, stats: WindowStats) -> None:
+        self.windows.append(stats)
+        self.total_windows += 1
+        self.total_events += stats.events
+        self.total_pairs += stats.pairs
+        self.total_sample_seconds += stats.sample_seconds
+        self.total_score_seconds += stats.score_seconds
+
+    def summary(self) -> Dict[str, float]:
+        total = self.total_sample_seconds + self.total_score_seconds
+        return {
+            "windows": self.total_windows,
+            "events": self.total_events,
+            "pairs": self.total_pairs,
+            "sample_seconds": round(self.total_sample_seconds, 4),
+            "score_seconds": round(self.total_score_seconds, 4),
+            "pairs_per_sec": round(self.total_pairs / total, 1) if total else 0.0,
+        }
+
+    def slowest(self, n: int = 3) -> list:
+        """The n slowest recent windows (ring-buffer scope) — the first place
+        to look when a run's step timing regresses."""
+        return sorted(self.windows, key=lambda w: -w.seconds)[:n]
+
+
+@contextlib.contextmanager
+def xla_trace(profile_dir: Optional[str]) -> Iterator[None]:
+    """Wrap a run in a ``jax.profiler`` trace when a directory is given."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class clock:  # noqa: N801 - tiny helper
+    """``with clock() as c: ...; c.seconds``"""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds = time.perf_counter() - self._t0
+        return False

@@ -168,16 +168,25 @@ class UserReservoirSampler:
             self.hist_len[uniq_a] += n_app
 
             sizes = a_slot  # number of partners per append event
-            if int(sizes.sum()) > 0:
-                col = _ragged_arange(sizes)
-                row_u = np.repeat(a_users, sizes)
-                partners = self.hist[row_u, col]
-                new_rep = np.repeat(a_items, sizes)
-                ones = np.ones(len(partners), dtype=np.int32)
-                # Both directions (reference :180-193).
-                blocks.append(PairDeltaBatch(new_rep, partners, ones))
-                blocks.append(PairDeltaBatch(partners, new_rep, ones))
-                self.counters.add(OBSERVED_COOCCURRENCES, 2 * int(sizes.sum()))
+            total_partners = int(sizes.sum())
+            if total_partners > 0:
+                # Hot path: native C++ expansion; fallback: vectorized numpy.
+                from .. import native
+
+                expanded = native.expand_appends(
+                    self.hist, a_users, a_items, a_slot)
+                if expanded is not None:
+                    blocks.append(PairDeltaBatch(*expanded))
+                else:
+                    col = _ragged_arange(sizes)
+                    row_u = np.repeat(a_users, sizes)
+                    partners = self.hist[row_u, col]
+                    new_rep = np.repeat(a_items, sizes)
+                    ones = np.ones(len(partners), dtype=np.int32)
+                    # Both directions (reference :180-193).
+                    blocks.append(PairDeltaBatch(new_rep, partners, ones))
+                    blocks.append(PairDeltaBatch(partners, new_rep, ones))
+                self.counters.add(OBSERVED_COOCCURRENCES, 2 * total_partners)
 
         # ---- Draw path ----
         d_mask = ~is_append
@@ -195,11 +204,22 @@ class UserReservoirSampler:
             feedback_items = d_items[~replace]
 
             # Replacements mutate slots sequentially (same slot can be hit
-            # twice in one window) -> per-event loop, O(kMax) numpy ops each.
+            # twice in one window). Hot path: native C++ expansion
+            # (native/reservoir_expand.cpp); fallback: per-event loop with
+            # O(kMax) numpy ops each.
             kc = self.user_cut
             r_users = d_users[replace]
             r_items = d_items[replace]
             r_slots = k[replace]
+            if len(r_users) and self.hist.shape[1] == kc:
+                from .. import native
+
+                expanded = native.expand_replacements(
+                    self.hist, r_users, r_items, r_slots)
+                if expanded is not None:
+                    src, dst, delta = expanded
+                    blocks.append(PairDeltaBatch(src, dst, delta))
+                    return PairDeltaBatch.concat(blocks), feedback_items
             for u, item, slot in zip(r_users.tolist(), r_items.tolist(), r_slots.tolist()):
                 hist_row = self.hist[u, :kc]
                 previous = int(hist_row[slot])

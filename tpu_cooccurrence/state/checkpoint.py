@@ -34,6 +34,7 @@ def save(job, directory: str, source=None) -> str:
         "item_cut": job.config.item_cut,
         "user_cut": job.config.user_cut,
         "top_k": job.config.top_k,
+        "window_slide": job.config.window_slide,
         "window_millis": job.config.window_millis,
         "windows_fired": job.windows_fired,
         "emissions": job.emissions,
@@ -46,11 +47,12 @@ def save(job, directory: str, source=None) -> str:
     arrays["item_cut_counts"] = job.item_cut.counts
 
     s = job.sampler
-    n_users = len(job.user_vocab)
-    arrays["hist"] = s.hist[:n_users]
-    arrays["hist_len"] = s.hist_len[:n_users]
-    arrays["total"] = s.total[:n_users]
-    arrays["draws"] = s.draws[:n_users]
+    if hasattr(s, "hist"):  # reservoir sampler; sliding sampler is stateless
+        n_users = len(job.user_vocab)
+        arrays["hist"] = s.hist[:n_users]
+        arrays["hist_len"] = s.hist_len[:n_users]
+        arrays["total"] = s.total[:n_users]
+        arrays["draws"] = s.draws[:n_users]
 
     # In-flight window buffers, flattened.
     starts, users_l, items_l, ts_l = [], [], [], []
@@ -102,13 +104,12 @@ def restore(job, directory: str, source=None) -> None:
     """Restore ``job`` (constructed with the same Config) from a checkpoint."""
     with open(os.path.join(directory, "meta.json")) as f:
         meta = json.load(f)
-    for key, attr in (("seed", "seed"), ("skip_cuts", "skip_cuts"),
-                      ("item_cut", "item_cut"), ("user_cut", "user_cut"),
-                      ("top_k", "top_k")):
-        if getattr(job.config, attr) != meta[key]:
+    for key in ("seed", "skip_cuts", "item_cut", "user_cut", "top_k",
+                "window_slide"):
+        if getattr(job.config, key) != meta.get(key):
             raise ValueError(
                 f"checkpoint config mismatch for {key}: "
-                f"{meta[key]} != {getattr(job.config, attr)}")
+                f"{meta.get(key)} != {getattr(job.config, key)}")
     data = np.load(os.path.join(directory, "state.npz"))
 
     job.item_vocab.restore_state(data["item_vocab"])
@@ -116,13 +117,14 @@ def restore(job, directory: str, source=None) -> None:
     job.item_cut.counts = data["item_cut_counts"].copy()
 
     s = job.sampler
-    n_users = len(job.user_vocab)
-    s._ensure_rows(max(n_users - 1, 0))
-    s._ensure_cols(data["hist"].shape[1])
-    s.hist[:n_users, : data["hist"].shape[1]] = data["hist"]
-    s.hist_len[:n_users] = data["hist_len"]
-    s.total[:n_users] = data["total"]
-    s.draws[:n_users] = data["draws"]
+    if hasattr(s, "hist") and "hist" in data:
+        n_users = len(job.user_vocab)
+        s._ensure_rows(max(n_users - 1, 0))
+        s._ensure_cols(data["hist"].shape[1])
+        s.hist[:n_users, : data["hist"].shape[1]] = data["hist"]
+        s.hist_len[:n_users] = data["hist_len"]
+        s.total[:n_users] = data["total"]
+        s.draws[:n_users] = data["draws"]
 
     job.engine.max_ts_seen = meta["max_ts_seen"]
     job.engine._buffers.clear()

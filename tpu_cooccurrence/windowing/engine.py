@@ -30,15 +30,23 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from .assigners import TumblingWindows
+from .assigners import SlidingWindows, TumblingWindows
 
 
 class WindowEngine:
-    """Accumulates interaction batches, drops late events, fires windows."""
+    """Accumulates interaction batches, drops late events, fires windows.
 
-    def __init__(self, size_ms: int) -> None:
-        self.assigner = TumblingWindows(size_ms)
+    With ``slide_ms`` set, windows overlap and each event is buffered into
+    every window containing it (``size/slide`` copies — the framework's
+    sliding extension; the reference is tumbling-only)."""
+
+    def __init__(self, size_ms: int, slide_ms: Optional[int] = None) -> None:
+        if slide_ms is None:
+            self.assigner = TumblingWindows(size_ms)
+        else:
+            self.assigner = SlidingWindows(size_ms, slide_ms)
         self.size_ms = size_ms
+        self.slide_ms = slide_ms
         self.max_ts_seen: Optional[int] = None
         # window start -> list of (users, items, ts) array chunks
         self._buffers: Dict[int, List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = {}
@@ -62,6 +70,12 @@ class WindowEngine:
         self.max_ts_seen = int(running[-1])
         if len(ts):
             starts = self.assigner.assign(ts)
+            if starts.ndim == 2:  # sliding: one copy per containing window
+                n_windows = starts.shape[1]
+                users = np.repeat(users, n_windows)
+                items = np.repeat(items, n_windows)
+                ts = np.repeat(ts, n_windows)
+                starts = starts.reshape(-1)
             # Group by window start (stable to preserve arrival order).
             order = np.argsort(starts, kind="stable")
             s_sorted = starts[order]
