@@ -11,6 +11,8 @@ def _run_fire(monkeypatch, force_fallback: bool):
     if force_fallback:
         monkeypatch.setattr(native, "expand_replacements",
                             lambda *a, **k: None)
+        monkeypatch.setattr(native, "expand_appends",
+                            lambda *a, **k: None)
     rng = np.random.default_rng(7)
     s = UserReservoirSampler(user_cut=4, seed=11, skip_cuts=False)
     outs = []

@@ -1,0 +1,25 @@
+"""End-to-end pipeline with the Pallas scorer forced on (interpret mode)."""
+
+import numpy as np
+
+from tpu_cooccurrence.config import Backend, Config
+
+from test_pipeline import random_stream, run_production
+
+
+def test_pipeline_pallas_on_matches_xla():
+    kw = dict(window_size=10, seed=0xBEEF, item_cut=5, user_cut=4,
+              num_items=30)
+    users, items, ts = random_stream(17, n=250)
+    xla = run_production(
+        Config(**kw, backend=Backend.DEVICE, pallas="off"), users, items, ts)
+    pls = run_production(
+        Config(**kw, backend=Backend.DEVICE, pallas="on"), users, items, ts)
+    assert set(xla.latest) == set(pls.latest)
+    for item in xla.latest:
+        a = xla.latest[item]
+        b = pls.latest[item]
+        assert len(a) == len(b)
+        np.testing.assert_allclose(
+            np.array([s for _, s in b]), np.array([s for _, s in a]),
+            rtol=1e-5, atol=1e-5)

@@ -90,6 +90,7 @@ class Config:
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
+    pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = TPU only)
     development_mode: bool = False  # invariant checks (FlinkCooccurrences.java:34)
     process_continuously: bool = False  # PROCESS_ONCE vs PROCESS_CONTINUOUSLY
 
@@ -161,6 +162,9 @@ class Config:
                        help="Slide (same unit as window) for sliding windows")
         p.add_argument("--profile-dir", default=None, dest="profile_dir",
                        help="Write a jax.profiler trace for TensorBoard")
+        p.add_argument("--pallas", choices=["auto", "on", "off"],
+                       default="auto",
+                       help="Fused Pallas score/top-K kernel (auto: TPU only)")
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")

@@ -91,7 +91,8 @@ class CooccurrenceJob:
                 raise ValueError(
                     "device backend needs --num-items (dense vocab capacity)")
             return DeviceScorer(num_items, self.config.top_k, self.counters,
-                                max_pairs_per_step=self.config.max_pairs_per_step)
+                                max_pairs_per_step=self.config.max_pairs_per_step,
+                                use_pallas=self.config.pallas)
         if backend == Backend.HYBRID:
             from .state.hybrid_scorer import HybridScorer
 

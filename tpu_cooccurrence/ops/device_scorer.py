@@ -76,17 +76,36 @@ def _score(C, row_sums, rows, observed, top_k: int):
 class DeviceScorer:
     """Dense sharless device backend over a fixed item-vocab capacity."""
 
+    PALLAS_TILE = 512
+
     def __init__(self, num_items: int, top_k: int,
                  counters: Optional[Counters] = None,
                  max_score_rows_per_call: int = 1024,
                  max_pairs_per_step: int = 1 << 20,
+                 use_pallas: str = "auto",
                  device=None) -> None:
-        self.num_items = num_items
         self.top_k = top_k
         self.counters = counters if counters is not None else Counters()
         self.max_score_rows = max_score_rows_per_call
         self.max_pairs_per_step = max_pairs_per_step
+        if use_pallas == "auto":
+            # The fused kernel targets TPU; in interpret mode on CPU it
+            # would be orders of magnitude slower than the XLA path.
+            self.use_pallas = jax.default_backend() == "tpu"
+        else:
+            self.use_pallas = use_pallas == "on"
+        # Off-TPU the kernel can only run interpreted (test/debug use).
+        self._pallas_interpret = jax.default_backend() != "tpu"
+        if self.use_pallas:
+            # Pad the vocab so the Pallas column-tile grid divides evenly;
+            # the extra columns stay zero and are masked out of scoring.
+            self.num_items = ((num_items + self.PALLAS_TILE - 1)
+                              // self.PALLAS_TILE) * self.PALLAS_TILE
+        else:
+            self.num_items = num_items
+        self.num_items_logical = num_items
         self.device = device
+        num_items = self.num_items
         with jax.default_device(device) if device is not None else contextlib.nullcontext():
             self.C = jnp.zeros((num_items, num_items), dtype=jnp.int32)
             self.row_sums = jnp.zeros((num_items,), dtype=jnp.int32)
@@ -127,8 +146,16 @@ class DeviceScorer:
             pad_s = pad_pow2(s, minimum=64)
             rows_padded = np.zeros(pad_s, dtype=np.int32)
             rows_padded[:s] = chunk
-            vals, idx = _score(self.C, self.row_sums, rows_padded,
-                               np.float32(self.observed), top_k=self.top_k)
+            if self.use_pallas:
+                from .pallas_score import pallas_score_topk
+
+                vals, idx = pallas_score_topk(
+                    self.C, self.row_sums, jnp.asarray(rows_padded),
+                    np.float32(self.observed), top_k=self.top_k,
+                    tile=self.PALLAS_TILE, interpret=self._pallas_interpret)
+            else:
+                vals, idx = _score(self.C, self.row_sums, rows_padded,
+                                   np.float32(self.observed), top_k=self.top_k)
             vals = np.asarray(vals[:s])
             idx = np.asarray(idx[:s])
             for r in range(s):
@@ -148,6 +175,11 @@ class DeviceScorer:
         }
 
     def restore_state(self, st: dict) -> None:
+        if st["C"].shape != (self.num_items, self.num_items):
+            raise ValueError(
+                f"checkpoint C shape {st['C'].shape} does not match this "
+                f"scorer's {(self.num_items, self.num_items)} — the pallas "
+                f"setting (vocab padding) must match the checkpointing run")
         self.C = jnp.asarray(st["C"], dtype=jnp.int32)
         self.row_sums = jnp.asarray(st["row_sums"], dtype=jnp.int32)
         self.observed = int(st["observed"][0])

@@ -116,15 +116,20 @@ class HybridScorer:
             hit = idx[exists]
             old = self.g_cnt[hit]
             new = old + d_val[exists]
-            self._zeros += int((new == 0).sum()) - int(((old == 0) & (new != 0)).sum())
+            self._zeros += (int(((old != 0) & (new == 0)).sum())
+                            - int(((old == 0) & (new != 0)).sum()))
             self.g_cnt[hit] = new
             if not exists.all():
                 miss = ~exists
+                # Keys inserted with a net-zero window delta (e.g. +1 then
+                # -1 within one window) are zero entries from birth.
+                self._zeros += int((d_val[miss] == 0).sum())
                 self.g_key = np.insert(self.g_key, idx[miss], d_key[miss])
                 self.g_cnt = np.insert(self.g_cnt, idx[miss], d_val[miss])
         else:
             self.g_key = d_key
             self.g_cnt = d_val
+            self._zeros = int((d_val == 0).sum())
         # Compact lazily once zero entries exceed 10% of storage.
         if self._zeros * 10 > len(self.g_cnt):
             keep = self.g_cnt != 0
