@@ -171,24 +171,41 @@ class CooccurrenceJob:
             # Score on the backend.
             with clock() as score_clock:
                 window_out: WindowTopK = self.scorer.process_window(ts, pairs)
+            # Pipelined backends return the previous window's results;
+            # they expose the count actually dispatched for this window.
             self.step_timer.record(WindowStats(
                 timestamp=ts, events=len(items), pairs=len(pairs),
-                rows_scored=len(window_out),
+                rows_scored=getattr(self.scorer, "last_dispatched_rows",
+                                    len(window_out)),
                 sample_seconds=sample_clock.seconds,
                 score_seconds=score_clock.seconds))
-            for dense_item, top in window_out:
-                ext_item = self.item_vocab.to_external(dense_item)
-                self.latest[ext_item] = [
-                    (self.item_vocab.to_external(j), s) for j, s in top]
-                self.emissions += 1
+            self._absorb(window_out)
             if (self.config.checkpoint_dir
                     and self.config.checkpoint_every_windows > 0
                     and self.windows_fired % self.config.checkpoint_every_windows == 0):
                 self.checkpoint()
+        if final:
+            # Backends with a result pipeline (device) hold the last window's
+            # top-K in flight; drain it.
+            self._absorb(self._flush_scorer())
+
+    def _flush_scorer(self) -> WindowTopK:
+        flush = getattr(self.scorer, "flush", None)
+        return flush() if flush is not None else []
+
+    def _absorb(self, window_out: WindowTopK) -> None:
+        for dense_item, top in window_out:
+            ext_item = self.item_vocab.to_external(dense_item)
+            self.latest[ext_item] = [
+                (self.item_vocab.to_external(j), s) for j, s in top]
+            self.emissions += 1
 
     def checkpoint(self, source=None) -> None:
         from .state import checkpoint as ckpt
 
+        # Results still in the scorer's fetch pipeline belong to already-
+        # processed windows; land them in `latest` before snapshotting.
+        self._absorb(self._flush_scorer())
         ckpt.save(self, self.config.checkpoint_dir, source=source)
 
     def restore(self, source=None) -> None:

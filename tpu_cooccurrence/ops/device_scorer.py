@@ -50,15 +50,30 @@ def pad_pow2(n: int, minimum: int = 256) -> int:
     return size
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
-def _update(C, row_sums, src, dst, delta, num_items: int):
+def _apply_coo(C, row_sums, src, dst, delta, num_items: int):
     C = C.at[src, dst].add(delta)
     rs_delta = jnp.zeros((num_items,), dtype=jnp.int32).at[src].add(delta)
     return C, row_sums + rs_delta
 
 
-@functools.partial(jax.jit, static_argnames=("top_k",))
-def _score(C, row_sums, rows, observed, top_k: int):
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+def _update(C, row_sums, src, dst, delta, num_items: int):
+    return _apply_coo(C, row_sums, src, dst, delta, num_items)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+def _update_coo(C, row_sums, coo, num_items: int):
+    """Scatter-apply a packed ``[3, N]`` (src, dst, delta) COO block.
+
+    Packing the three arrays into one host buffer costs one host->device
+    transfer instead of three — the tunneled single-chip link is
+    latency-bound, so transfer count matters as much as bytes.
+    """
+    return _apply_coo(C, row_sums, coo[0], coo[1], coo[2], num_items)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "packed"))
+def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
     counts = C[rows]  # [S, I] int32
     k11 = counts.astype(jnp.float32)
     rs = row_sums.astype(jnp.float32)
@@ -70,6 +85,9 @@ def _score(C, row_sums, rows, observed, top_k: int):
     scores = llr_stable(k11, k12, k21, k22)
     scores = jnp.where(counts != 0, scores, -jnp.inf)
     vals, idx = jax.lax.top_k(scores, top_k)
+    if packed:
+        # One fused [2, S, K] float32 result => a single device->host fetch.
+        return jnp.stack([vals, jax.lax.bitcast_convert_type(idx, jnp.float32)])
     return vals, idx
 
 
@@ -80,13 +98,16 @@ class DeviceScorer:
 
     def __init__(self, num_items: int, top_k: int,
                  counters: Optional[Counters] = None,
-                 max_score_rows_per_call: int = 1024,
+                 max_score_rows_per_call: int = 8192,
                  max_pairs_per_step: int = 1 << 20,
                  use_pallas: str = "auto",
                  device=None) -> None:
+        from ..xla_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         self.top_k = top_k
         self.counters = counters if counters is not None else Counters()
-        self.max_score_rows = max_score_rows_per_call
+        self._max_score_rows_cap = max_score_rows_per_call
         self.max_pairs_per_step = max_pairs_per_step
         if use_pallas == "auto":
             # The fused kernel targets TPU; in interpret mode on CPU it
@@ -104,34 +125,43 @@ class DeviceScorer:
         else:
             self.num_items = num_items
         self.num_items_logical = num_items
+        # Cap each score call's [S, I] working set (gathered counts / score
+        # matrix) to ~512 MB so vocab-ceiling configurations don't OOM; the
+        # result-fetch pipeline hides the extra per-chunk round trips.
+        budget_rows = max(64, (1 << 27) // max(self.num_items, 1))
+        self.max_score_rows = min(self._max_score_rows_cap,
+                                  1 << (budget_rows.bit_length() - 1))
         self.device = device
         num_items = self.num_items
         with jax.default_device(device) if device is not None else contextlib.nullcontext():
             self.C = jnp.zeros((num_items, num_items), dtype=jnp.int32)
             self.row_sums = jnp.zeros((num_items,), dtype=jnp.int32)
         self.observed = 0  # exact, host-side (int), fed to kernels as f32
+        # Result pipeline: window results are fetched one window late so the
+        # device->host copy (latency-bound on a tunneled chip) overlaps the
+        # next window's host sampling and device dispatch. ``flush()``
+        # returns the final in-flight window.
+        self._pending: Optional[List] = None
+        self.last_dispatched_rows = 0
 
     def process_window(self, ts: int, pairs: PairDeltaBatch
                        ) -> List[Tuple[int, List[Tuple[int, float]]]]:
+        self.last_dispatched_rows = 0
         if len(pairs) == 0:
             return []
         # Bounded COO buckets: chunk to max_pairs_per_step, pad each chunk to
         # a power of two (recompile guard, SURVEY §7 "dynamic shapes").
-        # Padding slots scatter delta 0 at (0, 0) — a no-op.
+        # Padding slots scatter delta 0 at (0, 0) — a no-op. The chunk ships
+        # as one packed [3, N] buffer (one transfer, not three).
         for lo in range(0, len(pairs), self.max_pairs_per_step):
-            s_chunk = pairs.src[lo: lo + self.max_pairs_per_step]
-            d_chunk = pairs.dst[lo: lo + self.max_pairs_per_step]
-            v_chunk = pairs.delta[lo: lo + self.max_pairs_per_step]
-            n = len(s_chunk)
-            pad = pad_pow2(n)
-            src = np.zeros(pad, dtype=np.int32)
-            dst = np.zeros(pad, dtype=np.int32)
-            delta = np.zeros(pad, dtype=np.int32)
-            src[:n] = s_chunk
-            dst[:n] = d_chunk
-            delta[:n] = v_chunk
-            self.C, self.row_sums = _update(
-                self.C, self.row_sums, src, dst, delta, num_items=self.num_items)
+            n = min(len(pairs) - lo, self.max_pairs_per_step)
+            pad = pad_pow2(n, minimum=1 << 14)
+            coo = np.zeros((3, pad), dtype=np.int32)
+            coo[0, :n] = pairs.src[lo: lo + n]
+            coo[1, :n] = pairs.dst[lo: lo + n]
+            coo[2, :n] = pairs.delta[lo: lo + n]
+            self.C, self.row_sums = _update_coo(
+                self.C, self.row_sums, coo, num_items=self.num_items)
 
         window_sum = int(pairs.delta.sum())
         self.observed += window_sum
@@ -139,25 +169,44 @@ class DeviceScorer:
 
         rows = np.unique(pairs.src).astype(np.int32)
         self.counters.add(RESCORED_ITEMS, len(rows))
-        out: List[Tuple[int, List[Tuple[int, float]]]] = []
+        self.last_dispatched_rows = len(rows)
+        chunks: List[Tuple[np.ndarray, int, object]] = []
         for lo in range(0, len(rows), self.max_score_rows):
             chunk = rows[lo: lo + self.max_score_rows]
             s = len(chunk)
-            pad_s = pad_pow2(s, minimum=64)
+            pad_s = min(pad_pow2(s, minimum=64), self.max_score_rows)
             rows_padded = np.zeros(pad_s, dtype=np.int32)
             rows_padded[:s] = chunk
             if self.use_pallas:
                 from .pallas_score import pallas_score_topk
 
-                vals, idx = pallas_score_topk(
+                packed = pallas_score_topk(
                     self.C, self.row_sums, jnp.asarray(rows_padded),
                     np.float32(self.observed), top_k=self.top_k,
-                    tile=self.PALLAS_TILE, interpret=self._pallas_interpret)
+                    tile=self.PALLAS_TILE, interpret=self._pallas_interpret,
+                    packed=True)
             else:
-                vals, idx = _score(self.C, self.row_sums, rows_padded,
-                                   np.float32(self.observed), top_k=self.top_k)
-            vals = np.asarray(vals[:s])
-            idx = np.asarray(idx[:s])
+                packed = _score(self.C, self.row_sums, rows_padded,
+                                np.float32(self.observed), top_k=self.top_k,
+                                packed=True)
+            if hasattr(packed, "copy_to_host_async"):
+                packed.copy_to_host_async()
+            chunks.append((chunk, s, packed))
+        prev, self._pending = self._pending, chunks
+        return self._materialize(prev) if prev is not None else []
+
+    def flush(self) -> List[Tuple[int, List[Tuple[int, float]]]]:
+        """Emit the final in-flight window's results (end of pipeline)."""
+        prev, self._pending = self._pending, None
+        return self._materialize(prev) if prev is not None else []
+
+    @staticmethod
+    def _materialize(chunks) -> List[Tuple[int, List[Tuple[int, float]]]]:
+        out: List[Tuple[int, List[Tuple[int, float]]]] = []
+        for chunk, s, packed in chunks:
+            host = np.asarray(packed)  # single [2, S, K] fetch
+            vals = host[0, :s]
+            idx = host[1, :s].view(np.int32)
             for r in range(s):
                 keep = np.isfinite(vals[r])
                 out.append((int(chunk[r]),
@@ -183,3 +232,6 @@ class DeviceScorer:
         self.C = jnp.asarray(st["C"], dtype=jnp.int32)
         self.row_sums = jnp.asarray(st["row_sums"], dtype=jnp.int32)
         self.observed = int(st["observed"][0])
+        # In-flight results belong to windows after the checkpoint; a
+        # restore that rolls back must not emit them.
+        self._pending = None

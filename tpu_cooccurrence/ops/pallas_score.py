@@ -4,15 +4,21 @@ The XLA path (``ops/device_scorer._score``) materializes a ``[S, I]`` float32
 score matrix in HBM and then runs ``lax.top_k`` over it — two full passes of
 HBM traffic over data that is consumed once. This kernel fuses the whole of
 hot loop 4 (SURVEY §3.4: contingency build + LLR + top-K selection): for
-each scored row it streams column tiles of the count matrix through VMEM,
-computes the stable-form LLR on the VPU, and folds each tile into a running
-top-K scratch without ever writing scores back to HBM.
+each block of scored rows it streams column tiles of the gathered count
+rows through VMEM, computes the stable-form LLR on the VPU, and folds each
+tile into a running top-K scratch without ever writing scores back to HBM.
 
-Rows are selected by scalar-prefetch indexing (the block index map reads the
-row id array), so the kernel also subsumes the row gather.
+The row gather ``C[rows]`` happens in XLA before the kernel and does
+materialize an ``[S, I]`` int32 buffer in HBM (TPU block layout requires
+sublane-aligned blocks, so arbitrary single-row blocks can't be indexed
+from inside the kernel). What the fusion removes versus the XLA path is
+the float32 score matrix write plus ``top_k``'s separate full re-read of
+it; the caller additionally bounds ``S`` so the gathered buffer stays
+within a fixed HBM budget (``DeviceScorer.max_score_rows``).
 
-Grid: ``(S, I // TILE)``; the running top-K lives in VMEM scratch that
-persists across the column-tile dimension (sequential grid execution),
+Grid: ``(S // 8, I // TILE)`` with 8 rows per block (the int32 sublane
+tile). The running top-K lives in VMEM scratch that persists across the
+column-tile dimension (sequential grid execution, innermost-last order),
 initialized at ``j == 0`` and written to the output block at the last tile.
 
 Tie-breaking matches ``lax.top_k`` (lowest column index among equal scores):
@@ -31,71 +37,81 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .llr import llr_stable
 
-_K_PAD = 128  # output lane width; logical top_k occupies the first K lanes
+_K_PAD = 128     # output lane width; logical top_k occupies the first K lanes
+_ROW_BLOCK = 8   # rows per grid step — the int32 sublane tile
 
 
-def _score_topk_kernel(rows_ref, c_ref, rsj_ref, rsi_ref, obs_ref,
+def _score_topk_kernel(g_ref, rsj_ref, rsi_ref, obs_ref,
                        vals_ref, idx_ref, run_vals, run_idx, *, top_k, tile):
     j = pl.program_id(1)
     n_j = pl.num_programs(1)
+    R = _ROW_BLOCK
 
     @pl.when(j == 0)
     def _init():
-        run_vals[:] = jnp.full((1, _K_PAD), -jnp.inf, dtype=jnp.float32)
-        run_idx[:] = jnp.zeros((1, _K_PAD), dtype=jnp.int32)
+        run_vals[...] = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
+        run_idx[...] = jnp.zeros((R, _K_PAD), dtype=jnp.int32)
 
-    k11 = c_ref[0, :].astype(jnp.float32)[None, :]          # [1, TILE]
+    counts = g_ref[...]                                     # [R, TILE] int32
+    k11 = counts.astype(jnp.float32)
     rsj = rsj_ref[0, :].astype(jnp.float32)[None, :]        # [1, TILE]
-    rsi = rsi_ref[0, 0].astype(jnp.float32)
+    rsi = rsi_ref[...].astype(jnp.float32)                  # [R, 1]
     observed = obs_ref[0, 0].astype(jnp.float32)
 
     k12 = rsi - k11
     k21 = rsj - k11
     k22 = observed + k11 - k12 - k21
     scores = llr_stable(k11, k12, k21, k22)
-    scores = jnp.where(k11 != 0, scores, -jnp.inf)
+    scores = jnp.where(counts != 0, scores, -jnp.inf)       # [R, TILE]
 
     col_base = j * tile
     cols = (col_base
-            + jax.lax.broadcasted_iota(jnp.int32, (1, tile), dimension=1))
+            + jax.lax.broadcasted_iota(jnp.int32, (R, tile), dimension=1))
 
-    # Candidates: running top-K (positions 0.._K_PAD) then this tile.
-    cand_vals = jnp.concatenate([run_vals[:], scores], axis=1)
-    cand_idx = jnp.concatenate([run_idx[:], cols], axis=1)
+    # Candidates: running top-K (positions 0.._K_PAD-1) then this tile.
+    cand_vals = jnp.concatenate([run_vals[...], scores], axis=1)
+    cand_idx = jnp.concatenate([run_idx[...], cols], axis=1)
     width = _K_PAD + tile
-    positions = jax.lax.broadcasted_iota(jnp.int32, (1, width), dimension=1)
+    positions = jax.lax.broadcasted_iota(jnp.int32, (R, width), dimension=1)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (R, _K_PAD), dimension=1)
 
-    new_vals = jnp.full((1, _K_PAD), -jnp.inf, dtype=jnp.float32)
-    new_idx = jnp.zeros((1, _K_PAD), dtype=jnp.int32)
+    new_vals = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
+    new_idx = jnp.zeros((R, _K_PAD), dtype=jnp.int32)
     for k in range(top_k):  # static unroll; top_k is small
-        m = jnp.max(cand_vals)
-        pos = jnp.min(jnp.where(cand_vals == m, positions, width))
-        sel = positions == pos
-        chosen_idx = jnp.max(jnp.where(sel, cand_idx, 0))
-        new_vals = new_vals.at[0, k].set(m)
-        new_idx = new_idx.at[0, k].set(chosen_idx)
+        m = jnp.max(cand_vals, axis=1, keepdims=True)                 # [R, 1]
+        pos = jnp.min(jnp.where(cand_vals == m, positions, width),
+                      axis=1, keepdims=True)                          # [R, 1]
+        sel = positions == pos                                        # [R, W]
+        chosen = jnp.max(jnp.where(sel, cand_idx, 0),
+                         axis=1, keepdims=True)                       # [R, 1]
+        lane_k = lanes == k
+        new_vals = jnp.where(lane_k, m, new_vals)
+        new_idx = jnp.where(lane_k, chosen, new_idx)
         cand_vals = jnp.where(sel, -jnp.inf, cand_vals)
 
-    run_vals[:] = new_vals
-    run_idx[:] = new_idx
+    run_vals[...] = new_vals
+    run_idx[...] = new_idx
 
     @pl.when(j == n_j - 1)
     def _emit():
-        vals_ref[:] = run_vals[:]
-        idx_ref[:] = run_idx[:]
+        vals_ref[...] = run_vals[...]
+        idx_ref[...] = run_idx[...]
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("top_k", "tile", "interpret"))
+                   static_argnames=("top_k", "tile", "interpret", "packed"))
 def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,
-                      tile: int = 512, interpret: bool = False):
-    """Fused row-gather + LLR + top-K. Mirrors ``device_scorer._score``.
+                      tile: int = 512, interpret: bool = False,
+                      packed: bool = False):
+    """Fused LLR + top-K over gathered rows. Mirrors ``device_scorer._score``.
 
     C        [I, I] int32 — dense co-occurrence counts (I % tile == 0)
     row_sums [I]    int32
     rows     [S]    int32 — row ids to score (padded rows allowed)
     observed scalar float32
-    Returns (vals [S, top_k] f32, idx [S, top_k] i32), scores descending.
+    Returns (vals [S, top_k] f32, idx [S, top_k] i32), scores descending;
+    with ``packed=True`` a single [2, S, top_k] float32 (idx bitcast) so the
+    caller fetches one buffer.
     """
     num_items = C.shape[0]
     if num_items % tile != 0:
@@ -105,36 +121,41 @@ def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,
             f"top_k {top_k} exceeds the kernel's lane width {_K_PAD}; "
             f"use the XLA scorer (pallas='off') for larger K")
     S = rows.shape[0]
-    rsi = row_sums[rows].reshape(S, 1)
+    pad_s = (-S) % _ROW_BLOCK
+    if pad_s:
+        rows = jnp.concatenate([rows, jnp.zeros(pad_s, dtype=rows.dtype)])
+    sp = S + pad_s
+    gathered = C[rows]                                   # [Sp, I] int32
+    rsi = row_sums[rows].reshape(sp, 1)
     rs2d = row_sums.reshape(1, num_items)
     obs = jnp.full((1, 1), observed, dtype=jnp.float32)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(S, num_items // tile),
-        in_specs=[
-            pl.BlockSpec((1, tile), lambda i, j, s: (s[i], j)),
-            pl.BlockSpec((1, tile), lambda i, j, s: (0, j)),
-            pl.BlockSpec((1, 1), lambda i, j, s: (i, 0)),
-            pl.BlockSpec((1, 1), lambda i, j, s: (0, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, _K_PAD), lambda i, j, s: (i, 0)),
-            pl.BlockSpec((1, _K_PAD), lambda i, j, s: (i, 0)),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((1, _K_PAD), jnp.float32),
-            pltpu.VMEM((1, _K_PAD), jnp.int32),
-        ],
-    )
     kernel = functools.partial(_score_topk_kernel, top_k=top_k, tile=tile)
     vals, idx = pl.pallas_call(
         kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((S, _K_PAD), jnp.float32),
-            jax.ShapeDtypeStruct((S, _K_PAD), jnp.int32),
+        grid=(sp // _ROW_BLOCK, num_items // tile),
+        in_specs=[
+            pl.BlockSpec((_ROW_BLOCK, tile), lambda i, j: (i, j)),
+            pl.BlockSpec((1, tile), lambda i, j: (0, j)),
+            pl.BlockSpec((_ROW_BLOCK, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((_ROW_BLOCK, _K_PAD), lambda i, j: (i, 0)),
+            pl.BlockSpec((_ROW_BLOCK, _K_PAD), lambda i, j: (i, 0)),
         ),
-        grid_spec=grid_spec,
+        scratch_shapes=[
+            pltpu.VMEM((_ROW_BLOCK, _K_PAD), jnp.float32),
+            pltpu.VMEM((_ROW_BLOCK, _K_PAD), jnp.int32),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
+            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.int32),
+        ),
         interpret=interpret,
-    )(rows, C, rs2d, rsi, obs)
-    return vals[:, :top_k], idx[:, :top_k]
+    )(gathered, rs2d, rsi, obs)
+    vals = vals[:S, :top_k]
+    idx = idx[:S, :top_k]
+    if packed:
+        return jnp.stack([vals, jax.lax.bitcast_convert_type(idx, jnp.float32)])
+    return vals, idx

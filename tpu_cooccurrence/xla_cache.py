@@ -1,0 +1,50 @@
+"""Persistent XLA compilation cache.
+
+The tunneled single-chip environment pays ~1s per executable compile and
+the framework's bucketed shapes produce a bounded but non-trivial set of
+programs; caching compiled executables on disk removes that cost from every
+run after the first (and from every window after the first in a run).
+
+Default location is repo-local (``.xla_cache/`` next to the package) so no
+paths outside the repository are touched; override with
+``TPU_COOC_COMPILE_CACHE`` (empty string disables).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+LOG = logging.getLogger("tpu_cooccurrence")
+
+_enabled = False
+
+
+def enable_compilation_cache() -> None:
+    """Idempotently point JAX's persistent compilation cache at disk."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+    path = os.environ.get("TPU_COOC_COMPILE_CACHE")
+    if path == "":
+        return
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir:
+            return  # the embedding application already configured one
+        if path is None:
+            repo = os.path.dirname(os.path.dirname(__file__))
+            if os.path.isdir(os.path.join(repo, ".git")):
+                path = os.path.join(repo, ".xla_cache")  # dev checkout
+            else:
+                path = os.path.join(
+                    os.path.expanduser("~"), ".cache", "tpu_cooccurrence",
+                    "xla")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as exc:  # pragma: no cover - version-dependent flags
+        LOG.info("persistent compilation cache unavailable: %s", exc)
