@@ -1,0 +1,73 @@
+"""Multi-host layer (single-process semantics on the virtual CPU mesh) and
+result-pipeline contracts of the pipelined backends."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from tpu_cooccurrence.parallel.distributed import (
+    init_multihost, make_multihost_mesh, put_global)
+from tpu_cooccurrence.parallel.mesh import ITEM_AXIS
+from tpu_cooccurrence.parallel.sharded import ShardedScorer
+from tpu_cooccurrence.ops.device_scorer import DeviceScorer
+from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
+
+
+def _pairs(src, dst, delta):
+    return PairDeltaBatch(np.asarray(src, np.int64), np.asarray(dst, np.int64),
+                          np.asarray(delta, np.int64))
+
+
+def test_make_multihost_mesh_covers_all_devices():
+    mesh = make_multihost_mesh()
+    assert mesh.axis_names == (ITEM_AXIS,)
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_init_multihost_standalone_noop():
+    init_multihost()  # no coordinator: must not raise or hang
+    assert jax.process_count() == 1
+
+
+def test_put_global_sharded_and_replicated():
+    mesh = make_multihost_mesh()
+    n = mesh.devices.size
+    arr = np.arange(n * 4, dtype=np.int32).reshape(n, 4)
+    g = put_global(arr, mesh, P(ITEM_AXIS))
+    np.testing.assert_array_equal(np.asarray(g), arr)
+    assert len(g.addressable_shards) == n
+    for shard in g.addressable_shards:
+        d = shard.index[0].start or 0
+        np.testing.assert_array_equal(np.asarray(shard.data), arr[d:d + 1])
+    r = put_global(np.arange(5, dtype=np.int32), mesh, P())
+    np.testing.assert_array_equal(np.asarray(r), np.arange(5))
+
+
+@pytest.mark.parametrize("scorer_cls", ["sharded", "device"])
+def test_result_pipeline_lags_one_window_and_flushes(scorer_cls):
+    if scorer_cls == "sharded":
+        scorer = ShardedScorer(16, 5, num_shards=4)
+    else:
+        scorer = DeviceScorer(16, 5, use_pallas="off")
+    w1 = scorer.process_window(0, _pairs([1, 2], [2, 1], [1, 1]))
+    assert w1 == []  # first window's results are still in flight
+    assert scorer.last_dispatched_rows == 2
+    w2 = scorer.process_window(1, _pairs([3], [4], [1]))
+    assert sorted(item for item, _ in w1 + w2) == [1, 2]  # window-1 results
+    tail = scorer.flush()
+    assert [item for item, _ in tail] == [3]
+    assert scorer.flush() == []  # idempotent once drained
+
+
+@pytest.mark.parametrize("scorer_cls", ["sharded", "device"])
+def test_restore_clears_pending(scorer_cls):
+    if scorer_cls == "sharded":
+        scorer = ShardedScorer(16, 5, num_shards=4)
+    else:
+        scorer = DeviceScorer(16, 5, use_pallas="off")
+    snap = scorer.checkpoint_state()
+    scorer.process_window(0, _pairs([1, 2], [2, 1], [1, 1]))
+    scorer.restore_state(snap)
+    assert scorer.flush() == []  # rolled-back results must not surface

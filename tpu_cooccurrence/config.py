@@ -93,12 +93,28 @@ class Config:
     pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = TPU only)
     development_mode: bool = False  # invariant checks (FlinkCooccurrences.java:34)
     process_continuously: bool = False  # PROCESS_ONCE vs PROCESS_CONTINUOUSLY
+    # Multi-host (multi-controller JAX): run one process per host, each
+    # consuming the same input stream; state shards over all hosts' chips
+    # and each process emits the rows its chips own (parallel/distributed.py).
+    coordinator: Optional[str] = None  # host:port of process 0
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
     def __post_init__(self):
         if self.seed is None:
             self.seed = time.time_ns()  # reference: System.nanoTime()
         if self.top_k <= 0:
             raise ValueError(f"{self.top_k} is <= 0")
+        multihost = (self.coordinator, self.num_processes, self.process_id)
+        if any(v is not None for v in multihost):
+            if any(v is None for v in multihost):
+                raise ValueError(
+                    "multi-host needs all of --coordinator, --num-processes "
+                    "and --process-id (or none of them)")
+            if not (0 <= self.process_id < self.num_processes):
+                raise ValueError(
+                    f"--process-id {self.process_id} out of range for "
+                    f"--num-processes {self.num_processes}")
 
     @property
     def window_millis(self) -> int:
@@ -171,6 +187,12 @@ class Config:
         p.add_argument("--development-mode", action="store_true", dest="development_mode")
         p.add_argument("--process-continuously", action="store_true",
                        dest="process_continuously")
+        p.add_argument("--coordinator", default=None,
+                       help="Multi-host: host:port of process 0")
+        p.add_argument("--num-processes", type=int, default=None,
+                       dest="num_processes", help="Multi-host: process count")
+        p.add_argument("--process-id", type=int, default=None,
+                       dest="process_id", help="Multi-host: this process's id")
         ns = p.parse_args(argv)
         return cls(**vars(ns))
 

@@ -105,9 +105,18 @@ class CooccurrenceJob:
             if num_items <= 0:
                 raise ValueError(
                     "sharded backend needs --num-items (dense vocab capacity)")
+            mesh = None
+            if self.config.coordinator is not None:
+                from .parallel.distributed import (init_multihost,
+                                                   make_multihost_mesh)
+
+                init_multihost(self.config.coordinator,
+                               self.config.num_processes,
+                               self.config.process_id)
+                mesh = make_multihost_mesh()
             return ShardedScorer(num_items, self.config.top_k,
                                  num_shards=self.config.num_shards,
-                                 counters=self.counters)
+                                 counters=self.counters, mesh=mesh)
         raise ValueError(f"unknown backend {backend}")
 
     # ------------------------------------------------------------------

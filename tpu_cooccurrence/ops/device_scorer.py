@@ -50,6 +50,12 @@ def pad_pow2(n: int, minimum: int = 256) -> int:
     return size
 
 
+def score_row_budget(num_items: int, cap: int) -> int:
+    """Rows per score call keeping the [S, I] working set ≲ 512 MB int32."""
+    budget_rows = max(64, (1 << 27) // max(num_items, 1))
+    return min(cap, 1 << (budget_rows.bit_length() - 1))
+
+
 def _apply_coo(C, row_sums, src, dst, delta, num_items: int):
     C = C.at[src, dst].add(delta)
     rs_delta = jnp.zeros((num_items,), dtype=jnp.int32).at[src].add(delta)
@@ -125,12 +131,11 @@ class DeviceScorer:
         else:
             self.num_items = num_items
         self.num_items_logical = num_items
-        # Cap each score call's [S, I] working set (gathered counts / score
-        # matrix) to ~512 MB so vocab-ceiling configurations don't OOM; the
-        # result-fetch pipeline hides the extra per-chunk round trips.
-        budget_rows = max(64, (1 << 27) // max(self.num_items, 1))
-        self.max_score_rows = min(self._max_score_rows_cap,
-                                  1 << (budget_rows.bit_length() - 1))
+        # Bound each score call's [S, I] working set so vocab-ceiling
+        # configurations don't OOM; the result-fetch pipeline hides the
+        # extra per-chunk round trips.
+        self.max_score_rows = score_row_budget(self.num_items,
+                                               self._max_score_rows_cap)
         self.device = device
         num_items = self.num_items
         with jax.default_device(device) if device is not None else contextlib.nullcontext():
@@ -148,7 +153,9 @@ class DeviceScorer:
                        ) -> List[Tuple[int, List[Tuple[int, float]]]]:
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
-            return []
+            # No new dispatch this window — drain any completed in-flight
+            # results now instead of withholding them behind idle windows.
+            return self.flush()
         # Bounded COO buckets: chunk to max_pairs_per_step, pad each chunk to
         # a power of two (recompile guard, SURVEY §7 "dynamic shapes").
         # Padding slots scatter delta 0 at (0, 0) — a no-op. The chunk ships

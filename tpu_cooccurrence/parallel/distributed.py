@@ -1,0 +1,100 @@
+"""Multi-host (multi-controller) distribution layer.
+
+The reference scales beyond one process with Flink's JobManager/TaskManager
+runtime and its Netty shuffle (SURVEY §2.6). The TPU-native equivalent is
+JAX's multi-controller runtime: one Python process per host, each driving
+its local chips, with collectives riding ICI within a host/pod slice and
+DCN across slices. This module owns that boundary:
+
+  * ``init_multihost()`` — wraps ``jax.distributed.initialize`` (no-op when
+    single-process; auto-detects coordinator on TPU pods).
+  * ``make_multihost_mesh()`` — a 1-D ``items`` mesh over ALL chips of all
+    hosts, built DCN-aware (hosts major) so XLA lowers ``psum`` over the
+    item axis into a hierarchical ICI-reduce + DCN-exchange instead of a
+    flat ring over DCN.
+  * ``put_global(arr, mesh, spec)`` — turn a host-replicated NumPy array
+    into a global sharded device array. Every process must call it with the
+    same values (the framework's ingest is deterministic, so replaying the
+    same stream on each host satisfies this — the analogue of the
+    reference's deterministic keyed partitioning of one logical stream).
+
+Result extraction stays process-local: each host materializes only the
+top-K blocks of rows its chips own (``Array.addressable_shards``), exactly
+like a Flink subtask emitting only its key partition.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import ITEM_AXIS
+
+LOG = logging.getLogger("tpu_cooccurrence")
+
+_initialized = False
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """Join the multi-controller runtime (idempotent; no-op standalone).
+
+    On TPU pods all three arguments are auto-detected from the metadata
+    server; on other fabrics pass them explicitly (the coordinator is
+    process 0 at ``host:port``).
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes is None:
+        # Standalone run (or TPU-pod autodetection handled by the runtime
+        # when env vars are present) — nothing to do.
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    LOG.info("multihost: process %d/%d, %d local / %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def make_multihost_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D ``items`` mesh over all chips of all hosts, DCN-aware.
+
+    Device order is hosts-major (all of host 0's chips, then host 1's, …)
+    so that contiguous item-row shards live within a host and the item-axis
+    ``psum`` decomposes into intra-host ICI reductions plus one inter-host
+    DCN exchange.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if jax.process_count() > 1:
+        devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    return Mesh(np.asarray(devices), (ITEM_AXIS,))
+
+
+def put_global(arr: np.ndarray, mesh: Mesh, spec: PartitionSpec):
+    """Host-replicated array -> global sharded device array.
+
+    Single-process this is ``device_put``; multi-controller it assembles a
+    global ``jax.Array`` where each process supplies only the shards its
+    devices own (the callback is invoked per addressable shard).
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        np.shape(arr), sharding, lambda idx: np.asarray(arr[idx]))

@@ -26,7 +26,6 @@ Works identically on a virtual CPU mesh
 
 from __future__ import annotations
 
-import functools
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -37,11 +36,11 @@ try:
     from jax import shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..ops.llr import llr_stable
-from ..ops.device_scorer import pad_pow2
+from ..ops.device_scorer import pad_pow2, score_row_budget
 from ..sampling.reservoir import PairDeltaBatch
 from .mesh import ITEM_AXIS, make_mesh, pad_to_multiple
 
@@ -52,7 +51,10 @@ class ShardedScorer:
     def __init__(self, num_items: int, top_k: int, num_shards: Optional[int] = None,
                  counters: Optional[Counters] = None,
                  mesh: Optional[Mesh] = None,
-                 max_score_rows_per_call: int = 1024) -> None:
+                 max_score_rows_per_call: int = 8192) -> None:
+        from ..xla_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         self.n_shards = self.mesh.devices.size
         self.num_items_logical = num_items
@@ -60,24 +62,35 @@ class ShardedScorer:
         self.rows_per_shard = self.num_items // self.n_shards
         self.top_k = top_k
         self.counters = counters if counters is not None else Counters()
-        self.max_score_rows = max_score_rows_per_call
+        # Bound each shard's per-call [S, I] score working set.
+        self.max_score_rows = score_row_budget(self.num_items,
+                                               max_score_rows_per_call)
         self.observed = 0  # exact host-side total
+        # One-window-deep result pipeline (see ops/device_scorer.py): the
+        # device->host fetch of window N's top-K overlaps window N+1's host
+        # sampling and dispatch; ``flush()`` drains the tail.
+        self._pending: Optional[List] = None
+        self.last_dispatched_rows = 0
 
-        c_sharding = NamedSharding(self.mesh, P(ITEM_AXIS, None))
-        rep = NamedSharding(self.mesh, P())
-        self.C = jax.device_put(
-            jnp.zeros((self.num_items, self.num_items), dtype=jnp.int32), c_sharding)
-        self.row_sums = jax.device_put(
-            jnp.zeros((self.num_items,), dtype=jnp.int32), rep)
+        from .distributed import put_global
+
+        self._put_global = put_global
+        self.C = put_global(
+            np.zeros((self.num_items, self.num_items), dtype=np.int32),
+            self.mesh, P(ITEM_AXIS, None))
+        self.row_sums = put_global(
+            np.zeros((self.num_items,), dtype=np.int32), self.mesh, P())
 
         num_items_c = self.num_items
         rows_per_shard_c = self.rows_per_shard
 
-        def _update(C_loc, row_sums, src, dst, delta):
-            # Per-shard slices arrive already owner-partitioned; localize rows.
+        def _update(C_loc, row_sums, coo):
+            # Per-shard [1, 3, P] slices arrive owner-partitioned (one packed
+            # buffer = one host->device transfer); localize rows.
+            src, dst, delta = coo[0, 0], coo[0, 1], coo[0, 2]
             lo = jax.lax.axis_index(ITEM_AXIS) * rows_per_shard_c
-            C_loc = C_loc.at[src[0] - lo, dst[0]].add(delta[0])
-            rs_part = jnp.zeros((num_items_c,), dtype=jnp.int32).at[src[0]].add(delta[0])
+            C_loc = C_loc.at[src - lo, dst].add(delta)
+            rs_part = jnp.zeros((num_items_c,), dtype=jnp.int32).at[src].add(delta)
             row_sums = row_sums + jax.lax.psum(rs_part, ITEM_AXIS)
             return C_loc, row_sums
 
@@ -94,17 +107,19 @@ class ShardedScorer:
             scores = llr_stable(k11, k12, k21, k22)
             scores = jnp.where(counts != 0, scores, -jnp.inf)
             vals, idx = jax.lax.top_k(scores, top_k)
-            return vals[None], idx[None]
+            # Pack per shard into [1, 2, S, K] f32 => one fetchable buffer.
+            return jnp.stack(
+                [vals, jax.lax.bitcast_convert_type(idx, jnp.float32)])[None]
 
         self._update = jax.jit(shard_map(
             _update, mesh=self.mesh,
-            in_specs=(P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P(ITEM_AXIS), P(ITEM_AXIS)),
+            in_specs=(P(ITEM_AXIS, None), P(), P(ITEM_AXIS)),
             out_specs=(P(ITEM_AXIS, None), P()),
         ), donate_argnums=(0, 1))
         self._score = jax.jit(shard_map(
             _score, mesh=self.mesh,
             in_specs=(P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
-            out_specs=(P(ITEM_AXIS), P(ITEM_AXIS)),
+            out_specs=P(ITEM_AXIS),
         ))
 
     # ------------------------------------------------------------------
@@ -129,15 +144,19 @@ class ShardedScorer:
 
     def process_window(self, ts: int, pairs: PairDeltaBatch
                        ) -> List[Tuple[int, List[Tuple[int, float]]]]:
+        self.last_dispatched_rows = 0
         if len(pairs) == 0:
-            return []
+            # No new dispatch this window — drain any completed in-flight
+            # results now instead of withholding them behind idle windows.
+            return self.flush()
         src = pairs.src.astype(np.int32)
         dst = pairs.dst.astype(np.int32)
         delta = pairs.delta.astype(np.int32)
         owners = (src // self.rows_per_shard).astype(np.int64)
 
         # Owner-partitioned [D, P] blocks; padding rows point at each shard's
-        # first owned row with delta 0 (scatter no-op).
+        # first owned row with delta 0 (scatter no-op). The three blocks ship
+        # as one packed [D, 3, P] buffer (one transfer).
         shard_first_row = (np.arange(self.n_shards, dtype=np.int32)
                            * self.rows_per_shard)
         src_b, _ = self._partition_by_owner(src, owners, 256, shard_first_row)
@@ -145,9 +164,10 @@ class ShardedScorer:
                                             np.zeros(self.n_shards, np.int32))
         delta_b, _ = self._partition_by_owner(delta, owners, 256,
                                               np.zeros(self.n_shards, np.int32))
+        coo_b = self._put_global(np.stack([src_b, dst_b, delta_b], axis=1),
+                                 self.mesh, P(ITEM_AXIS))
 
-        self.C, self.row_sums = self._update(self.C, self.row_sums,
-                                             src_b, dst_b, delta_b)
+        self.C, self.row_sums = self._update(self.C, self.row_sums, coo_b)
 
         window_sum = int(pairs.delta.sum())
         self.observed += window_sum
@@ -155,27 +175,76 @@ class ShardedScorer:
 
         rows = np.unique(pairs.src).astype(np.int32)
         self.counters.add(RESCORED_ITEMS, len(rows))
+        self.last_dispatched_rows = len(rows)
         row_owners = (rows // self.rows_per_shard).astype(np.int64)
         rows_b, row_counts = self._partition_by_owner(
             rows, row_owners, 64, shard_first_row)
 
+        # Chunk the padded per-shard row dimension to the HBM budget (both
+        # are powers of two, so every chunk is shape-stable).
+        chunks: List[Tuple[int, np.ndarray, object]] = []
+        for lo in range(0, rows_b.shape[1], self.max_score_rows):
+            rb = np.ascontiguousarray(rows_b[:, lo: lo + self.max_score_rows])
+            rb_g = self._put_global(rb, self.mesh, P(ITEM_AXIS))
+            packed = self._score(self.C, self.row_sums, rb_g,
+                                 np.float32(self.observed))
+            if hasattr(packed, "copy_to_host_async"):
+                packed.copy_to_host_async()
+            chunks.append((lo, rb, packed))
+        prev, self._pending = self._pending, (row_counts, chunks)
+        return self._materialize(prev) if prev is not None else []
+
+    def flush(self) -> List[Tuple[int, List[Tuple[int, float]]]]:
+        """Emit the final in-flight window's results (end of pipeline)."""
+        prev, self._pending = self._pending, None
+        return self._materialize(prev) if prev is not None else []
+
+    def _materialize(self, pending) -> List[Tuple[int, List[Tuple[int, float]]]]:
+        """Fetch in-flight [D, 2, S, K] blocks and build (row, top-K) lists.
+
+        Iterates *addressable* shards only: single-process that is all of
+        them; multi-host each process emits exactly the rows its chips own
+        (the analogue of a Flink subtask emitting its key partition).
+        """
+        row_counts, chunks = pending
         out: List[Tuple[int, List[Tuple[int, float]]]] = []
-        # Chunk the padded column dimension if enormous; typical windows fit.
-        vals, idx = self._score(self.C, self.row_sums, rows_b,
-                                np.float32(self.observed))
-        vals = np.asarray(vals)
-        idx = np.asarray(idx)
-        for d in range(self.n_shards):
-            for r in range(int(row_counts[d])):
-                keep = np.isfinite(vals[d, r])
-                out.append((int(rows_b[d, r]),
-                            list(zip(idx[d, r][keep].tolist(),
-                                     vals[d, r][keep].tolist()))))
+        for lo, rb, packed in chunks:
+            for shard in packed.addressable_shards:
+                d = shard.index[0].start or 0
+                host = np.asarray(shard.data)[0]  # [2, S, K]
+                vals = host[0]
+                idx = host[1].view(np.int32)
+                n_valid = min(rb.shape[1], int(row_counts[d]) - lo)
+                for r in range(n_valid):
+                    keep = np.isfinite(vals[r])
+                    out.append((int(rb[d, r]),
+                                list(zip(idx[r][keep].tolist(),
+                                         vals[r][keep].tolist()))))
         return out
 
     # -- checkpoint ------------------------------------------------------
 
+    @property
+    def process_suffix(self) -> str:
+        """Checkpoint filename suffix: multi-host runs save per process."""
+        return f".p{jax.process_index()}" if jax.process_count() > 1 else ""
+
     def checkpoint_state(self) -> dict:
+        if jax.process_count() > 1:
+            # C is sharded across hosts and not fully addressable from any
+            # single process; each process snapshots the contiguous row
+            # block its chips own (device order is hosts-major, see
+            # distributed.make_multihost_mesh). row_sums is replicated.
+            shards = sorted(self.C.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            c_local = np.concatenate([np.asarray(s.data) for s in shards])
+            row_lo = shards[0].index[0].start or 0
+            return {
+                "C_local": c_local,
+                "row_lo": np.asarray([row_lo], dtype=np.int64),
+                "row_sums": np.asarray(self.row_sums),
+                "observed": np.asarray([self.observed], dtype=np.int64),
+            }
         return {
             "C": np.asarray(self.C),
             "row_sums": np.asarray(self.row_sums),
@@ -183,9 +252,30 @@ class ShardedScorer:
         }
 
     def restore_state(self, st: dict) -> None:
-        c_sharding = NamedSharding(self.mesh, P(ITEM_AXIS, None))
-        rep = NamedSharding(self.mesh, P())
-        self.C = jax.device_put(jnp.asarray(st["C"], dtype=jnp.int32), c_sharding)
-        self.row_sums = jax.device_put(
-            jnp.asarray(st["row_sums"], dtype=jnp.int32), rep)
+        if "C_local" in st:
+            if jax.process_count() == 1:
+                raise ValueError(
+                    "checkpoint was written by a multi-host run (per-process "
+                    "row blocks); restore it under the same process layout")
+            from jax.sharding import NamedSharding
+
+            c_local = np.asarray(st["C_local"], dtype=np.int32)
+            row_lo = int(st["row_lo"][0])
+
+            def _local_block(idx):
+                rows = idx[0]
+                return c_local[rows.start - row_lo: rows.stop - row_lo,
+                               idx[1]]
+
+            self.C = jax.make_array_from_callback(
+                (self.num_items, self.num_items),
+                NamedSharding(self.mesh, P(ITEM_AXIS, None)), _local_block)
+        else:
+            self.C = self._put_global(np.asarray(st["C"], dtype=np.int32),
+                                      self.mesh, P(ITEM_AXIS, None))
+        self.row_sums = self._put_global(
+            np.asarray(st["row_sums"], dtype=np.int32), self.mesh, P())
         self.observed = int(st["observed"][0])
+        # In-flight results belong to windows after the checkpoint; a
+        # restore that rolls back must not emit them.
+        self._pending = None

@@ -87,22 +87,26 @@ def save(job, directory: str, source=None) -> str:
     arrays["latest_others"] = np.asarray(lat_others, dtype=np.int64)
     arrays["latest_scores"] = np.asarray(lat_scores, dtype=np.float64)
 
+    # Multi-host runs checkpoint per process (each host owns a row block
+    # and its partition of the results); the scorer supplies the suffix.
+    suffix = getattr(job.scorer, "process_suffix", "")
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     os.close(fd)
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
-    npz_path = os.path.join(directory, "state.npz")
+    npz_path = os.path.join(directory, f"state{suffix}.npz")
     os.replace(tmp, npz_path)
-    meta_tmp = os.path.join(directory, "meta.json.tmp")
+    meta_tmp = os.path.join(directory, f"meta{suffix}.json.tmp")
     with open(meta_tmp, "w") as f:
         json.dump(meta, f)
-    os.replace(meta_tmp, os.path.join(directory, "meta.json"))
+    os.replace(meta_tmp, os.path.join(directory, f"meta{suffix}.json"))
     return npz_path
 
 
 def restore(job, directory: str, source=None) -> None:
     """Restore ``job`` (constructed with the same Config) from a checkpoint."""
-    with open(os.path.join(directory, "meta.json")) as f:
+    suffix = getattr(job.scorer, "process_suffix", "")
+    with open(os.path.join(directory, f"meta{suffix}.json")) as f:
         meta = json.load(f)
     for key in ("seed", "skip_cuts", "item_cut", "user_cut", "top_k",
                 "window_slide"):
@@ -110,7 +114,7 @@ def restore(job, directory: str, source=None) -> None:
             raise ValueError(
                 f"checkpoint config mismatch for {key}: "
                 f"{meta.get(key)} != {getattr(job.config, key)}")
-    data = np.load(os.path.join(directory, "state.npz"))
+    data = np.load(os.path.join(directory, f"state{suffix}.npz"))
 
     job.item_vocab.restore_state(data["item_vocab"])
     job.user_vocab.restore_state(data["user_vocab"])
