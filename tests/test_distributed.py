@@ -13,6 +13,7 @@ from tpu_cooccurrence.parallel.mesh import ITEM_AXIS
 from tpu_cooccurrence.parallel.sharded import ShardedScorer
 from tpu_cooccurrence.ops.device_scorer import DeviceScorer
 from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
+from tpu_cooccurrence.state.results import materialize_dense
 
 
 def _pairs(src, dst, delta):
@@ -51,14 +52,14 @@ def test_result_pipeline_lags_one_window_and_flushes(scorer_cls):
         scorer = ShardedScorer(16, 5, num_shards=4)
     else:
         scorer = DeviceScorer(16, 5, use_pallas="off")
-    w1 = scorer.process_window(0, _pairs([1, 2], [2, 1], [1, 1]))
+    w1 = materialize_dense(scorer.process_window(0, _pairs([1, 2], [2, 1], [1, 1])))
     assert w1 == []  # first window's results are still in flight
     assert scorer.last_dispatched_rows == 2
-    w2 = scorer.process_window(1, _pairs([3], [4], [1]))
+    w2 = materialize_dense(scorer.process_window(1, _pairs([3], [4], [1])))
     assert sorted(item for item, _ in w1 + w2) == [1, 2]  # window-1 results
-    tail = scorer.flush()
+    tail = materialize_dense(scorer.flush())
     assert [item for item, _ in tail] == [3]
-    assert scorer.flush() == []  # idempotent once drained
+    assert materialize_dense(scorer.flush()) == []  # idempotent once drained
 
 
 @pytest.mark.parametrize("scorer_cls", ["sharded", "device"])
@@ -70,4 +71,5 @@ def test_restore_clears_pending(scorer_cls):
     snap = scorer.checkpoint_state()
     scorer.process_window(0, _pairs([1, 2], [2, 1], [1, 1]))
     scorer.restore_state(snap)
-    assert scorer.flush() == []  # rolled-back results must not surface
+    # rolled-back results must not surface
+    assert materialize_dense(scorer.flush()) == []

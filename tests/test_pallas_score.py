@@ -65,3 +65,47 @@ def test_pallas_rejects_bad_tile():
         pallas_score_topk(C, jnp.zeros((130,), jnp.int32),
                           jnp.zeros((2,), jnp.int32), np.float32(0),
                           top_k=5, tile=128, interpret=True)
+
+
+def test_pallas_packed_value_space_decode():
+    """packed=True ships idx as float *values* (not a bitcast view).
+
+    The host decode is ``astype(int32)``; a bitcast of the kernel's second
+    output miscompiles on real-TPU Mosaic at >=4 row blocks, which is why
+    the contract is value-space (see pallas_score.py).
+    """
+    rng = np.random.default_rng(7)
+    num_items, s, top_k = 256, 32, 8
+    C = np.zeros((num_items, num_items), dtype=np.int32)
+    src = rng.integers(0, num_items, 3000)
+    dst = rng.integers(0, num_items, 3000)
+    np.add.at(C, (src, dst), 1)
+    row_sums = C.sum(axis=1).astype(np.int32)
+    observed = np.float32(row_sums.sum())
+    rows = rng.integers(0, num_items, s).astype(np.int32)
+
+    vals, idx = pallas_score_topk(
+        jnp.asarray(C), jnp.asarray(row_sums), jnp.asarray(rows), observed,
+        top_k=top_k, tile=128, interpret=True)
+    packed = np.asarray(pallas_score_topk(
+        jnp.asarray(C), jnp.asarray(row_sums), jnp.asarray(rows), observed,
+        top_k=top_k, tile=128, interpret=True, packed=True))
+    np.testing.assert_allclose(packed[0], np.asarray(vals), rtol=1e-6)
+    np.testing.assert_array_equal(packed[1].astype(np.int32), np.asarray(idx))
+
+
+def test_pallas_rejects_vocab_beyond_float32_exact():
+    import functools
+
+    import jax
+
+    big = (1 << 24) + 128
+    with pytest.raises(ValueError, match="2\\^24"):
+        # eval_shape: the guard must fire at trace time, no allocation.
+        jax.eval_shape(
+            functools.partial(pallas_score_topk, top_k=5, tile=128,
+                              interpret=True),
+            jax.ShapeDtypeStruct((big, big), jnp.int32),
+            jax.ShapeDtypeStruct((big,), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32))

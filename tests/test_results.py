@@ -1,0 +1,86 @@
+"""LatestResults store: array absorption, lazy mapping, compaction."""
+
+import numpy as np
+
+from tpu_cooccurrence.state.results import (LatestResults, TopKBatch,
+                                            materialize_dense)
+from tpu_cooccurrence.state.vocab import IdMap
+
+
+def _vocab(n):
+    v = IdMap()
+    v.map_batch(np.arange(n, dtype=np.int64) * 10)  # external = dense*10
+    return v
+
+
+def _batch(rows, idx, vals):
+    return TopKBatch(np.asarray(rows, np.int32),
+                     np.asarray(idx, np.int32),
+                     np.asarray(vals, np.float32))
+
+
+def test_absorb_and_lazy_materialize():
+    v = _vocab(8)
+    lr = LatestResults(v)
+    lr.absorb_batch(_batch([1, 3], [[2, 5], [0, 4]],
+                           [[9.0, 7.0], [3.0, -np.inf]]))
+    assert set(lr) == {10, 30}
+    assert lr[10] == [(20, 9.0), (50, 7.0)]
+    assert lr[30] == [(0, 3.0)]  # -inf slot filtered
+    assert 10 in lr and 20 not in lr
+    assert len(lr) == 2
+
+
+def test_newer_batch_supersedes():
+    v = _vocab(8)
+    lr = LatestResults(v)
+    lr.absorb_batch(_batch([1], [[2, 3]], [[5.0, 4.0]]))
+    lr.absorb_batch(_batch([1, 2], [[4, 5], [6, 7]],
+                           [[8.0, 6.0], [2.0, 1.0]]))
+    assert lr[10] == [(40, 8.0), (50, 6.0)]
+    assert lr[20] == [(60, 2.0), (70, 1.0)]
+
+
+def test_pointer_growth_past_initial_capacity():
+    n = 3000  # > the 1024 initial pointer table
+    v = _vocab(n)
+    lr = LatestResults(v)
+    rows = np.arange(n, dtype=np.int32)
+    idx = np.tile(np.array([[0, 1]], np.int32), (n, 1))
+    vals = np.stack([np.arange(n, dtype=np.float32),
+                     np.arange(n, dtype=np.float32) - 1], axis=1)
+    lr.absorb_batch(TopKBatch(rows, idx, vals))
+    assert len(lr) == n
+    assert lr[(n - 1) * 10] == [(0, float(n - 1)), (10, float(n - 2))]
+
+
+def test_list_rows_and_batches_mix():
+    v = _vocab(8)
+    lr = LatestResults(v)
+    lr.set_row(1, [(2, 5.0)])
+    lr.absorb_batch(_batch([2], [[3, 0]], [[4.0, -np.inf]]))
+    lr.set_row(2, [(5, 1.0)])  # list row supersedes batch row
+    assert lr[10] == [(20, 5.0)]
+    assert lr[20] == [(50, 1.0)]
+
+
+def test_compaction_preserves_live_rows():
+    v = _vocab(64)
+    lr = LatestResults(v)
+    lr._COMPACT_MIN_ROWS = 8  # force compaction early
+    for t in range(16):
+        rows = [t % 4, 4 + t % 4]
+        lr.absorb_batch(_batch(rows, [[1, 2], [3, 4]],
+                               [[float(t), 1.0], [float(t), 0.5]]))
+    assert len(lr) == 8
+    for d in range(4):
+        last = max(t for t in range(16) if t % 4 == d)
+        assert lr[d * 10][0][1] == float(last)
+    assert len(lr._batches) <= 3  # old superseded batches were dropped
+
+
+def test_materialize_dense_passthrough_and_batch():
+    out = [(3, [(1, 2.0)])]
+    assert materialize_dense(out) == out
+    b = _batch([5], [[7, 0]], [[1.5, -np.inf]])
+    assert materialize_dense(b) == [(5, [(7, 1.5)])]

@@ -34,6 +34,7 @@ from .sampling.reservoir import PairDeltaBatch, UserReservoirSampler
 from .sampling.sliding import SlidingBasketSampler
 from .observability import StepTimer, WindowStats, clock
 from .state.rescorer import HostRescorer, WindowTopK
+from .state.results import LatestResults, TopKBatch
 from .state.vocab import IdMap
 from .windowing.engine import WindowEngine
 
@@ -66,8 +67,9 @@ class CooccurrenceJob:
                 config.user_cut, config.seed, config.skip_cuts,
                 counters=self.counters)
         self.scorer = scorer if scorer is not None else self._make_scorer()
-        # results: external item id -> [(external other, score) desc]
-        self.latest: Dict[int, List[Tuple[int, float]]] = {}
+        # results: external item id -> [(external other, score) desc];
+        # array-backed, lazily materialized (state/results.py)
+        self.latest = LatestResults(self.item_vocab)
         self.emissions = 0
         self.windows_fired = 0
         self.step_timer = StepTimer()
@@ -143,7 +145,7 @@ class CooccurrenceJob:
         """End of stream — Watermark(MAX_VALUE) fires everything."""
         self._drain(final=True)
 
-    def run(self, batches: Iterable[InteractionBatch]) -> Dict[int, List[Tuple[int, float]]]:
+    def run(self, batches: Iterable[InteractionBatch]) -> "LatestResults":
         start = time.monotonic_ns()
         for users, items, ts in batches:
             self.add_batch(users, items, ts)
@@ -203,10 +205,12 @@ class CooccurrenceJob:
         return flush() if flush is not None else []
 
     def _absorb(self, window_out: WindowTopK) -> None:
+        if isinstance(window_out, TopKBatch):
+            self.latest.absorb_batch(window_out)
+            self.emissions += len(window_out)
+            return
         for dense_item, top in window_out:
-            ext_item = self.item_vocab.to_external(dense_item)
-            self.latest[ext_item] = [
-                (self.item_vocab.to_external(j), s) for j, s in top]
+            self.latest.set_row(dense_item, top)
             self.emissions += 1
 
     def checkpoint(self, source=None) -> None:

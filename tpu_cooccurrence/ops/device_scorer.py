@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..sampling.reservoir import PairDeltaBatch
+from ..state.results import TopKBatch
 from .llr import llr_stable
 
 
@@ -47,6 +48,19 @@ def pad_pow2(n: int, minimum: int = 256) -> int:
     size = minimum
     while size < n:
         size *= 2
+    return size
+
+
+def pad_pow4(n: int, minimum: int = 256) -> int:
+    """Power-of-4 bucket: ≤4x padding waste, 2x fewer compiled programs.
+
+    Scatter/score work on padded slots is cheap device time; each distinct
+    shape is an XLA compile (~1-2s on the tunneled chip), so a coarser
+    bucket ladder wins for streaming workloads whose per-window sizes vary.
+    """
+    size = minimum
+    while size < n:
+        size *= 4
     return size
 
 
@@ -149,8 +163,7 @@ class DeviceScorer:
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
 
-    def process_window(self, ts: int, pairs: PairDeltaBatch
-                       ) -> List[Tuple[int, List[Tuple[int, float]]]]:
+    def process_window(self, ts: int, pairs: PairDeltaBatch) -> TopKBatch:
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
             # No new dispatch this window — drain any completed in-flight
@@ -162,7 +175,7 @@ class DeviceScorer:
         # as one packed [3, N] buffer (one transfer, not three).
         for lo in range(0, len(pairs), self.max_pairs_per_step):
             n = min(len(pairs) - lo, self.max_pairs_per_step)
-            pad = pad_pow2(n, minimum=1 << 14)
+            pad = pad_pow4(n, minimum=1 << 14)
             coo = np.zeros((3, pad), dtype=np.int32)
             coo[0, :n] = pairs.src[lo: lo + n]
             coo[1, :n] = pairs.dst[lo: lo + n]
@@ -181,7 +194,7 @@ class DeviceScorer:
         for lo in range(0, len(rows), self.max_score_rows):
             chunk = rows[lo: lo + self.max_score_rows]
             s = len(chunk)
-            pad_s = min(pad_pow2(s, minimum=64), self.max_score_rows)
+            pad_s = min(pad_pow4(s, minimum=64), self.max_score_rows)
             rows_padded = np.zeros(pad_s, dtype=np.int32)
             rows_padded[:s] = chunk
             if self.use_pallas:
@@ -200,26 +213,27 @@ class DeviceScorer:
                 packed.copy_to_host_async()
             chunks.append((chunk, s, packed))
         prev, self._pending = self._pending, chunks
-        return self._materialize(prev) if prev is not None else []
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
 
-    def flush(self) -> List[Tuple[int, List[Tuple[int, float]]]]:
+    def flush(self) -> TopKBatch:
         """Emit the final in-flight window's results (end of pipeline)."""
         prev, self._pending = self._pending, None
-        return self._materialize(prev) if prev is not None else []
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
 
-    @staticmethod
-    def _materialize(chunks) -> List[Tuple[int, List[Tuple[int, float]]]]:
-        out: List[Tuple[int, List[Tuple[int, float]]]] = []
+    def _materialize(self, chunks) -> TopKBatch:
+        rows_l, idx_l, vals_l = [], [], []
         for chunk, s, packed in chunks:
             host = np.asarray(packed)  # single [2, S, K] fetch
-            vals = host[0, :s]
-            idx = host[1, :s].view(np.int32)
-            for r in range(s):
-                keep = np.isfinite(vals[r])
-                out.append((int(chunk[r]),
-                            list(zip(idx[r][keep].tolist(),
-                                     vals[r][keep].tolist()))))
-        return out
+            rows_l.append(chunk)
+            vals_l.append(host[0, :s])
+            if self.use_pallas:
+                # Pallas packs ids as float values (see pallas_score.py).
+                idx_l.append(host[1, :s].astype(np.int32))
+            else:
+                idx_l.append(host[1, :s].view(np.int32))
+        return TopKBatch.concatenate(rows_l, idx_l, vals_l, self.top_k)
 
     # -- checkpoint ------------------------------------------------------
 

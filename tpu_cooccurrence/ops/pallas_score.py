@@ -50,7 +50,7 @@ def _score_topk_kernel(g_ref, rsj_ref, rsi_ref, obs_ref,
     @pl.when(j == 0)
     def _init():
         run_vals[...] = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
-        run_idx[...] = jnp.zeros((R, _K_PAD), dtype=jnp.int32)
+        run_idx[...] = jnp.zeros((R, _K_PAD), dtype=jnp.float32)
 
     counts = g_ref[...]                                     # [R, TILE] int32
     k11 = counts.astype(jnp.float32)
@@ -64,9 +64,15 @@ def _score_topk_kernel(g_ref, rsj_ref, rsi_ref, obs_ref,
     scores = llr_stable(k11, k12, k21, k22)
     scores = jnp.where(counts != 0, scores, -jnp.inf)       # [R, TILE]
 
+    # Column ids ride through the selection as float32: int32 VMEM scratch
+    # carried across grid steps miscompiles on current Mosaic (output block
+    # silently zeroed once the row-grid dimension reaches 4 — observed on
+    # v5e, jax 0.8.x); float32 holds ids exactly below 2^24, which the
+    # wrapper enforces via the vocab-size guard.
     col_base = j * tile
     cols = (col_base
-            + jax.lax.broadcasted_iota(jnp.int32, (R, tile), dimension=1))
+            + jax.lax.broadcasted_iota(jnp.int32, (R, tile), dimension=1)
+            ).astype(jnp.float32)
 
     # Candidates: running top-K (positions 0.._K_PAD-1) then this tile.
     cand_vals = jnp.concatenate([run_vals[...], scores], axis=1)
@@ -76,13 +82,13 @@ def _score_topk_kernel(g_ref, rsj_ref, rsi_ref, obs_ref,
     lanes = jax.lax.broadcasted_iota(jnp.int32, (R, _K_PAD), dimension=1)
 
     new_vals = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
-    new_idx = jnp.zeros((R, _K_PAD), dtype=jnp.int32)
+    new_idx = jnp.zeros((R, _K_PAD), dtype=jnp.float32)
     for k in range(top_k):  # static unroll; top_k is small
         m = jnp.max(cand_vals, axis=1, keepdims=True)                 # [R, 1]
         pos = jnp.min(jnp.where(cand_vals == m, positions, width),
                       axis=1, keepdims=True)                          # [R, 1]
         sel = positions == pos                                        # [R, W]
-        chosen = jnp.max(jnp.where(sel, cand_idx, 0),
+        chosen = jnp.max(jnp.where(sel, cand_idx, 0.0),
                          axis=1, keepdims=True)                       # [R, 1]
         lane_k = lanes == k
         new_vals = jnp.where(lane_k, m, new_vals)
@@ -110,12 +116,18 @@ def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,
     rows     [S]    int32 — row ids to score (padded rows allowed)
     observed scalar float32
     Returns (vals [S, top_k] f32, idx [S, top_k] i32), scores descending;
-    with ``packed=True`` a single [2, S, top_k] float32 (idx bitcast) so the
+    with ``packed=True`` a single [2, S, top_k] float32 — idx as exact
+    float *values* (decode with ``astype``, not a bitcast view) — so the
     caller fetches one buffer.
     """
     num_items = C.shape[0]
     if num_items % tile != 0:
         raise ValueError(f"num_items {num_items} must be a multiple of tile {tile}")
+    if num_items > 1 << 24:
+        raise ValueError(
+            f"num_items {num_items} exceeds 2^24: column ids are tracked as "
+            f"exact float32 inside the kernel (int32 scratch miscompiles on "
+            f"Mosaic); use the XLA scorer (pallas='off') beyond that")
     if top_k > _K_PAD:
         raise ValueError(
             f"top_k {top_k} exceeds the kernel's lane width {_K_PAD}; "
@@ -146,16 +158,20 @@ def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,
         ),
         scratch_shapes=[
             pltpu.VMEM((_ROW_BLOCK, _K_PAD), jnp.float32),
-            pltpu.VMEM((_ROW_BLOCK, _K_PAD), jnp.int32),
+            pltpu.VMEM((_ROW_BLOCK, _K_PAD), jnp.float32),
         ],
         out_shape=(
             jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
-            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.int32),
+            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
         ),
         interpret=interpret,
     )(gathered, rs2d, rsi, obs)
     vals = vals[:S, :top_k]
-    idx = idx[:S, :top_k]
     if packed:
-        return jnp.stack([vals, jax.lax.bitcast_convert_type(idx, jnp.float32)])
-    return vals, idx
+        # Value-space packing: ids stay exact float32 (wrapper guard caps
+        # the vocab at 2^24). bitcast_convert_type on the kernel's second
+        # output miscompiles to zeros on current Mosaic once the row grid
+        # reaches 4 blocks, so the host decodes with astype, not view —
+        # see DeviceScorer._materialize.
+        return jnp.stack([vals, idx[:S, :top_k]])
+    return vals, idx[:S, :top_k].astype(jnp.int32)

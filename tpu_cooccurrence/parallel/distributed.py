@@ -50,12 +50,13 @@ def init_multihost(coordinator_address: Optional[str] = None,
     process 0 at ``host:port``).
     """
     global _initialized
-    if _initialized:
-        return
     if coordinator_address is None and num_processes is None:
         # Standalone run (or TPU-pod autodetection handled by the runtime
-        # when env vars are present) — nothing to do.
-        _initialized = True
+        # when env vars are present) — nothing to do. Deliberately does NOT
+        # latch ``_initialized``: an argument-free probe must not swallow a
+        # later real ``initialize(coordinator, ...)`` call.
+        return
+    if _initialized:
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

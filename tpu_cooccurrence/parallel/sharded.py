@@ -39,6 +39,7 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from ..state.results import TopKBatch
 from ..ops.llr import llr_stable
 from ..ops.device_scorer import pad_pow2, score_row_budget
 from ..sampling.reservoir import PairDeltaBatch
@@ -142,8 +143,9 @@ class ShardedScorer:
             out[d, : len(sel)] = values[sel]
         return out, counts
 
-    def process_window(self, ts: int, pairs: PairDeltaBatch
-                       ) -> List[Tuple[int, List[Tuple[int, float]]]]:
+    def process_window(self, ts: int, pairs: PairDeltaBatch):
+        """One sharded update+score step; returns the *previous* window's
+        results as a packed ``TopKBatch`` (one-window-deep pipeline)."""
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
             # No new dispatch this window — drain any completed in-flight
@@ -192,35 +194,35 @@ class ShardedScorer:
                 packed.copy_to_host_async()
             chunks.append((lo, rb, packed))
         prev, self._pending = self._pending, (row_counts, chunks)
-        return self._materialize(prev) if prev is not None else []
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
 
-    def flush(self) -> List[Tuple[int, List[Tuple[int, float]]]]:
+    def flush(self):
         """Emit the final in-flight window's results (end of pipeline)."""
         prev, self._pending = self._pending, None
-        return self._materialize(prev) if prev is not None else []
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
 
-    def _materialize(self, pending) -> List[Tuple[int, List[Tuple[int, float]]]]:
-        """Fetch in-flight [D, 2, S, K] blocks and build (row, top-K) lists.
+    def _materialize(self, pending):
+        """Fetch in-flight [D, 2, S, K] blocks into one packed TopKBatch.
 
         Iterates *addressable* shards only: single-process that is all of
         them; multi-host each process emits exactly the rows its chips own
         (the analogue of a Flink subtask emitting its key partition).
         """
         row_counts, chunks = pending
-        out: List[Tuple[int, List[Tuple[int, float]]]] = []
+        rows_l, idx_l, vals_l = [], [], []
         for lo, rb, packed in chunks:
             for shard in packed.addressable_shards:
                 d = shard.index[0].start or 0
                 host = np.asarray(shard.data)[0]  # [2, S, K]
-                vals = host[0]
-                idx = host[1].view(np.int32)
                 n_valid = min(rb.shape[1], int(row_counts[d]) - lo)
-                for r in range(n_valid):
-                    keep = np.isfinite(vals[r])
-                    out.append((int(rb[d, r]),
-                                list(zip(idx[r][keep].tolist(),
-                                         vals[r][keep].tolist()))))
-        return out
+                if n_valid <= 0:
+                    continue
+                rows_l.append(rb[d, :n_valid])
+                vals_l.append(host[0, :n_valid])
+                idx_l.append(host[1, :n_valid].view(np.int32))
+        return TopKBatch.concatenate(rows_l, idx_l, vals_l, self.top_k)
 
     # -- checkpoint ------------------------------------------------------
 

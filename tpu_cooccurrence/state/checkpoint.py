@@ -148,14 +148,18 @@ def restore(job, directory: str, source=None) -> None:
     job.emissions = meta["emissions"]
     job.counters.replace_all(meta["counters"])
 
-    job.latest = {}
+    # The store keeps dense ids; the .npz holds external ids (the public
+    # result shape), so map back through the already-restored vocab.
+    job.latest.clear()
     items = data["latest_items"]
     offsets = data["latest_offsets"]
+    to_dense = job.item_vocab.to_dense
     for pos, item in enumerate(items.tolist()):
         lo, hi = int(offsets[pos]), int(offsets[pos + 1])
-        job.latest[item] = list(zip(
-            data["latest_others"][lo:hi].tolist(),
+        top = list(zip(
+            (to_dense(j) for j in data["latest_others"][lo:hi].tolist()),
             data["latest_scores"][lo:hi].tolist()))
+        job.latest.set_row(to_dense(item), top)
 
     if source is not None and "source" in meta:
         source.restore_state(meta["source"])

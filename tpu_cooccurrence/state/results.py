@@ -1,0 +1,219 @@
+"""Array-native top-K result store with lazy materialization.
+
+The reference terminates its result stream in a no-op sink
+(``FlinkCooccurrences.java:169-171``) — results exist only as a stream of
+``(item, topK)`` records. We keep results *consumable*, but the hot path
+must not pay Python-per-row costs: device backends hand back whole windows
+as packed ``[S, K]`` arrays (:class:`TopKBatch`), and :class:`LatestResults`
+absorbs them with O(S) numpy scatters into a dense pointer table. The
+per-item ``[(other, score), ...]`` lists the public API exposes are built
+lazily, only for items actually read (CLI dump, tests, checkpoint).
+
+All stored ids are *dense* vocab indices; external ids appear only at the
+materialization boundary (``IdMap.to_external_batch``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Mapping, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TopKBatch:
+    """One window's top-K results in packed array form (dense-id space).
+
+    ``vals`` may contain ``-inf`` for rows with fewer than K co-occurring
+    items; the matching ``idx`` entries are garbage and are filtered at
+    materialization time.
+    """
+
+    rows: np.ndarray  # [S] int32 dense item ids
+    idx: np.ndarray   # [S, K] int32 dense other-item ids
+    vals: np.ndarray  # [S, K] float32 scores (descending)
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    @staticmethod
+    def empty(top_k: int) -> "TopKBatch":
+        return TopKBatch(np.zeros(0, np.int32),
+                         np.zeros((0, top_k), np.int32),
+                         np.zeros((0, top_k), np.float32))
+
+    @staticmethod
+    def concatenate(rows_l, idx_l, vals_l, top_k: int) -> "TopKBatch":
+        """Assemble per-chunk host arrays into one batch ([] -> empty)."""
+        if not rows_l:
+            return TopKBatch.empty(top_k)
+        return TopKBatch(np.concatenate(rows_l), np.concatenate(idx_l),
+                         np.concatenate(vals_l))
+
+
+def materialize_dense(window_out) -> List[Tuple[int, List[Tuple[int, float]]]]:
+    """Expand a backend's window output to (dense item, [(dense, score)]).
+
+    Accepts either the packed :class:`TopKBatch` (device/sharded backends)
+    or an already-materialized list (host backends). Debug/test helper —
+    the job's hot path absorbs batches without this expansion.
+    """
+    if not isinstance(window_out, TopKBatch):
+        return list(window_out)
+    out = []
+    for r in range(len(window_out.rows)):
+        vals = window_out.vals[r]
+        keep = np.isfinite(vals)
+        out.append((int(window_out.rows[r]),
+                    list(zip(window_out.idx[r][keep].tolist(),
+                             vals[keep].astype(float).tolist()))))
+    return out
+
+
+class _ListBatch:
+    """Adapter for host backends that produce per-row Python lists."""
+
+    def __init__(self) -> None:
+        self.rows: List[List[Tuple[int, float]]] = []
+
+    def append(self, top: List[Tuple[int, float]]) -> int:
+        self.rows.append(top)
+        return len(self.rows) - 1
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+
+class LatestResults(Mapping):
+    """``{external item -> [(external other, score), ...]}`` view, array-backed.
+
+    A dense pointer table maps each item to its most recent result row
+    across all absorbed batches; superseded rows linger until
+    :meth:`_compact` trims them (triggered when dead rows dominate).
+    """
+
+    _COMPACT_MIN_ROWS = 1 << 20
+
+    def __init__(self, vocab) -> None:
+        self._vocab = vocab
+        self._batches: list = []
+        self._ptr_batch = np.full(1024, -1, dtype=np.int64)
+        self._ptr_row = np.zeros(1024, dtype=np.int64)
+        self._total_rows = 0
+
+    # -- absorption (hot path) ------------------------------------------
+
+    def _ensure(self, n: int) -> None:
+        if n <= len(self._ptr_batch):
+            return
+        cap = len(self._ptr_batch)
+        while cap < n:
+            cap *= 2
+        grown = np.full(cap, -1, dtype=np.int64)
+        grown[: len(self._ptr_batch)] = self._ptr_batch
+        grown_rows = np.zeros(cap, dtype=np.int64)
+        grown_rows[: len(self._ptr_row)] = self._ptr_row
+        self._ptr_batch = grown
+        self._ptr_row = grown_rows
+
+    def absorb_batch(self, batch: TopKBatch) -> None:
+        if len(batch) == 0:
+            return
+        bid = len(self._batches)
+        self._batches.append(batch)
+        rows = batch.rows.astype(np.int64)
+        self._ensure(int(rows.max()) + 1)
+        self._ptr_batch[rows] = bid
+        self._ptr_row[rows] = np.arange(len(rows), dtype=np.int64)
+        self._total_rows += len(rows)
+        if (self._total_rows >= self._COMPACT_MIN_ROWS
+                and self._total_rows > 2 * len(self)):
+            self._compact()
+
+    def set_row(self, dense_item: int, top: List[Tuple[int, float]]) -> None:
+        """Single-row update from a host (list-producing) backend."""
+        if not self._batches or not isinstance(self._batches[-1], _ListBatch):
+            self._batches.append(_ListBatch())
+        bid = len(self._batches) - 1
+        row = self._batches[bid].append(top)
+        self._ensure(dense_item + 1)
+        self._ptr_batch[dense_item] = bid
+        self._ptr_row[dense_item] = row
+        self._total_rows += 1
+        if (self._total_rows >= self._COMPACT_MIN_ROWS
+                and self._total_rows > 2 * len(self)):
+            self._compact()
+
+    def _compact(self) -> None:
+        """Drop superseded rows: rebuild live array rows into one batch."""
+        live = np.nonzero(self._ptr_batch[: len(self._vocab)] >= 0)[0]
+        bids = self._ptr_batch[live]
+        rows = self._ptr_row[live]
+        keep_lists = []  # list batches are kept as-is (host paths are small)
+        arr_rows, arr_idx, arr_vals = [], [], []
+        for bid in np.unique(bids):
+            b = self._batches[bid]
+            sel = bids == bid
+            r = rows[sel]
+            if isinstance(b, _ListBatch):
+                keep_lists.append((bid, b, live[sel], r))
+                continue
+            arr_rows.append(b.rows[r])
+            arr_idx.append(b.idx[r])
+            arr_vals.append(b.vals[r])
+        self._batches = []
+        self._ptr_batch[:] = -1
+        self._total_rows = 0
+        if arr_rows:
+            merged = TopKBatch(np.concatenate(arr_rows),
+                               np.concatenate(arr_idx),
+                               np.concatenate(arr_vals))
+            self.absorb_batch(merged)
+        for _, b, dense_ids, r in keep_lists:
+            for d, row in zip(dense_ids.tolist(), r.tolist()):
+                self.set_row(d, b.rows[row])
+
+    # -- Mapping API (lazy, cold path) ----------------------------------
+
+    def _live_dense(self) -> np.ndarray:
+        n = min(len(self._ptr_batch), len(self._vocab))
+        return np.nonzero(self._ptr_batch[:n] >= 0)[0]
+
+    def __len__(self) -> int:
+        return int(len(self._live_dense()))
+
+    def __iter__(self) -> Iterator[int]:
+        live = self._live_dense()
+        if len(live) == 0:
+            return iter(())
+        return iter(self._vocab.to_external_batch(live).tolist())
+
+    def __contains__(self, ext_item) -> bool:
+        dense = self._vocab.to_dense(ext_item)
+        return (dense is not None and dense < len(self._ptr_batch)
+                and self._ptr_batch[dense] >= 0)
+
+    def __getitem__(self, ext_item) -> List[Tuple[int, float]]:
+        dense = self._vocab.to_dense(ext_item)
+        if (dense is None or dense >= len(self._ptr_batch)
+                or self._ptr_batch[dense] < 0):
+            raise KeyError(ext_item)
+        b = self._batches[self._ptr_batch[dense]]
+        row = int(self._ptr_row[dense])
+        if isinstance(b, _ListBatch):
+            top = b.rows[row]
+            return [(self._vocab.to_external(j), s) for j, s in top]
+        vals = b.vals[row]
+        keep = np.isfinite(vals)
+        if not keep.any():
+            return []
+        ext = self._vocab.to_external_batch(b.idx[row][keep].astype(np.int64))
+        return list(zip(ext.tolist(), vals[keep].astype(float).tolist()))
+
+    # -- checkpoint helpers ---------------------------------------------
+
+    def clear(self) -> None:
+        self._batches = []
+        self._ptr_batch[:] = -1
+        self._total_rows = 0

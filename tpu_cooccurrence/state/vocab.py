@@ -20,6 +20,7 @@ class IdMap:
     def __init__(self) -> None:
         self._fwd: Dict[int, int] = {}
         self._rev: list = []
+        self._rev_arr: np.ndarray = np.zeros(0, dtype=np.int64)  # cache
 
     def __len__(self) -> int:
         return len(self._rev)
@@ -59,9 +60,16 @@ class IdMap:
     def to_external(self, dense: int) -> int:
         return self._rev[dense]
 
+    def to_dense(self, ext):
+        """Dense id for an external id, or ``None`` if never seen."""
+        return self._fwd.get(ext)
+
     def to_external_batch(self, dense: np.ndarray) -> np.ndarray:
-        rev = np.asarray(self._rev, dtype=np.int64)
-        return rev[dense]
+        # Rebuilt only when the vocab has grown since the last call (result
+        # materialization calls this per row — it must not be O(vocab)).
+        if len(self._rev_arr) != len(self._rev):
+            self._rev_arr = np.asarray(self._rev, dtype=np.int64)
+        return self._rev_arr[dense]
 
     # -- checkpoint ------------------------------------------------------
 
@@ -71,3 +79,5 @@ class IdMap:
     def restore_state(self, rev: np.ndarray) -> None:
         self._rev = [int(x) for x in rev]
         self._fwd = {ext: i for i, ext in enumerate(self._rev)}
+        self._rev_arr = np.zeros(0, dtype=np.int64)  # length check is not
+        # enough here: a same-length restore must still drop the cache

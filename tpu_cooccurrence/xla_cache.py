@@ -20,6 +20,26 @@ LOG = logging.getLogger("tpu_cooccurrence")
 _enabled = False
 
 
+def _host_fingerprint() -> str:
+    """Short stable id of this host's CPU feature set (+ platform)."""
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 lists features under "flags", aarch64 under "Features".
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    digest = hashlib.sha1(
+        f"{platform.machine()}|{feats}".encode()).hexdigest()[:12]
+    return digest
+
+
 def enable_compilation_cache() -> None:
     """Idempotently point JAX's persistent compilation cache at disk."""
     global _enabled
@@ -42,6 +62,11 @@ def enable_compilation_cache() -> None:
                 path = os.path.join(
                     os.path.expanduser("~"), ".cache", "tpu_cooccurrence",
                     "xla")
+        # The workspace (and this cache dir) can move between hosts with
+        # different CPU feature sets; XLA:CPU AOT results are
+        # feature-specific and loading a foreign one risks SIGILL. Key the
+        # cache by a host fingerprint so each machine gets its own bucket.
+        path = os.path.join(path, _host_fingerprint())
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
