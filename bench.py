@@ -46,9 +46,15 @@ def main() -> None:
     # throughput rather than one-time XLA compile latency.
     run("device", users, items, ts, num_items=n_items, window_ms=100)
 
-    pairs, elapsed = run("device", users, items, ts,
-                         num_items=n_items, window_ms=100)
-    pairs_per_sec = pairs / max(elapsed, 1e-9)
+    # Median of three measured runs: the benched chip can be reached over a
+    # shared tunnel, where single-run wall-clock swings by 2x under
+    # contention.
+    samples = []
+    for _ in range(3):
+        pairs, elapsed = run("device", users, items, ts,
+                             num_items=n_items, window_ms=100)
+        samples.append(pairs / max(elapsed, 1e-9))
+    pairs_per_sec = sorted(samples)[1]
 
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.

@@ -1,0 +1,42 @@
+"""Shared per-window COO aggregation (ops/aggregate.py)."""
+
+import numpy as np
+
+from tpu_cooccurrence.ops.aggregate import aggregate_window_coo, distinct_sorted
+
+
+def test_aggregate_folds_duplicates_exactly():
+    rng = np.random.default_rng(11)
+    n = 50_000
+    src = rng.integers(0, 300, n).astype(np.int64)
+    dst = rng.integers(0, 300, n).astype(np.int64)
+    delta = rng.integers(-1, 3, n).astype(np.int64)
+
+    a_src, a_dst, a_delta = aggregate_window_coo(src, dst, delta)
+
+    dense = np.zeros((300, 300), dtype=np.int64)
+    np.add.at(dense, (src, dst), delta)
+    got = np.zeros_like(dense)
+    np.add.at(got, (a_src, a_dst), a_delta.astype(np.int64))
+    np.testing.assert_array_equal(got, dense)
+
+    # One entry per distinct cell, sorted by (src, dst).
+    key = (a_src.astype(np.int64) << 32) | a_dst.astype(np.int64)
+    assert (np.diff(key) > 0).all()
+    # Net-zero cells are kept (the reference also rescores their rows).
+    assert (a_delta == 0).any()
+
+
+def test_aggregate_empty():
+    e = np.zeros(0, dtype=np.int64)
+    a_src, a_dst, a_delta = aggregate_window_coo(e, e, e)
+    assert len(a_src) == len(a_dst) == len(a_delta) == 0
+
+
+def test_distinct_sorted():
+    assert distinct_sorted(np.array([], dtype=np.int32)).size == 0
+    np.testing.assert_array_equal(
+        distinct_sorted(np.array([0, 0, 2, 5, 5, 5, 9], dtype=np.int32)),
+        [0, 2, 5, 9])
+    np.testing.assert_array_equal(
+        distinct_sorted(np.array([3], dtype=np.int32)), [3])

@@ -111,3 +111,48 @@ def test_periodic_checkpointing(tmp_path):
     job.finish()
     assert (tmp_path / "ckpt" / "state.npz").exists()
     assert (tmp_path / "ckpt" / "meta.json").exists()
+
+
+def test_restore_across_vocab_padding_change(tmp_path):
+    """A checkpoint written with pallas vocab padding restores after the
+    default flipped to the unpadded XLA path (and vice versa)."""
+    from tpu_cooccurrence.ops.device_scorer import DeviceScorer
+
+    rng = np.random.default_rng(5)
+    padded = DeviceScorer(40, 5, use_pallas="on")       # pads 40 -> 512
+    assert padded.num_items > 40
+    import jax.numpy as jnp
+
+    C = np.zeros((padded.num_items, padded.num_items), np.int32)
+    C[:40, :40] = rng.integers(0, 9, (40, 40))
+    padded.C = jnp.asarray(C)
+    padded.row_sums = jnp.asarray(C.sum(axis=1).astype(np.int32))
+    padded.observed = int(C.sum())
+    st = padded.checkpoint_state()
+
+    plain = DeviceScorer(40, 5, use_pallas="off")
+    plain.restore_state(st)                              # slice down
+    np.testing.assert_array_equal(np.asarray(plain.C), C[:40, :40])
+    assert plain.observed == padded.observed
+
+    st2 = plain.checkpoint_state()
+    padded2 = DeviceScorer(40, 5, use_pallas="on")
+    padded2.restore_state(st2)                           # zero-extend
+    np.testing.assert_array_equal(np.asarray(padded2.C), C)
+
+
+def test_restore_rejects_out_of_capacity_counts(tmp_path):
+    from tpu_cooccurrence.ops.device_scorer import DeviceScorer
+    import jax.numpy as jnp
+    import pytest
+
+    big = DeviceScorer(64, 5, use_pallas="off")
+    C = np.zeros((64, 64), np.int32)
+    C[50, 50] = 3                                        # beyond capacity 40
+    big.C = jnp.asarray(C)
+    big.row_sums = jnp.asarray(C.sum(axis=1).astype(np.int32))
+    st = big.checkpoint_state()
+
+    small = DeviceScorer(40, 5, use_pallas="off")
+    with pytest.raises(ValueError, match="capacity"):
+        small.restore_state(st)

@@ -1,0 +1,44 @@
+"""Host-side per-window COO aggregation shared by the device backends.
+
+The reference folds a window's pair deltas per (item, other) cell before
+they reach the rescorer (``ItemRowAggregator.java:26-31``); here the same
+fold additionally shrinks the device scatter and removes duplicate indices,
+which a TPU scatter would otherwise apply serially.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def aggregate_window_coo(src: np.ndarray, dst: np.ndarray,
+                         delta: np.ndarray, return_key: bool = False):
+    """Fold duplicate ``(src, dst)`` pairs of one window into single entries.
+
+    Returns ``(src, dst, delta)`` sorted by ``(src, dst)`` with one entry
+    per distinct cell and the window's deltas summed as int64 (exact: the
+    bincount accumulates in float64, whose 2^53 integer range is far above
+    any window's total). With ``return_key=True`` the packed
+    ``src << 32 | dst`` int64 key array is appended (same order), for
+    callers that index by packed key. Entries whose deltas cancel to zero
+    are kept — a zero scatter-add is a no-op, and the reference also emits
+    (and rescores rows for) net-zero cells.
+    """
+    key = (src.astype(np.int64) << 32) | dst.astype(np.int64)
+    uniq_key, inverse = np.unique(key, return_inverse=True)
+    agg = np.bincount(inverse, weights=delta,
+                      minlength=len(uniq_key)).astype(np.int64)
+    out = ((uniq_key >> 32).astype(np.int32),
+           (uniq_key & 0xFFFFFFFF).astype(np.int32),
+           agg)
+    return out + (uniq_key,) if return_key else out
+
+
+def distinct_sorted(sorted_vals: np.ndarray) -> np.ndarray:
+    """Distinct values of an already-sorted array (no re-sort)."""
+    if len(sorted_vals) == 0:
+        return sorted_vals
+    return sorted_vals[np.flatnonzero(
+        np.diff(sorted_vals, prepend=sorted_vals[0] - 1))]

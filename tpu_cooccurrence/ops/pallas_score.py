@@ -64,39 +64,50 @@ def _score_topk_kernel(g_ref, rsj_ref, rsi_ref, obs_ref,
     scores = llr_stable(k11, k12, k21, k22)
     scores = jnp.where(counts != 0, scores, -jnp.inf)       # [R, TILE]
 
-    # Column ids ride through the selection as float32: int32 VMEM scratch
-    # carried across grid steps miscompiles on current Mosaic (output block
-    # silently zeroed once the row-grid dimension reaches 4 — observed on
-    # v5e, jax 0.8.x); float32 holds ids exactly below 2^24, which the
-    # wrapper enforces via the vocab-size guard.
-    col_base = j * tile
-    cols = (col_base
-            + jax.lax.broadcasted_iota(jnp.int32, (R, tile), dimension=1)
-            ).astype(jnp.float32)
+    # Threshold skip: the merge below costs more VPU work than the LLR
+    # itself (top_k sequential extractions over the candidate width). A
+    # tile only needs it if some row's tile-max beats that row's running
+    # K-th best; after the first few column tiles most tiles lose and the
+    # whole merge is skipped, leaving the kernel LLR-bound.
+    thresh = run_vals[:, top_k - 1:top_k]                   # [R, 1]
+    tile_max = jnp.max(scores, axis=1, keepdims=True)       # [R, 1]
+    need_merge = jnp.any(tile_max > thresh)
 
-    # Candidates: running top-K (positions 0.._K_PAD-1) then this tile.
-    cand_vals = jnp.concatenate([run_vals[...], scores], axis=1)
-    cand_idx = jnp.concatenate([run_idx[...], cols], axis=1)
-    width = _K_PAD + tile
-    positions = jax.lax.broadcasted_iota(jnp.int32, (R, width), dimension=1)
-    lanes = jax.lax.broadcasted_iota(jnp.int32, (R, _K_PAD), dimension=1)
+    @pl.when((j == 0) | need_merge)
+    def _merge():
+        # Column ids ride through the selection as float32: int32 VMEM
+        # scratch carried across grid steps miscompiles on current Mosaic
+        # (output block silently zeroed once the row-grid dimension reaches
+        # 4 — observed on v5e, jax 0.8.x); float32 holds ids exactly below
+        # 2^24, which the wrapper enforces via the vocab-size guard.
+        col_base = j * tile
+        cols = (col_base
+                + jax.lax.broadcasted_iota(jnp.int32, (R, tile), dimension=1)
+                ).astype(jnp.float32)
 
-    new_vals = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
-    new_idx = jnp.zeros((R, _K_PAD), dtype=jnp.float32)
-    for k in range(top_k):  # static unroll; top_k is small
-        m = jnp.max(cand_vals, axis=1, keepdims=True)                 # [R, 1]
-        pos = jnp.min(jnp.where(cand_vals == m, positions, width),
-                      axis=1, keepdims=True)                          # [R, 1]
-        sel = positions == pos                                        # [R, W]
-        chosen = jnp.max(jnp.where(sel, cand_idx, 0.0),
-                         axis=1, keepdims=True)                       # [R, 1]
-        lane_k = lanes == k
-        new_vals = jnp.where(lane_k, m, new_vals)
-        new_idx = jnp.where(lane_k, chosen, new_idx)
-        cand_vals = jnp.where(sel, -jnp.inf, cand_vals)
+        # Candidates: running top-K (positions 0.._K_PAD-1) then this tile.
+        cand_vals = jnp.concatenate([run_vals[...], scores], axis=1)
+        cand_idx = jnp.concatenate([run_idx[...], cols], axis=1)
+        width = _K_PAD + tile
+        positions = jax.lax.broadcasted_iota(jnp.int32, (R, width), dimension=1)
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (R, _K_PAD), dimension=1)
 
-    run_vals[...] = new_vals
-    run_idx[...] = new_idx
+        new_vals = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
+        new_idx = jnp.zeros((R, _K_PAD), dtype=jnp.float32)
+        for k in range(top_k):  # static unroll; top_k is small
+            m = jnp.max(cand_vals, axis=1, keepdims=True)             # [R, 1]
+            pos = jnp.min(jnp.where(cand_vals == m, positions, width),
+                          axis=1, keepdims=True)                      # [R, 1]
+            sel = positions == pos                                    # [R, W]
+            chosen = jnp.max(jnp.where(sel, cand_idx, 0.0),
+                             axis=1, keepdims=True)                   # [R, 1]
+            lane_k = lanes == k
+            new_vals = jnp.where(lane_k, m, new_vals)
+            new_idx = jnp.where(lane_k, chosen, new_idx)
+            cand_vals = jnp.where(sel, -jnp.inf, cand_vals)
+
+        run_vals[...] = new_vals
+        run_idx[...] = new_idx
 
     @pl.when(j == n_j - 1)
     def _emit():

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..state.results import TopKBatch
+from ..ops.aggregate import aggregate_window_coo, distinct_sorted
 from ..ops.llr import llr_stable
 from ..ops.device_scorer import pad_pow2, score_row_budget
 from ..sampling.reservoir import PairDeltaBatch
@@ -151,9 +152,12 @@ class ShardedScorer:
             # No new dispatch this window — drain any completed in-flight
             # results now instead of withholding them behind idle windows.
             return self.flush()
-        src = pairs.src.astype(np.int32)
-        dst = pairs.dst.astype(np.int32)
-        delta = pairs.delta.astype(np.int32)
+        # Shared per-window cell aggregation (see ops/aggregate.py): the
+        # hash-shuffle analogue ships each distinct cell once per window and
+        # keeps duplicate indices out of the per-shard scatters.
+        src, dst, delta64 = aggregate_window_coo(
+            pairs.src, pairs.dst, pairs.delta)
+        delta = delta64.astype(np.int32)
         owners = (src // self.rows_per_shard).astype(np.int64)
 
         # Owner-partitioned [D, P] blocks; padding rows point at each shard's
@@ -175,7 +179,7 @@ class ShardedScorer:
         self.observed += window_sum
         self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
 
-        rows = np.unique(pairs.src).astype(np.int32)
+        rows = distinct_sorted(src)
         self.counters.add(RESCORED_ITEMS, len(rows))
         self.last_dispatched_rows = len(rows)
         row_owners = (rows // self.rows_per_shard).astype(np.int64)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from ..ops.aggregate import aggregate_window_coo
 from ..ops.llr import llr_stable
 from ..ops.device_scorer import pad_pow2
 from ..sampling.reservoir import PairDeltaBatch
@@ -96,17 +97,10 @@ class HybridScorer:
         self.observed += window_sum
         self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
 
-        # Aggregate the window's COO to unique sorted keys.
-        d_key_raw = (pairs.src << 32) | pairs.dst
-        order = np.argsort(d_key_raw, kind="stable")
-        dk_sorted = d_key_raw[order]
-        dv_sorted = delta64[order]
-        first = np.empty(len(dk_sorted), dtype=bool)
-        first[0] = True
-        np.not_equal(dk_sorted[1:], dk_sorted[:-1], out=first[1:])
-        group = np.cumsum(first) - 1
-        d_key = dk_sorted[first]
-        d_val = np.bincount(group, weights=dv_sorted).astype(np.int64)
+        # Aggregate the window's COO to unique sorted keys (shared helper,
+        # ops/aggregate.py; key order matches the matrix's packed-key sort).
+        _, _, d_val, d_key = aggregate_window_coo(
+            pairs.src, pairs.dst, delta64, return_key=True)
 
         # Merge: in-place update for existing keys, single insert for new.
         if len(self.g_key):
