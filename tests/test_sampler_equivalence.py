@@ -102,3 +102,27 @@ def test_sampler_skip_cuts_histories_unbounded():
     agg = aggregate(pairs)
     assert all(v == 1 for v in agg.values())
     assert len(agg) == 50 * 49
+
+
+def test_reservoir_retention_is_uniform():
+    """Algorithm-R property (UserInteractionCounter...java:206-245): after a
+    user streams M distinct items through a kMax reservoir, every stream
+    position is retained with probability kMax/M — the sketch is an unbiased
+    uniform sample, not recency-biased."""
+    k_max, m, n_seeds = 8, 64, 400
+    hits = np.zeros(m, dtype=np.int64)
+    items = np.arange(m, dtype=np.int64)
+    users = np.zeros(m, dtype=np.int64)
+    sampled = np.ones(m, dtype=bool)
+    for seed in range(n_seeds):
+        s = UserReservoirSampler(k_max, seed=seed * 7919 + 1, skip_cuts=False)
+        s.fire(users, items, sampled)
+        assert int(s.hist_len[0]) == k_max  # reservoir exactly full, every seed
+        kept = s.hist[0, : int(s.hist_len[0])]
+        hits[kept] += 1
+    p = k_max / m
+    freq = hits / n_seeds
+    # Binomial(n_seeds, p) per position: sigma ~ 0.0166 -> +-5 sigma bounds.
+    sigma = (p * (1 - p) / n_seeds) ** 0.5
+    assert freq.min() > p - 5 * sigma, (freq.min(), p)
+    assert freq.max() < p + 5 * sigma, (freq.max(), p)

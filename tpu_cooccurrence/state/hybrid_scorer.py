@@ -27,29 +27,36 @@ import jax
 import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
-from ..ops.aggregate import aggregate_window_coo
+from ..ops.aggregate import aggregate_window_coo, distinct_sorted
 from ..ops.llr import llr_stable
 from ..ops.device_scorer import pad_pow2
 from ..sampling.reservoir import PairDeltaBatch
+from .results import TopKBatch
 
 
 @functools.partial(jax.jit, static_argnames=("top_k",))
-def _score_rows_batched(k11, other_sums, row_sums, observed, valid, top_k: int):
+def _score_rows_batched(block, row_sums, observed, top_k: int):
     """LLR + top-K over padded row blocks.
 
-    k11        [S, R] f32 — co-occurrence counts of each row's nonzeros
-    other_sums [S, R] f32 — rowSum(j) for each nonzero column j
-    row_sums   [S]    f32 — rowSum(i) per scored row
-    valid      [S, R] bool — padding mask
+    block    [2, S, R] f32 — (k11 counts, rowSum(j)) per row nonzero; padded
+             and zero-count slots carry ``k11 == 0`` (the validity mask —
+             the reference skips zero cells too, so no separate mask ships)
+    row_sums [S] f32 — rowSum(i) per scored row
+
+    One packed input and one packed ``[2, S, K]`` output (scores; slot
+    indices bitcast): the tunneled host<->device hop is bandwidth- and
+    per-transfer-latency-bound, so both count and bytes matter.
     """
+    k11 = block[0]
+    other_sums = block[1]
     rsi = row_sums[:, None]
     k12 = rsi - k11
     k21 = other_sums - k11
     k22 = observed + k11 - k12 - k21
     scores = llr_stable(k11, k12, k21, k22)
-    scores = jnp.where(valid, scores, -jnp.inf)
+    scores = jnp.where(k11 != 0, scores, -jnp.inf)
     vals, idx = jax.lax.top_k(scores, top_k)
-    return vals, idx
+    return jnp.stack([vals, jax.lax.bitcast_convert_type(idx, jnp.float32)])
 
 
 class HybridScorer:
@@ -72,6 +79,11 @@ class HybridScorer:
         self._zeros = 0
         self.row_sums = np.zeros(row_sum_capacity, dtype=np.int64)
         self.observed = 0
+        # One-window-deep result pipeline (see ops/device_scorer.py): the
+        # latency-bound device->host fetch of window N's top-K overlaps
+        # window N+1's host merge and dispatch; ``flush()`` drains the tail.
+        self._pending: Optional[List] = None
+        self.last_dispatched_rows = 0
 
     def _ensure(self, max_id: int) -> None:
         # Strict bound: id 2^31 - 1 would overflow the (rows + 1) << 32
@@ -84,10 +96,12 @@ class HybridScorer:
             grown[: len(self.row_sums)] = self.row_sums
             self.row_sums = grown
 
-    def process_window(self, ts: int, pairs: PairDeltaBatch
-                       ) -> List[Tuple[int, List[Tuple[int, float]]]]:
+    def process_window(self, ts: int, pairs: PairDeltaBatch):
+        self.last_dispatched_rows = 0
         if len(pairs) == 0:
-            return []
+            # No new dispatch this window — drain any completed in-flight
+            # results now instead of withholding them behind idle windows.
+            return self.flush()
         delta64 = pairs.delta.astype(np.int64)
         self._ensure(int(max(pairs.src.max(), pairs.dst.max())))
 
@@ -133,17 +147,20 @@ class HybridScorer:
 
         # Rows to score: every row that received any delta (even net-zero,
         # matching the reference's bufferedItemRowDeltas keying, :87-91).
-        rows = np.unique(pairs.src)
+        # d_key is sorted, so distinct srcs fall out without a re-sort.
+        rows = distinct_sorted((d_key >> 32))
         self.counters.add(RESCORED_ITEMS, len(rows))
+        self.last_dispatched_rows = len(rows)
 
         starts = np.searchsorted(self.g_key, rows << 32, side="left")
         ends = np.searchsorted(self.g_key, (rows + 1) << 32, side="left")
         lens = ends - starts
 
-        if self.development_mode:
-            sums = np.zeros(len(rows), dtype=np.int64)
-            for pos in range(len(rows)):  # dev-mode only: exactness check
-                sums[pos] = self.g_cnt[starts[pos]:ends[pos]].sum()
+        if self.development_mode and len(self.g_cnt):
+            # Row-sum consistency (reference dev check, :183-193), as
+            # segment sums over the sorted storage.
+            cs = np.concatenate([[0], np.cumsum(self.g_cnt)])
+            sums = cs[ends] - cs[starts]
             expect = self.row_sums[rows]
             if not np.array_equal(sums, expect):
                 bad = int(np.flatnonzero(sums != expect)[0])
@@ -151,32 +168,42 @@ class HybridScorer:
                     f"Item row {int(expect[bad])} does not match actual row "
                     f"sum {int(sums[bad])} (item {int(rows[bad])})")
 
-        if len(self.g_cnt) == 0:
-            # Entire matrix cancelled to zero: every scored row is empty.
-            return [(int(r), []) for r in rows]
+        chunks: List[Tuple[np.ndarray, np.ndarray, object]] = []
+        if len(self.g_cnt):
+            # Score in length-bucketed chunks: one giant row must not
+            # inflate the padding of thousands of short rows, and S*R per
+            # device call stays bounded (~4M elements) regardless of the
+            # window. Dispatches are async (one packed buffer each); the
+            # fetch happens one window later (see flush/_materialize).
+            by_len = np.argsort(lens, kind="stable")
+            budget = 1 << 22
+            pos = 0
+            min_r = max(16, self.top_k)  # lax.top_k needs k <= R
+            while pos < len(by_len):
+                R = pad_pow2(int(lens[by_len[pos]]) or 1, minimum=min_r)
+                max_s = max(budget // R, 1)
+                chunk = by_len[pos: pos + max_s]
+                # Extend R to cover the chunk's longest row (sorted
+                # ascending, so it's the last element), then trim the chunk
+                # if R grew.
+                R = pad_pow2(int(lens[chunk[-1]]) or 1, minimum=min_r)
+                max_s = max(budget // R, 1)
+                chunk = chunk[:max_s]
+                pos += len(chunk)
+                chunks.append(self._dispatch_chunk(
+                    rows[chunk], starts[chunk], lens[chunk], R))
+        else:
+            # Entire matrix cancelled to zero: every scored row is empty
+            # (all -inf batch; ids are filtered at materialization).
+            chunks.append((rows.astype(np.int32),
+                           np.zeros((len(rows), 1), np.int32), None))
 
-        # Score in length-bucketed chunks: one giant row must not inflate the
-        # padding of thousands of short rows, and S*R per device call stays
-        # bounded (~4M elements) regardless of the window.
-        out: List[Tuple[int, List[Tuple[int, float]]]] = []
-        by_len = np.argsort(lens, kind="stable")
-        budget = 1 << 22
-        pos = 0
-        min_r = max(16, self.top_k)  # lax.top_k needs k <= R
-        while pos < len(by_len):
-            R = pad_pow2(int(lens[by_len[pos]]) or 1, minimum=min_r)
-            max_s = max(budget // R, 1)
-            chunk = by_len[pos: pos + max_s]
-            # Extend R to cover the chunk's longest row (sorted ascending, so
-            # it's the last element), then trim the chunk if R grew.
-            R = pad_pow2(int(lens[chunk[-1]]) or 1, minimum=min_r)
-            max_s = max(budget // R, 1)
-            chunk = chunk[:max_s]
-            pos += len(chunk)
-            out.extend(self._score_chunk(rows[chunk], starts[chunk], lens[chunk], R))
-        return out
+        prev, self._pending = self._pending, chunks
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
 
-    def _score_chunk(self, rows, starts, lens, R) -> List[Tuple[int, List[Tuple[int, float]]]]:
+    def _dispatch_chunk(self, rows, starts, lens, R):
+        """Async-dispatch one [S, R] block; returns (rows, col ids, device buf)."""
         S = len(rows)
         S_pad = pad_pow2(S, minimum=16)
         col_idx = np.arange(R, dtype=np.int64)[None, :]
@@ -185,27 +212,47 @@ class HybridScorer:
         flat_idx = np.zeros((S_pad, R), dtype=np.int64)
         flat_idx[:S] = np.minimum(starts[:, None] + col_idx,
                                   len(self.g_cnt) - 1)
-        k11 = np.where(valid, self.g_cnt[flat_idx], 0).astype(np.float32)
+        block = np.zeros((2, S_pad, R), dtype=np.float32)
+        k11 = block[0]
+        np.copyto(k11, np.where(valid, self.g_cnt[flat_idx], 0))
         valid &= k11 != 0  # zero entries (pending compaction) are not scored
-        cols_padded = np.where(valid, self.g_key[flat_idx] & 0xFFFFFFFF, 0)
-        other_sums = np.where(valid, self.row_sums[cols_padded], 0).astype(np.float32)
+        cols_padded = np.where(valid, self.g_key[flat_idx] & 0xFFFFFFFF,
+                               0).astype(np.int32)
+        np.copyto(block[1], np.where(valid, self.row_sums[cols_padded], 0))
         rsums = np.zeros(S_pad, dtype=np.float32)
         rsums[:S] = self.row_sums[rows]
 
-        vals, idx = _score_rows_batched(
-            k11, other_sums, rsums, np.float32(self.observed), valid,
-            top_k=self.top_k)
-        vals = np.asarray(vals[:S])
-        idx = np.asarray(idx[:S])
+        packed = _score_rows_batched(
+            block, rsums, np.float32(self.observed), top_k=self.top_k)
+        if hasattr(packed, "copy_to_host_async"):
+            packed.copy_to_host_async()
+        return rows.astype(np.int32), cols_padded[:S], packed
 
-        out: List[Tuple[int, List[Tuple[int, float]]]] = []
-        take = np.take_along_axis(cols_padded[:S], idx, axis=1)
-        finite = np.isfinite(vals)
-        for r in range(S):
-            keep = finite[r]
-            out.append((int(rows[r]), list(zip(take[r][keep].tolist(),
-                                               vals[r][keep].tolist()))))
-        return out
+    def flush(self) -> TopKBatch:
+        """Emit the final in-flight window's results (end of pipeline)."""
+        prev, self._pending = self._pending, None
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
+
+    def _materialize(self, chunks) -> TopKBatch:
+        rows_l, idx_l, vals_l = [], [], []
+        for rows, cols_padded, packed in chunks:
+            S = len(rows)
+            if packed is None:  # zero-matrix window: all-empty rows
+                rows_l.append(rows)
+                vals_l.append(np.full((S, self.top_k), -np.inf, np.float32))
+                idx_l.append(np.zeros((S, self.top_k), np.int32))
+                continue
+            host = np.asarray(packed)  # single [2, S_pad, K] fetch
+            vals = host[0, :S]
+            slot = host[1, :S].view(np.int32)
+            # Map top-K slot indices back to dense item ids. -inf rows carry
+            # garbage slots (in-range by top_k's contract); their ids are
+            # filtered at materialization (TopKBatch contract).
+            idx_l.append(np.take_along_axis(cols_padded, slot, axis=1))
+            vals_l.append(vals)
+            rows_l.append(rows)
+        return TopKBatch.concatenate(rows_l, idx_l, vals_l, self.top_k)
 
     # -- checkpoint ------------------------------------------------------
 
@@ -224,3 +271,6 @@ class HybridScorer:
         self._zeros = 0
         self.row_sums = st["row_sums"].copy()
         self.observed = int(st["observed"][0])
+        # In-flight results belong to windows after the checkpoint; a
+        # restore that rolls back must not emit them.
+        self._pending = None
