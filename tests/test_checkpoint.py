@@ -156,3 +156,19 @@ def test_restore_rejects_out_of_capacity_counts(tmp_path):
     small = DeviceScorer(40, 5, use_pallas="off")
     with pytest.raises(ValueError, match="capacity"):
         small.restore_state(st)
+
+
+def test_restore_ignores_stale_meta_sidecar(tmp_path):
+    """The npz is the atomic commit point: restore must not read the
+    meta.json sidecar (which can lag by a crash between the two writes)."""
+    users, items, ts = random_stream(25, n=300)
+    cfg = make_cfg(tmp_path)
+    a = CooccurrenceJob(cfg)
+    a.add_batch(users, items, ts)
+    a.checkpoint()
+    # Corrupt the sidecar as a crash between the npz and meta writes would.
+    (tmp_path / "ckpt" / "meta.json").write_text('{"seed": 999}')
+
+    b = CooccurrenceJob(make_cfg(tmp_path))
+    b.restore()  # must succeed, using the meta embedded in the npz
+    assert b.windows_fired == a.windows_fired

@@ -95,3 +95,27 @@ def test_write_interactions_csv_roundtrip(tmp_path):
     u, i, t = parse_lines(open(p).read().splitlines())
     np.testing.assert_array_equal(u, [1, 2])
     np.testing.assert_array_equal(i, [3, 4])
+
+
+def test_midfile_resume_with_shared_mtime(tmp_path):
+    """Files sharing mtime_ns: a checkpoint mid-way through the second must
+    resume there — not re-read the first, not lose the second's tail."""
+    import os
+
+    from tpu_cooccurrence.io.source import FileMonitorSource
+
+    a, b = tmp_path / "a.csv", tmp_path / "b.csv"
+    a.write_text("a1\na2\n")
+    b.write_text("b1\nb2\nb3\n")
+    t = os.stat(a).st_mtime_ns
+    os.utime(b, ns=(t, t))  # identical mtime
+
+    src = FileMonitorSource(str(tmp_path))
+    it = src.lines()
+    got = [next(it) for _ in range(3)]   # a1 a2 b1
+    assert got == ["a1", "a2", "b1"]
+    state = src.checkpoint_state()
+
+    src2 = FileMonitorSource(str(tmp_path))
+    src2.restore_state(state)
+    assert list(src2.lines()) == ["b2", "b3"]

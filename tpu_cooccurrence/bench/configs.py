@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -179,18 +179,17 @@ ALL_CONFIGS: List[Tuple[str, Callable[[], BenchResult]]] = [
 ]
 
 
-def run_all() -> List[BenchResult]:
-    results = []
+def run_all() -> Iterator[BenchResult]:
     for _name, fn in ALL_CONFIGS:
-        results.append(fn())
-    return results
+        yield fn()
 
 
 def main() -> None:
     import json
 
+    # Stream each result as it completes (config 3-5 take minutes each).
     for res in run_all():
-        print(json.dumps(res.as_dict()))
+        print(json.dumps(res.as_dict()), flush=True)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     source = FileMonitorSource(
         config.input, job.counters,
         process_continuously=config.process_continuously)
+    # Crash recovery (the reference delegates this to Flink restarts): when
+    # a checkpoint exists in --checkpoint-dir, restore it — including the
+    # source's exact position, mid-file included — and continue from there.
+    # Periodic checkpoints during the run snapshot the source too
+    # (job.source).
+    if config.checkpoint_dir:
+        from .state import checkpoint as ckpt
+
+        job.source = source
+        if ckpt.exists(job, config.checkpoint_dir):
+            job.restore(source=source)
+            LOG.info("restored checkpoint from %s (windows_fired=%d)",
+                     config.checkpoint_dir, job.windows_fired)
     from .observability import xla_trace
 
     with xla_trace(config.profile_dir):

@@ -90,7 +90,8 @@ class Config:
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
-    pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = TPU only)
+    pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = off:
+    # measured slower than XLA's fused path on current TPUs, see device_scorer)
     development_mode: bool = False  # invariant checks (FlinkCooccurrences.java:34)
     process_continuously: bool = False  # PROCESS_ONCE vs PROCESS_CONTINUOUSLY
     # Multi-host (multi-controller JAX): run one process per host, each
@@ -180,7 +181,8 @@ class Config:
                        help="Write a jax.profiler trace for TensorBoard")
         p.add_argument("--pallas", choices=["auto", "on", "off"],
                        default="auto",
-                       help="Fused Pallas score/top-K kernel (auto: TPU only)")
+                       help="Fused Pallas score/top-K kernel (auto: off — XLA path "
+                            "measured faster on current TPUs)")
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")

@@ -43,16 +43,30 @@ class FileMonitorSource:
         self.process_continuously = process_continuously
         self.poll_interval_s = poll_interval_s
         # Checkpointed monotone progress marker (reference:
-        # ContinuousFileMonitoringFunction.java:380-392).
+        # ContinuousFileMonitoringFunction.java:380-392). Advanced only when
+        # a file has been fully consumed; a mid-file position is carried
+        # separately so a checkpoint taken mid-file resumes exactly (the
+        # reference cannot: its marker covers whole splits only).
         self.global_modification_time: int = -1
+        self._current_file: Optional[str] = None
+        self._current_mtime: int = -1
+        self._current_line: int = 0
 
     # -- checkpoint hooks ------------------------------------------------
 
     def checkpoint_state(self) -> dict:
-        return {"global_modification_time": self.global_modification_time}
+        return {
+            "global_modification_time": self.global_modification_time,
+            "current_file": self._current_file,
+            "current_mtime": self._current_mtime,
+            "current_line": self._current_line,
+        }
 
     def restore_state(self, state: dict) -> None:
         self.global_modification_time = int(state["global_modification_time"])
+        self._current_file = state.get("current_file")
+        self._current_mtime = int(state.get("current_mtime", -1))
+        self._current_line = int(state.get("current_line", 0))
 
     # -- listing ---------------------------------------------------------
 
@@ -80,18 +94,59 @@ class FileMonitorSource:
     # -- reading ---------------------------------------------------------
 
     def lines(self) -> Iterator[str]:
-        """Yield all input lines, file by file, in order."""
+        """Yield all input lines, file by file, in order.
+
+        The progress marker advances only once a file is exhausted; while a
+        file is open, (path, mtime, lines yielded) track the exact position
+        so a checkpoint taken between batches loses nothing. A restored
+        source skips the already-consumed prefix of the in-flight file (if
+        it still exists unmodified) and continues.
+        """
+        # Restored mid-file position (if any): resume only when the same
+        # file is re-listed with an unchanged mtime; a file modified since
+        # the checkpoint is re-read whole (its already-windowed prefix
+        # re-arrives behind the watermark and is dropped as late).
+        skip_file = self._current_file
+        skip_mtime = self._current_mtime
+        skip_lines = self._current_line
         while True:
             splits = self._list_splits()
-            for mtime, p in splits:
+            if skip_file is not None:
+                # Consumption order is the deterministic (mtime, path) sort,
+                # so files ordered before the in-flight one were fully
+                # consumed even when they share its mtime (the > marker
+                # filter alone cannot know that).
+                splits = [s for s in splits if s >= (skip_mtime, skip_file)]
+            for pos, (mtime, p) in enumerate(splits):
                 self.counters.add(SPLIT_READER_NUM_SPLITS, 1)
-                if mtime > self.global_modification_time:
-                    self.global_modification_time = mtime
+                to_skip = skip_lines if (p == skip_file
+                                         and mtime == skip_mtime) else 0
+                skip_file = None
+                self._current_file = p
+                self._current_mtime = mtime
+                self._current_line = to_skip
                 with open(p, "r") as f:
                     for line in f:
+                        if to_skip:  # raw-line count, blank lines included
+                            to_skip -= 1
+                            continue
+                        self._current_line += 1
                         line = line.rstrip("\n")
                         if line:
                             yield line
+                # Advance the marker only once the LAST file sharing this
+                # mtime completes: the marker's invariant is "everything at
+                # or below is fully consumed", and _list_splits filters with
+                # a strict >, so advancing early would hide same-mtime
+                # siblings from a restored run.
+                last_of_mtime = (pos + 1 == len(splits)
+                                 or splits[pos + 1][0] > mtime)
+                if last_of_mtime and mtime > self.global_modification_time:
+                    self.global_modification_time = mtime
+                self._current_file = None
+                self._current_mtime = -1
+                self._current_line = 0
+            skip_file = None  # the restored position applies only once
             if not self.process_continuously:
                 return
             time.sleep(self.poll_interval_s)

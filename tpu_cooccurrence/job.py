@@ -73,6 +73,9 @@ class CooccurrenceJob:
         self.emissions = 0
         self.windows_fired = 0
         self.step_timer = StepTimer()
+        # Optional file source attached by the CLI so periodic checkpoints
+        # snapshot the input offset too (crash recovery resumes mid-stream).
+        self.source = None
         # One in-process feedback channel (the reference counts one queue
         # handshake per subtask open,
         # UserInteractionCounterOneInputStreamOperator.java:109). Sliding
@@ -194,7 +197,7 @@ class CooccurrenceJob:
             if (self.config.checkpoint_dir
                     and self.config.checkpoint_every_windows > 0
                     and self.windows_fired % self.config.checkpoint_every_windows == 0):
-                self.checkpoint()
+                self.checkpoint(source=self.source)
         if final:
             # Backends with a result pipeline (device) hold the last window's
             # top-K in flight; drain it.

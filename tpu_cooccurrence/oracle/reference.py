@@ -302,7 +302,10 @@ class OracleJob:
                         f"Item row {row_sum} does not match actual row sum {actual}")
 
             self._heap.reset()
-            for other, count in row.items():
+            # Sorted column order: deterministic lowest-index tie-breaking
+            # (see state/rescorer.py _score_row).
+            for other in sorted(row):
+                count = row[other]
                 if count == 0:
                     continue
                 other_sum = self.global_row_sums.get(other, 0)

@@ -10,8 +10,9 @@ totals, draw counters), in-flight window buffers + watermark, the scorer's
 matrix/row-sums/observed total, and the source offset — so a restored job
 continues bit-identically (validated in ``tests/test_checkpoint.py``).
 
-Format: a single ``.npz`` of arrays + a JSON sidecar of scalars. Writes are
-atomic (tmp file + rename).
+Format: a single ``.npz`` holding the arrays AND the JSON-encoded scalars
+(``meta_json``), committed by one atomic rename; a ``meta.json`` sidecar is
+written afterwards for human inspection only and plays no part in restore.
 """
 
 from __future__ import annotations
@@ -22,6 +23,14 @@ import tempfile
 from typing import Optional
 
 import numpy as np
+
+
+def exists(job, directory: str) -> bool:
+    """True when ``directory`` holds a checkpoint this job could restore
+    (same file-naming scheme as :func:`save`, including the per-process
+    suffix of multi-host runs)."""
+    suffix = getattr(job.scorer, "process_suffix", "")
+    return os.path.exists(os.path.join(directory, f"state{suffix}.npz"))
 
 
 def save(job, directory: str, source=None) -> str:
@@ -87,6 +96,14 @@ def save(job, directory: str, source=None) -> str:
     arrays["latest_others"] = np.asarray(lat_others, dtype=np.int64)
     arrays["latest_scores"] = np.asarray(lat_scores, dtype=np.float64)
 
+    # The meta scalars ride INSIDE the .npz so one atomic rename commits
+    # the whole checkpoint — a crash between two file replacements would
+    # otherwise leave a mixed-generation (arrays N, meta N-1) state that
+    # restores without error and silently double-ingests. The sidecar
+    # meta.json is written afterwards purely for human inspection.
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+
     # Multi-host runs checkpoint per process (each host owns a row block
     # and its partition of the results); the scorer supplies the suffix.
     suffix = getattr(job.scorer, "process_suffix", "")
@@ -106,15 +123,16 @@ def save(job, directory: str, source=None) -> str:
 def restore(job, directory: str, source=None) -> None:
     """Restore ``job`` (constructed with the same Config) from a checkpoint."""
     suffix = getattr(job.scorer, "process_suffix", "")
-    with open(os.path.join(directory, f"meta{suffix}.json")) as f:
-        meta = json.load(f)
+    data = np.load(os.path.join(directory, f"state{suffix}.npz"))
+    # Meta comes from inside the npz (the atomic commit point); the
+    # meta.json sidecar is informational only and may lag by a crash.
+    meta = json.loads(bytes(data["meta_json"]).decode())
     for key in ("seed", "skip_cuts", "item_cut", "user_cut", "top_k",
                 "window_slide"):
         if getattr(job.config, key) != meta.get(key):
             raise ValueError(
                 f"checkpoint config mismatch for {key}: "
                 f"{meta.get(key)} != {getattr(job.config, key)}")
-    data = np.load(os.path.join(directory, f"state{suffix}.npz"))
 
     job.item_vocab.restore_state(data["item_vocab"])
     job.user_vocab.restore_state(data["user_vocab"])

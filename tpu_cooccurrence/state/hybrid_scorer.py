@@ -69,6 +69,9 @@ class HybridScorer:
     def __init__(self, top_k: int, counters: Optional[Counters] = None,
                  development_mode: bool = False,
                  row_sum_capacity: int = 1024) -> None:
+        from ..xla_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         self.top_k = top_k
         self.counters = counters if counters is not None else Counters()
         self.development_mode = development_mode
@@ -156,9 +159,10 @@ class HybridScorer:
         ends = np.searchsorted(self.g_key, (rows + 1) << 32, side="left")
         lens = ends - starts
 
-        if self.development_mode and len(self.g_cnt):
+        if self.development_mode:
             # Row-sum consistency (reference dev check, :183-193), as
-            # segment sums over the sorted storage.
+            # segment sums over the sorted storage (empty storage included:
+            # every scored row must then sum to zero).
             cs = np.concatenate([[0], np.cumsum(self.g_cnt)])
             sums = cs[ends] - cs[starts]
             expect = self.row_sums[rows]
@@ -171,27 +175,35 @@ class HybridScorer:
         chunks: List[Tuple[np.ndarray, np.ndarray, object]] = []
         if len(self.g_cnt):
             # Score in length-bucketed chunks: one giant row must not
-            # inflate the padding of thousands of short rows, and S*R per
-            # device call stays bounded (~4M elements) regardless of the
-            # window. Dispatches are async (one packed buffer each); the
-            # fetch happens one window later (see flush/_materialize).
+            # inflate the padding of thousands of short rows. Block shapes
+            # come from a bounded two-dimensional ladder — R is the pow-2
+            # row-length bucket, S_pad = min(pad_pow2(S), budget // R) — so
+            # at most O(log R x log S) programs ever compile. (A free
+            # per-chunk S_pad walks an unbounded shape space on a growing
+            # stream, and every new combination is a multi-second XLA
+            # compile on the tunneled chip, which dwarfed the scoring
+            # itself; a fixed S_pad = budget//R wastes ~8 MB of transfer per
+            # small window instead.) Dispatches are async (one packed
+            # buffer each); the fetch happens one window later (see
+            # flush/_materialize).
             by_len = np.argsort(lens, kind="stable")
-            budget = 1 << 22
+            budget = 1 << 20
             pos = 0
             min_r = max(16, self.top_k)  # lax.top_k needs k <= R
             while pos < len(by_len):
                 R = pad_pow2(int(lens[by_len[pos]]) or 1, minimum=min_r)
-                max_s = max(budget // R, 1)
-                chunk = by_len[pos: pos + max_s]
+                s_block = max(budget // R, 16)
+                chunk = by_len[pos: pos + s_block]
                 # Extend R to cover the chunk's longest row (sorted
                 # ascending, so it's the last element), then trim the chunk
                 # if R grew.
                 R = pad_pow2(int(lens[chunk[-1]]) or 1, minimum=min_r)
-                max_s = max(budget // R, 1)
-                chunk = chunk[:max_s]
+                s_block = max(budget // R, 16)
+                chunk = chunk[:s_block]
                 pos += len(chunk)
+                s_pad = min(pad_pow2(len(chunk), minimum=16), s_block)
                 chunks.append(self._dispatch_chunk(
-                    rows[chunk], starts[chunk], lens[chunk], R))
+                    rows[chunk], starts[chunk], lens[chunk], R, s_pad))
         else:
             # Entire matrix cancelled to zero: every scored row is empty
             # (all -inf batch; ids are filtered at materialization).
@@ -202,10 +214,9 @@ class HybridScorer:
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
 
-    def _dispatch_chunk(self, rows, starts, lens, R):
-        """Async-dispatch one [S, R] block; returns (rows, col ids, device buf)."""
+    def _dispatch_chunk(self, rows, starts, lens, R, S_pad):
+        """Async-dispatch one [S_pad, R] block; returns (rows, col ids, buf)."""
         S = len(rows)
-        S_pad = pad_pow2(S, minimum=16)
         col_idx = np.arange(R, dtype=np.int64)[None, :]
         valid = np.zeros((S_pad, R), dtype=bool)
         valid[:S] = col_idx < lens[:, None]

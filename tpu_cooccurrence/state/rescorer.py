@@ -75,8 +75,12 @@ class HostRescorer:
             if actual != row_sum:
                 raise AssertionError(
                     f"Item row {row_sum} does not match actual row sum {actual}")
-        others = np.fromiter((j for j, c in row.items() if c != 0), dtype=np.int64,
-                             count=sum(1 for c in row.values() if c != 0))
+        # Sorted column order: deterministic tie-breaking (lowest index wins
+        # among equal scores, matching lax.top_k) that survives
+        # checkpoint/restore — unlike the reference, whose tie order floats
+        # with hashmap iteration order.
+        others = np.array(sorted(j for j, c in row.items() if c != 0),
+                          dtype=np.int64)
         if len(others) == 0:
             return []
         k11 = np.fromiter((row[int(j)] for j in others), dtype=np.int64,
