@@ -172,3 +172,32 @@ def test_restore_ignores_stale_meta_sidecar(tmp_path):
     b = CooccurrenceJob(make_cfg(tmp_path))
     b.restore()  # must succeed, using the meta embedded in the npz
     assert b.windows_fired == a.windows_fired
+
+
+def test_restore_across_count_dtype(tmp_path):
+    """int16 checkpoints widen to int32 freely; narrowing is bounds-checked."""
+    import jax.numpy as jnp
+    import pytest
+
+    from tpu_cooccurrence.ops.device_scorer import DeviceScorer
+
+    s16 = DeviceScorer(32, 5, count_dtype="int16")
+    C = np.zeros((32, 32), np.int16)
+    C[3, 4] = 1000
+    s16.C = jnp.asarray(C)
+    s16.row_sums = jnp.asarray(C.sum(axis=1).astype(np.int32))
+    st = s16.checkpoint_state()
+
+    s32 = DeviceScorer(32, 5, count_dtype="int32")
+    s32.restore_state(st)
+    assert np.asarray(s32.C).dtype == np.int32
+    assert int(np.asarray(s32.C)[3, 4]) == 1000
+
+    big = DeviceScorer(32, 5, count_dtype="int32")
+    C2 = np.zeros((32, 32), np.int32)
+    C2[1, 1] = 70_000  # beyond int16
+    big.C = jnp.asarray(C2)
+    big.row_sums = jnp.asarray(C2.sum(axis=1).astype(np.int32))
+    st2 = big.checkpoint_state()
+    with pytest.raises(ValueError, match="int16"):
+        DeviceScorer(32, 5, count_dtype="int16").restore_state(st2)

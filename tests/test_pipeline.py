@@ -144,3 +144,25 @@ def test_batch_boundaries_do_not_matter():
                   backend=Backend.ORACLE)
     b = run_production(cfg2, users, items, ts, chunk=600)
     assert_latest_equal(a.latest, b.latest)
+
+
+def test_device_int16_counts_match_oracle():
+    """--count-dtype int16 (reference-style short counts) is exact while
+    counts stay within int16 range."""
+    users, items, ts = random_stream(41)
+    kw = dict(window_size=10, seed=0xD0D0, item_cut=6, user_cut=4)
+    a = run_production(Config(**kw, backend=Backend.ORACLE), users, items, ts)
+    b = run_production(Config(**kw, backend=Backend.DEVICE, num_items=32,
+                              count_dtype="int16"), users, items, ts)
+    assert_latest_equal(a.latest, b.latest, tol=dict(rtol=1e-4, atol=1e-4))
+    assert a.counters.as_dict() == b.counters.as_dict()
+
+
+def test_sharded_int16_counts_match_oracle():
+    users, items, ts = random_stream(42)
+    kw = dict(window_size=10, seed=0xD0D1, skip_cuts=True)
+    a = run_production(Config(**kw, backend=Backend.ORACLE), users, items, ts)
+    b = run_production(Config(**kw, backend=Backend.SHARDED, num_items=32,
+                              num_shards=8, count_dtype="int16"),
+                       users, items, ts)
+    assert_latest_equal(a.latest, b.latest, tol=dict(rtol=1e-4, atol=1e-4))

@@ -92,6 +92,9 @@ class Config:
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
     pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = off:
     # measured slower than XLA's fused path on current TPUs, see device_scorer)
+    count_dtype: str = "int32"  # dense C cell dtype; int16 halves HBM
+    # (reference-style short counts incl. its wraparound, doubles the
+    # dense/sharded vocab ceiling)
     development_mode: bool = False  # invariant checks (FlinkCooccurrences.java:34)
     process_continuously: bool = False  # PROCESS_ONCE vs PROCESS_CONTINUOUSLY
     # Multi-host (multi-controller JAX): run one process per host, each
@@ -183,6 +186,11 @@ class Config:
                        default="auto",
                        help="Fused Pallas score/top-K kernel (auto: off — XLA path "
                             "measured faster on current TPUs)")
+        p.add_argument("--count-dtype", choices=["int32", "int16"],
+                       default="int32", dest="count_dtype",
+                       help="Dense count-matrix cell dtype (int16 halves "
+                            "device memory; counts then wrap like the "
+                            "reference's Java shorts)")
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")

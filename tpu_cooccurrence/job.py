@@ -97,7 +97,8 @@ class CooccurrenceJob:
                     "device backend needs --num-items (dense vocab capacity)")
             return DeviceScorer(num_items, self.config.top_k, self.counters,
                                 max_pairs_per_step=self.config.max_pairs_per_step,
-                                use_pallas=self.config.pallas)
+                                use_pallas=self.config.pallas,
+                                count_dtype=self.config.count_dtype)
         if backend == Backend.HYBRID:
             from .state.hybrid_scorer import HybridScorer
 
@@ -121,7 +122,8 @@ class CooccurrenceJob:
                 mesh = make_multihost_mesh()
             return ShardedScorer(num_items, self.config.top_k,
                                  num_shards=self.config.num_shards,
-                                 counters=self.counters, mesh=mesh)
+                                 counters=self.counters, mesh=mesh,
+                                 count_dtype=self.config.count_dtype)
         raise ValueError(f"unknown backend {backend}")
 
     # ------------------------------------------------------------------

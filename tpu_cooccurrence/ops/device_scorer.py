@@ -21,10 +21,13 @@ of programs (SURVEY §7 "hard parts": padding/bucketing of COO buffers).
 Padded pair slots carry ``delta == 0`` at indices (0, 0) — a scatter-add of
 zero is a no-op. Padded row slots score row 0 and are dropped on host.
 
-Counts are int32 (the reference uses Java short16 with silent wraparound —
-we deliberately widen, SURVEY §7). LLR runs in float32 via the stable
-``log1p`` form (``ops/llr.py``); ``observed`` is tracked exactly on host and
-fed per step as a float32 scalar.
+Counts are int32 by default (the reference uses Java short16 with silent
+wraparound — we deliberately widen, SURVEY §7); ``count_dtype="int16"``
+opts back into reference-style shorts, halving HBM so the dense matrix
+reaches ~90k-item vocabularies, wraparound included. Row sums are int32
+always. LLR runs in float32 via the stable ``log1p`` form (``ops/llr.py``);
+``observed`` is tracked exactly on host and fed per step as a float32
+scalar.
 """
 
 from __future__ import annotations
@@ -77,8 +80,31 @@ def score_row_budget(num_items: int, cap: int) -> int:
     return min(cap, 1 << (budget_rows.bit_length() - 1))
 
 
+def fit_count_dtype(arr, dtype: np.dtype) -> np.ndarray:
+    """Cast checkpointed counts to a scorer's dtype.
+
+    Widening is always safe (no scan); narrowing (int32 checkpoint ->
+    int16 run) scans for out-of-range values instead of silently wrapping.
+    """
+    arr = np.asarray(arr)
+    if arr.dtype == dtype:
+        return arr
+    if not np.can_cast(arr.dtype, dtype, casting="safe"):
+        info = np.iinfo(dtype)
+        if arr.size and (arr.min() < info.min or arr.max() > info.max):
+            raise ValueError(
+                f"checkpoint counts exceed {np.dtype(dtype).name} range — "
+                f"restore with --count-dtype {arr.dtype.name}")
+    return arr.astype(dtype)
+
+
 def _apply_coo(C, row_sums, src, dst, delta, num_items: int):
-    C = C.at[src, dst].add(delta)
+    # C may be int16 (reference-style short counts, --count-dtype int16 —
+    # halves HBM so the dense backend reaches ~90k-item vocabularies; cell
+    # wraparound then matches the reference's documented silent-overflow
+    # behavior, ItemRowAggregator.java:16). Row sums stay int32 always:
+    # they grow far past 2^15.
+    C = C.at[src, dst].add(delta.astype(C.dtype))
     rs_delta = jnp.zeros((num_items,), dtype=jnp.int32).at[src].add(delta)
     return C, row_sums + rs_delta
 
@@ -103,10 +129,11 @@ def _update_coo(C, row_sums, coo, num_items: int):
 def _update_coo_u16(C, row_sums, coo, num_items: int):
     """Scatter-apply a packed ``[3, N]`` uint16 COO block (half the bytes).
 
-    The dense backend caps the vocab at 65536 anyway (C is I^2 int32), so
-    src/dst always fit uint16; deltas ride as uint16 two's complement and
-    are sign-extended here. The caller falls back to the int32 block when
-    a window's aggregated cell delta leaves int16 range.
+    Used only when the vocab fits 2^16 (the caller checks ``num_items`` —
+    int16-count runs can exceed that, and then ship int32 blocks); deltas
+    ride as uint16 two's complement and are sign-extended here. The caller
+    also falls back to the int32 block when a window's aggregated cell
+    delta leaves int16 range.
     """
     src = coo[0].astype(jnp.int32)
     dst = coo[1].astype(jnp.int32)
@@ -143,10 +170,14 @@ class DeviceScorer:
                  max_score_rows_per_call: int = 8192,
                  max_pairs_per_step: int = 1 << 20,
                  use_pallas: str = "auto",
+                 count_dtype: str = "int32",
                  device=None) -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
+        if count_dtype not in ("int32", "int16"):
+            raise ValueError(f"count_dtype must be int32|int16, got {count_dtype}")
+        self.count_dtype = np.dtype(count_dtype)
         self.top_k = top_k
         self.counters = counters if counters is not None else Counters()
         self._max_score_rows_cap = max_score_rows_per_call
@@ -163,6 +194,10 @@ class DeviceScorer:
             self.use_pallas = use_pallas == "on"
         # Off-TPU the kernel can only run interpreted (test/debug use).
         self._pallas_interpret = jax.default_backend() != "tpu"
+        if self.use_pallas and self.count_dtype != np.int32:
+            raise ValueError(
+                "the Pallas kernel's 8-row blocks assume int32 sublane "
+                "tiling; use --pallas off with --count-dtype int16")
         if self.use_pallas:
             # Pad the vocab so the Pallas column-tile grid divides evenly;
             # the extra columns stay zero and are masked out of scoring.
@@ -179,7 +214,8 @@ class DeviceScorer:
         self.device = device
         num_items = self.num_items
         with jax.default_device(device) if device is not None else contextlib.nullcontext():
-            self.C = jnp.zeros((num_items, num_items), dtype=jnp.int32)
+            self.C = jnp.zeros((num_items, num_items),
+                               dtype=jnp.dtype(self.count_dtype.name))
             self.row_sums = jnp.zeros((num_items,), dtype=jnp.int32)
         self.observed = 0  # exact, host-side (int), fed to kernels as f32
         # Result pipeline: window results are fetched one window late so the
@@ -293,7 +329,7 @@ class DeviceScorer:
         }
 
     def restore_state(self, st: dict) -> None:
-        ck = np.asarray(st["C"], dtype=np.int32)
+        ck = fit_count_dtype(st["C"], self.count_dtype)
         if ck.shape != (self.num_items, self.num_items):
             # Vocab padding differs between runs when the pallas setting
             # changes (the kernel pads to tile multiples). Both layouts hold
@@ -308,7 +344,8 @@ class DeviceScorer:
                     f"checkpoint C shape {ck.shape} holds counts beyond this "
                     f"scorer's capacity {self.num_items} — restore with "
                     f"--num-items >= the checkpointing run's")
-            fitted = np.zeros((self.num_items, self.num_items), dtype=np.int32)
+            fitted = np.zeros((self.num_items, self.num_items),
+                              dtype=self.count_dtype)
             m = min(n, self.num_items)
             fitted[:m, :m] = ck[:m, :m]
             ck = fitted

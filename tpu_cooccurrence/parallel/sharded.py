@@ -53,10 +53,14 @@ class ShardedScorer:
     def __init__(self, num_items: int, top_k: int, num_shards: Optional[int] = None,
                  counters: Optional[Counters] = None,
                  mesh: Optional[Mesh] = None,
-                 max_score_rows_per_call: int = 8192) -> None:
+                 max_score_rows_per_call: int = 8192,
+                 count_dtype: str = "int32") -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
+        if count_dtype not in ("int32", "int16"):
+            raise ValueError(f"count_dtype must be int32|int16, got {count_dtype}")
+        self.count_dtype = np.dtype(count_dtype)
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         self.n_shards = self.mesh.devices.size
         self.num_items_logical = num_items
@@ -78,7 +82,7 @@ class ShardedScorer:
 
         self._put_global = put_global
         self.C = put_global(
-            np.zeros((self.num_items, self.num_items), dtype=np.int32),
+            np.zeros((self.num_items, self.num_items), dtype=self.count_dtype),
             self.mesh, P(ITEM_AXIS, None))
         self.row_sums = put_global(
             np.zeros((self.num_items,), dtype=np.int32), self.mesh, P())
@@ -91,7 +95,9 @@ class ShardedScorer:
             # buffer = one host->device transfer); localize rows.
             src, dst, delta = coo[0, 0], coo[0, 1], coo[0, 2]
             lo = jax.lax.axis_index(ITEM_AXIS) * rows_per_shard_c
-            C_loc = C_loc.at[src - lo, dst].add(delta)
+            # C may be int16 (--count-dtype, reference-style short counts);
+            # row sums stay int32 (see ops/device_scorer._apply_coo).
+            C_loc = C_loc.at[src - lo, dst].add(delta.astype(C_loc.dtype))
             rs_part = jnp.zeros((num_items_c,), dtype=jnp.int32).at[src].add(delta)
             row_sums = row_sums + jax.lax.psum(rs_part, ITEM_AXIS)
             return C_loc, row_sums
@@ -257,6 +263,11 @@ class ShardedScorer:
             "observed": np.asarray([self.observed], dtype=np.int64),
         }
 
+    def _fit_count_dtype(self, arr) -> np.ndarray:
+        from ..ops.device_scorer import fit_count_dtype
+
+        return fit_count_dtype(arr, self.count_dtype)
+
     def restore_state(self, st: dict) -> None:
         if "C_local" in st:
             if jax.process_count() == 1:
@@ -265,7 +276,7 @@ class ShardedScorer:
                     "row blocks); restore it under the same process layout")
             from jax.sharding import NamedSharding
 
-            c_local = np.asarray(st["C_local"], dtype=np.int32)
+            c_local = self._fit_count_dtype(st["C_local"])
             row_lo = int(st["row_lo"][0])
 
             def _local_block(idx):
@@ -277,7 +288,7 @@ class ShardedScorer:
                 (self.num_items, self.num_items),
                 NamedSharding(self.mesh, P(ITEM_AXIS, None)), _local_block)
         else:
-            self.C = self._put_global(np.asarray(st["C"], dtype=np.int32),
+            self.C = self._put_global(self._fit_count_dtype(st["C"]),
                                       self.mesh, P(ITEM_AXIS, None))
         self.row_sums = self._put_global(
             np.asarray(st["row_sums"], dtype=np.int32), self.mesh, P())
