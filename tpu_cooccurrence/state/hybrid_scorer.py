@@ -174,19 +174,28 @@ class HybridScorer:
 
         chunks: List[Tuple[np.ndarray, np.ndarray, object]] = []
         if len(self.g_cnt):
-            # Score in length-bucketed chunks: one giant row must not
-            # inflate the padding of thousands of short rows. Block shapes
-            # come from a bounded two-dimensional ladder — R is the pow-2
-            # row-length bucket, S_pad = min(pad_pow2(S), budget // R) — so
-            # at most O(log R x log S) programs ever compile. (A free
-            # per-chunk S_pad walks an unbounded shape space on a growing
-            # stream, and every new combination is a multi-second XLA
-            # compile on the tunneled chip, which dwarfed the scoring
-            # itself; a fixed S_pad = budget//R wastes ~8 MB of transfer per
-            # small window instead.) Dispatches are async (one packed
-            # buffer each); the fetch happens one window later (see
-            # flush/_materialize).
-            by_len = np.argsort(lens, kind="stable")
+            # Split by row length. Short rows (the long-tail mass at big
+            # vocabularies — typically >95% of rows but a sliver of the
+            # cells) are scored ON HOST in float64: shipping them padded to
+            # device rectangles cost ~20x their content in transfer on the
+            # ~100 MB/s tunneled link, while host numpy scores them in
+            # milliseconds. Long rows (head items, most of the cells) go to
+            # the device in length-bucketed [S_pad, R] blocks where padding
+            # is tight.
+            short = lens <= self.HOST_ROW_MAX
+            if short.any():
+                chunks.append(self._score_short_rows_host(
+                    rows[short], starts[short], lens[short]))
+            long_idx = np.flatnonzero(~short)
+            # Length-bucketed device blocks over a bounded two-dimensional
+            # shape ladder — R is the pow-2 row-length bucket, S_pad =
+            # min(pad_pow2(S), budget // R) — so at most O(log R x log S)
+            # programs ever compile (a free per-chunk S_pad walks an
+            # unbounded shape space on a growing stream, and every new
+            # combination is a multi-second XLA compile on the tunneled
+            # chip). Dispatches are async (one packed buffer each); the
+            # fetch happens one window later (see flush/_materialize).
+            by_len = long_idx[np.argsort(lens[long_idx], kind="stable")]
             budget = 1 << 20
             pos = 0
             min_r = max(16, self.top_k)  # lax.top_k needs k <= R
@@ -213,6 +222,45 @@ class HybridScorer:
         prev, self._pending = self._pending, chunks
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
+
+    # Rows at or below this length are scored on host (float64, exact);
+    # above it, on device. Sized so host LLR work stays in the single-digit
+    # milliseconds per window while the padded-rectangle transfer the host
+    # path replaces would have dwarfed the content.
+    HOST_ROW_MAX = 32
+
+    def _score_short_rows_host(self, rows, starts, lens):
+        """Score rows of <= HOST_ROW_MAX nonzeros on host; returns a chunk
+        in already-materialized form (ids final, payload == 'host')."""
+        from ..ops.llr import llr_np
+
+        S = len(rows)
+        R = max(int(lens.max()) if S else 1, 1)
+        col_idx = np.arange(R, dtype=np.int64)[None, :]
+        valid = col_idx < lens[:, None]
+        flat_idx = np.minimum(starts[:, None] + col_idx, len(self.g_cnt) - 1)
+        k11 = np.where(valid, self.g_cnt[flat_idx], 0).astype(np.float64)
+        valid &= k11 != 0  # zero entries (pending compaction) unscored
+        cols = np.where(valid, self.g_key[flat_idx] & 0xFFFFFFFF,
+                        0).astype(np.int64)
+        rsj = np.where(valid, self.row_sums[cols], 0).astype(np.float64)
+        rsi = self.row_sums[rows].astype(np.float64)[:, None]
+        k12 = rsi - k11
+        k21 = rsj - k11
+        k22 = float(self.observed) + k11 - k12 - k21
+        scores = llr_np(k11, k12, k21, k22)
+        scores[~valid] = -np.inf
+        # Stable argsort of -scores: descending scores, ties broken by the
+        # lower column (matches the device lax.top_k tie-break).
+        order = np.argsort(-scores, axis=1, kind="stable")[:, : self.top_k]
+        vals = np.take_along_axis(scores, order, axis=1).astype(np.float32)
+        idx = np.take_along_axis(cols, order, axis=1).astype(np.int32)
+        if vals.shape[1] < self.top_k:  # every row shorter than K
+            pad = self.top_k - vals.shape[1]
+            vals = np.pad(vals, ((0, 0), (0, pad)),
+                          constant_values=-np.inf)
+            idx = np.pad(idx, ((0, 0), (0, pad)))
+        return rows.astype(np.int32), idx, (("host", vals))
 
     def _dispatch_chunk(self, rows, starts, lens, R, S_pad):
         """Async-dispatch one [S_pad, R] block; returns (rows, col ids, buf)."""
