@@ -75,6 +75,22 @@ def assert_latest_equal(oracle_latest, prod_latest, tol=None):
             np.testing.assert_allclose(p_scores, o_scores, **tol)
 
 
+def assert_latest_close(a_latest, b_latest, rtol=1e-4, atol=1e-3, gap=1e-2):
+    """Tolerance comparison for f32-vs-f64 backends: scores to (rtol, atol),
+    and the recommended item ids exactly whenever every score gap in the row
+    exceeds ``gap`` (near-ties may legitimately reorder across precisions)."""
+    assert set(a_latest) == set(b_latest)
+    for item in a_latest:
+        o = a_latest[item]
+        p = b_latest[item]
+        assert len(o) == len(p), f"row {item}: {o} vs {p}"
+        o_scores = np.array([s for _, s in o])
+        p_scores = np.array([s for _, s in p])
+        np.testing.assert_allclose(p_scores, o_scores, rtol=rtol, atol=atol)
+        if len(o_scores) > 1 and np.min(np.abs(np.diff(o_scores))) > gap:
+            assert [j for j, _ in o] == [j for j, _ in p], f"row {item}"
+
+
 CONFIGS = [
     dict(skip_cuts=True),
     dict(item_cut=5, user_cut=4),

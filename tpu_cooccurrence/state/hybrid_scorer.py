@@ -87,6 +87,10 @@ class HybridScorer:
         # window N+1's host merge and dispatch; ``flush()`` drains the tail.
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
+        # Introspection: lifetime chunk counts per scoring path, so tests
+        # can assert a stream actually exercised both host and device paths.
+        self.dispatched_host_chunks = 0
+        self.dispatched_device_chunks = 0
 
     def _ensure(self, max_id: int) -> None:
         # Strict bound: id 2^31 - 1 would overflow the (rows + 1) << 32
@@ -184,6 +188,7 @@ class HybridScorer:
             # is tight.
             short = lens <= self.HOST_ROW_MAX
             if short.any():
+                self.dispatched_host_chunks += 1
                 chunks.append(self._score_short_rows_host(
                     rows[short], starts[short], lens[short]))
             long_idx = np.flatnonzero(~short)
@@ -211,6 +216,7 @@ class HybridScorer:
                 chunk = chunk[:s_block]
                 pos += len(chunk)
                 s_pad = min(pad_pow2(len(chunk), minimum=16), s_block)
+                self.dispatched_device_chunks += 1
                 chunks.append(self._dispatch_chunk(
                     rows[chunk], starts[chunk], lens[chunk], R, s_pad))
         else:
@@ -301,6 +307,13 @@ class HybridScorer:
                 rows_l.append(rows)
                 vals_l.append(np.full((S, self.top_k), -np.inf, np.float32))
                 idx_l.append(np.zeros((S, self.top_k), np.int32))
+                continue
+            if isinstance(packed, tuple) and packed[0] == "host":
+                # Host-scored chunk (_score_short_rows_host): ids and values
+                # are already final — cols_padded IS the [S, K] id matrix.
+                rows_l.append(rows)
+                idx_l.append(cols_padded)
+                vals_l.append(packed[1])
                 continue
             host = np.asarray(packed)  # single [2, S_pad, K] fetch
             vals = host[0, :S]
