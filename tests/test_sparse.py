@@ -1,0 +1,153 @@
+"""Sparse device backend (HBM slab + host index) tests.
+
+Tiny initial capacities force every structural path — heap doubling, row
+relocation, compaction, items-capacity growth — on small test streams.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config
+from tpu_cooccurrence.metrics import (
+    OBSERVED_COOCCURRENCES,
+    RESCORED_ITEMS,
+    ROW_SUM_PROCESS_WINDOW,
+)
+from tpu_cooccurrence.state.sparse_scorer import SparseDeviceScorer
+
+from test_pipeline import (
+    assert_latest_close,
+    random_stream,
+    relabel_first_appearance,
+    run_production,
+)
+
+
+def tiny_scorer_factory(cfg):
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    scorer = SparseDeviceScorer(cfg.top_k, development_mode=True,
+                                capacity=64, items_capacity=8,
+                                compact_min_heap=256)
+    job = CooccurrenceJob(cfg, scorer=scorer)
+    scorer.counters = job.counters
+    return job
+
+
+@pytest.mark.parametrize("overrides", [
+    dict(skip_cuts=True),
+    dict(item_cut=5, user_cut=4),
+    dict(item_cut=3, user_cut=2, window_size=25),
+])
+def test_sparse_matches_oracle_backend(overrides):
+    kw = dict(window_size=10, seed=0xBEEF, development_mode=True)
+    kw.update(overrides)
+    users, items, ts = random_stream(31)
+    a = run_production(Config(**kw, backend=Backend.ORACLE), users, items, ts)
+    b = run_production(Config(**kw, backend=Backend.SPARSE), users, items, ts)
+    assert_latest_close(a.latest, b.latest)
+    for name in (OBSERVED_COOCCURRENCES, ROW_SUM_PROCESS_WINDOW,
+                 RESCORED_ITEMS):
+        assert a.counters.get(name) == b.counters.get(name), name
+
+
+def test_sparse_growth_and_compaction_paths():
+    """Tiny capacities force heap doubling, relocations, and compaction
+    while matching the oracle end to end."""
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    kw = dict(window_size=20, seed=0xD1, skip_cuts=True,
+              development_mode=True)
+    rng = np.random.default_rng(11)
+    n = 3000
+    users = relabel_first_appearance(rng.integers(0, 10, n))
+    items = relabel_first_appearance(rng.integers(0, 150, n))
+    ts = np.cumsum(rng.integers(0, 2, n)).astype(np.int64)
+
+    a = run_production(Config(**kw, backend=Backend.ORACLE), users, items, ts)
+    cfg = Config(**kw, backend=Backend.SPARSE)
+    b = tiny_scorer_factory(cfg)
+    for lo in range(0, n, 97):
+        b.add_batch(users[lo:lo + 97], items[lo:lo + 97], ts[lo:lo + 97])
+    b.finish()
+    sc = b.scorer
+    assert sc.capacity > 64          # heap doubled
+    assert sc.items_cap > 8          # item registry grew
+    assert sc.compactions > 0        # defragmentation actually ran
+    assert_latest_close(a.latest, b.latest)
+
+
+def test_sparse_index_invariants():
+    """Host index/registry invariants after a mixed stream: sorted keys,
+    in-range slots, per-row segments exactly [start, start+len)."""
+    users, items, ts = random_stream(77, n=900, n_items=40)
+    cfg = Config(window_size=15, seed=3, item_cut=6, user_cut=4,
+                 backend=Backend.SPARSE, development_mode=True)
+    job = tiny_scorer_factory(cfg)
+    job.add_batch(users, items, ts)
+    job.finish()
+    sc = job.scorer
+    assert np.all(np.diff(sc.g_key) > 0)  # strictly sorted, unique
+    assert len(sc.g_slot) == len(sc.g_key)
+    rows = (sc.g_key >> 32).astype(np.int64)
+    for r in np.unique(rows):
+        slots = np.sort(sc.g_slot[rows == r])
+        start, ln = sc.row_start[r], sc.row_len[r]
+        assert ln == len(slots)
+        np.testing.assert_array_equal(slots, np.arange(start, start + ln))
+        assert ln <= sc.row_cap[r]
+    assert sc.heap_end <= sc.capacity
+
+
+def test_sparse_checkpoint_roundtrip(tmp_path):
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    kw = dict(window_size=10, seed=4, item_cut=5, user_cut=3,
+              backend=Backend.SPARSE, checkpoint_dir=str(tmp_path / "ck"),
+              development_mode=True)
+    users, items, ts = random_stream(33, n=400)
+    half = 180
+
+    ref = CooccurrenceJob(Config(**kw))
+    ref.add_batch(users, items, ts)
+    ref.finish()
+
+    a = CooccurrenceJob(Config(**kw))
+    a.add_batch(users[:half], items[:half], ts[:half])
+    a.checkpoint()
+    b = CooccurrenceJob(Config(**kw))
+    b.restore()
+    b.add_batch(users[half:], items[half:], ts[half:])
+    b.finish()
+
+    assert set(ref.latest) == set(b.latest)
+    for item in ref.latest:
+        np.testing.assert_allclose(
+            np.array([s for _, s in b.latest[item]]),
+            np.array([s for _, s in ref.latest[item]]), rtol=1e-6, atol=1e-6)
+
+
+def test_sparse_hybrid_checkpoint_interchange(tmp_path):
+    """The canonical sparse-matrix checkpoint restores across backends:
+    write from hybrid, resume on sparse (and the reverse)."""
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    users, items, ts = random_stream(35, n=400)
+    half = 200
+    for first, second in [(Backend.HYBRID, Backend.SPARSE),
+                          (Backend.SPARSE, Backend.HYBRID)]:
+        kw = dict(window_size=10, seed=9, item_cut=5, user_cut=3,
+                  checkpoint_dir=str(tmp_path / f"ck-{first.value}"),
+                  development_mode=True)
+        ref = CooccurrenceJob(Config(**kw, backend=second))
+        ref.add_batch(users, items, ts)
+        ref.finish()
+
+        a = CooccurrenceJob(Config(**kw, backend=first))
+        a.add_batch(users[:half], items[:half], ts[:half])
+        a.checkpoint()
+        b = CooccurrenceJob(Config(**kw, backend=second))
+        b.restore()
+        b.add_batch(users[half:], items[half:], ts[half:])
+        b.finish()
+        assert_latest_close(ref.latest, b.latest, rtol=1e-5, atol=1e-5)

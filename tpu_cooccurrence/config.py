@@ -53,6 +53,8 @@ class Backend(enum.Enum):
     DEVICE = "device"
     SHARDED = "sharded"
     HYBRID = "hybrid"  # host sparse rows + device batched scoring (big vocab)
+    SPARSE = "sparse"  # device-resident sparse slab, host index (big vocab,
+    # minimal host<->device transfer — see state/sparse_scorer.py)
 
 
 def _parse_seed(value: str) -> int:

@@ -104,6 +104,11 @@ class CooccurrenceJob:
 
             return HybridScorer(self.config.top_k, self.counters,
                                 self.config.development_mode)
+        if backend == Backend.SPARSE:
+            from .state.sparse_scorer import SparseDeviceScorer
+
+            return SparseDeviceScorer(self.config.top_k, self.counters,
+                                      self.config.development_mode)
         if backend == Backend.SHARDED:
             from .parallel.sharded import ShardedScorer
 
