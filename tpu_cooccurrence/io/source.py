@@ -104,8 +104,13 @@ class FileMonitorSource:
         """
         # Restored mid-file position (if any): resume only when the same
         # file is re-listed with an unchanged mtime; a file modified since
-        # the checkpoint is re-read whole (its already-windowed prefix
-        # re-arrives behind the watermark and is dropped as late).
+        # the checkpoint is re-read whole. Prefix events behind the
+        # restored watermark are then dropped as late, but prefix events in
+        # still-open (checkpointed, unfired) windows are NOT late and are
+        # double-counted — same exposure as the reference, which re-forwards
+        # a modified file as a whole new split
+        # (ContinuousFileMonitoringFunction.java:239-257). Don't modify an
+        # in-flight input file concurrently with a checkpointed run.
         skip_file = self._current_file
         skip_mtime = self._current_mtime
         skip_lines = self._current_line

@@ -154,6 +154,20 @@ class CooccurrenceJob:
     def finish(self) -> None:
         """End of stream — Watermark(MAX_VALUE) fires everything."""
         self._drain(final=True)
+        if self.config.development_mode:
+            # Pipeline-drain invariant (the moral equivalent of the
+            # reference's buffered-element balance counters,
+            # UserInteractionCounterOneInputStreamOperator.java:134-137):
+            # every row dispatched into a scorer's result pipeline must be
+            # materialized exactly once — a flush that drops or double-
+            # emits an in-flight window shows up as a mismatch here.
+            from .metrics import RESCORED_ITEMS
+
+            rescored = self.counters.get(RESCORED_ITEMS)
+            if self.emissions != rescored:
+                raise AssertionError(
+                    f"result pipeline out of balance: {rescored} rows "
+                    f"dispatched but {self.emissions} materialized")
 
     def run(self, batches: Iterable[InteractionBatch]) -> "LatestResults":
         start = time.monotonic_ns()

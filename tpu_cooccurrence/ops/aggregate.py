@@ -36,6 +36,18 @@ def aggregate_window_coo(src: np.ndarray, dst: np.ndarray,
     return out + (uniq_key,) if return_key else out
 
 
+def narrow_deltas_int32(agg: np.ndarray) -> np.ndarray:
+    """Narrow exact int64 per-cell window deltas to the device's int32.
+
+    A single window's aggregated cell delta beyond int32 would otherwise
+    wrap silently in the scatter-add (cheap check: the array is small and
+    already materialized).
+    """
+    if len(agg) and max(-int(agg.min()), int(agg.max())) >= 2**31:
+        raise ValueError("window cell delta exceeds int32 range")
+    return agg.astype(np.int32)
+
+
 def distinct_sorted(sorted_vals: np.ndarray) -> np.ndarray:
     """Distinct values of an already-sorted array (no re-sort)."""
     if len(sorted_vals) == 0:

@@ -44,7 +44,8 @@ import jax.numpy as jnp
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..sampling.reservoir import PairDeltaBatch
 from ..state.results import TopKBatch
-from .aggregate import aggregate_window_coo, distinct_sorted
+from .aggregate import (aggregate_window_coo, distinct_sorted,
+                        narrow_deltas_int32)
 from .llr import llr_stable
 
 
@@ -233,7 +234,7 @@ class DeviceScorer:
             return self.flush()
         src, dst, agg_delta = aggregate_window_coo(
             pairs.src, pairs.dst, pairs.delta)
-        agg_delta = agg_delta.astype(np.int32)
+        agg_delta = narrow_deltas_int32(agg_delta)
 
         # Bounded COO buckets: chunk to max_pairs_per_step, pad each chunk to
         # a power of two (recompile guard, SURVEY §7 "dynamic shapes").

@@ -40,7 +40,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..state.results import TopKBatch
-from ..ops.aggregate import aggregate_window_coo, distinct_sorted
+from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
+                             narrow_deltas_int32)
 from ..ops.llr import llr_stable
 from ..ops.device_scorer import pad_pow2, score_row_budget
 from ..sampling.reservoir import PairDeltaBatch
@@ -163,7 +164,7 @@ class ShardedScorer:
         # keeps duplicate indices out of the per-shard scatters.
         src, dst, delta64 = aggregate_window_coo(
             pairs.src, pairs.dst, pairs.delta)
-        delta = delta64.astype(np.int32)
+        delta = narrow_deltas_int32(delta64)
         owners = (src // self.rows_per_shard).astype(np.int64)
 
         # Owner-partitioned [D, P] blocks; padding rows point at each shard's

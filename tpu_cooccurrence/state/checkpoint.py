@@ -126,6 +126,11 @@ def restore(job, directory: str, source=None) -> None:
     data = np.load(os.path.join(directory, f"state{suffix}.npz"))
     # Meta comes from inside the npz (the atomic commit point); the
     # meta.json sidecar is informational only and may lag by a crash.
+    if "meta_json" not in data:
+        raise ValueError(
+            f"incompatible checkpoint format in {directory}: no embedded "
+            "meta_json (written by a pre-atomic-commit version of this "
+            "framework) — re-checkpoint with the current version")
     meta = json.loads(bytes(data["meta_json"]).decode())
     for key in ("seed", "skip_cuts", "item_cut", "user_cut", "top_k",
                 "window_slide"):

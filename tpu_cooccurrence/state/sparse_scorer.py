@@ -52,7 +52,8 @@ import jax
 import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
-from ..ops.aggregate import aggregate_window_coo, distinct_sorted
+from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
+                             narrow_deltas_int32)
 from ..ops.device_scorer import pad_pow2, pad_pow4
 from ..ops.llr import llr_stable
 from ..sampling.reservoir import PairDeltaBatch, _ragged_arange
@@ -252,8 +253,7 @@ class SparseDeviceScorer:
         self._ensure_items(int(max(pairs.src.max(), pairs.dst.max())))
         src_d, _, d_val, d_key = aggregate_window_coo(
             pairs.src, pairs.dst, delta64, return_key=True)
-        if len(d_val) and max(-int(d_val.min()), int(d_val.max())) >= 2**31:
-            raise ValueError("window cell delta exceeds int32 range")
+        d_val32 = narrow_deltas_int32(d_val)
 
         # Row sums first (watermark ordering, reference
         # ItemRowRescorerTwoInputStreamOperator.java:116-142). The host
@@ -300,7 +300,7 @@ class SparseDeviceScorer:
         upd[0, :n_new] = slots[~exists]
         upd[1, :n_new] = (new_key & 0xFFFFFFFF).astype(np.int32)
         upd[0, n_new: n_new + n_d] = slots
-        upd[1, n_new: n_new + n_d] = d_val.astype(np.int32)
+        upd[1, n_new: n_new + n_d] = d_val32
         upd[0, n_new + n_d: n] = rows
         upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
         bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
