@@ -162,6 +162,47 @@ def test_batch_boundaries_do_not_matter():
     assert_latest_equal(a.latest, b.latest)
 
 
+def test_device_backend_auto_derives_vocab():
+    """num_items == 0: the dense backend grows C from the data (the
+    config.py promise) and matches the oracle across growth events."""
+    from tpu_cooccurrence.ops.device_scorer import DeviceScorer
+
+    users, items, ts = random_stream(51, n=900, n_items=60)
+    kw = dict(window_size=10, seed=0xA0, item_cut=6, user_cut=4,
+              development_mode=True)
+    a = run_production(Config(**kw, backend=Backend.ORACLE), users, items, ts)
+    cfg = Config(**kw, backend=Backend.DEVICE)  # num_items defaults to 0
+    job = CooccurrenceJob(cfg)
+    # Start tiny so the stream forces several doublings.
+    job.scorer = DeviceScorer(0, cfg.top_k, job.counters)
+    job.scorer.num_items = job.scorer.num_items_logical = 16
+    job.scorer.C = job.scorer.C[:16, :16]
+    job.scorer.row_sums = job.scorer.row_sums[:16]
+    for lo in range(0, len(users), 97):
+        job.add_batch(users[lo:lo + 97], items[lo:lo + 97], ts[lo:lo + 97])
+    job.finish()
+    assert job.scorer.num_items >= 60  # grew past the stream's vocab
+    assert_latest_close(a.latest, job.latest)
+
+
+def test_device_backend_auto_derive_checkpoint_roundtrip(tmp_path):
+    kw = dict(window_size=10, seed=7, item_cut=5, user_cut=3,
+              backend=Backend.DEVICE, checkpoint_dir=str(tmp_path / "ck"))
+    users, items, ts = random_stream(52, n=400)
+    half = 200
+    ref = CooccurrenceJob(Config(**kw))
+    ref.add_batch(users, items, ts)
+    ref.finish()
+    a = CooccurrenceJob(Config(**kw))
+    a.add_batch(users[:half], items[:half], ts[:half])
+    a.checkpoint()
+    b = CooccurrenceJob(Config(**kw))
+    b.restore()
+    b.add_batch(users[half:], items[half:], ts[half:])
+    b.finish()
+    assert_latest_close(ref.latest, b.latest, rtol=1e-6, atol=1e-6)
+
+
 def test_device_int16_counts_match_oracle():
     """--count-dtype int16 (reference-style short counts) is exact while
     counts stay within int16 range."""

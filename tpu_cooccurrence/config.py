@@ -85,7 +85,9 @@ class Config:
 
     # --- TPU-framework extensions (no reference analogue) ---
     backend: Backend = Backend.DEVICE
-    num_items: int = 0  # dense device vocab capacity; 0 = grow from data (host pre-scan)
+    num_items: int = 0  # dense device vocab capacity; 0 = derive from the
+    # data (the device backend doubles its C on vocab growth; sharded
+    # still requires an explicit capacity — resharding is not automatic)
     num_shards: int = 1  # item-axis shards over the device mesh
     window_slide: Optional[int] = None  # sliding windows; None = tumbling
     max_pairs_per_step: int = 1 << 20  # COO padding bucket (recompile guard)
@@ -177,7 +179,9 @@ class Config:
         p.add_argument("--backend", type=Backend, choices=list(Backend),
                        default=Backend.DEVICE)
         p.add_argument("--num-items", type=int, default=0, dest="num_items",
-                       help="Dense item-vocabulary capacity on device (0 = derive)")
+                       help="Dense item-vocabulary capacity on device "
+                            "(0 = derive from data; device backend only — "
+                            "sharded requires an explicit capacity)")
         p.add_argument("--num-shards", type=int, default=1, dest="num_shards",
                        help="Item-axis shards over the device mesh")
         p.add_argument("--window-slide", type=int, default=None, dest="window_slide",

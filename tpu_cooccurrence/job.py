@@ -91,10 +91,10 @@ class CooccurrenceJob:
         if backend == Backend.DEVICE:
             from .ops.device_scorer import DeviceScorer
 
+            # num_items == 0 derives the vocab from the data (the scorer
+            # doubles its dense C on growth); an explicit value is a hard
+            # capacity check, enforced in add_batch.
             num_items = self.config.num_items
-            if num_items <= 0:
-                raise ValueError(
-                    "device backend needs --num-items (dense vocab capacity)")
             return DeviceScorer(num_items, self.config.top_k, self.counters,
                                 max_pairs_per_step=self.config.max_pairs_per_step,
                                 use_pallas=self.config.pallas,

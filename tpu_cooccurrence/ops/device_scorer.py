@@ -142,6 +142,15 @@ def _update_coo_u16(C, row_sums, coo, num_items: int):
     return _apply_coo(C, row_sums, src, dst, delta, num_items)
 
 
+@functools.partial(jax.jit, static_argnames=("n",))
+def _grow_dense(C, row_sums, n: int):
+    """Re-allocate the dense state to an ``n x n`` capacity (auto-derive)."""
+    old = C.shape[0]
+    newC = jnp.zeros((n, n), C.dtype).at[:old, :old].set(C)
+    new_rs = jnp.zeros((n,), row_sums.dtype).at[:old].set(row_sums)
+    return newC, new_rs
+
+
 @functools.partial(jax.jit, static_argnames=("top_k", "packed"))
 def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
     counts = C[rows]  # [S, I] int32
@@ -199,6 +208,13 @@ class DeviceScorer:
             raise ValueError(
                 "the Pallas kernel's 8-row blocks assume int32 sublane "
                 "tiling; use --pallas off with --count-dtype int16")
+        # num_items == 0: derive the vocab from the data — start at a
+        # modest capacity and double C whenever a window's max dense id
+        # outgrows it (amortized O(final) copy work). An explicit
+        # num_items stays a hard capacity (the job enforces it).
+        self.auto_capacity = num_items <= 0
+        if self.auto_capacity:
+            num_items = pad_pow2(max(1 << 10, top_k))
         if self.use_pallas:
             # Pad the vocab so the Pallas column-tile grid divides evenly;
             # the extra columns stay zero and are masked out of scoring.
@@ -226,12 +242,27 @@ class DeviceScorer:
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
 
+    def _ensure_capacity(self, max_id: int) -> None:
+        if max_id < self.num_items:
+            return
+        if not self.auto_capacity:
+            raise ValueError(
+                f"item id {max_id} exceeds --num-items capacity "
+                f"{self.num_items_logical}")
+        n = self.num_items
+        while n <= max_id:
+            n *= 2
+        self.C, self.row_sums = _grow_dense(self.C, self.row_sums, n=n)
+        self.num_items = self.num_items_logical = n
+        self.max_score_rows = score_row_budget(n, self._max_score_rows_cap)
+
     def process_window(self, ts: int, pairs: PairDeltaBatch) -> TopKBatch:
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
             # No new dispatch this window — drain any completed in-flight
             # results now instead of withholding them behind idle windows.
             return self.flush()
+        self._ensure_capacity(int(max(pairs.src.max(), pairs.dst.max())))
         src, dst, agg_delta = aggregate_window_coo(
             pairs.src, pairs.dst, pairs.delta)
         agg_delta = narrow_deltas_int32(agg_delta)
@@ -331,6 +362,18 @@ class DeviceScorer:
 
     def restore_state(self, st: dict) -> None:
         ck = fit_count_dtype(st["C"], self.count_dtype)
+        if self.auto_capacity and ck.shape[0] > self.num_items:
+            # Derived-capacity scorers adopt the checkpoint's size —
+            # re-applying the Pallas tile rounding the constructor performs
+            # (the checkpoint may come from a non-pallas run whose capacity
+            # is not a tile multiple).
+            n = ck.shape[0]
+            if self.use_pallas:
+                n = ((n + self.PALLAS_TILE - 1)
+                     // self.PALLAS_TILE) * self.PALLAS_TILE
+            self.num_items = self.num_items_logical = n
+            self.max_score_rows = score_row_budget(self.num_items,
+                                                   self._max_score_rows_cap)
         if ck.shape != (self.num_items, self.num_items):
             # Vocab padding differs between runs when the pallas setting
             # changes (the kernel pads to tile multiples). Both layouts hold
