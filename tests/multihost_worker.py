@@ -1,0 +1,62 @@
+"""Subprocess worker for ``tests/test_multihost.py``.
+
+Runs one multi-controller process of a 2-process sharded job on the CPU
+backend (virtual local devices; the parent controls JAX_PLATFORMS /
+XLA_FLAGS via the environment). Invoked as:
+
+    python multihost_worker.py <spec.json> <out.json>
+
+``spec`` fields: stream (npz path with users/items/ts), window_size, seed,
+item_cut, user_cut, num_items, coordinator, num_processes, process_id,
+phase ("full" | "first-half" | "resume"), half, checkpoint_dir.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    with open(sys.argv[1]) as f:
+        spec = json.load(f)
+    import numpy as np
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    data = np.load(spec["stream"])
+    users, items, ts = data["users"], data["items"], data["ts"]
+    cfg = Config(
+        window_size=spec["window_size"], seed=spec["seed"],
+        item_cut=spec["item_cut"], user_cut=spec["user_cut"],
+        backend=Backend.SHARDED, num_items=spec["num_items"],
+        checkpoint_dir=spec.get("checkpoint_dir"),
+        coordinator=spec["coordinator"],
+        num_processes=spec["num_processes"],
+        process_id=spec["process_id"])
+    job = CooccurrenceJob(cfg)
+    half = spec.get("half", len(users))
+    phase = spec["phase"]
+    if phase == "full":
+        job.add_batch(users, items, ts)
+        job.finish()
+    elif phase == "first-half":
+        job.add_batch(users[:half], items[:half], ts[:half])
+        job.checkpoint()
+    elif phase == "resume":
+        job.restore()
+        job.add_batch(users[half:], items[half:], ts[half:])
+        job.finish()
+    else:
+        raise ValueError(f"unknown phase {phase}")
+
+    out = {
+        "process_id": spec["process_id"],
+        "counters": job.counters.as_dict(),
+        "latest": {str(item): job.latest[item] for item in job.latest},
+    }
+    with open(sys.argv[2], "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -154,13 +154,16 @@ class CooccurrenceJob:
     def finish(self) -> None:
         """End of stream — Watermark(MAX_VALUE) fires everything."""
         self._drain(final=True)
-        if self.config.development_mode:
+        if (self.config.development_mode
+                and not getattr(self.scorer, "process_suffix", "")):
             # Pipeline-drain invariant (the moral equivalent of the
             # reference's buffered-element balance counters,
             # UserInteractionCounterOneInputStreamOperator.java:134-137):
             # every row dispatched into a scorer's result pipeline must be
             # materialized exactly once — a flush that drops or double-
             # emits an in-flight window shows up as a mismatch here.
+            # Multi-host processes are exempt: each materializes only the
+            # rows its chips own while the dispatch counter sees all rows.
             from .metrics import RESCORED_ITEMS
 
             rescored = self.counters.get(RESCORED_ITEMS)
