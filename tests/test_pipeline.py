@@ -88,7 +88,10 @@ def assert_latest_close(a_latest, b_latest, rtol=1e-4, atol=1e-3, gap=1e-2):
         p_scores = np.array([s for _, s in p])
         np.testing.assert_allclose(p_scores, o_scores, rtol=rtol, atol=atol)
         if len(o_scores) > 1 and np.min(np.abs(np.diff(o_scores))) > gap:
-            assert [j for j, _ in o] == [j for j, _ in p], f"row {item}"
+            # The final rank stays uncertain even with clean in-list gaps:
+            # the unseen K+1'th score may near-tie it across precisions.
+            assert [j for j, _ in o][:-1] == [j for j, _ in p][:-1], \
+                f"row {item}"
 
 
 CONFIGS = [

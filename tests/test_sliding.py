@@ -9,6 +9,11 @@ import pytest
 
 from tpu_cooccurrence.config import Backend, Config
 from tpu_cooccurrence.job import CooccurrenceJob
+from tpu_cooccurrence.metrics import (
+    OBSERVED_COOCCURRENCES,
+    RESCORED_ITEMS,
+    ROW_SUM_PROCESS_WINDOW,
+)
 from tpu_cooccurrence.sampling.sliding import SlidingBasketSampler
 
 
@@ -116,6 +121,63 @@ def test_sliding_device_matches_oracle_backend():
         d = np.array([s for _, s in b.latest[item]])
         assert len(o) == len(d)
         np.testing.assert_allclose(d, o, rtol=1e-4, atol=1e-3)
+
+
+def _run_sliding_oracle(cfg, users, items, ts):
+    from tpu_cooccurrence.oracle.sliding import SlidingOracleJob
+
+    oracle = SlidingOracleJob(cfg)
+    for u, i, t in zip(users.tolist(), items.tolist(), ts.tolist()):
+        oracle.process(u, i, t)
+    oracle.finish()
+    return oracle
+
+
+@pytest.mark.parametrize("overrides", [
+    dict(skip_cuts=True),
+    dict(item_cut=6, user_cut=5),
+    dict(item_cut=3, user_cut=2, window_slide=10),
+    dict(item_cut=500, user_cut=500, window_size=40, window_slide=8),
+])
+def test_sliding_end_to_end_matches_record_at_a_time_oracle(overrides):
+    """The full production sliding path (vectorized engine + per-window
+    caps + ragged basket expansion + scorer) against the naive
+    record-at-a-time SlidingOracleJob, across caps and overlaps."""
+    from test_pipeline import assert_latest_close, relabel_first_appearance
+
+    kw = dict(window_size=20, window_slide=5, seed=9,
+              development_mode=True)
+    kw.update(overrides)
+    rng = np.random.default_rng(sum(kw["window_size"] for _ in [0]) + 17)
+    n = 700
+    users = relabel_first_appearance(rng.integers(0, 9, n))
+    items = relabel_first_appearance(rng.integers(0, 25, n))
+    ts = np.cumsum(rng.integers(0, 3, n)).astype(np.int64)
+
+    oracle = _run_sliding_oracle(Config(**kw, backend=Backend.ORACLE),
+                                 users, items, ts)
+
+    for backend, extra in [(Backend.ORACLE, {}),
+                           (Backend.DEVICE, dict(num_items=32))]:
+        job = CooccurrenceJob(Config(**kw, backend=backend, **extra))
+        for lo in range(0, n, 93):  # batch boundaries must not matter
+            job.add_batch(users[lo:lo + 93], items[lo:lo + 93],
+                          ts[lo:lo + 93])
+        job.finish()
+        prod_latest = {item: job.latest[item] for item in job.latest}
+        if backend == Backend.ORACLE:
+            # Same f64 math end to end: exact equality expected.
+            assert set(oracle.latest) == set(prod_latest)
+            for item in oracle.latest:
+                assert sorted(oracle.latest[item],
+                              key=lambda e: (-e[1], e[0])) == \
+                    sorted(prod_latest[item], key=lambda e: (-e[1], e[0])), \
+                    f"row {item}"
+        else:
+            assert_latest_close(oracle.latest, prod_latest)
+        for name in (OBSERVED_COOCCURRENCES, ROW_SUM_PROCESS_WINDOW,
+                     RESCORED_ITEMS):
+            assert oracle.counters.get(name) == job.counters.get(name), name
 
 
 def test_sliding_slide_must_divide():

@@ -1,0 +1,143 @@
+"""Record-at-a-time oracle for sliding-window (windowed-basket) mode.
+
+Sliding windows are a framework extension (the reference is tumbling-only,
+``FlinkCooccurrences.java:139,153``; its operators reject multi-window
+assignment, ``UserInteractionCounterOneInputStreamOperator.java:126-128``),
+so this oracle pins the *documented* semantics of ``sampling/sliding.py``
+end to end, the way :class:`~tpu_cooccurrence.oracle.reference.OracleJob`
+pins the reference's tumbling semantics:
+
+  * every event belongs to ``size/slide`` overlapping windows;
+  * within each fired window, the caps are per-window: the first ``fMax``
+    in-window interactions per item and first ``kMax`` per user survive
+    (arrival order; no cross-window feedback);
+  * each user's surviving in-window interactions form a basket, and every
+    ordered pair of distinct basket positions contributes ``+1``;
+  * pair deltas accumulate into the persistent matrix / row sums /
+    ``observed``, and every updated row is LLR-rescored with top-K — the
+    same downstream semantics as tumbling mode
+    (``ItemRowRescorerTwoInputStreamOperator.java:158-241``).
+
+Everything here is scalar, dict-based float64 Python — deliberately naive
+and independent of the vectorized window engine, cap ranking, ragged
+basket expansion, and device scorers it validates.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+from ..config import Config
+from ..metrics import (
+    Counters,
+    ITEM_LATE_ELEMENTS,
+    OBSERVED_COOCCURRENCES,
+    RESCORED_ITEMS,
+    ROW_SUM_PROCESS_WINDOW,
+    USER_LATE_ELEMENTS,
+)
+from .heap import TopKHeap
+from .reference import _llr_scalar
+
+
+class SlidingOracleJob:
+    """Naive record-at-a-time sliding-mode pipeline (the test oracle)."""
+
+    def __init__(self, config: Config) -> None:
+        assert config.slide_millis is not None, "sliding mode only"
+        self.config = config
+        self.size = config.window_millis
+        self.slide = config.slide_millis
+        if self.size % self.slide != 0:
+            raise ValueError("window size must be a multiple of slide")
+        self.counters = Counters()
+        self.max_ts_seen: int | None = None
+        # window start -> [(user, item)] in arrival order
+        self._buffers: Dict[int, List[Tuple[int, int]]] = defaultdict(list)
+        # Persistent scoring state (same roles as OracleJob's).
+        self.item_rows: Dict[int, Dict[int, int]] = {}
+        self.global_row_sums: Dict[int, int] = defaultdict(int)
+        self.observed = 0
+        self.latest: Dict[int, List[Tuple[int, float]]] = {}
+        self._heap = TopKHeap(config.top_k)
+
+    # -- ingest -----------------------------------------------------------
+
+    def process(self, user: int, item: int, ts: int) -> None:
+        if self.max_ts_seen is not None and ts < self.max_ts_seen:
+            self.counters.add(ITEM_LATE_ELEMENTS, 1)
+            self.counters.add(USER_LATE_ELEMENTS, 1)
+            return
+        self.max_ts_seen = max(ts, self.max_ts_seen or ts)
+        # Every window [start, start+size) containing ts, ascending start.
+        last_start = (ts // self.slide) * self.slide
+        start = last_start - self.size + self.slide
+        while start <= last_start:
+            if start <= ts < start + self.size:
+                self._buffers[start].append((user, item))
+            start += self.slide
+        self._fire_ready(self.max_ts_seen - 1)
+
+    def finish(self) -> None:
+        self._fire_ready(None)
+
+    # -- window fire ------------------------------------------------------
+
+    def _fire_ready(self, watermark: int | None) -> None:
+        ready = sorted(
+            s for s in self._buffers
+            if watermark is None or s + self.size - 1 <= watermark)
+        for start in ready:
+            self._fire(self._buffers.pop(start))
+
+    def _fire(self, events: List[Tuple[int, int]]) -> None:
+        # Per-window caps, record at a time, in arrival order.
+        item_seen: Dict[int, int] = defaultdict(int)
+        user_seen: Dict[int, int] = defaultdict(int)
+        baskets: Dict[int, List[int]] = defaultdict(list)
+        for user, item in events:
+            keep = True
+            if not self.config.skip_cuts:
+                keep = (item_seen[item] < self.config.item_cut
+                        and user_seen[user] < self.config.user_cut)
+                item_seen[item] += 1
+                user_seen[user] += 1
+            if keep:
+                baskets[user].append(item)
+        # Basket expansion: every ordered pair of distinct positions.
+        window_delta: Dict[int, Dict[int, int]] = defaultdict(
+            lambda: defaultdict(int))
+        for basket in baskets.values():
+            for a, src in enumerate(basket):
+                for b, dst in enumerate(basket):
+                    if a != b:
+                        window_delta[src][dst] += 1
+                        self.counters.add(OBSERVED_COOCCURRENCES, 1)
+        if not window_delta:
+            return
+        # Row sums before scoring (watermark ordering), zero-suppressed.
+        for src, row_delta in window_delta.items():
+            s = sum(row_delta.values())
+            if s != 0:
+                self.counters.add(ROW_SUM_PROCESS_WINDOW, s)
+                self.global_row_sums[src] += s
+                self.observed += s
+        # Merge + rescore every updated row.
+        for src in sorted(window_delta):
+            row = self.item_rows.setdefault(src, {})
+            for dst, d in window_delta[src].items():
+                row[dst] = row.get(dst, 0) + d
+            self._score_row(src, row)
+
+    def _score_row(self, item: int, row: Dict[int, int]) -> None:
+        self.counters.add(RESCORED_ITEMS, 1)
+        row_sum = self.global_row_sums[item]
+        self._heap.reset()
+        for other in sorted(j for j, c in row.items() if c != 0):
+            k11 = row[other]
+            k12 = row_sum - k11
+            k21 = self.global_row_sums[other] - k11
+            k22 = self.observed + k11 - k12 - k21
+            self._heap.offer(other, _llr_scalar(k11, k12, k21, k22))
+        self.latest[item] = self._heap.sorted_desc()
