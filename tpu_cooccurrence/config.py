@@ -91,6 +91,8 @@ class Config:
     num_shards: int = 1  # item-axis shards over the device mesh
     window_slide: Optional[int] = None  # sliding windows; None = tumbling
     max_pairs_per_step: int = 1 << 20  # COO padding bucket (recompile guard)
+    sample_workers: int = 1  # host sampling threads (user-partitioned; the
+    # keyed-parallelism analogue of the reference's P user-operator subtasks)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
@@ -113,6 +115,10 @@ class Config:
             self.seed = time.time_ns()  # reference: System.nanoTime()
         if self.top_k <= 0:
             raise ValueError(f"{self.top_k} is <= 0")
+        if self.sample_workers > 1 and self.window_slide is not None:
+            raise ValueError(
+                "--sample-workers applies to the tumbling reservoir path; "
+                "the sliding sampler is stateless and runs serially")
         multihost = (self.coordinator, self.num_processes, self.process_id)
         if any(v is not None for v in multihost):
             if any(v is None for v in multihost):
@@ -186,6 +192,10 @@ class Config:
                        help="Item-axis shards over the device mesh")
         p.add_argument("--window-slide", type=int, default=None, dest="window_slide",
                        help="Slide (same unit as window) for sliding windows")
+        p.add_argument("--sample-workers", type=int, default=1,
+                       dest="sample_workers",
+                       help="Host sampling worker threads (user-partitioned; "
+                            "default 1 = serial)")
         p.add_argument("--profile-dir", default=None, dest="profile_dir",
                        help="Write a jax.profiler trace for TensorBoard")
         p.add_argument("--pallas", choices=["auto", "on", "off"],

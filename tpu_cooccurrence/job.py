@@ -62,6 +62,12 @@ class CooccurrenceJob:
             self.sampler = SlidingBasketSampler(
                 config.item_cut, config.user_cut, config.skip_cuts,
                 counters=self.counters)
+        elif config.sample_workers > 1:
+            from .sampling.parallel import PartitionedReservoirSampler
+
+            self.sampler = PartitionedReservoirSampler(
+                config.user_cut, config.seed, config.skip_cuts,
+                workers=config.sample_workers, counters=self.counters)
         else:
             self.sampler = UserReservoirSampler(
                 config.user_cut, config.seed, config.skip_cuts,

@@ -11,6 +11,7 @@ import ctypes
 import logging
 import os
 import subprocess
+import threading
 from typing import Optional
 
 import numpy as np
@@ -23,13 +24,19 @@ _LIB = os.path.join(_HERE, "libreservoir_expand.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_lock = threading.Lock()  # partitioned sampler threads race the first call
 
 
 def _build() -> bool:
     try:
+        # Build to a temp name + atomic rename: a concurrent *process*
+        # (e.g. two CLI runs) must never observe a half-written .so whose
+        # mtime passes the staleness check.
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
         return True
     except Exception as exc:  # pragma: no cover - environment-dependent
         LOG.info("native build unavailable (%s); using NumPy fallback", exc)
@@ -37,7 +44,15 @@ def _build() -> bool:
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    """The loaded library, building it on first call; None if unavailable."""
+    """The loaded library, building it on first call; None if unavailable.
+
+    Thread-safe: worker threads of the partitioned sampler may all reach
+    the first call together."""
+    with _lock:
+        return _get_lib_locked()
+
+
+def _get_lib_locked() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
@@ -55,11 +70,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.expand_replacements.restype = ctypes.c_int64
     lib.expand_replacements.argtypes = [
-        i64p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int64,
+        i32p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int64,
         i64p, i64p, i32p]
     lib.expand_appends.restype = ctypes.c_int64
     lib.expand_appends.argtypes = [
-        i64p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int64,
+        i32p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int64,
         i64p, i64p, i32p]
     _lib = lib
     return _lib
@@ -95,9 +110,9 @@ def expand_appends(hist: np.ndarray, users: np.ndarray, items: np.ndarray,
     delta = np.empty(cap, dtype=np.int32)
     users = np.ascontiguousarray(users, dtype=np.int64)
     items = np.ascontiguousarray(items, dtype=np.int64)
-    assert hist.flags.c_contiguous
+    assert hist.flags.c_contiguous and hist.dtype == np.int32
     written = lib.expand_appends(
-        _ptr64(hist), hist.shape[1], _ptr64(users), _ptr64(items),
+        _ptr32(hist), hist.shape[1], _ptr64(users), _ptr64(items),
         _ptr64(slots), n, _ptr64(src), _ptr64(dst), _ptr32(delta))
     return src[:written], dst[:written], delta[:written]
 
@@ -106,7 +121,7 @@ def expand_replacements(hist: np.ndarray, users: np.ndarray,
                         items: np.ndarray, slots: np.ndarray):
     """Native replacement expansion; returns (src, dst, delta) or None.
 
-    ``hist`` is the [U, k_max] int64 reservoir storage and is MUTATED
+    ``hist`` is the [U, k_max] int32 reservoir storage and is MUTATED
     (slots written in event order), matching the NumPy path's semantics.
     """
     lib = get_lib()
@@ -121,8 +136,8 @@ def expand_replacements(hist: np.ndarray, users: np.ndarray,
     users = np.ascontiguousarray(users, dtype=np.int64)
     items = np.ascontiguousarray(items, dtype=np.int64)
     slots = np.ascontiguousarray(slots, dtype=np.int64)
-    assert hist.flags.c_contiguous
+    assert hist.flags.c_contiguous and hist.dtype == np.int32
     written = lib.expand_replacements(
-        _ptr64(hist), k_max, _ptr64(users), _ptr64(items), _ptr64(slots),
+        _ptr32(hist), k_max, _ptr64(users), _ptr64(items), _ptr64(slots),
         n, _ptr64(src), _ptr64(dst), _ptr32(delta))
     return src[:written], dst[:written], delta[:written]

@@ -17,7 +17,7 @@ extern "C" {
 
 // Expand replacement events into pair deltas.
 //
-// hist        [n_users_cap * k_max] row-major reservoir storage (mutated!)
+// hist        [n_users_cap * k_max] row-major int32 reservoir storage (mutated!)
 // users/items/slots [n_repl] replacement events in processing order
 // out_src/out_dst/out_delta [n_repl * 4 * (k_max - 1)] preallocated outputs
 //
@@ -26,14 +26,14 @@ extern "C" {
 // the k_max-1 slots excluding the replaced one, read *at event time*.
 // Returns the number of emitted entries.
 int64_t expand_replacements(
-    int64_t* hist, int64_t k_max,
+    int32_t* hist, int64_t k_max,
     const int64_t* users, const int64_t* items, const int64_t* slots,
     int64_t n_repl,
     int64_t* out_src, int64_t* out_dst, int32_t* out_delta) {
   int64_t pos = 0;
   const int64_t m = k_max - 1;
   for (int64_t e = 0; e < n_repl; ++e) {
-    int64_t* row = hist + users[e] * k_max;
+    int32_t* row = hist + users[e] * k_max;
     const int64_t item = items[e];
     const int64_t slot = slots[e];
     const int64_t prev = row[slot];
@@ -61,7 +61,7 @@ int64_t expand_replacements(
       src3[w] = other; dst3[w] = prev;  del3[w] = -1;
       ++w;
     }
-    row[slot] = item;
+    row[slot] = static_cast<int32_t>(item);
     pos += 4 * m;
   }
   return pos;
@@ -74,13 +74,13 @@ int64_t expand_replacements(
 // sampling/reservoir.py fact 1). Caller must have already written the new
 // items into their slots. Returns entries written.
 int64_t expand_appends(
-    const int64_t* hist, int64_t hist_cols,
+    const int32_t* hist, int64_t hist_cols,
     const int64_t* users, const int64_t* items, const int64_t* slots,
     int64_t n_app,
     int64_t* out_src, int64_t* out_dst, int32_t* out_delta) {
   int64_t pos = 0;
   for (int64_t e = 0; e < n_app; ++e) {
-    const int64_t* row = hist + users[e] * hist_cols;
+    const int32_t* row = hist + users[e] * hist_cols;
     const int64_t item = items[e];
     const int64_t n = slots[e];  // number of partners
     int64_t* srcA = out_src + pos;

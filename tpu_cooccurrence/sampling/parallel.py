@@ -1,0 +1,125 @@
+"""User-partitioned parallel reservoir sampling (host-side scale-out).
+
+The reference scales its hot loop with keyed data parallelism: the user
+operator runs P subtasks, each owning the users that hash to it
+(``FlinkCooccurrences.java:70,108``). This is the host analogue: W worker
+threads, worker ``w`` owning dense users with ``u % W == w``, each with an
+independent :class:`UserReservoirSampler` over *part-local* compact ids
+(``u // W`` — dense within the part, so per-part state arrays hold only
+their share of users).
+
+Bit-identical to the serial sampler by construction:
+
+  * reservoir state is strictly per-user, and the stable partition mask
+    preserves each user's arrival order;
+  * the draw RNG hashes ``(seed, global user id, per-user draw index)``
+    (``sampling/rng.py``) — order- and partition-independent — so every
+    accept/replace/reject decision is the same as serial (the wrapper
+    passes the global ids for hashing, part-local ids for state);
+  * pair-delta blocks are concatenated in worker order; consumers fold
+    them per cell (``ops/aggregate.py``), so block order is immaterial.
+
+Threads, not processes: the ctypes C++ pair expansion releases the GIL
+and per-user state stays in place — no serialization, no IPC, and
+checkpoints reassemble the exact serial layout (a serial checkpoint
+restores into any worker count and back).
+
+Measured reality (this machine, benchmark config 4's 1M-event Zipfian
+stream): the sampling pipeline is NOT thread-scalable today — per-window
+work is dominated by small GIL-holding NumPy kernels (grouped ranks,
+uniques, fancy indexing), so 4 workers run at ~0.9x serial speed. The
+host-side wins that actually landed are serial: vectorized vocab mapping
+(``state/vocab.py``) and int32 reservoir storage, together ~1.6x. This
+module stays because it is semantically free (bit-identical, tested) and
+becomes the scale-out seam the moment the GIL-holding fraction shrinks
+(free-threaded CPython, or expansion-dominated workloads).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import Counters
+from .reservoir import PairDeltaBatch, UserReservoirSampler
+
+
+class PartitionedReservoirSampler:
+    """W user-partitioned reservoir samplers fired concurrently."""
+
+    def __init__(self, user_cut: int, seed: int, skip_cuts: bool,
+                 workers: int, capacity: int = 1024,
+                 counters: Optional[Counters] = None) -> None:
+        if workers < 2:
+            raise ValueError("use UserReservoirSampler for a single worker")
+        self.workers = workers
+        self.counters = counters if counters is not None else Counters()
+        # Each part gets private counters, merged after every fire — the
+        # shared registry is a plain dict and must not see racing adds.
+        self.parts = [
+            UserReservoirSampler(user_cut, seed, skip_cuts,
+                                 capacity=max(capacity // workers, 16),
+                                 counters=Counters())
+            for _ in range(workers)
+        ]
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="sampler")
+
+    def _fire_part(self, part: int, users: np.ndarray, items: np.ndarray,
+                   sampled: np.ndarray) -> Tuple[PairDeltaBatch, np.ndarray]:
+        local = users // self.workers
+        return self.parts[part].fire(local, items, sampled, rng_users=users)
+
+    def fire(self, users: np.ndarray, items: np.ndarray,
+             sampled: np.ndarray) -> Tuple[PairDeltaBatch, np.ndarray]:
+        part_of = users % self.workers
+        futures = []
+        for p in range(self.workers):
+            mask = part_of == p  # stable: preserves per-user arrival order
+            futures.append(self._pool.submit(
+                self._fire_part, p, users[mask], items[mask], sampled[mask]))
+        blocks: List[PairDeltaBatch] = []
+        feedback: List[np.ndarray] = []
+        for p, fut in enumerate(futures):
+            pairs, fb = fut.result()
+            blocks.append(pairs)
+            feedback.append(fb)
+            self.counters.merge(self.parts[p].counters)
+            self.parts[p].counters.replace_all({})
+        return (PairDeltaBatch.concat(blocks), np.concatenate(feedback))
+
+    # -- checkpoint -------------------------------------------------------
+    # Serial (global dense-id) layout on disk: global user u lives at part
+    # u % W, local row u // W — so checkpoints are interchangeable across
+    # worker counts (including the serial sampler's).
+
+    def checkpoint_state(self, n_users: int) -> dict:
+        cols = max((p.hist.shape[1] for p in self.parts), default=0)
+        hist = np.zeros((n_users, cols), dtype=np.int32)
+        hist_len = np.zeros(n_users, dtype=np.int64)
+        total = np.zeros(n_users, dtype=np.int64)
+        draws = np.zeros(n_users, dtype=np.int64)
+        for p, part in enumerate(self.parts):
+            n_local = (n_users - p + self.workers - 1) // self.workers
+            if n_local <= 0:
+                continue
+            # The vocab can be ahead of the sampler (unfired buffered
+            # windows); size each part up before slicing.
+            part._ensure_rows(n_local - 1)
+            hist[p::self.workers, : part.hist.shape[1]] = part.hist[:n_local]
+            hist_len[p::self.workers] = part.hist_len[:n_local]
+            total[p::self.workers] = part.total[:n_local]
+            draws[p::self.workers] = part.draws[:n_local]
+        return {"hist": hist, "hist_len": hist_len, "total": total,
+                "draws": draws}
+
+    def restore_state(self, st: dict, n_users: int) -> None:
+        for p, part in enumerate(self.parts):
+            n_local = (n_users - p + self.workers - 1) // self.workers
+            if n_local <= 0:
+                continue
+            part.restore_state(
+                {k: st[k][p::self.workers] for k in
+                 ("hist", "hist_len", "total", "draws")}, n_local)

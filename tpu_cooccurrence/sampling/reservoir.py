@@ -88,7 +88,10 @@ class UserReservoirSampler:
         self.skip_cuts = skip_cuts
         self.counters = counters if counters is not None else Counters()
         init_cols = 8 if skip_cuts else user_cut
-        self.hist = np.zeros((capacity, init_cols), dtype=np.int64)
+        # int32 storage: histories hold dense item ids (< 2^31 by the
+        # job's vocab mapping); at 100k+ users x kMax columns the growth
+        # memcpys and cache footprint are the sampler's dominant cost.
+        self.hist = np.zeros((capacity, init_cols), dtype=np.int32)
         self.hist_len = np.zeros(capacity, dtype=np.int64)
         self.total = np.zeros(capacity, dtype=np.int64)
         self.draws = np.zeros(capacity, dtype=np.int64)
@@ -121,13 +124,21 @@ class UserReservoirSampler:
         users: np.ndarray,
         items: np.ndarray,
         sampled: np.ndarray,
+        rng_users: Optional[np.ndarray] = None,
     ) -> Tuple[PairDeltaBatch, np.ndarray]:
         """Process one window's tagged interactions (arrival order).
 
         Returns ``(pair_deltas, feedback_items)`` where ``feedback_items``
         are the rejected interactions' items (each implies a ``-1`` item-cut
         decrement, reference :246-248).
+
+        ``rng_users`` (default: ``users``) supplies the ids hashed by the
+        draw RNG. The partitioned sampler indexes state by *part-local*
+        compact ids but must draw with the *global* dense ids so its
+        decisions are bit-identical to the serial sampler's.
         """
+        if rng_users is None:
+            rng_users = users
         if len(users) == 0:
             return PairDeltaBatch.concat([]), np.zeros(0, dtype=np.int64)
         self._ensure_rows(int(users.max()))
@@ -143,6 +154,7 @@ class UserReservoirSampler:
 
         s_users = users[sampled]
         s_items = items[sampled]
+        s_rng = rng_users[sampled]
         s_total = total_at_event[sampled]
         s_rank = grouped_rank(s_users)  # rank among *sampled* events per user
 
@@ -180,7 +192,7 @@ class UserReservoirSampler:
                 else:
                     col = _ragged_arange(sizes)
                     row_u = np.repeat(a_users, sizes)
-                    partners = self.hist[row_u, col]
+                    partners = self.hist[row_u, col].astype(np.int64)
                     new_rep = np.repeat(a_items, sizes)
                     ones = np.ones(len(partners), dtype=np.int32)
                     # Both directions (reference :180-193).
@@ -199,7 +211,7 @@ class UserReservoirSampler:
             d_idx = self.draws[d_users] + d_rank
             uniq_d, n_draws = np.unique(d_users, return_counts=True)
             self.draws[uniq_d] += n_draws
-            k = reservoir_draw(self.seed, d_users, d_idx, d_total)
+            k = reservoir_draw(self.seed, s_rng[d_mask], d_idx, d_total)
             replace = k < self.user_cut
             feedback_items = d_items[~replace]
 
@@ -223,7 +235,8 @@ class UserReservoirSampler:
             for u, item, slot in zip(r_users.tolist(), r_items.tolist(), r_slots.tolist()):
                 hist_row = self.hist[u, :kc]
                 previous = int(hist_row[slot])
-                others = np.delete(hist_row, slot)  # kMax-1 partners (skip slot)
+                # kMax-1 partners (skip slot)
+                others = np.delete(hist_row, slot).astype(np.int64)
                 new_rep = np.full(kc - 1, item, dtype=np.int64)
                 prev_rep = np.full(kc - 1, previous, dtype=np.int64)
                 plus = np.ones(kc - 1, dtype=np.int32)
@@ -240,3 +253,27 @@ class UserReservoirSampler:
             feedback_items = np.zeros(0, dtype=np.int64)
 
         return PairDeltaBatch.concat(blocks), feedback_items
+
+    # -- checkpoint -------------------------------------------------------
+
+    def checkpoint_state(self, n_users: int) -> dict:
+        """Reservoir state for the first ``n_users`` dense users.
+
+        The vocab can be ahead of the sampler (users whose events are
+        still buffered in unfired windows, or late-dropped) — size the
+        state arrays up before slicing, or the slice comes up short."""
+        self._ensure_rows(max(n_users - 1, 0))
+        return {
+            "hist": self.hist[:n_users],
+            "hist_len": self.hist_len[:n_users],
+            "total": self.total[:n_users],
+            "draws": self.draws[:n_users],
+        }
+
+    def restore_state(self, st: dict, n_users: int) -> None:
+        self._ensure_rows(max(n_users - 1, 0))
+        self._ensure_cols(st["hist"].shape[1])
+        self.hist[:n_users, : st["hist"].shape[1]] = st["hist"]
+        self.hist_len[:n_users] = st["hist_len"]
+        self.total[:n_users] = st["total"]
+        self.draws[:n_users] = st["draws"]

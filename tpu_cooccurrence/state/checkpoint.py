@@ -56,12 +56,10 @@ def save(job, directory: str, source=None) -> str:
     arrays["item_cut_counts"] = job.item_cut.counts
 
     s = job.sampler
-    if hasattr(s, "hist"):  # reservoir sampler; sliding sampler is stateless
-        n_users = len(job.user_vocab)
-        arrays["hist"] = s.hist[:n_users]
-        arrays["hist_len"] = s.hist_len[:n_users]
-        arrays["total"] = s.total[:n_users]
-        arrays["draws"] = s.draws[:n_users]
+    if hasattr(s, "checkpoint_state"):  # reservoir samplers (serial or
+        # partitioned, both in the serial global-dense-id layout); the
+        # sliding sampler is stateless
+        arrays.update(s.checkpoint_state(len(job.user_vocab)))
 
     # In-flight window buffers, flattened.
     starts, users_l, items_l, ts_l = [], [], [], []
@@ -144,14 +142,10 @@ def restore(job, directory: str, source=None) -> None:
     job.item_cut.counts = data["item_cut_counts"].copy()
 
     s = job.sampler
-    if hasattr(s, "hist") and "hist" in data:
-        n_users = len(job.user_vocab)
-        s._ensure_rows(max(n_users - 1, 0))
-        s._ensure_cols(data["hist"].shape[1])
-        s.hist[:n_users, : data["hist"].shape[1]] = data["hist"]
-        s.hist_len[:n_users] = data["hist_len"]
-        s.total[:n_users] = data["total"]
-        s.draws[:n_users] = data["draws"]
+    if hasattr(s, "restore_state") and "hist" in data:
+        s.restore_state({k: data[k] for k in
+                         ("hist", "hist_len", "total", "draws")},
+                        len(job.user_vocab))
 
     job.engine.max_ts_seen = meta["max_ts_seen"]
     job.engine._buffers.clear()

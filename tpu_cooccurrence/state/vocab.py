@@ -15,12 +15,20 @@ import numpy as np
 
 
 class IdMap:
-    """Grow-only external->dense id mapping with batch lookup."""
+    """Grow-only external->dense id mapping with batch lookup.
+
+    Lookups run against a sorted (external, dense) array pair — fully
+    vectorized ``searchsorted``, no per-id Python. A lazy dict mirror
+    serves the scalar :meth:`to_dense` API.
+    """
 
     def __init__(self) -> None:
-        self._fwd: Dict[int, int] = {}
+        self._keys = np.zeros(0, dtype=np.int64)   # sorted external ids
+        self._vals = np.zeros(0, dtype=np.int64)   # dense id per key
         self._rev: list = []
         self._rev_arr: np.ndarray = np.zeros(0, dtype=np.int64)  # cache
+        self._fwd: Dict[int, int] = {}  # lazy mirror for to_dense()
+        self._fwd_n = 0  # how many dense ids the mirror covers
 
     def __len__(self) -> int:
         return len(self._rev)
@@ -28,33 +36,38 @@ class IdMap:
     def map_batch(self, ids: np.ndarray) -> np.ndarray:
         """Map a batch of external ids, assigning new dense ids as needed.
 
-        Dense ids are assigned in first-appearance order. Only the batch's
-        *unique* ids touch the Python dict; the expansion back to the full
-        batch is a vectorized take.
+        Dense ids are assigned in first-appearance order (deterministic for
+        a fixed stream). The whole batch is one unique + searchsorted +
+        merge — no per-id Python loop.
         """
-        fwd = self._fwd
-        rev = self._rev
+        ids = np.asarray(ids, dtype=np.int64)
         uniq, inverse = np.unique(ids, return_inverse=True)
         dense_uniq = np.empty(len(uniq), dtype=np.int64)
-        missing = []
-        for pos, ext in enumerate(uniq.tolist()):
-            dense = fwd.get(ext)
-            if dense is None:
-                missing.append(pos)
-            else:
-                dense_uniq[pos] = dense
-        if missing:
+        if len(self._keys):
+            pos = np.searchsorted(self._keys, uniq)
+            safe = np.minimum(pos, len(self._keys) - 1)
+            hit = self._keys[safe] == uniq
+        else:
+            pos = np.zeros(len(uniq), dtype=np.int64)
+            hit = np.zeros(len(uniq), dtype=bool)
+        dense_uniq[hit] = self._vals[pos[hit]]
+        miss = np.flatnonzero(~hit)
+        if len(miss):
             # np.unique sorts, but first-appearance order must win for
             # determinism: assign new ids by first position in the batch.
-            first_pos = np.full(len(uniq), np.iinfo(np.int64).max, dtype=np.int64)
-            np.minimum.at(first_pos, inverse, np.arange(len(inverse), dtype=np.int64))
-            missing.sort(key=lambda u_idx: int(first_pos[u_idx]))
-            for u_idx in missing:
-                ext = int(uniq[u_idx])
-                dense = len(rev)
-                fwd[ext] = dense
-                rev.append(ext)
-                dense_uniq[u_idx] = dense
+            first_pos = np.full(len(uniq), np.iinfo(np.int64).max,
+                                dtype=np.int64)
+            np.minimum.at(first_pos, inverse,
+                          np.arange(len(inverse), dtype=np.int64))
+            order = miss[np.argsort(first_pos[miss], kind="stable")]
+            new_ext = uniq[order]
+            new_dense = len(self._rev) + np.arange(len(order), dtype=np.int64)
+            dense_uniq[order] = new_dense
+            self._rev.extend(new_ext.tolist())
+            # Merge the (sorted) new keys into the sorted lookup arrays.
+            ins = pos[miss]  # miss is sorted, so uniq[miss] is sorted too
+            self._keys = np.insert(self._keys, ins, uniq[miss])
+            self._vals = np.insert(self._vals, ins, dense_uniq[miss])
         return dense_uniq[inverse]
 
     def to_external(self, dense: int) -> int:
@@ -62,6 +75,10 @@ class IdMap:
 
     def to_dense(self, ext):
         """Dense id for an external id, or ``None`` if never seen."""
+        if self._fwd_n != len(self._rev):
+            for dense in range(self._fwd_n, len(self._rev)):
+                self._fwd[self._rev[dense]] = dense
+            self._fwd_n = len(self._rev)
         return self._fwd.get(ext)
 
     def to_external_batch(self, dense: np.ndarray) -> np.ndarray:
@@ -78,6 +95,11 @@ class IdMap:
 
     def restore_state(self, rev: np.ndarray) -> None:
         self._rev = [int(x) for x in rev]
-        self._fwd = {ext: i for i, ext in enumerate(self._rev)}
+        rev = np.asarray(rev, dtype=np.int64)
+        order = np.argsort(rev, kind="stable")
+        self._keys = rev[order]
+        self._vals = order.astype(np.int64)
+        self._fwd = {}
+        self._fwd_n = 0
         self._rev_arr = np.zeros(0, dtype=np.int64)  # length check is not
         # enough here: a same-length restore must still drop the cache
