@@ -27,6 +27,32 @@ def test_batched_lines():
     assert [len(b[0]) for b in batches] == [4, 4, 2]
 
 
+def test_batched_lines_latency_flush():
+    """--buffer-timeout semantics: an aged partial batch flushes on the
+    continuous source's idle heartbeat instead of waiting for batch_size."""
+    import time as _time
+
+    def stream():
+        yield "1,1,1"
+        yield "2,2,2"
+        _time.sleep(0.03)
+        yield None  # idle heartbeat: batch is now older than the bound
+        yield "3,3,3"
+        yield None  # fresh batch, not aged: no flush
+        _time.sleep(0.03)
+        yield None  # aged now: flush
+
+    batches = list(batched_lines(stream(), batch_size=100,
+                                 max_latency_s=0.02))
+    assert [b[0].tolist() for b in batches] == [[1, 2], [3]]
+
+
+def test_batched_lines_heartbeats_ignored_without_latency_bound():
+    batches = list(batched_lines(
+        iter(["1,1,1", None, "2,2,2", None]), batch_size=100))
+    assert [b[0].tolist() for b in batches] == [[1, 2]]
+
+
 def test_source_modification_time_order(tmp_path):
     # Reference forwards splits sorted by modification time
     # (ContinuousFileMonitoringFunction.java:239-257).

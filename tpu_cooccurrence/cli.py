@@ -48,7 +48,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from .observability import xla_trace
 
     with xla_trace(config.profile_dir):
-        job.run(batched_lines(source.lines()))
+        # --buffer-timeout bounds how long a parsed line may wait in a
+        # partial batch (reference: FlinkCooccurrences.java:46); it only
+        # matters when tailing input continuously — process-once runs
+        # always flush at end of stream.
+        latency = (config.buffer_timeout / 1000.0
+                   if config.process_continuously else None)
+        job.run(batched_lines(source.lines(), max_latency_s=latency))
 
     if config.development_mode:
         for w in job.step_timer.slowest():

@@ -81,7 +81,9 @@ class Config:
     window_size: int = 0
     window_unit: WindowUnit = WindowUnit.MILLISECONDS
     seed: Optional[int] = None
-    buffer_timeout: int = 100  # retained for CLI parity; no-op (no net stack)
+    buffer_timeout: int = 100  # ms a parsed line may wait in a partial
+    # batch when tailing continuously (reference: record flush bound,
+    # FlinkCooccurrences.java:46); no-op in process-once runs
 
     # --- TPU-framework extensions (no reference analogue) ---
     backend: Backend = Backend.DEVICE

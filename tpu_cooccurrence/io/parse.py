@@ -8,7 +8,8 @@ arrays — the framework's record unit is a *batch*, not a record.
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator, List, Tuple
+import time
+from typing import Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -33,12 +34,33 @@ def parse_lines(lines: Iterable[str]) -> InteractionBatch:
     )
 
 
-def batched_lines(lines: Iterable[str], batch_size: int = 65536) -> Iterator[InteractionBatch]:
-    """Group a line stream into fixed-size parsed batches."""
+def batched_lines(lines: Iterable[str], batch_size: int = 65536,
+                  max_latency_s: Optional[float] = None
+                  ) -> Iterator[InteractionBatch]:
+    """Group a line stream into parsed batches.
+
+    Batches flush at ``batch_size`` lines, or — when ``max_latency_s`` is
+    set (the ``--buffer-timeout`` analogue of the reference's record-flush
+    bound, ``FlinkCooccurrences.java:46``) — once the oldest buffered line
+    has waited that long. A continuous-mode source interleaves ``None``
+    heartbeats while idle so an aged partial batch flushes even when no
+    further lines arrive.
+    """
     buf: List[str] = []
+    oldest = 0.0
     for line in lines:
+        if line is None:  # idle heartbeat (continuous sources only)
+            if buf and max_latency_s is not None \
+                    and time.monotonic() - oldest >= max_latency_s:
+                yield parse_lines(buf)
+                buf.clear()
+            continue
+        if not buf:
+            oldest = time.monotonic()
         buf.append(line)
-        if len(buf) >= batch_size:
+        if len(buf) >= batch_size or (
+                max_latency_s is not None
+                and time.monotonic() - oldest >= max_latency_s):
             yield parse_lines(buf)
             buf.clear()
     if buf:

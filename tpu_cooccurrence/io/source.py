@@ -154,4 +154,7 @@ class FileMonitorSource:
             skip_file = None  # the restored position applies only once
             if not self.process_continuously:
                 return
+            # Idle heartbeat: lets the downstream batcher flush an aged
+            # partial batch (--buffer-timeout) while no new lines arrive.
+            yield None
             time.sleep(self.poll_interval_s)
