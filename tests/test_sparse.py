@@ -87,16 +87,17 @@ def test_sparse_index_invariants():
     job.add_batch(users, items, ts)
     job.finish()
     sc = job.scorer
-    assert np.all(np.diff(sc.g_key) > 0)  # strictly sorted, unique
-    assert len(sc.g_slot) == len(sc.g_key)
-    rows = (sc.g_key >> 32).astype(np.int64)
+    idx = sc.index
+    assert np.all(np.diff(idx.g_key) > 0)  # strictly sorted, unique
+    assert len(idx.g_slot) == len(idx.g_key)
+    rows = (idx.g_key >> 32).astype(np.int64)
     for r in np.unique(rows):
-        slots = np.sort(sc.g_slot[rows == r])
-        start, ln = sc.row_start[r], sc.row_len[r]
+        slots = np.sort(idx.g_slot[rows == r])
+        start, ln = idx.row_start[r], idx.row_len[r]
         assert ln == len(slots)
         np.testing.assert_array_equal(slots, np.arange(start, start + ln))
-        assert ln <= sc.row_cap[r]
-    assert sc.heap_end <= sc.capacity
+        assert ln <= idx.row_cap[r]
+    assert idx.heap_end <= sc.capacity
 
 
 def test_sparse_checkpoint_roundtrip(tmp_path):

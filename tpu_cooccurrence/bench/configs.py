@@ -141,10 +141,11 @@ def config3_ml25m_sliding(backend: Backend = Backend.DEVICE,
     return _run("ml-25m-sliding", cfg, users, items, ts, standin)
 
 
-def config4_zipfian_1m(backend: Backend = Backend.HYBRID,
+def config4_zipfian_1m(backend: Backend = Backend.SPARSE,
                             n_events: int = 1_000_000) -> BenchResult:
     """1M-item Zipfian stream. Dense device state is infeasible at this
-    vocabulary, so the hybrid backend carries it."""
+    vocabulary; the device-resident sparse slab backend carries it (the
+    host-matrix hybrid remains as the fallback comparison point)."""
     users, items, ts = synthetic.zipfian_interactions(
         n_events, n_items=1_000_000, n_users=100_000, alpha=1.1, seed=4,
         events_per_ms=200)

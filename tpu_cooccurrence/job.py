@@ -111,6 +111,17 @@ class CooccurrenceJob:
             return HybridScorer(self.config.top_k, self.counters,
                                 self.config.development_mode)
         if backend == Backend.SPARSE:
+            if self.config.num_shards > 1:
+                if self.config.coordinator is not None:
+                    raise NotImplementedError(
+                        "multi-host sharded-sparse is not wired yet — use "
+                        "--backend sharded for multi-host runs")
+                from .parallel.sharded_sparse import ShardedSparseScorer
+
+                return ShardedSparseScorer(
+                    self.config.top_k, num_shards=self.config.num_shards,
+                    counters=self.counters,
+                    development_mode=self.config.development_mode)
             from .state.sparse_scorer import SparseDeviceScorer
 
             return SparseDeviceScorer(self.config.top_k, self.counters,

@@ -1,0 +1,513 @@
+"""Multi-chip sparse backend: row-sharded HBM slabs over an item mesh.
+
+Combines the two scale axes of this framework: the device-resident sparse
+slab of ``state/sparse_scorer.py`` (vocabularies beyond any dense ceiling,
+minimal host<->device transfer) and the mesh distribution of
+``parallel/sharded.py`` (the TPU-native replacement of the reference's
+keyed shuffle + broadcast, SURVEY §2.6):
+
+  * Item rows are **modulo-sharded**: shard ``d`` of ``D`` owns every row
+    ``r`` with ``r % D == d`` — the ``keyBy(item)`` analogue. Modulo (not
+    block) keeps Zipf-head rows spread across chips. Each shard runs its
+    own :class:`~tpu_cooccurrence.state.sparse_scorer.SlabIndex` over
+    *shard-local* row ids ``r // D`` and a private slab in its HBM.
+  * ``row_sums`` is **replicated** (the broadcast analogue,
+    ``FlinkCooccurrences.java:163``): each shard scatters its owned rows'
+    window deltas into a partial vector and a ``lax.psum`` over ICI
+    makes every replica whole — the only cross-chip communication in the
+    entire step. Scoring then reads any partner's sum locally.
+  * Scoring and top-K stay **shard-local** (each shard owns its rows
+    outright), exactly like the dense sharded backend.
+
+One program per step phase (``shard_map`` under ``jit``), fixed shapes
+via the same pow-4 ladders as the single-device sparse backend, host
+placement decisions per shard. Works identically on a virtual CPU mesh
+and real TPU meshes.
+
+Checkpoints use the canonical sparse-matrix format (global key space), so
+they are interchangeable with the single-device sparse and hybrid
+backends — a 1-chip checkpoint restores onto 8 shards and back.
+(Multi-process runs would need per-process snapshots like the dense
+sharded backend's; this backend currently checkpoints single-process
+meshes only and says so loudly.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
+                             narrow_deltas_int32)
+from ..ops.device_scorer import pad_pow2, pad_pow4
+from ..sampling.reservoir import PairDeltaBatch
+from ..state.results import TopKBatch
+from ..state.sparse_scorer import (_SENT, SlabIndex, _apply_cells,
+                                   _pow2ceil, _score_rect, score_buckets)
+from .mesh import ITEM_AXIS, make_mesh
+
+
+class ShardedSparseScorer:
+    """Modulo-row-sharded sparse slabs + replicated row sums via psum."""
+
+    SCORE_BUDGET = 1 << 24  # per-shard padded-cell budget per score call
+
+    def __init__(self, top_k: int, num_shards: Optional[int] = None,
+                 counters: Optional[Counters] = None,
+                 mesh: Optional[Mesh] = None,
+                 development_mode: bool = False,
+                 capacity: int = 1 << 14,
+                 items_capacity: int = 1 << 10,
+                 compact_min_heap: int = 1 << 16) -> None:
+        from ..xla_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        self.top_k = top_k
+        self.counters = counters if counters is not None else Counters()
+        self.development_mode = development_mode
+        self.mesh = mesh if mesh is not None else make_mesh(num_shards)
+        self.n_shards = self.mesh.devices.size
+        self.indexes = [SlabIndex(rows_capacity=max(items_capacity
+                                                    // self.n_shards, 16))
+                        for _ in range(self.n_shards)]
+        self.items_cap = int(items_capacity)
+        self.row_sums_host = np.zeros(self.items_cap, dtype=np.int64)
+        self.compact_min_heap = int(compact_min_heap)
+        self.capacity = int(capacity)  # per-shard slab capacity
+        self.observed = 0
+        self._pending: Optional[List] = None
+        self.last_dispatched_rows = 0
+        self._score_fns: Dict[int, object] = {}  # R -> jitted shard_map fn
+
+        from .distributed import put_global
+
+        self._put_global = put_global
+        self.cnt = put_global(
+            np.zeros((self.n_shards, self.capacity), np.int32),
+            self.mesh, P(ITEM_AXIS, None))
+        self.dst = put_global(
+            np.zeros((self.n_shards, self.capacity), np.int32),
+            self.mesh, P(ITEM_AXIS, None))
+        self.row_sums = put_global(
+            np.zeros((self.items_cap,), np.int32), self.mesh, P())
+        self._build_update()
+
+    # -- mesh kernels -----------------------------------------------------
+
+    def _build_update(self) -> None:
+        """(Re)build the update program for the current items_cap."""
+        items_cap = self.items_cap
+
+        def _update(cnt_loc, dst_loc, row_sums, upd_loc, bounds_loc,
+                    rs_part_loc):
+            # Per-shard slices arrive as leading-1 blocks.
+            cnt, dst = _apply_cells(cnt_loc[0], dst_loc[0], upd_loc[0],
+                                    bounds_loc[0])
+            # Owned-row partial sums -> psum makes every replica whole:
+            # the step's only collective (ICI), replacing the reference's
+            # keyed shuffle + re-broadcast round trip.
+            part = jnp.zeros((items_cap,), jnp.int32).at[
+                rs_part_loc[0, 0]].add(rs_part_loc[0, 1], mode="drop")
+            row_sums = row_sums + jax.lax.psum(part, ITEM_AXIS)
+            return cnt[None], dst[None], row_sums
+
+        self._update = jax.jit(shard_map(
+            _update, mesh=self.mesh,
+            in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None), P(),
+                      P(ITEM_AXIS), P(ITEM_AXIS), P(ITEM_AXIS)),
+            out_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None), P()),
+        ), donate_argnums=(0, 1, 2))
+
+        # Move/grow/compaction programs are built per static width on
+        # demand and cached — a fresh jit wrapper per call would miss
+        # jax's compile cache every time (cache resets on items_cap
+        # growth; they just retrace).
+        self._move_fns: Dict[int, object] = {}
+        self._grow_fns: Dict[int, object] = {}
+        self._compact_fns: Dict[int, object] = {}
+
+    def _moves_fn(self, L: int):
+        fn = self._move_fns.get(L)
+        if fn is None:
+            def _moves(cnt_loc, dst_loc, mv_loc):
+                mv = mv_loc[0]
+                old_start, new_start, ln = mv[0], mv[1], mv[2]
+                col = jnp.arange(L, dtype=jnp.int32)[None, :]
+                valid = col < ln[:, None]
+                src_idx = jnp.where(valid, old_start[:, None] + col, 0)
+                out_idx = jnp.where(valid, new_start[:, None] + col, _SENT)
+                cnt = cnt_loc[0].at[out_idx.ravel()].set(
+                    cnt_loc[0][src_idx].ravel(), mode="drop")
+                dst = dst_loc[0].at[out_idx.ravel()].set(
+                    dst_loc[0][src_idx].ravel(), mode="drop")
+                return cnt[None], dst[None]
+
+            fn = jax.jit(shard_map(
+                _moves, mesh=self.mesh,
+                in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None),
+                          P(ITEM_AXIS)),
+                out_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None)),
+            ), donate_argnums=(0, 1))
+            self._move_fns[L] = fn
+        return fn
+
+    def _score_fn(self, R: int):
+        fn = self._score_fns.get(R)
+        if fn is None:
+            top_k = self.top_k
+
+            def _score(cnt_loc, dst_loc, row_sums, meta_loc, observed):
+                out = _score_rect(cnt_loc[0], dst_loc[0], row_sums,
+                                  meta_loc[0], observed, top_k, R)
+                return out[None]
+
+            fn = jax.jit(shard_map(
+                _score, mesh=self.mesh,
+                in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None), P(),
+                          P(ITEM_AXIS), P()),
+                out_specs=P(ITEM_AXIS),
+            ))
+            self._score_fns[R] = fn
+        return fn
+
+    def _grow_fn(self, n: int):
+        fn = self._grow_fns.get(n)
+        if fn is None:
+            def _grow2(cnt_loc, dst_loc):
+                z = jnp.zeros((1, n), jnp.int32)
+                return (z.at[:, : cnt_loc.shape[1]].set(cnt_loc),
+                        z.at[:, : dst_loc.shape[1]].set(dst_loc))
+
+            fn = jax.jit(shard_map(
+                _grow2, mesh=self.mesh,
+                in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None)),
+                out_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None)),
+            ))
+            self._grow_fns[n] = fn
+        return fn
+
+    def _compact_gather_fn(self, g_pad: int):
+        fn = self._compact_fns.get(g_pad)
+        if fn is None:
+            def _cg(cnt_loc, dst_loc, gmap_loc):
+                gmap = gmap_loc[0]
+                cap = cnt_loc.shape[1]
+                return (jnp.zeros((cap,), jnp.int32).at[: g_pad].set(
+                            cnt_loc[0][gmap])[None],
+                        jnp.zeros((cap,), jnp.int32).at[: g_pad].set(
+                            dst_loc[0][gmap])[None])
+
+            fn = jax.jit(shard_map(
+                _cg, mesh=self.mesh,
+                in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None),
+                          P(ITEM_AXIS)),
+                out_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None)),
+            ), donate_argnums=(0, 1))
+            self._compact_fns[g_pad] = fn
+        return fn
+
+    # -- capacity ---------------------------------------------------------
+
+    def _ensure_items(self, max_id: int) -> None:
+        if max_id >= (1 << 31) - 1:
+            raise ValueError("sparse backend supports item ids < 2^31 - 1")
+        if max_id < self.items_cap:
+            return
+        new_cap = int(_pow2ceil(np.asarray([max_id + 1]), 1024)[0])
+        grown = np.zeros(new_cap, dtype=np.int64)
+        grown[: len(self.row_sums_host)] = self.row_sums_host
+        self.row_sums_host = grown
+        self.items_cap = new_cap
+        # The replicated row-sum vector is reconstructible from the host
+        # mirror — re-upload instead of growing on device.
+        self.row_sums = self._put_global(
+            self.row_sums_host.astype(np.int32), self.mesh, P())
+        self._build_update()  # items_cap is baked into the psum scatter
+
+    def _ensure_heap(self, need_end: int) -> None:
+        if need_end <= self.capacity:
+            return
+        new_cap = self.capacity
+        while new_cap < need_end:
+            new_cap *= 2
+        self.cnt, self.dst = self._grow_fn(new_cap)(self.cnt, self.dst)
+        self.capacity = new_cap
+
+    # -- the window step --------------------------------------------------
+
+    def _local_key(self, src: np.ndarray, dst: np.ndarray) -> np.ndarray:
+        return ((src // self.n_shards).astype(np.int64) << 32) | dst
+
+    def process_window(self, ts: int, pairs: PairDeltaBatch):
+        self.last_dispatched_rows = 0
+        D = self.n_shards
+        if len(pairs) == 0:
+            return self.flush()
+        if any(ix.needs_compaction(self.compact_min_heap)
+               for ix in self.indexes):
+            self._compact_all()
+        delta64 = pairs.delta.astype(np.int64)
+        self._ensure_items(int(max(pairs.src.max(), pairs.dst.max())))
+        src_d, dst_d, d_val, _ = aggregate_window_coo(
+            pairs.src, pairs.dst, delta64, return_key=True)
+        d_val32 = narrow_deltas_int32(d_val)
+
+        # Global row sums (watermark ordering first), host-exact.
+        rows = distinct_sorted(src_d)
+        row_ends = np.searchsorted(src_d, rows, side="right")
+        cum = np.concatenate([[0], np.cumsum(d_val)])
+        rs_delta = cum[row_ends] - cum[np.searchsorted(src_d, rows)]
+        self.row_sums_host[rows] += rs_delta
+        if self.row_sums_host[rows].max(initial=0) >= 2**31:
+            raise ValueError("row sum exceeds int32 range")
+        window_sum = int(delta64.sum())
+        self.observed += window_sum
+        self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
+
+        # Per-shard placement: cells by owner, local keys stay sorted
+        # because src // D is monotone within a fixed residue class.
+        owner = (src_d % D).astype(np.int64)
+        plans = []
+        sec_new: List[Tuple[np.ndarray, np.ndarray]] = []
+        sec_delta: List[Tuple[np.ndarray, np.ndarray]] = []
+        mv_blocks: List[Optional[np.ndarray]] = []
+        for d in range(D):
+            sel = owner == d
+            lk = self._local_key(src_d[sel], dst_d[sel])
+            plan = self.indexes[d].apply(lk)
+            plans.append(plan)
+            sec_new.append((plan.slots[plan.new_sel],
+                            (lk[plan.new_sel] & 0xFFFFFFFF).astype(np.int32)))
+            sec_delta.append((plan.slots, d_val32[sel]))
+            mv_blocks.append((plan.mv, plan.mv_len))
+        self._ensure_heap(max(ix.heap_end for ix in self.indexes))
+
+        # Moves: one [D, 3, Mv_pad] block at the widest shard's rectangle.
+        mv_pad = max((mv.shape[1] for mv, _ in mv_blocks if mv is not None),
+                     default=0)
+        mv_len = max((ml for mv, ml in mv_blocks if mv is not None),
+                     default=0)
+        if mv_pad:
+            mv_all = np.zeros((D, 3, mv_pad), dtype=np.int32)
+            for d, (mv, _) in enumerate(mv_blocks):
+                if mv is not None:
+                    mv_all[d, :, : mv.shape[1]] = mv
+            self.cnt, self.dst = self._moves_fn(mv_len)(
+                self.cnt, self.dst, mv_all)
+
+        # Update: [D, 2, N_pad] cell sections + [D, 2] bounds + owner-
+        # partitioned [D, 2, Rp] row-sum parts (psum'd to every replica).
+        n_per = [len(s[0]) + len(dl[0]) for s, dl in zip(sec_new, sec_delta)]
+        n_pad = pad_pow4(max(n_per + [1]), minimum=1 << 10)
+        upd = np.full((D, 2, n_pad), _SENT, dtype=np.int32)
+        upd[:, 1, :] = 0
+        bounds = np.zeros((D, 2), dtype=np.int32)
+        for d in range(D):
+            (ns, nd), (ds_, dv) = sec_new[d], sec_delta[d]
+            b0 = len(ns)
+            b1 = b0 + len(ds_)
+            upd[d, 0, :b0] = ns
+            upd[d, 1, :b0] = nd
+            upd[d, 0, b0:b1] = ds_
+            upd[d, 1, b0:b1] = dv
+            bounds[d] = (b0, b1)
+        row_owner = (rows % D).astype(np.int64)
+        rp = pad_pow4(int(np.bincount(row_owner, minlength=D).max())
+                      if len(rows) else 1, minimum=256)
+        rs_part = np.full((D, 2, rp), _SENT, dtype=np.int32)
+        rs_part[:, 1, :] = 0
+        for d in range(D):
+            sel = row_owner == d
+            k = int(sel.sum())
+            rs_part[d, 0, :k] = rows[sel]
+            rs_part[d, 1, :k] = rs_delta[sel].astype(np.int32)
+        self.cnt, self.dst, self.row_sums = self._update(
+            self.cnt, self.dst, self.row_sums, upd, bounds, rs_part)
+
+        if self.development_mode:
+            self._check_row_sums(rows)
+
+        self.counters.add(RESCORED_ITEMS, len(rows))
+        self.last_dispatched_rows = len(rows)
+        chunks = self._dispatch_scoring(rows, row_owner)
+        prev, self._pending = self._pending, chunks
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
+
+    def _dispatch_scoring(self, rows: np.ndarray,
+                          row_owner: np.ndarray) -> List[Tuple]:
+        """Global pow-4 length buckets; within a bucket, rows partition by
+        owner into one [D, 3, S_pad] meta block per dispatch."""
+        D = self.n_shards
+        local = (rows // D).astype(np.int64)
+        starts = np.empty(len(rows), dtype=np.int32)
+        lens = np.empty(len(rows), dtype=np.int32)
+        for d in range(D):
+            sel = row_owner == d
+            starts[sel] = self.indexes[d].row_start[local[sel]]
+            lens[sel] = self.indexes[d].row_len[local[sel]]
+        min_r = max(16, self.top_k)
+        bucket, order = score_buckets(lens, min_r)
+        b_sorted = bucket[order]
+        chunks: List[Tuple] = []
+        pos = 0
+        while pos < len(order):
+            b = int(b_sorted[pos])
+            end = int(np.searchsorted(b_sorted, b, side="right"))
+            R = min_r << (2 * b)
+            s_block = max(self.SCORE_BUDGET // R, 16)
+            members = order[pos:end]
+            counts = np.bincount(row_owner[members], minlength=D)
+            # Per-shard chunking: split the bucket so no shard exceeds
+            # s_block rows per dispatch.
+            n_dispatch = max(1, -(-int(counts.max()) // s_block))
+            per_shard = [members[row_owner[members] == d] for d in range(D)]
+            for i in range(n_dispatch):
+                parts = [p[i * s_block: (i + 1) * s_block]
+                         for p in per_shard]
+                s_max = max((len(p) for p in parts), default=0)
+                s_pad = min(pad_pow4(max(s_max, 1), minimum=16), s_block)
+                meta = np.zeros((D, 3, s_pad), dtype=np.int32)
+                for d, p in enumerate(parts):
+                    meta[d, 0, : len(p)] = rows[p]
+                    meta[d, 1, : len(p)] = starts[p]
+                    meta[d, 2, : len(p)] = lens[p]
+                packed = self._score_fn(R)(
+                    self.cnt, self.dst, self.row_sums, meta,
+                    np.float32(self.observed))
+                if hasattr(packed, "copy_to_host_async"):
+                    packed.copy_to_host_async()
+                chunks.append(([rows[p] for p in parts], packed))
+            pos = end
+        return chunks
+
+    def _compact_all(self) -> None:
+        gmaps = [ix.compact() for ix in self.indexes]
+        g_pad = min(pad_pow2(max(len(g) for g in gmaps), minimum=1 << 10),
+                    self.capacity)
+        gm = np.zeros((self.n_shards, g_pad), dtype=np.int32)
+        for d, g in enumerate(gmaps):
+            gm[d, : len(g)] = g
+        self.cnt, self.dst = self._compact_gather_fn(g_pad)(
+            self.cnt, self.dst, gm)
+
+    def _check_row_sums(self, rows: np.ndarray) -> None:
+        cnt = np.asarray(self.cnt)
+        D = self.n_shards
+        for r in rows.tolist():
+            d, lr = r % D, r // D
+            s = int(self.indexes[d].row_start[lr])
+            ln = int(self.indexes[d].row_len[lr])
+            actual = int(cnt[d, s: s + ln].sum())
+            if actual != int(self.row_sums_host[r]):
+                raise AssertionError(
+                    f"Item row {int(self.row_sums_host[r])} does not match "
+                    f"actual row sum {actual} (item {r})")
+
+    # -- results ----------------------------------------------------------
+
+    def flush(self) -> TopKBatch:
+        prev, self._pending = self._pending, None
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
+
+    def _materialize(self, chunks) -> TopKBatch:
+        rows_l, idx_l, vals_l = [], [], []
+        for per_shard_rows, packed in chunks:
+            for shard in packed.addressable_shards:
+                d = shard.index[0].start or 0
+                rows_d = per_shard_rows[d]
+                if not len(rows_d):
+                    continue
+                host = np.asarray(shard.data)[0]  # [2, S_pad, K]
+                rows_l.append(rows_d)
+                vals_l.append(host[0, : len(rows_d)])
+                idx_l.append(host[1, : len(rows_d)].view(np.int32))
+        return TopKBatch.concatenate(rows_l, idx_l, vals_l, self.top_k)
+
+    # -- checkpoint -------------------------------------------------------
+
+    def checkpoint_state(self) -> dict:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "sharded-sparse checkpoints are single-process for now — "
+                "use the dense sharded backend for multi-host checkpoints")
+        D = self.n_shards
+        cnt = np.asarray(self.cnt)  # [D, E]
+        keys_l, vals_l = [], []
+        for d, ix in enumerate(self.indexes):
+            if not len(ix.g_key):
+                continue
+            local_rows = (ix.g_key >> 32).astype(np.int64)
+            g_dst = ix.g_key & 0xFFFFFFFF
+            g_src = local_rows * D + d
+            keys_l.append((g_src << 32) | g_dst)
+            vals_l.append(cnt[d][ix.g_slot])
+        if keys_l:
+            keys = np.concatenate(keys_l)
+            vals = np.concatenate(vals_l)
+            order = np.argsort(keys, kind="stable")
+            keys, vals = keys[order], vals[order]
+            nz = vals != 0
+            keys, vals = keys[nz], vals[nz]
+        else:
+            keys = np.zeros(0, dtype=np.int64)
+            vals = np.zeros(0, dtype=np.int64)
+        return {
+            "rows_key": keys,
+            "rows_cnt": vals.astype(np.int64),
+            "row_sums": self.row_sums_host.copy(),
+            "observed": np.asarray([self.observed], dtype=np.int64),
+        }
+
+    def restore_state(self, st: dict) -> None:
+        D = self.n_shards
+        key = st["rows_key"]
+        cnt_vals = st["rows_cnt"].astype(np.int32)
+        src = (key >> 32).astype(np.int64)
+        dst = (key & 0xFFFFFFFF).astype(np.int64)
+        max_id = int(max(src.max(initial=0), dst.max(initial=0)))
+        if max_id >= self.items_cap:
+            new_cap = int(_pow2ceil(np.asarray([max_id + 1]), 1024)[0])
+            self.row_sums_host = np.zeros(new_cap, dtype=np.int64)
+            self.items_cap = new_cap
+            self._build_update()
+        owner = (src % D).astype(np.int64)
+        need = 0
+        per_shard = []
+        for d in range(D):
+            sel = owner == d
+            lk = self._local_key(src[sel], dst[sel])
+            slots = self.indexes[d].rebuild_from_keys(lk)
+            per_shard.append((slots, cnt_vals[sel], dst[sel]))
+            need = max(need, self.indexes[d].heap_end)
+        while self.capacity < need:
+            self.capacity *= 2
+        cnt_host = np.zeros((D, self.capacity), dtype=np.int32)
+        dst_host = np.zeros((D, self.capacity), dtype=np.int32)
+        for d, (slots, cv, dv) in enumerate(per_shard):
+            cnt_host[d, slots] = cv
+            dst_host[d, slots] = dv.astype(np.int32)
+        self.cnt = self._put_global(cnt_host, self.mesh, P(ITEM_AXIS, None))
+        self.dst = self._put_global(dst_host, self.mesh, P(ITEM_AXIS, None))
+        rs = np.asarray(st["row_sums"], dtype=np.int64)
+        if len(rs) > self.items_cap and rs[self.items_cap:].any():
+            raise ValueError("checkpoint row sums extend past its cells")
+        self.row_sums_host[:] = 0
+        m = min(len(rs), self.items_cap)
+        self.row_sums_host[:m] = rs[:m]
+        self.row_sums = self._put_global(
+            self.row_sums_host.astype(np.int32), self.mesh, P())
+        self.observed = int(st["observed"][0])
+        self._pending = None

@@ -14,8 +14,8 @@ Design (no reference analogue — the reference delegates all state to
 Flink's heap, ``ItemRowRescorerTwoInputStreamOperator.java:33-37``):
 
 * **Host keeps the index, device keeps the data.** The host maintains the
-  sorted packed-key array of all matrix cells (like the hybrid backend)
-  plus, per cell, the *device slot* its count lives in. Every placement
+  sorted packed-key array of all matrix cells (:class:`SlabIndex`) plus,
+  per cell, the *device slot* its count lives in. Every placement
   decision (slot assignment, row growth, compaction) is host-computed
   numpy; the device never needs data-dependent control flow — every
   kernel is a fixed-shape scatter/gather jit, exactly what XLA wants.
@@ -39,10 +39,15 @@ index, i.e. the earliest-*inserted* cell of the row — which matches the
 reference's heap behavior (it keeps the earlier entry) rather than the
 dense backend's lowest-item-id rule. All cross-backend tests compare ids
 only where score gaps exceed tolerance.
+
+:class:`SlabIndex` is row-id-space agnostic so the multi-chip backend
+(``parallel/sharded_sparse.py``) can keep one index per shard over
+shard-local row ids and slots.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import List, Optional, Tuple
 
@@ -98,6 +103,17 @@ def _apply_update(cnt, dst, row_sums, upd, bounds):
 
     Section order matters: new-cell zeroing must precede the delta add.
     """
+    cnt, dst = _apply_cells(cnt, dst, upd, bounds)
+    pos = jnp.arange(upd.shape[1], dtype=jnp.int32)
+    rs_idx = jnp.where(pos >= bounds[1], upd[0], _SENT)
+    row_sums = row_sums.at[rs_idx].add(
+        jnp.where(pos >= bounds[1], upd[1], 0), mode="drop")
+    return cnt, dst, row_sums
+
+
+def _apply_cells(cnt, dst, upd, bounds):
+    """New-cell + delta sections of an update buffer (shared with the
+    sharded backend, whose row sums update separately — replicated)."""
     idx, val = upd[0], upd[1]
     pos = jnp.arange(upd.shape[1], dtype=jnp.int32)
     is_new = pos < bounds[0]
@@ -107,20 +123,15 @@ def _apply_update(cnt, dst, row_sums, upd, bounds):
     cnt = cnt.at[new_idx].set(0, mode="drop")
     d_idx = jnp.where(is_delta, idx, _SENT)
     cnt = cnt.at[d_idx].add(jnp.where(is_delta, val, 0), mode="drop")
-    rs_idx = jnp.where(pos >= bounds[1], idx, _SENT)
-    row_sums = row_sums.at[rs_idx].add(
-        jnp.where(pos >= bounds[1], val, 0), mode="drop")
-    return cnt, dst, row_sums
+    return cnt, dst
 
 
-@functools.partial(jax.jit, static_argnames=("top_k", "R"))
-def _score_slab(cnt, dst, row_sums, meta, observed, top_k: int, R: int):
-    """LLR + top-K over one length bucket of updated rows.
+def _score_rect(cnt, dst, row_sums, meta, observed, top_k: int, R: int):
+    """LLR + top-K over one length bucket of updated rows (trace body).
 
     ``meta``: [3, S_pad] int32 (row id, slab start, row len); padded rows
-    carry len == 0 and score all -inf. Everything scored is gathered from
-    HBM; the only output is the packed [2, S_pad, K] result (scores;
-    partner ids bitcast to float lanes).
+    carry len == 0 and score all -inf. ``meta[0]`` row ids index
+    ``row_sums`` (global id space); starts index the local slab.
     """
     rowids, starts, lens = meta[0], meta[1], meta[2]
     col = jnp.arange(R, dtype=jnp.int32)[None, :]
@@ -142,6 +153,10 @@ def _score_slab(cnt, dst, row_sums, meta, observed, top_k: int, R: int):
     return jnp.stack([vals, jax.lax.bitcast_convert_type(ids, jnp.float32)])
 
 
+_score_slab = functools.partial(jax.jit, static_argnames=("top_k", "R"))(
+    _score_rect)
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def _grow(arr, n: int):
     # No donation: the output is a different buffer size, so XLA could
@@ -161,115 +176,76 @@ def _pow2ceil(x: np.ndarray, minimum: int) -> np.ndarray:
     return (1 << np.ceil(np.log2(v)).astype(np.int64)).astype(np.int32)
 
 
-class SparseDeviceScorer:
-    """Sorted-key host index over a device-resident sparse count slab."""
+def score_buckets(lens: np.ndarray, min_r: int):
+    """pow-4 length buckets: bucket b scores rows at ``R = min_r * 4^b``
+    (the smallest b with R >= len). Returns (bucket-per-row, order sorted
+    by bucket). Integer math, exact at powers:
+    ``shift = ceil(len / 2^floor(log2 min_r)) - 1``;
+    ``b = ceil(log2(shift+1) / 2)`` via frexp's exponent
+    (``frexp(s)[1] = floor(log2 s) + 1``, ``frexp(0) = 0``)."""
+    shift = (np.maximum(lens, 1) - 1) >> (min_r.bit_length() - 1)
+    bucket = (np.frexp(shift.astype(np.float64))[1] + 1) // 2
+    return bucket, np.argsort(bucket, kind="stable")
 
-    # Per-score-chunk padded-cell budget. Padding is device compute only —
-    # it never crosses the wire in this backend — so the budget is sized
-    # for HBM transients ([S, R] gather + scores), not transfer, and the
-    # length ladder is coarse (pow-4): fewer dispatches beats tighter
-    # padding when every dispatch pays tunnel round-trip latency.
-    SCORE_BUDGET = 1 << 24
 
-    def __init__(self, top_k: int, counters: Optional[Counters] = None,
-                 development_mode: bool = False,
-                 capacity: int = 1 << 16,
-                 items_capacity: int = 1 << 10,
-                 compact_min_heap: int = 1 << 16) -> None:
-        from ..xla_cache import enable_compilation_cache
+@dataclasses.dataclass
+class AllocPlan:
+    """Device-facing output of one window's :meth:`SlabIndex.apply`."""
 
-        enable_compilation_cache()
-        self.top_k = top_k
-        self.counters = counters if counters is not None else Counters()
-        self.development_mode = development_mode
-        # Host index: packed (src << 32 | dst) keys sorted ascending, and
-        # each cell's device slot.
+    mv: Optional[np.ndarray]      # [3, Mv_pad] int32 move instructions
+    mv_len: int                   # static rectangle width for _apply_moves
+    slots: np.ndarray             # slab slot per window cell (d_key order)
+    new_sel: np.ndarray           # bool per window cell: newly inserted
+
+    @property
+    def n_new(self) -> int:
+        return int(self.new_sel.sum())
+
+
+class SlabIndex:
+    """Sorted-key cell index + per-row slab registry + allocator.
+
+    Row-id-space agnostic: callers pack keys as ``row << 32 | dst`` in
+    whatever row space they shard by (global for the single-device
+    backend, shard-local for the sharded one). Slots are offsets into the
+    caller's slab arrays; the index never touches a device.
+
+    Invariant the allocator and compactor rely on: a row's live slots are
+    always exactly ``[start, start + len)`` (appends are contiguous and
+    cells are never removed), so within-row slot offsets are dense.
+    """
+
+    def __init__(self, rows_capacity: int = 1 << 10) -> None:
         self.g_key = np.zeros(0, dtype=np.int64)
         self.g_slot = np.zeros(0, dtype=np.int32)
-        # Per-row slab registry. Cap 0 = unallocated. Row slots are always
-        # exactly [start, start + len) — appends are contiguous, so
-        # within-row slot offsets are dense (compaction relies on this).
-        self.items_cap = int(items_capacity)
-        self.row_start = np.zeros(self.items_cap, dtype=np.int32)
-        self.row_len = np.zeros(self.items_cap, dtype=np.int32)
-        self.row_cap = np.zeros(self.items_cap, dtype=np.int32)
-        self.row_sums_host = np.zeros(self.items_cap, dtype=np.int64)
+        self.rows_cap = int(rows_capacity)
+        self.row_start = np.zeros(self.rows_cap, dtype=np.int32)
+        self.row_len = np.zeros(self.rows_cap, dtype=np.int32)
+        self.row_cap = np.zeros(self.rows_cap, dtype=np.int32)
         self.heap_end = 0
         self.garbage = 0  # cells in freed (moved-out) regions
-        self.compact_min_heap = int(compact_min_heap)
         self.compactions = 0
-        self.capacity = int(capacity)
-        self.cnt = jnp.zeros(self.capacity, dtype=jnp.int32)
-        self.dst = jnp.zeros(self.capacity, dtype=jnp.int32)
-        self.row_sums = jnp.zeros(self.items_cap, dtype=jnp.int32)
-        self.observed = 0
-        # One-window-deep result pipeline (see ops/device_scorer.py).
-        self._pending: Optional[List] = None
-        self.last_dispatched_rows = 0
 
-    # -- capacity management --------------------------------------------
+    def __len__(self) -> int:
+        return len(self.g_key)
 
-    def _ensure_items(self, max_id: int) -> None:
-        if max_id >= (1 << 31) - 1:
-            raise ValueError("sparse backend supports item ids < 2^31 - 1")
-        if max_id < self.items_cap:
+    def ensure_rows(self, max_row: int) -> None:
+        if max_row < self.rows_cap:
             return
-        new_cap = int(_pow2ceil(np.asarray([max_id + 1]), 1024)[0])
-        for name in ("row_start", "row_len", "row_cap", "row_sums_host"):
+        new_cap = int(_pow2ceil(np.asarray([max_row + 1]), 1024)[0])
+        for name in ("row_start", "row_len", "row_cap"):
             old = getattr(self, name)
             grown = np.zeros(new_cap, dtype=old.dtype)
             grown[: len(old)] = old
             setattr(self, name, grown)
-        self.row_sums = _grow(self.row_sums, n=new_cap)
-        self.items_cap = new_cap
+        self.rows_cap = new_cap
 
-    def _ensure_heap(self, need_end: int) -> None:
-        if need_end <= self.capacity:
-            return
-        new_cap = self.capacity
-        while new_cap < need_end:
-            new_cap *= 2
-        self.cnt = _grow(self.cnt, n=new_cap)
-        self.dst = _grow(self.dst, n=new_cap)
-        self.capacity = new_cap
-
-    # -- the window step --------------------------------------------------
-
-    def process_window(self, ts: int, pairs: PairDeltaBatch):
-        self.last_dispatched_rows = 0
-        if len(pairs) == 0:
-            # No new dispatch — drain any completed in-flight results now.
-            return self.flush()
-        # Reclaim freed slab regions once they dominate the heap. Runs
-        # between windows only: mid-window the move/update instructions
-        # already carry concrete slab addresses.
-        # Threshold at 1/3: pure cap-doubling alone converges to garbage
-        # just UNDER half the heap (sum of freed caps 4+8+..+C/2 = C-4 per
-        # row vs live cap C), so a 1/2 threshold would never fire.
-        if (self.garbage * 3 > self.heap_end
-                and self.heap_end > self.compact_min_heap):
-            self._compact()
-        delta64 = pairs.delta.astype(np.int64)
-        self._ensure_items(int(max(pairs.src.max(), pairs.dst.max())))
-        src_d, _, d_val, d_key = aggregate_window_coo(
-            pairs.src, pairs.dst, delta64, return_key=True)
-        d_val32 = narrow_deltas_int32(d_val)
-
-        # Row sums first (watermark ordering, reference
-        # ItemRowRescorerTwoInputStreamOperator.java:116-142). The host
-        # mirror is exact (int64); the device copy feeds the k21 gathers.
-        rows = distinct_sorted(src_d)
-        row_ends = np.searchsorted(src_d, rows, side="right")
-        cum = np.concatenate([[0], np.cumsum(d_val)])
-        rs_delta = cum[row_ends] - cum[np.searchsorted(src_d, rows)]
-        self.row_sums_host[rows] += rs_delta
-        if self.row_sums_host[rows].max(initial=0) >= 2**31:
-            raise ValueError("row sum exceeds int32 range")
-        window_sum = int(delta64.sum())
-        self.observed += window_sum
-        self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
-
-        # Classify window cells against the index.
+    def apply(self, d_key: np.ndarray) -> AllocPlan:
+        """Classify one window's (sorted unique) cell keys against the
+        index, allocate slots for the new ones (recording relocations of
+        outgrown rows), and insert them. Returns the device-facing plan;
+        the caller dispatches moves BEFORE any cell writes and must size
+        its slab to ``heap_end`` beforehand."""
         pos = np.searchsorted(self.g_key, d_key)
         if len(self.g_key):
             safe = np.minimum(pos, len(self.g_key) - 1)
@@ -277,63 +253,25 @@ class SparseDeviceScorer:
         else:
             exists = np.zeros(len(d_key), dtype=bool)
         new_key = d_key[~exists]
-
         mv = None
         mv_len = 0
+        new_slots = np.zeros(0, dtype=np.int32)
         if len(new_key):
-            mv, mv_len = self._allocate(new_key)
-        # Existing-cell slots AFTER move adjustments, BEFORE insertion.
+            mv, mv_len, new_slots = self._allocate(new_key)
         slots = np.empty(len(d_key), dtype=np.int32)
         slots[exists] = self.g_slot[pos[exists]]
         if len(new_key):
-            slots[~exists] = self._new_slots
+            slots[~exists] = new_slots
             self.g_key = np.insert(self.g_key, pos[~exists], new_key)
-            self.g_slot = np.insert(self.g_slot, pos[~exists],
-                                    self._new_slots)
-
-        # One packed update upload: new cells | deltas | row sums.
-        n_new, n_d, n_rs = int((~exists).sum()), len(d_key), len(rows)
-        n = n_new + n_d + n_rs
-        n_pad = pad_pow4(n, minimum=1 << 12)
-        upd = np.full((2, n_pad), _SENT, dtype=np.int32)
-        upd[1] = 0
-        upd[0, :n_new] = slots[~exists]
-        upd[1, :n_new] = (new_key & 0xFFFFFFFF).astype(np.int32)
-        upd[0, n_new: n_new + n_d] = slots
-        upd[1, n_new: n_new + n_d] = d_val32
-        upd[0, n_new + n_d: n] = rows
-        upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
-        bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
-
-        if mv is not None:
-            self.cnt, self.dst = _apply_moves(self.cnt, self.dst, mv,
-                                              L=mv_len)
-        self.cnt, self.dst, self.row_sums = _apply_update(
-            self.cnt, self.dst, self.row_sums, upd, bounds)
-
-        if self.development_mode:
-            self._check_row_sums(rows)
-
-        # Score every updated row, length-bucketed (same two-dimensional
-        # shape ladder as the hybrid backend, but padding is device-only).
-        self.counters.add(RESCORED_ITEMS, len(rows))
-        self.last_dispatched_rows = len(rows)
-        chunks = self._dispatch_scoring(rows)
-
-        prev, self._pending = self._pending, chunks
-        return (self._materialize(prev) if prev is not None
-                else TopKBatch.empty(self.top_k))
+            self.g_slot = np.insert(self.g_slot, pos[~exists], new_slots)
+        return AllocPlan(mv, mv_len, slots, ~exists)
 
     def _allocate(self, new_key: np.ndarray):
-        """Assign slab slots for this window's new cells.
-
-        Returns the move-instruction array for outgrown rows (or None) and
-        stores the per-new-cell slots in ``self._new_slots`` (aligned with
-        ``new_key`` order, which is sorted by packed key)."""
         n_src = (new_key >> 32).astype(np.int64)
         rows_new, first_idx, counts = np.unique(
             n_src, return_index=True, return_counts=True)
         rows_new32 = rows_new.astype(np.int32)
+        self.ensure_rows(int(rows_new32.max()))
         need = self.row_len[rows_new32] + counts.astype(np.int32)
         grow_mask = need > self.row_cap[rows_new32]
         mv = None
@@ -344,9 +282,7 @@ class SparseDeviceScorer:
             offs = (self.heap_end
                     + np.concatenate([[0], np.cumsum(new_caps)[:-1]])
                     ).astype(np.int32)
-            new_end = self.heap_end + int(new_caps.sum())
-            self._ensure_heap(new_end)
-            self.heap_end = new_end
+            self.heap_end += int(new_caps.sum())
             old_start = self.row_start[grow_rows].copy()
             old_len = self.row_len[grow_rows].copy()
             self.garbage += int(self.row_cap[grow_rows].sum())
@@ -373,13 +309,21 @@ class SparseDeviceScorer:
         # so same-row entries are contiguous and rank is positional).
         rank = (np.arange(len(new_key))
                 - np.repeat(first_idx, counts)).astype(np.int32)
-        self._new_slots = (self.row_start[n_src] + self.row_len[n_src]
-                           + rank).astype(np.int32)
+        new_slots = (self.row_start[n_src] + self.row_len[n_src]
+                     + rank).astype(np.int32)
         self.row_len[rows_new32] = need
-        return mv, mv_len
+        return mv, mv_len, new_slots
 
-    def _compact(self) -> None:
-        """Defragment the slab: re-lay rows contiguously (row-id order)."""
+    def needs_compaction(self, min_heap: int) -> bool:
+        # Threshold at 1/3: pure cap-doubling alone converges to garbage
+        # just UNDER half the heap (sum of freed caps 4+8+..+C/2 = C-4 per
+        # row vs live cap C), so a 1/2 threshold would never fire.
+        return self.garbage * 3 > self.heap_end and self.heap_end > min_heap
+
+    def compact(self) -> np.ndarray:
+        """Defragment: re-lay rows contiguously (row-id order). Returns
+        the slot-space gather map (new slab = old slab[gmap]); updates the
+        index in place. The caller runs the device gather."""
         alloc = np.flatnonzero(self.row_cap > 0).astype(np.int32)
         lens = self.row_len[alloc]
         old_starts = self.row_start[alloc]
@@ -390,15 +334,9 @@ class SparseDeviceScorer:
         within = _ragged_arange(lens).astype(np.int32)
         # Gather map in slot order; slots of a row are exactly
         # [start, start+len), so the map is dense per row.
-        # Bucketed size, clamped to the slab (junk gathered into padding
-        # slots past new_end lands in free space; new-cell writes zero
-        # their slots explicitly before use).
-        gmap = np.zeros(min(pad_pow2(max(new_end, 1), minimum=1 << 10),
-                            self.capacity), dtype=np.int32)
+        gmap = np.zeros(max(new_end, 1), dtype=np.int32)
         gmap[np.repeat(new_starts, lens) + within] = (
             np.repeat(old_starts, lens) + within)
-        self.cnt, self.dst = _compact_gather(self.cnt, self.dst, gmap,
-                                             cap=self.capacity)
         # g_key is row-major sorted, so its per-row segments line up with
         # ``alloc`` (every allocated row has len >= 1 cells in the index).
         self.g_slot += np.repeat(new_starts - old_starts, lens)
@@ -407,18 +345,184 @@ class SparseDeviceScorer:
         self.heap_end = new_end
         self.garbage = 0
         self.compactions += 1
+        return gmap
+
+    def rebuild_from_keys(self, keys: np.ndarray) -> np.ndarray:
+        """Reset to a fresh contiguous layout for ``keys`` (sorted packed
+        cell keys, e.g. from a checkpoint). Returns the slot per key."""
+        rows_all = (keys >> 32).astype(np.int64)
+        self.row_start[:] = 0
+        self.row_len[:] = 0
+        self.row_cap[:] = 0
+        if len(keys) == 0:
+            self.g_key = keys.copy()
+            self.g_slot = np.zeros(0, dtype=np.int32)
+            self.heap_end = 0
+            self.garbage = 0
+            return self.g_slot
+        self.ensure_rows(int(rows_all.max()))
+        rows_u, counts = np.unique(rows_all, return_counts=True)
+        rows_u32 = rows_u.astype(np.int32)
+        caps = _pow2ceil(counts.astype(np.int32), minimum=4)
+        starts = np.concatenate([[0], np.cumsum(caps)[:-1]]).astype(np.int32)
+        self.row_start[rows_u32] = starts
+        self.row_len[rows_u32] = counts
+        self.row_cap[rows_u32] = caps
+        self.heap_end = int(caps.sum())
+        self.garbage = 0
+        self.g_key = keys.copy()
+        self.g_slot = (np.repeat(starts, counts)
+                       + _ragged_arange(counts)).astype(np.int32)
+        return self.g_slot
+
+
+class SparseDeviceScorer:
+    """Single-device scorer over a :class:`SlabIndex`-managed HBM slab."""
+
+    # Per-score-chunk padded-cell budget. Padding is device compute only —
+    # it never crosses the wire in this backend — so the budget is sized
+    # for HBM transients ([S, R] gather + scores), not transfer, and the
+    # length ladder is coarse (pow-4): fewer dispatches beats tighter
+    # padding when every dispatch pays tunnel round-trip latency.
+    SCORE_BUDGET = 1 << 24
+
+    def __init__(self, top_k: int, counters: Optional[Counters] = None,
+                 development_mode: bool = False,
+                 capacity: int = 1 << 16,
+                 items_capacity: int = 1 << 10,
+                 compact_min_heap: int = 1 << 16) -> None:
+        from ..xla_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        self.top_k = top_k
+        self.counters = counters if counters is not None else Counters()
+        self.development_mode = development_mode
+        self.index = SlabIndex(rows_capacity=items_capacity)
+        self.items_cap = int(items_capacity)
+        self.row_sums_host = np.zeros(self.items_cap, dtype=np.int64)
+        self.compact_min_heap = int(compact_min_heap)
+        self.capacity = int(capacity)
+        self.cnt = jnp.zeros(self.capacity, dtype=jnp.int32)
+        self.dst = jnp.zeros(self.capacity, dtype=jnp.int32)
+        self.row_sums = jnp.zeros(self.items_cap, dtype=jnp.int32)
+        self.observed = 0
+        # One-window-deep result pipeline (see ops/device_scorer.py).
+        self._pending: Optional[List] = None
+        self.last_dispatched_rows = 0
+
+    # Back-compat introspection used by tests.
+    @property
+    def heap_end(self) -> int:
+        return self.index.heap_end
+
+    @property
+    def compactions(self) -> int:
+        return self.index.compactions
+
+    # -- capacity management --------------------------------------------
+
+    def _ensure_items(self, max_id: int) -> None:
+        if max_id >= (1 << 31) - 1:
+            raise ValueError("sparse backend supports item ids < 2^31 - 1")
+        if max_id < self.items_cap:
+            return
+        new_cap = int(_pow2ceil(np.asarray([max_id + 1]), 1024)[0])
+        grown = np.zeros(new_cap, dtype=np.int64)
+        grown[: len(self.row_sums_host)] = self.row_sums_host
+        self.row_sums_host = grown
+        self.row_sums = _grow(self.row_sums, n=new_cap)
+        self.items_cap = new_cap
+
+    def _ensure_heap(self, need_end: int) -> None:
+        if need_end <= self.capacity:
+            return
+        new_cap = self.capacity
+        while new_cap < need_end:
+            new_cap *= 2
+        self.cnt = _grow(self.cnt, n=new_cap)
+        self.dst = _grow(self.dst, n=new_cap)
+        self.capacity = new_cap
+
+    # -- the window step --------------------------------------------------
+
+    def process_window(self, ts: int, pairs: PairDeltaBatch):
+        self.last_dispatched_rows = 0
+        if len(pairs) == 0:
+            # No new dispatch — drain any completed in-flight results now.
+            return self.flush()
+        # Reclaim freed slab regions once they dominate the heap. Runs
+        # between windows only: mid-window the move/update instructions
+        # already carry concrete slab addresses.
+        if self.index.needs_compaction(self.compact_min_heap):
+            gmap = self.index.compact()
+            gmap_pad = np.zeros(min(pad_pow2(len(gmap), minimum=1 << 10),
+                                    self.capacity), dtype=np.int32)
+            gmap_pad[: len(gmap)] = gmap
+            self.cnt, self.dst = _compact_gather(self.cnt, self.dst,
+                                                 gmap_pad, cap=self.capacity)
+        delta64 = pairs.delta.astype(np.int64)
+        self._ensure_items(int(max(pairs.src.max(), pairs.dst.max())))
+        src_d, _, d_val, d_key = aggregate_window_coo(
+            pairs.src, pairs.dst, delta64, return_key=True)
+        d_val32 = narrow_deltas_int32(d_val)
+
+        # Row sums first (watermark ordering, reference
+        # ItemRowRescorerTwoInputStreamOperator.java:116-142). The host
+        # mirror is exact (int64); the device copy feeds the k21 gathers.
+        rows = distinct_sorted(src_d)
+        row_ends = np.searchsorted(src_d, rows, side="right")
+        cum = np.concatenate([[0], np.cumsum(d_val)])
+        rs_delta = cum[row_ends] - cum[np.searchsorted(src_d, rows)]
+        self.row_sums_host[rows] += rs_delta
+        if self.row_sums_host[rows].max(initial=0) >= 2**31:
+            raise ValueError("row sum exceeds int32 range")
+        window_sum = int(delta64.sum())
+        self.observed += window_sum
+        self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
+
+        plan = self.index.apply(d_key)
+        self._ensure_heap(self.index.heap_end)
+
+        # One packed update upload: new cells | deltas | row sums.
+        n_new = plan.n_new
+        n_d, n_rs = len(d_key), len(rows)
+        n = n_new + n_d + n_rs
+        n_pad = pad_pow4(n, minimum=1 << 12)
+        upd = np.full((2, n_pad), _SENT, dtype=np.int32)
+        upd[1] = 0
+        if n_new:
+            upd[0, :n_new] = plan.slots[plan.new_sel]
+            upd[1, :n_new] = (d_key[plan.new_sel]
+                              & 0xFFFFFFFF).astype(np.int32)
+        upd[0, n_new: n_new + n_d] = plan.slots
+        upd[1, n_new: n_new + n_d] = d_val32
+        upd[0, n_new + n_d: n] = rows
+        upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
+        bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
+
+        if plan.mv is not None:
+            self.cnt, self.dst = _apply_moves(self.cnt, self.dst, plan.mv,
+                                              L=plan.mv_len)
+        self.cnt, self.dst, self.row_sums = _apply_update(
+            self.cnt, self.dst, self.row_sums, upd, bounds)
+
+        if self.development_mode:
+            self._check_row_sums(rows)
+
+        # Score every updated row, length-bucketed (padding is device-only).
+        self.counters.add(RESCORED_ITEMS, len(rows))
+        self.last_dispatched_rows = len(rows)
+        chunks = self._dispatch_scoring(rows)
+
+        prev, self._pending = self._pending, chunks
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
 
     def _dispatch_scoring(self, rows: np.ndarray) -> List[Tuple]:
-        starts = self.row_start[rows]
-        lens = self.row_len[rows]
+        starts = self.index.row_start[rows]
+        lens = self.index.row_len[rows]
         min_r = max(16, self.top_k)  # lax.top_k needs k <= R
-        # pow-4 length buckets: bucket b holds rows scored at R = min_r*4^b
-        # (smallest b with R >= len). Integer math, exact at powers:
-        # shift = ceil(len / 2^floor(log2 min_r)) - 1; b = ceil(log2(shift+1)/2)
-        # via frexp's exponent (frexp(s)[1] = floor(log2 s) + 1, frexp(0) = 0).
-        shift = (np.maximum(lens, 1) - 1) >> (min_r.bit_length() - 1)
-        bucket = (np.frexp(shift.astype(np.float64))[1] + 1) // 2
-        order = np.argsort(bucket, kind="stable")
+        bucket, order = score_buckets(lens, min_r)
         b_sorted = bucket[order]
         chunks: List[Tuple[np.ndarray, int, object]] = []
         pos = 0
@@ -451,7 +555,8 @@ class SparseDeviceScorer:
         """Dev-mode invariant: slab row contents sum to the tracked row sum
         (reference check, ItemRowRescorerTwoInputStreamOperator.java:183-193)."""
         cnt = np.asarray(self.cnt)
-        starts, lens = self.row_start[rows], self.row_len[rows]
+        starts = self.index.row_start[rows]
+        lens = self.index.row_len[rows]
         for r, s, ln in zip(rows.tolist(), starts.tolist(), lens.tolist()):
             actual = int(cnt[s: s + ln].sum())
             if actual != int(self.row_sums_host[r]):
@@ -480,15 +585,16 @@ class SparseDeviceScorer:
     def checkpoint_state(self) -> dict:
         """Canonical sparse-matrix snapshot — same keys as the hybrid
         backend, so checkpoints are interchangeable between the two."""
-        if len(self.g_slot):
+        idx = self.index
+        if len(idx.g_slot):
             # Gather live cells ON DEVICE so the fetch is nnz values, not
             # the whole slab (capacity >= 2x nnz from pow-2 slack+garbage).
-            vals = np.asarray(self.cnt[jnp.asarray(self.g_slot)])
+            vals = np.asarray(self.cnt[jnp.asarray(idx.g_slot)])
         else:
             vals = np.zeros(0, np.int64)
         nz = vals != 0
         return {
-            "rows_key": self.g_key[nz],
+            "rows_key": idx.g_key[nz],
             "rows_cnt": vals[nz].astype(np.int64),
             "row_sums": self.row_sums_host.copy(),
             "observed": np.asarray([self.observed], dtype=np.int64),
@@ -497,39 +603,22 @@ class SparseDeviceScorer:
     def restore_state(self, st: dict) -> None:
         key = st["rows_key"]
         cnt_vals = st["rows_cnt"]
-        rows_all = (key >> 32).astype(np.int64)
-        max_id = int(max(rows_all.max(initial=0),
+        max_id = int(max((key >> 32).max(initial=0),
                          int((key & 0xFFFFFFFF).max(initial=0))))
         # Size host registries/capacities directly — the device arrays are
         # rebuilt wholesale below, so the _ensure_* grow-copy kernels would
         # only produce buffers we immediately discard.
         if max_id >= self.items_cap:
             new_cap = int(_pow2ceil(np.asarray([max_id + 1]), 1024)[0])
-            for name in ("row_start", "row_len", "row_cap", "row_sums_host"):
-                setattr(self, name,
-                        np.zeros(new_cap, dtype=getattr(self, name).dtype))
+            self.row_sums_host = np.zeros(new_cap, dtype=np.int64)
             self.items_cap = new_cap
-        self.row_start[:] = 0
-        self.row_len[:] = 0
-        self.row_cap[:] = 0
-        rows_u, counts = np.unique(rows_all, return_counts=True)
-        rows_u32 = rows_u.astype(np.int32)
-        caps = _pow2ceil(counts.astype(np.int32), minimum=4)
-        starts = np.concatenate([[0], np.cumsum(caps)[:-1]]).astype(np.int32)
-        self.row_start[rows_u32] = starts
-        self.row_len[rows_u32] = counts
-        self.row_cap[rows_u32] = caps
-        self.heap_end = int(caps.sum())
-        self.garbage = 0
-        while self.capacity < self.heap_end:
+        slots = self.index.rebuild_from_keys(key)
+        while self.capacity < self.index.heap_end:
             self.capacity *= 2
-        self.g_key = key.copy()
-        self.g_slot = (np.repeat(starts, counts)
-                       + _ragged_arange(counts)).astype(np.int32)
         cnt_host = np.zeros(self.capacity, dtype=np.int32)
         dst_host = np.zeros(self.capacity, dtype=np.int32)
-        cnt_host[self.g_slot] = cnt_vals.astype(np.int32)
-        dst_host[self.g_slot] = (key & 0xFFFFFFFF).astype(np.int32)
+        cnt_host[slots] = cnt_vals.astype(np.int32)
+        dst_host[slots] = (key & 0xFFFFFFFF).astype(np.int32)
         self.cnt = jnp.asarray(cnt_host)
         self.dst = jnp.asarray(dst_host)
         rs = np.asarray(st["row_sums"], dtype=np.int64)
@@ -540,8 +629,7 @@ class SparseDeviceScorer:
         self.row_sums_host[:] = 0
         m = min(len(rs), self.items_cap)
         self.row_sums_host[:m] = rs[:m]
-        self.row_sums = jnp.asarray(
-            self.row_sums_host.astype(np.int32))
+        self.row_sums = jnp.asarray(self.row_sums_host.astype(np.int32))
         self.observed = int(st["observed"][0])
         # In-flight results belong to windows after the checkpoint.
         self._pending = None
