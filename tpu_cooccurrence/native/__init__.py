@@ -19,7 +19,8 @@ import numpy as np
 LOG = logging.getLogger("tpu_cooccurrence.native")
 
 _HERE = os.path.dirname(__file__)
-_SRC = os.path.join(_HERE, "reservoir_expand.cpp")
+_SRCS = [os.path.join(_HERE, "reservoir_expand.cpp"),
+         os.path.join(_HERE, "sliding_expand.cpp")]
 _LIB = os.path.join(_HERE, "libreservoir_expand.so")
 
 _lib: Optional[ctypes.CDLL] = None
@@ -34,7 +35,7 @@ def _build() -> bool:
         # mtime passes the staleness check.
         tmp = f"{_LIB}.{os.getpid()}.tmp"
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, *_SRCS],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB)
         return True
@@ -58,7 +59,8 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
         return _lib
     _tried = True
     if not os.path.exists(_LIB) or (
-            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            os.path.getmtime(_LIB) < max(os.path.getmtime(s)
+                                         for s in _SRCS)):
         if not _build():
             return None
     try:
@@ -76,6 +78,14 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
     lib.expand_appends.argtypes = [
         i32p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int64,
         i64p, i64p, i32p]
+    lib.sliding_prepare.restype = ctypes.c_int64
+    lib.sliding_prepare.argtypes = [
+        i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, i32p, i32p, i64p, i64p, i64p, i64p, i64p]
+    lib.sliding_emit.restype = None
+    lib.sliding_emit.argtypes = [
+        i64p, i64p, ctypes.c_int64, i32p, i64p, ctypes.c_int64,
+        i64p, i64p, i64p, i64p]
     _lib = lib
     return _lib
 
@@ -141,3 +151,76 @@ def expand_replacements(hist: np.ndarray, users: np.ndarray,
         _ptr32(hist), k_max, _ptr64(users), _ptr64(items), _ptr64(slots),
         n, _ptr64(src), _ptr64(dst), _ptr32(delta))
     return src[:written], dst[:written], delta[:written]
+
+
+class SlidingScratch:
+    """Persistent dense scratch for the native sliding expansion.
+
+    One instance per sampler: the dense count arrays are grown to the
+    largest ids seen and re-zeroed (used prefix only) between windows —
+    a memset, vs the NumPy path's per-window argsorts.
+    """
+
+    def __init__(self) -> None:
+        self.item_count = np.zeros(1024, dtype=np.int32)
+        self.user_count = np.zeros(1024, dtype=np.int32)
+        self.user_start = np.zeros(1024, dtype=np.int64)
+
+    def _ensure(self, max_item: int, max_user: int) -> None:
+        if max_item >= len(self.item_count):
+            self.item_count = np.zeros(
+                max(2 * len(self.item_count), max_item + 1), dtype=np.int32)
+        if max_user >= len(self.user_count):
+            n = max(2 * len(self.user_count), max_user + 1)
+            self.user_count = np.zeros(n, dtype=np.int32)
+            self.user_start = np.zeros(n, dtype=np.int64)
+
+
+def sliding_expand(users: np.ndarray, items: np.ndarray, f_max: int,
+                   k_max: int, skip_cuts: bool,
+                   scratch: SlidingScratch):
+    """Native sliding basket expansion; returns (src, dst) or None.
+
+    Byte-identical output to the NumPy path in ``sampling/sliding.py``
+    (groups ascending by user id, arrival order within groups, partners
+    by ascending basket position skipping self).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(users)
+    users = np.ascontiguousarray(users, dtype=np.int64)
+    items = np.ascontiguousarray(items, dtype=np.int64)
+    max_item = int(items.max())
+    max_user = int(users.max())
+    scratch._ensure(max_item, max_user)
+    # Zero the used prefixes (phase 1 contract). user_start needs none:
+    # only touched entries are written-then-read.
+    scratch.item_count[: max_item + 1].fill(0)
+    scratch.user_count[: max_user + 1].fill(0)
+    kept_users = np.empty(n, dtype=np.int64)
+    kept_items = np.empty(n, dtype=np.int64)
+    touched = np.empty(n, dtype=np.int64)
+    n_touched = np.zeros(1, dtype=np.int64)
+    total_pairs = np.zeros(1, dtype=np.int64)
+    n_kept = lib.sliding_prepare(
+        _ptr64(users), _ptr64(items), n, f_max, k_max,
+        1 if skip_cuts else 0, _ptr32(scratch.item_count),
+        _ptr32(scratch.user_count), _ptr64(kept_users), _ptr64(kept_items),
+        _ptr64(touched), _ptr64(n_touched), _ptr64(total_pairs))
+    nt = int(n_touched[0])
+    total = int(total_pairs[0])
+    if total == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z
+    # Ascending user-id group order — matches argsort(users) grouping.
+    touched_sorted = np.sort(touched[:nt])
+    grouped = np.empty(n_kept, dtype=np.int64)
+    src = np.empty(total, dtype=np.int64)
+    dst = np.empty(total, dtype=np.int64)
+    lib.sliding_emit(
+        _ptr64(kept_users), _ptr64(kept_items), n_kept,
+        _ptr32(scratch.user_count), _ptr64(touched_sorted), nt,
+        _ptr64(scratch.user_start), _ptr64(grouped), _ptr64(src),
+        _ptr64(dst))
+    return src, dst

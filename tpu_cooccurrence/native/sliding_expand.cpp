@@ -1,0 +1,131 @@
+// Native sliding-window basket expansion (host hot path, config 3 shape).
+//
+// The NumPy sliding path (sampling/sliding.py) is dominated by
+// comparison sorts: argsort(users) for grouping plus two grouped_rank
+// argsorts for the per-window cuts — O(n log n) each, ~60% of host time
+// at the ML-25M shape. Ids here are dense vocab ids, so every one of
+// those sorts is a counting pass in C: this kernel applies both cuts,
+// groups kept events by user (stable, arrival order), and emits all
+// ordered distinct-position basket pairs in O(n + pairs) with no
+// temporaries beyond the caller's dense scratch arrays.
+//
+// Two-call protocol (the caller cannot size the pair output up front):
+//   1) sliding_prepare: cuts + kept compaction + per-user kept counts +
+//      touched-user list; returns n_kept and writes total_pairs.
+//   2) sliding_emit: counting-sort scatter into grouped order + pair
+//      emission. Emission order matches the NumPy path exactly: events
+//      in (user-stable, arrival) order, partners by ascending basket
+//      position with the event's own position skipped.
+//
+// Scratch ownership: Python owns and zeroes the dense arrays between
+// windows (item_count/user_count sized to the window's max id + 1).
+//
+// The reference has no sliding mode at all (FlinkCooccurrences.java:
+// 139,153 wires tumbling only); this supports the framework's sliding
+// extension (benchmark config 3).
+//
+// Build: via native/__init__.py (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+
+extern "C" {
+
+// Phase 1: cuts + compaction. All counts are per-window ranks over ALL
+// arrivals (kept or not) — grouped_rank semantics (item_cut.py:20).
+//
+// users/items [n]: dense ids, arrival order.
+// item_count [max_item+1], user_count [max_user+1]: zeroed by caller;
+//   on return user_count[u] holds u's KEPT count (reused by phase 2).
+// kept_users/kept_items [n]: compacted kept events (arrival order).
+// touched [n]: unique kept users in first-kept order; *n_touched set.
+// *total_pairs: sum over users of m*(m-1).
+// Returns n_kept.
+int64_t sliding_prepare(
+    const int64_t* users, const int64_t* items, int64_t n,
+    int64_t f_max, int64_t k_max, int32_t skip_cuts,
+    int32_t* item_count, int32_t* user_count,
+    int64_t* kept_users, int64_t* kept_items,
+    int64_t* touched, int64_t* n_touched, int64_t* total_pairs) {
+  int64_t w = 0;
+  if (skip_cuts) {
+    for (int64_t e = 0; e < n; ++e) {
+      kept_users[w] = users[e];
+      kept_items[w] = items[e];
+      ++w;
+    }
+  } else {
+    // Arrival ranks count every event; the keep test uses the pre-
+    // increment rank, exactly like grouped_rank(x) < cap.
+    for (int64_t e = 0; e < n; ++e) {
+      const int64_t u = users[e];
+      const int64_t it = items[e];
+      const int32_t ir = item_count[it]++;
+      const int32_t ur = user_count[u]++;
+      if (ir < f_max && ur < k_max) {
+        kept_users[w] = u;
+        kept_items[w] = it;
+        ++w;
+      }
+    }
+    // user_count now holds arrival counts; rebuild it as KEPT counts for
+    // phase 2 (zero only touched entries, then recount over kept).
+    for (int64_t e = 0; e < n; ++e) user_count[users[e]] = 0;
+  }
+  int64_t nt = 0;
+  for (int64_t e = 0; e < w; ++e) {
+    const int64_t u = kept_users[e];
+    if (user_count[u]++ == 0) touched[nt++] = u;
+  }
+  int64_t pairs = 0;
+  for (int64_t t = 0; t < nt; ++t) {
+    const int64_t m = user_count[touched[t]];
+    pairs += m * (m - 1);
+  }
+  *n_touched = nt;
+  *total_pairs = pairs;
+  return w;
+}
+
+// Phase 2: group + emit. Consumes phase 1's outputs unchanged
+// (user_count = kept counts, touched list) plus:
+//   user_start [max_user+1]: scratch, overwritten (no zeroing needed —
+//     only touched entries are read/written);
+//   grouped [n_kept]: scratch for the counting-sort scatter;
+//   out_src/out_dst [total_pairs]: pair outputs.
+void sliding_emit(
+    const int64_t* kept_users, const int64_t* kept_items, int64_t n_kept,
+    const int32_t* user_count, const int64_t* touched, int64_t n_touched,
+    int64_t* user_start, int64_t* grouped,
+    int64_t* out_src, int64_t* out_dst) {
+  // Prefix offsets in touched (first-kept) order — any fixed order works
+  // for grouping; pair order below depends only on within-group order.
+  int64_t off = 0;
+  for (int64_t t = 0; t < n_touched; ++t) {
+    const int64_t u = touched[t];
+    user_start[u] = off;
+    off += user_count[u];
+  }
+  // Stable counting-sort scatter (arrival order within each group).
+  // user_start[u] ends at u's group END; group starts are recomputed
+  // from the counts during emission.
+  for (int64_t e = 0; e < n_kept; ++e) {
+    grouped[user_start[kept_users[e]]++] = kept_items[e];
+  }
+  int64_t p = 0;
+  for (int64_t t = 0; t < n_touched; ++t) {
+    const int64_t u = touched[t];
+    const int64_t m = user_count[u];
+    const int64_t* g = grouped + (user_start[u] - m);
+    for (int64_t o = 0; o < m; ++o) {
+      const int64_t self = g[o];
+      for (int64_t q = 0; q < m; ++q) {
+        if (q == o) continue;
+        out_src[p] = self;
+        out_dst[p] = g[q];
+        ++p;
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -42,10 +42,31 @@ class SlidingBasketSampler:
         self.user_cut = user_cut
         self.skip_cuts = skip_cuts
         self.counters = counters if counters is not None else Counters()
+        from ..native import SlidingScratch
+
+        self._scratch = SlidingScratch()
 
     def fire(self, users: np.ndarray, items: np.ndarray) -> PairDeltaBatch:
         if len(users) == 0:
             return PairDeltaBatch.concat([])
+        # Native path: cuts + grouping + expansion as O(n) counting passes
+        # over the dense ids (the NumPy path below pays three O(n log n)
+        # argsorts per window — ~60% of ML-25M-shape host time). Output is
+        # byte-identical; tests pin both paths against each other and the
+        # sliding oracle.
+        from ..native import sliding_expand
+
+        native = sliding_expand(users, items, self.item_cut, self.user_cut,
+                                self.skip_cuts, self._scratch)
+        if native is not None:
+            src, dst = native
+            delta = np.ones(len(src), dtype=np.int32)
+            self.counters.add(OBSERVED_COOCCURRENCES, len(src))
+            return PairDeltaBatch(src, dst, delta)
+        return self._fire_numpy(users, items)
+
+    def _fire_numpy(self, users: np.ndarray,
+                    items: np.ndarray) -> PairDeltaBatch:
         if not self.skip_cuts:
             keep = ((grouped_rank(items) < self.item_cut)
                     & (grouped_rank(users) < self.user_cut))

@@ -70,20 +70,24 @@ class WindowEngine:
         self.max_ts_seen = int(running[-1])
         if len(ts):
             starts = self.assigner.assign(ts)
-            if starts.ndim == 2:  # sliding: one copy per containing window
-                n_windows = starts.shape[1]
-                users = np.repeat(users, n_windows)
-                items = np.repeat(items, n_windows)
-                ts = np.repeat(ts, n_windows)
-                starts = starts.reshape(-1)
-            # Group by window start (stable to preserve arrival order).
-            order = np.argsort(starts, kind="stable")
-            s_sorted = starts[order]
-            boundaries = np.flatnonzero(np.diff(s_sorted)) + 1
-            for chunk in np.split(order, boundaries):
-                start = int(starts[chunk[0]])
-                self._buffers.setdefault(start, []).append(
-                    (users[chunk], items[chunk], ts[chunk]))
+            # Post-drop ``ts`` is non-decreasing (every kept event meets the
+            # running max), and both assigners are monotone in ts — so each
+            # starts column is already sorted: group with a boundary scan,
+            # no argsort and no per-window-copy repeat (the former sliding
+            # path materialized size/slide copies and stable-sorted them).
+            cols = starts.T if starts.ndim == 2 else starts[None, :]
+            # Sliding column j of window W covers ts in
+            # [W + j*slide, W + (j+1)*slide) (assigners.SlidingWindows:
+            # start = last - j*slide), so natural column order appends each
+            # window's chunks in arrival order — which the cut operators'
+            # per-window ranks depend on.
+            for col in cols:
+                bounds = np.flatnonzero(col[1:] != col[:-1]) + 1
+                lo = 0
+                for hi in (*bounds.tolist(), len(col)):
+                    self._buffers.setdefault(int(col[lo]), []).append(
+                        (users[lo:hi], items[lo:hi], ts[lo:hi]))
+                    lo = hi
         return n_late
 
     def fire_ready(self, final: bool = False) -> Iterator[Tuple[int, np.ndarray, np.ndarray]]:
