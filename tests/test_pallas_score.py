@@ -109,3 +109,70 @@ def test_pallas_rejects_vocab_beyond_float32_exact():
             jax.ShapeDtypeStruct((big,), jnp.int32),
             jax.ShapeDtypeStruct((2,), jnp.int32),
             jax.ShapeDtypeStruct((), jnp.float32))
+
+
+@pytest.mark.parametrize("seed,num_items,s,top_k", [
+    (3, 256, 8, 10),
+    (4, 512, 24, 5),
+])
+def test_pallas_int16_counts_match_xla(seed, num_items, s, top_k):
+    """int16 (reference-style short) counts run with 16-row blocks."""
+    rng = np.random.default_rng(seed)
+    C = np.zeros((num_items, num_items), dtype=np.int16)
+    nnz = 4000
+    src = rng.integers(0, num_items, nnz)
+    dst = rng.integers(0, num_items, nnz)
+    np.add.at(C, (src, dst), 1)
+    row_sums = C.sum(axis=1, dtype=np.int64).astype(np.int32)
+    observed = np.float32(row_sums.sum())
+    rows = rng.integers(0, num_items, s).astype(np.int32)
+
+    ref_vals, ref_idx = _score(jnp.asarray(C), jnp.asarray(row_sums),
+                               jnp.asarray(rows), observed, top_k=top_k)
+    got_vals, got_idx = pallas_score_topk(
+        jnp.asarray(C), jnp.asarray(row_sums), jnp.asarray(rows), observed,
+        top_k=top_k, tile=128, interpret=True)
+    ref_vals = np.asarray(ref_vals)
+    got_vals = np.asarray(got_vals)
+    np.testing.assert_allclose(got_vals, ref_vals, rtol=1e-5, atol=1e-5)
+    # Tie-aware index check (same protocol as the int32 test above): a
+    # col_base/run_idx bug under 16-row blocks must not hide behind
+    # correct scores.
+    ref_idx = np.asarray(ref_idx)
+    got_idx = np.asarray(got_idx)
+    for r in range(s):
+        for k in range(top_k):
+            if not np.isfinite(ref_vals[r, k]):
+                continue
+            if np.isclose(ref_vals[r], ref_vals[r, k]).sum() == 1:
+                assert got_idx[r, k] == ref_idx[r, k], (r, k)
+
+
+def test_pallas_int16_device_scorer_end_to_end():
+    """DeviceScorer accepts --pallas on with --count-dtype int16 and
+    matches the XLA path's results."""
+    from tpu_cooccurrence.ops.device_scorer import DeviceScorer
+    from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
+
+    rng = np.random.default_rng(9)
+    n = 3000
+    src = rng.integers(0, 512, n).astype(np.int64)
+    dst = rng.integers(0, 512, n).astype(np.int64)
+    keep = src != dst
+    pairs = PairDeltaBatch(src[keep], dst[keep],
+                           np.ones(int(keep.sum()), dtype=np.int32))
+    out = {}
+    for pallas in ("on", "off"):
+        sc = DeviceScorer(512, top_k=10, use_pallas=pallas,
+                          count_dtype="int16")
+        sc.process_window(0, pairs)
+        out[pallas] = sc.flush()
+    np.testing.assert_array_equal(out["on"].rows, out["off"].rows)
+    np.testing.assert_allclose(out["on"].vals, out["off"].vals,
+                               rtol=1e-5, atol=1e-5)
+    # Indices agree wherever a row's scores have no ties at the cutoff.
+    for r in range(len(out["on"].rows)):
+        v = out["off"].vals[r]
+        for k in range(v.shape[0]):
+            if np.isfinite(v[k]) and np.isclose(v, v[k]).sum() == 1:
+                assert out["on"].idx[r, k] == out["off"].idx[r, k]

@@ -1,0 +1,74 @@
+"""IdMap: dense-table fast path vs sorted general path equivalence."""
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.state.vocab import IdMap
+
+
+def sorted_only_map() -> IdMap:
+    m = IdMap()
+    m._leave_table_mode()  # force the general path from the start
+    return m
+
+
+def test_first_appearance_order():
+    m = IdMap()
+    out = m.map_batch(np.array([50, 3, 50, 7, 3, 1]))
+    np.testing.assert_array_equal(out, [0, 1, 0, 2, 1, 3])
+    assert [m.to_external(i) for i in range(4)] == [50, 3, 7, 1]
+
+
+def test_table_and_sorted_paths_agree():
+    rng = np.random.default_rng(11)
+    a, b = IdMap(), sorted_only_map()
+    assert a._table is not None and b._table is None
+    for _ in range(8):
+        ids = rng.integers(0, 5000, int(rng.integers(1, 4000)))
+        np.testing.assert_array_equal(a.map_batch(ids), b.map_batch(ids))
+    assert a._table is not None  # stayed on the fast path
+    assert len(a) == len(b)
+
+
+def test_switch_to_sorted_on_large_id_keeps_mapping():
+    m = IdMap()
+    first = m.map_batch(np.array([9, 4, 9, 2]))
+    # An id past the table cap permanently switches regimes …
+    big = IdMap._TABLE_CAP + 5
+    out = m.map_batch(np.array([4, big, 9, big, 2]))
+    assert m._table is None
+    # … preserving every previously assigned dense id.
+    np.testing.assert_array_equal(out, [first[1], 3, first[0], 3, first[3]])
+    again = m.map_batch(np.array([big, 4]))
+    np.testing.assert_array_equal(again, [3, first[1]])
+
+
+def test_switch_to_sorted_on_negative_id():
+    m = IdMap()
+    m.map_batch(np.array([1, 2]))
+    out = m.map_batch(np.array([-7, 1]))
+    assert m._table is None
+    np.testing.assert_array_equal(out, [2, 0])
+
+
+@pytest.mark.parametrize("make", [IdMap, sorted_only_map])
+def test_restore_roundtrip_continues_mapping(make):
+    m = make()
+    m.map_batch(np.array([100, 7, 42]))
+    state = m.checkpoint_state()
+    m2 = IdMap()
+    m2.restore_state(state)
+    np.testing.assert_array_equal(m2.map_batch(np.array([42, 100, 7])),
+                                  [2, 0, 1])
+    # New ids continue after the restored vocab.
+    np.testing.assert_array_equal(m2.map_batch(np.array([5, 42])), [3, 2])
+    assert m2.to_dense(7) == 1 and m2.to_dense(999) is None
+
+
+def test_restore_large_ids_lands_in_sorted_mode():
+    m = IdMap()
+    rev = np.array([IdMap._TABLE_CAP + 9, 3])
+    m.restore_state(rev)
+    assert m._table is None
+    np.testing.assert_array_equal(
+        m.map_batch(np.array([3, IdMap._TABLE_CAP + 9])), [1, 0])

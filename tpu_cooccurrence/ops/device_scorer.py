@@ -204,10 +204,6 @@ class DeviceScorer:
             self.use_pallas = use_pallas == "on"
         # Off-TPU the kernel can only run interpreted (test/debug use).
         self._pallas_interpret = jax.default_backend() != "tpu"
-        if self.use_pallas and self.count_dtype != np.int32:
-            raise ValueError(
-                "the Pallas kernel's 8-row blocks assume int32 sublane "
-                "tiling; use --pallas off with --count-dtype int16")
         # num_items == 0: derive the vocab from the data — start at a
         # modest capacity and double C whenever a window's max dense id
         # outgrows it (amortized O(final) copy work). An explicit

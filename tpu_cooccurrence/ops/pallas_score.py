@@ -16,10 +16,13 @@ the float32 score matrix write plus ``top_k``'s separate full re-read of
 it; the caller additionally bounds ``S`` so the gathered buffer stays
 within a fixed HBM budget (``DeviceScorer.max_score_rows``).
 
-Grid: ``(S // 8, I // TILE)`` with 8 rows per block (the int32 sublane
-tile). The running top-K lives in VMEM scratch that persists across the
-column-tile dimension (sequential grid execution, innermost-last order),
-initialized at ``j == 0`` and written to the output block at the last tile.
+Grid: ``(S // R, I // TILE)`` with ``R = row_block(count_dtype)`` rows per
+block — the count dtype's sublane tile (8 for int32, 16 for int16, whose
+halved bytes are exactly the regime where fusing away the f32 score
+matrix matters most). The running top-K lives in VMEM scratch that
+persists across the column-tile dimension (sequential grid execution,
+innermost-last order), initialized at ``j == 0`` and written to the
+output block at the last tile.
 
 Tie-breaking matches ``lax.top_k`` (lowest column index among equal scores):
 within a tile the extraction picks the minimum position, and the running
@@ -38,21 +41,31 @@ from jax.experimental.pallas import tpu as pltpu
 from .llr import llr_stable
 
 _K_PAD = 128     # output lane width; logical top_k occupies the first K lanes
-_ROW_BLOCK = 8   # rows per grid step — the int32 sublane tile
+
+
+def row_block(count_dtype) -> int:
+    """Rows per grid step: the sublane tile of the count dtype.
+
+    int32 tiles are (8, 128); int16 packs two values per sublane word, so
+    its native tile is (16, 128) — 16-row blocks keep the gathered count
+    rectangle layout-aligned and feed the VPU full registers.
+    """
+    return 16 if jnp.dtype(count_dtype).itemsize == 2 else 8
 
 
 def _score_topk_kernel(g_ref, rsj_ref, rsi_ref, obs_ref,
-                       vals_ref, idx_ref, run_vals, run_idx, *, top_k, tile):
+                       vals_ref, idx_ref, run_vals, run_idx, *, top_k, tile,
+                       block):
     j = pl.program_id(1)
     n_j = pl.num_programs(1)
-    R = _ROW_BLOCK
+    R = block
 
     @pl.when(j == 0)
     def _init():
         run_vals[...] = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
         run_idx[...] = jnp.zeros((R, _K_PAD), dtype=jnp.float32)
 
-    counts = g_ref[...]                                     # [R, TILE] int32
+    counts = g_ref[...]                                     # [R, TILE] counts
     k11 = counts.astype(jnp.float32)
     rsj = rsj_ref[0, :].astype(jnp.float32)[None, :]        # [1, TILE]
     rsi = rsi_ref[...].astype(jnp.float32)                  # [R, 1]
@@ -122,7 +135,7 @@ def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,
                       packed: bool = False):
     """Fused LLR + top-K over gathered rows. Mirrors ``device_scorer._score``.
 
-    C        [I, I] int32 — dense co-occurrence counts (I % tile == 0)
+    C        [I, I] int32|int16 — dense co-occurrence counts (I % tile == 0)
     row_sums [I]    int32
     rows     [S]    int32 — row ids to score (padded rows allowed)
     observed scalar float32
@@ -132,6 +145,10 @@ def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,
     caller fetches one buffer.
     """
     num_items = C.shape[0]
+    if C.dtype not in (jnp.int32, jnp.int16):
+        raise ValueError(
+            f"pallas scorer supports int32|int16 counts, got {C.dtype}")
+    blk = row_block(C.dtype)
     if num_items % tile != 0:
         raise ValueError(f"num_items {num_items} must be a multiple of tile {tile}")
     if num_items > 1 << 24:
@@ -144,32 +161,33 @@ def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,
             f"top_k {top_k} exceeds the kernel's lane width {_K_PAD}; "
             f"use the XLA scorer (pallas='off') for larger K")
     S = rows.shape[0]
-    pad_s = (-S) % _ROW_BLOCK
+    pad_s = (-S) % blk
     if pad_s:
         rows = jnp.concatenate([rows, jnp.zeros(pad_s, dtype=rows.dtype)])
     sp = S + pad_s
-    gathered = C[rows]                                   # [Sp, I] int32
+    gathered = C[rows]                                   # [Sp, I] count dtype
     rsi = row_sums[rows].reshape(sp, 1)
     rs2d = row_sums.reshape(1, num_items)
     obs = jnp.full((1, 1), observed, dtype=jnp.float32)
 
-    kernel = functools.partial(_score_topk_kernel, top_k=top_k, tile=tile)
+    kernel = functools.partial(_score_topk_kernel, top_k=top_k, tile=tile,
+                               block=blk)
     vals, idx = pl.pallas_call(
         kernel,
-        grid=(sp // _ROW_BLOCK, num_items // tile),
+        grid=(sp // blk, num_items // tile),
         in_specs=[
-            pl.BlockSpec((_ROW_BLOCK, tile), lambda i, j: (i, j)),
+            pl.BlockSpec((blk, tile), lambda i, j: (i, j)),
             pl.BlockSpec((1, tile), lambda i, j: (0, j)),
-            pl.BlockSpec((_ROW_BLOCK, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((_ROW_BLOCK, _K_PAD), lambda i, j: (i, 0)),
-            pl.BlockSpec((_ROW_BLOCK, _K_PAD), lambda i, j: (i, 0)),
+            pl.BlockSpec((blk, _K_PAD), lambda i, j: (i, 0)),
+            pl.BlockSpec((blk, _K_PAD), lambda i, j: (i, 0)),
         ),
         scratch_shapes=[
-            pltpu.VMEM((_ROW_BLOCK, _K_PAD), jnp.float32),
-            pltpu.VMEM((_ROW_BLOCK, _K_PAD), jnp.float32),
+            pltpu.VMEM((blk, _K_PAD), jnp.float32),
+            pltpu.VMEM((blk, _K_PAD), jnp.float32),
         ],
         out_shape=(
             jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),

@@ -9,7 +9,7 @@ RNG key for the reservoir sampler.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -17,10 +17,24 @@ import numpy as np
 class IdMap:
     """Grow-only external->dense id mapping with batch lookup.
 
-    Lookups run against a sorted (external, dense) array pair — fully
-    vectorized ``searchsorted``, no per-id Python. A lazy dict mirror
-    serves the scalar :meth:`to_dense` API.
+    Two regimes, switched automatically:
+
+    * **table** (fast path): while every external id is a small
+      non-negative int (true of every benchmark dataset — MovieLens /
+      Instacart ids and the synthetic streams are bounded), lookups are a
+      single fancy-index into a dense ``ext -> dense+1`` table — O(n),
+      no sort. The table grows to the max id seen, capped at
+      ``_TABLE_CAP`` entries (128 MB).
+    * **sorted** (general path): first batch with a negative or
+      too-large id permanently switches to a sorted (external, dense)
+      array pair — fully vectorized ``searchsorted``. The per-batch
+      ``np.unique`` sort this pays was the vocab-mapping hot spot at the
+      25M-event shape, which is why the table path exists.
+
+    A lazy dict mirror serves the scalar :meth:`to_dense` API.
     """
+
+    _TABLE_CAP = 1 << 24
 
     def __init__(self) -> None:
         self._keys = np.zeros(0, dtype=np.int64)   # sorted external ids
@@ -29,6 +43,7 @@ class IdMap:
         self._rev_arr: np.ndarray = np.zeros(0, dtype=np.int64)  # cache
         self._fwd: Dict[int, int] = {}  # lazy mirror for to_dense()
         self._fwd_n = 0  # how many dense ids the mirror covers
+        self._table: Optional[np.ndarray] = np.zeros(1024, dtype=np.int64)
 
     def __len__(self) -> int:
         return len(self._rev)
@@ -37,10 +52,47 @@ class IdMap:
         """Map a batch of external ids, assigning new dense ids as needed.
 
         Dense ids are assigned in first-appearance order (deterministic for
-        a fixed stream). The whole batch is one unique + searchsorted +
-        merge — no per-id Python loop.
+        a fixed stream). No per-id Python loop in either regime.
         """
         ids = np.asarray(ids, dtype=np.int64)
+        if self._table is not None and len(ids):
+            mx = int(ids.max())
+            if int(ids.min()) >= 0 and mx < self._TABLE_CAP:
+                return self._map_table(ids, mx)
+            self._leave_table_mode()
+        return self._map_sorted(ids)
+
+    def _map_table(self, ids: np.ndarray, mx: int) -> np.ndarray:
+        table = self._table
+        if mx >= len(table):
+            grown = np.zeros(max(2 * len(table), mx + 1), dtype=np.int64)
+            grown[: len(table)] = table
+            self._table = table = grown
+        dense1 = table[ids]  # dense id + 1; 0 = unseen
+        miss = dense1 == 0
+        if miss.any():
+            miss_ids = ids[miss]
+            # First-appearance order over the (small) new-id subset only.
+            uniq, first = np.unique(miss_ids, return_index=True)
+            order = np.argsort(first, kind="stable")
+            new_ext = uniq[order]
+            base = len(self._rev)
+            table[new_ext] = base + 1 + np.arange(len(new_ext),
+                                                  dtype=np.int64)
+            self._rev.extend(new_ext.tolist())
+            dense1 = table[ids]
+        return dense1 - 1
+
+    def _leave_table_mode(self) -> None:
+        """Materialize the sorted arrays from ``_rev`` and switch for good
+        (an id outside the table regime was seen)."""
+        rev = np.asarray(self._rev, dtype=np.int64)
+        order = np.argsort(rev, kind="stable")
+        self._keys = rev[order]
+        self._vals = order.astype(np.int64)
+        self._table = None
+
+    def _map_sorted(self, ids: np.ndarray) -> np.ndarray:
         uniq, inverse = np.unique(ids, return_inverse=True)
         dense_uniq = np.empty(len(uniq), dtype=np.int64)
         if len(self._keys):
@@ -96,9 +148,15 @@ class IdMap:
     def restore_state(self, rev: np.ndarray) -> None:
         self._rev = [int(x) for x in rev]
         rev = np.asarray(rev, dtype=np.int64)
-        order = np.argsort(rev, kind="stable")
-        self._keys = rev[order]
-        self._vals = order.astype(np.int64)
+        if len(rev) == 0 or (rev.min() >= 0 and rev.max() < self._TABLE_CAP):
+            # Rebuild the fast-path table (mode is part of restored state).
+            n = max(1024, int(rev.max(initial=0)) + 1)
+            self._table = np.zeros(n, dtype=np.int64)
+            self._table[rev] = 1 + np.arange(len(rev), dtype=np.int64)
+            self._keys = np.zeros(0, dtype=np.int64)
+            self._vals = np.zeros(0, dtype=np.int64)
+        else:
+            self._leave_table_mode()
         self._fwd = {}
         self._fwd_n = 0
         self._rev_arr = np.zeros(0, dtype=np.int64)  # length check is not
