@@ -25,10 +25,13 @@ def main() -> None:
 
     data = np.load(spec["stream"])
     users, items, ts = data["users"], data["items"], data["ts"]
+    backend = Backend(spec.get("backend", "sharded"))
     cfg = Config(
         window_size=spec["window_size"], seed=spec["seed"],
         item_cut=spec["item_cut"], user_cut=spec["user_cut"],
-        backend=Backend.SHARDED, num_items=spec["num_items"],
+        backend=backend, num_items=spec["num_items"],
+        num_shards=spec.get("num_shards", 1) if backend == Backend.SPARSE
+        else 1,
         checkpoint_dir=spec.get("checkpoint_dir"),
         coordinator=spec["coordinator"],
         num_processes=spec["num_processes"],

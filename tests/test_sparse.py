@@ -152,3 +152,15 @@ def test_sparse_hybrid_checkpoint_interchange(tmp_path):
         b.add_batch(users[half:], items[half:], ts[half:])
         b.finish()
         assert_latest_close(ref.latest, b.latest, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_coordinator_requires_shards():
+    import pytest
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    cfg = Config(window_size=10, seed=1, backend=Backend.SPARSE,
+                 coordinator="127.0.0.1:1", num_processes=2, process_id=0)
+    with pytest.raises(ValueError, match="num-shards"):
+        CooccurrenceJob(cfg)

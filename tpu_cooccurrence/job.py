@@ -112,16 +112,21 @@ class CooccurrenceJob:
                                 self.config.development_mode)
         if backend == Backend.SPARSE:
             if self.config.num_shards > 1:
-                if self.config.coordinator is not None:
-                    raise NotImplementedError(
-                        "multi-host sharded-sparse is not wired yet — use "
-                        "--backend sharded for multi-host runs")
+                from .parallel.distributed import maybe_multihost_mesh
                 from .parallel.sharded_sparse import ShardedSparseScorer
 
                 return ShardedSparseScorer(
                     self.config.top_k, num_shards=self.config.num_shards,
                     counters=self.counters,
+                    mesh=maybe_multihost_mesh(self.config),
                     development_mode=self.config.development_mode)
+            if self.config.coordinator is not None:
+                # A coordinator with the default single shard would run one
+                # full independent job per process (and clobber a shared
+                # checkpoint dir) — misconfiguration, not a mode.
+                raise ValueError(
+                    "--coordinator with --backend sparse needs "
+                    "--num-shards > 1 (the sharded-sparse mesh)")
             from .state.sparse_scorer import SparseDeviceScorer
 
             return SparseDeviceScorer(self.config.top_k, self.counters,
@@ -133,18 +138,12 @@ class CooccurrenceJob:
             if num_items <= 0:
                 raise ValueError(
                     "sharded backend needs --num-items (dense vocab capacity)")
-            mesh = None
-            if self.config.coordinator is not None:
-                from .parallel.distributed import (init_multihost,
-                                                   make_multihost_mesh)
+            from .parallel.distributed import maybe_multihost_mesh
 
-                init_multihost(self.config.coordinator,
-                               self.config.num_processes,
-                               self.config.process_id)
-                mesh = make_multihost_mesh()
             return ShardedScorer(num_items, self.config.top_k,
                                  num_shards=self.config.num_shards,
-                                 counters=self.counters, mesh=mesh,
+                                 counters=self.counters,
+                                 mesh=maybe_multihost_mesh(self.config),
                                  count_dtype=self.config.count_dtype)
         raise ValueError(f"unknown backend {backend}")
 

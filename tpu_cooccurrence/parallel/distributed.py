@@ -99,3 +99,13 @@ def put_global(arr: np.ndarray, mesh: Mesh, spec: PartitionSpec):
         return jax.device_put(arr, sharding)
     return jax.make_array_from_callback(
         np.shape(arr), sharding, lambda idx: np.asarray(arr[idx]))
+
+
+def maybe_multihost_mesh(config) -> Optional[Mesh]:
+    """Join the multi-controller runtime and build the global mesh when the
+    config asks for one (``--coordinator``); None for standalone runs."""
+    if config.coordinator is None:
+        return None
+    init_multihost(config.coordinator, config.num_processes,
+                   config.process_id)
+    return make_multihost_mesh()

@@ -24,12 +24,13 @@ via the same pow-4 ladders as the single-device sparse backend, host
 placement decisions per shard. Works identically on a virtual CPU mesh
 and real TPU meshes.
 
-Checkpoints use the canonical sparse-matrix format (global key space), so
-they are interchangeable with the single-device sparse and hybrid
-backends — a 1-chip checkpoint restores onto 8 shards and back.
-(Multi-process runs would need per-process snapshots like the dense
-sharded backend's; this backend currently checkpoints single-process
-meshes only and says so loudly.)
+Single-process checkpoints use the canonical sparse-matrix format (global
+key space), so they are interchangeable with the single-device sparse and
+hybrid backends — a 1-chip checkpoint restores onto 8 shards and back.
+Multi-host (multi-controller) runs save per process instead
+(``process_suffix``, like the dense sharded backend): the host-replicated
+index keys go in every file, the slab counts only for the shards the
+process's chips own; restore requires the writing run's process layout.
 """
 
 from __future__ import annotations
@@ -304,7 +305,8 @@ class ShardedSparseScorer:
                 if mv is not None:
                     mv_all[d, :, : mv.shape[1]] = mv
             self.cnt, self.dst = self._moves_fn(mv_len)(
-                self.cnt, self.dst, mv_all)
+                self.cnt, self.dst,
+                self._put_global(mv_all, self.mesh, P(ITEM_AXIS)))
 
         # Update: [D, 2, N_pad] cell sections + [D, 2] bounds + owner-
         # partitioned [D, 2, Rp] row-sum parts (psum'd to every replica).
@@ -333,7 +335,10 @@ class ShardedSparseScorer:
             rs_part[d, 0, :k] = rows[sel]
             rs_part[d, 1, :k] = rs_delta[sel].astype(np.int32)
         self.cnt, self.dst, self.row_sums = self._update(
-            self.cnt, self.dst, self.row_sums, upd, bounds, rs_part)
+            self.cnt, self.dst, self.row_sums,
+            self._put_global(upd, self.mesh, P(ITEM_AXIS)),
+            self._put_global(bounds, self.mesh, P(ITEM_AXIS)),
+            self._put_global(rs_part, self.mesh, P(ITEM_AXIS)))
 
         if self.development_mode:
             self._check_row_sums(rows)
@@ -384,7 +389,8 @@ class ShardedSparseScorer:
                     meta[d, 1, : len(p)] = starts[p]
                     meta[d, 2, : len(p)] = lens[p]
                 packed = self._score_fn(R)(
-                    self.cnt, self.dst, self.row_sums, meta,
+                    self.cnt, self.dst, self.row_sums,
+                    self._put_global(meta, self.mesh, P(ITEM_AXIS)),
                     np.float32(self.observed))
                 if hasattr(packed, "copy_to_host_async"):
                     packed.copy_to_host_async()
@@ -400,16 +406,25 @@ class ShardedSparseScorer:
         for d, g in enumerate(gmaps):
             gm[d, : len(g)] = g
         self.cnt, self.dst = self._compact_gather_fn(g_pad)(
-            self.cnt, self.dst, gm)
+            self.cnt, self.dst,
+            self._put_global(gm, self.mesh, P(ITEM_AXIS)))
+
+    def _local_slabs(self) -> Dict[int, np.ndarray]:
+        """Fetch the count slab of every ADDRESSABLE shard (multi-host: the
+        shards this process's chips own) keyed by global shard id."""
+        return {int(shard.index[0].start or 0): np.asarray(shard.data)[0]
+                for shard in self.cnt.addressable_shards}
 
     def _check_row_sums(self, rows: np.ndarray) -> None:
-        cnt = np.asarray(self.cnt)
+        local = self._local_slabs()
         D = self.n_shards
         for r in rows.tolist():
             d, lr = r % D, r // D
+            if d not in local:  # owned by another process's chips
+                continue
             s = int(self.indexes[d].row_start[lr])
             ln = int(self.indexes[d].row_len[lr])
-            actual = int(cnt[d, s: s + ln].sum())
+            actual = int(local[d][s: s + ln].sum())
             if actual != int(self.row_sums_host[r]):
                 raise AssertionError(
                     f"Item row {int(self.row_sums_host[r])} does not match "
@@ -438,22 +453,46 @@ class ShardedSparseScorer:
 
     # -- checkpoint -------------------------------------------------------
 
+    @property
+    def process_suffix(self) -> str:
+        """Checkpoint filename suffix: multi-host runs save per process."""
+        return f".p{jax.process_index()}" if jax.process_count() > 1 else ""
+
+    def _global_key(self, d: int, ix: SlabIndex) -> np.ndarray:
+        local_rows = (ix.g_key >> 32).astype(np.int64)
+        return ((local_rows * self.n_shards + d) << 32) | (
+            ix.g_key & 0xFFFFFFFF)
+
     def checkpoint_state(self) -> dict:
+        local = self._local_slabs()
         if jax.process_count() > 1:
-            raise NotImplementedError(
-                "sharded-sparse checkpoints are single-process for now — "
-                "use the dense sharded backend for multi-host checkpoints")
+            # Per-process snapshot. The *index* (cell keys, placement) is
+            # host-replicated — every process has all D of them and saves
+            # the identical global key union so a restored process can
+            # rebuild every shard's SlabIndex from its own file. The slab
+            # *counts* live on chips; each process saves only its
+            # addressable shards' (ascending shard id, g_key order).
+            keys_l = [self._global_key(d, ix)
+                      for d, ix in enumerate(self.indexes) if len(ix.g_key)]
+            keys = (np.sort(np.concatenate(keys_l)) if keys_l
+                    else np.zeros(0, dtype=np.int64))
+            shard_ids = sorted(local)
+            cnt_l = [local[d][self.indexes[d].g_slot] for d in shard_ids]
+            return {
+                "mh_rows_key": keys,
+                "mh_local_shards": np.asarray(shard_ids, dtype=np.int64),
+                "mh_local_cnt": (np.concatenate(cnt_l).astype(np.int64)
+                                 if cnt_l else np.zeros(0, np.int64)),
+                "row_sums": self.row_sums_host.copy(),
+                "observed": np.asarray([self.observed], dtype=np.int64),
+            }
         D = self.n_shards
-        cnt = np.asarray(self.cnt)  # [D, E]
         keys_l, vals_l = [], []
         for d, ix in enumerate(self.indexes):
             if not len(ix.g_key):
                 continue
-            local_rows = (ix.g_key >> 32).astype(np.int64)
-            g_dst = ix.g_key & 0xFFFFFFFF
-            g_src = local_rows * D + d
-            keys_l.append((g_src << 32) | g_dst)
-            vals_l.append(cnt[d][ix.g_slot])
+            keys_l.append(self._global_key(d, ix))
+            vals_l.append(local[d][ix.g_slot])
         if keys_l:
             keys = np.concatenate(keys_l)
             vals = np.concatenate(vals_l)
@@ -472,6 +511,8 @@ class ShardedSparseScorer:
         }
 
     def restore_state(self, st: dict) -> None:
+        if "mh_rows_key" in st:
+            return self._restore_multihost(st)
         D = self.n_shards
         key = st["rows_key"]
         cnt_vals = st["rows_cnt"].astype(np.int32)
@@ -504,6 +545,68 @@ class ShardedSparseScorer:
         rs = np.asarray(st["row_sums"], dtype=np.int64)
         if len(rs) > self.items_cap and rs[self.items_cap:].any():
             raise ValueError("checkpoint row sums extend past its cells")
+        self.row_sums_host[:] = 0
+        m = min(len(rs), self.items_cap)
+        self.row_sums_host[:m] = rs[:m]
+        self.row_sums = self._put_global(
+            self.row_sums_host.astype(np.int32), self.mesh, P())
+        self.observed = int(st["observed"][0])
+        self._pending = None
+
+    def _restore_multihost(self, st: dict) -> None:
+        """Restore a per-process snapshot (same process layout required).
+
+        The file's key union rebuilds every shard's index (identical in all
+        processes by construction); only the locally-owned shards' counts
+        are in the file, and only they are uploaded — ``put_global``'s
+        callback never asks a process for a remote shard's block. ``dst``
+        values are derivable from the keys for every shard.
+        """
+        if jax.process_count() == 1:
+            raise ValueError(
+                "checkpoint was written by a multi-host sharded-sparse run "
+                "(per-process slab blocks); restore it under the same "
+                "process layout")
+        local_ids = sorted(self._local_slabs())
+        saved_ids = st["mh_local_shards"].tolist()
+        if saved_ids != local_ids:
+            raise ValueError(
+                f"checkpoint owns shards {saved_ids} but this process owns "
+                f"{local_ids} — restore under the writing run's layout")
+        D = self.n_shards
+        key = st["mh_rows_key"]
+        src = (key >> 32).astype(np.int64)
+        dst = (key & 0xFFFFFFFF).astype(np.int64)
+        max_id = int(max(src.max(initial=0), dst.max(initial=0)))
+        if max_id >= self.items_cap:
+            new_cap = int(_pow2ceil(np.asarray([max_id + 1]), 1024)[0])
+            self.row_sums_host = np.zeros(new_cap, dtype=np.int64)
+            self.items_cap = new_cap
+            self._build_update()
+        owner = (src % D).astype(np.int64)
+        need = 0
+        slots_by_shard = {}
+        for d in range(D):
+            sel = owner == d
+            lk = self._local_key(src[sel], dst[sel])
+            slots_by_shard[d] = (self.indexes[d].rebuild_from_keys(lk),
+                                 dst[sel])
+            need = max(need, self.indexes[d].heap_end)
+        while self.capacity < need:
+            self.capacity *= 2
+        cnt_host = np.zeros((D, self.capacity), dtype=np.int32)
+        dst_host = np.zeros((D, self.capacity), dtype=np.int32)
+        for d, (slots, dv) in slots_by_shard.items():
+            dst_host[d, slots] = dv.astype(np.int32)
+        lo = 0
+        cnt_local = st["mh_local_cnt"].astype(np.int32)
+        for d in local_ids:
+            slots, _ = slots_by_shard[d]
+            cnt_host[d, slots] = cnt_local[lo: lo + len(slots)]
+            lo += len(slots)
+        self.cnt = self._put_global(cnt_host, self.mesh, P(ITEM_AXIS, None))
+        self.dst = self._put_global(dst_host, self.mesh, P(ITEM_AXIS, None))
+        rs = np.asarray(st["row_sums"], dtype=np.int64)
         self.row_sums_host[:] = 0
         m = min(len(rs), self.items_cap)
         self.row_sums_host[:m] = rs[:m]
