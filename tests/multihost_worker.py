@@ -33,6 +33,7 @@ def main() -> None:
         num_shards=spec.get("num_shards", 1) if backend == Backend.SPARSE
         else 1,
         checkpoint_dir=spec.get("checkpoint_dir"),
+        partition_sampling=spec.get("partition_sampling", False),
         coordinator=spec["coordinator"],
         num_processes=spec["num_processes"],
         process_id=spec["process_id"])

@@ -40,7 +40,8 @@ def _free_port() -> int:
 
 
 def _spawn_pair(tmp_path, phase: str, half: int, stream_path: str,
-                checkpoint_dir: str, backend: str = "sharded"):
+                checkpoint_dir: str, backend: str = "sharded",
+                partition_sampling: bool = False):
     """Launch both processes of one phase and return their parsed outputs."""
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -55,9 +56,10 @@ def _spawn_pair(tmp_path, phase: str, half: int, stream_path: str,
         spec = dict(STREAM_KW, stream=stream_path, coordinator=coordinator,
                     num_processes=2, process_id=pid, phase=phase, half=half,
                     checkpoint_dir=checkpoint_dir, backend=backend,
-                    num_shards=8)
-        spec_path = tmp_path / f"spec-{backend}-{phase}-{pid}.json"
-        out_path = tmp_path / f"out-{backend}-{phase}-{pid}.json"
+                    num_shards=8, partition_sampling=partition_sampling)
+        tag = f"{backend}{'-ps' if partition_sampling else ''}"
+        spec_path = tmp_path / f"spec-{tag}-{phase}-{pid}.json"
+        out_path = tmp_path / f"out-{tag}-{phase}-{pid}.json"
         spec_path.write_text(json.dumps(spec))
         outs.append(out_path)
         procs.append(subprocess.Popen(
@@ -162,3 +164,24 @@ def test_multihost_sharded_sparse_checkpoint_resume(tmp_path, stream):
     results = _spawn_pair(tmp_path, "resume", half, stream_path, ck_dir,
                           backend="sparse")
     _assert_matches_reference(results, users, items, ts, backend="sparse")
+
+
+def test_multihost_partitioned_sampling_matches_replicated(tmp_path, stream):
+    """--partition-sampling: each process reservoirs 1/P of the users and
+    the per-window allgather reproduces the serial pipeline exactly —
+    results AND counters (the RNG is partition-independent by design)."""
+    stream_path, users, items, ts = stream
+    results = _spawn_pair(tmp_path, "full", len(users), stream_path,
+                          checkpoint_dir=None, partition_sampling=True)
+    _assert_matches_reference(results, users, items, ts)
+
+
+def test_multihost_partitioned_sampling_checkpoint_resume(tmp_path, stream):
+    stream_path, users, items, ts = stream
+    ck_dir = str(tmp_path / "ck-ps")
+    half = 250
+    _spawn_pair(tmp_path, "first-half", half, stream_path, ck_dir,
+                partition_sampling=True)
+    results = _spawn_pair(tmp_path, "resume", half, stream_path, ck_dir,
+                          partition_sampling=True)
+    _assert_matches_reference(results, users, items, ts)

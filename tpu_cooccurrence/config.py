@@ -111,6 +111,10 @@ class Config:
     coordinator: Optional[str] = None  # host:port of process 0
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    partition_sampling: bool = False  # split the user reservoir across
+    # processes (u % P) and allgather pair deltas per window — the
+    # reference's keyed-parallel ingest scaling (sampling/multihost.py);
+    # off = every process samples the full stream (replicated host state)
 
     def __post_init__(self):
         if self.seed is None:
@@ -131,6 +135,19 @@ class Config:
                 raise ValueError(
                     f"--process-id {self.process_id} out of range for "
                     f"--num-processes {self.num_processes}")
+        if self.partition_sampling:
+            if self.coordinator is None:
+                raise ValueError(
+                    "--partition-sampling is a multi-host mode — it needs "
+                    "--coordinator/--num-processes/--process-id")
+            if self.window_slide is not None:
+                raise ValueError(
+                    "--partition-sampling applies to the tumbling reservoir "
+                    "pipeline; the sliding sampler runs replicated")
+            if self.sample_workers > 1:
+                raise ValueError(
+                    "--partition-sampling and --sample-workers are separate "
+                    "scale-out axes; combine is not supported yet")
 
     @property
     def window_millis(self) -> int:
@@ -215,6 +232,12 @@ class Config:
         p.add_argument("--development-mode", action="store_true", dest="development_mode")
         p.add_argument("--process-continuously", action="store_true",
                        dest="process_continuously")
+        p.add_argument("--partition-sampling", action="store_true",
+                       dest="partition_sampling",
+                       help="Multi-host: partition the user reservoir "
+                            "across processes (u %% P) and allgather pair "
+                            "deltas per window instead of replicating all "
+                            "host sampling on every process")
         p.add_argument("--coordinator", default=None,
                        help="Multi-host: host:port of process 0")
         p.add_argument("--num-processes", type=int, default=None,

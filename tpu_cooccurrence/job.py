@@ -62,6 +62,17 @@ class CooccurrenceJob:
             self.sampler = SlidingBasketSampler(
                 config.item_cut, config.user_cut, config.skip_cuts,
                 counters=self.counters)
+        elif config.partition_sampling:
+            # Needs the multi-controller runtime up before process_index()
+            # is meaningful; idempotent with the scorer's own init.
+            from .parallel.distributed import init_multihost
+            from .sampling.multihost import ProcessPartitionedSampler
+
+            init_multihost(config.coordinator, config.num_processes,
+                           config.process_id)
+            self.sampler = ProcessPartitionedSampler(
+                config.user_cut, config.seed, config.skip_cuts,
+                counters=self.counters)
         elif config.sample_workers > 1:
             from .sampling.parallel import PartitionedReservoirSampler
 
@@ -73,6 +84,19 @@ class CooccurrenceJob:
                 config.user_cut, config.seed, config.skip_cuts,
                 counters=self.counters)
         self.scorer = scorer if scorer is not None else self._make_scorer()
+        if config.partition_sampling:
+            import jax
+
+            if (jax.process_count() > 1
+                    and not getattr(self.scorer, "process_suffix", "")):
+                # Partitioned snapshots are per-process-distinct; a backend
+                # without per-process checkpoint files would have every
+                # process clobber the same state.npz (last writer wins,
+                # other partitions' reservoirs unrecoverable).
+                raise ValueError(
+                    "--partition-sampling needs a backend with per-process "
+                    "checkpoints: --backend sharded, or sparse with "
+                    "--num-shards > 1")
         # results: external item id -> [(external other, score) desc];
         # array-backed, lazily materialized (state/results.py)
         self.latest = LatestResults(self.item_vocab)

@@ -1,0 +1,166 @@
+"""Process-partitioned reservoir sampling: scale ingest across hosts.
+
+The reference scales its sampling hot loop with keyed data parallelism —
+P subtasks, each owning the users that hash to it, exchanging results
+through Flink's shuffle (``FlinkCooccurrences.java:70,108``). Without
+this, a multi-controller run of this framework replicates ALL host-side
+sampling on every process (each host consumes the whole stream), so
+host-bound workloads gain nothing from more hosts.
+
+``--partition-sampling`` restores the reference's scaling model at the
+process level: process ``p`` of ``P`` runs the user reservoir only for
+users with ``u % P == p`` (1/P of the expansion work), then the emitted
+pair-delta blocks, rejection feedback, and counter deltas are packed into
+ONE vector and exchanged per window (a lengths gather + a payload gather
+— two collective rounds) — the TPU-native shuffle, riding the same
+gloo/DCN fabric as the collectives. Item cuts stay
+replicated (they are global per-item ranks over the window, vectorized
+and cheap; partitioning them would change semantics).
+
+Bit-identical to serial by the same argument as the thread-partitioned
+sampler (``sampling/parallel.py``): reservoir state is strictly per-user,
+the partition mask preserves each user's arrival order, and the draw RNG
+hashes ``(seed, global user id, per-user draw index)`` — partition- and
+order-independent. Block concatenation in process order is deterministic,
+and every consumer folds blocks per cell, so inter-block order is
+immaterial to scores.
+
+Checkpoints: each process snapshots only its own users' reservoir state
+(the others are zeros in the fixed global layout) plus a
+``sampler_part = [process_index, process_count]`` marker; restore
+validates the layout matches and the generic restore path refuses to
+feed a partitioned snapshot to a non-partitioned sampler.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import Counters
+from .reservoir import PairDeltaBatch, UserReservoirSampler
+
+# Fixed exchange order for counter deltas (names resolved lazily to avoid
+# hard-coding the metric strings here).
+_XCHG_COUNTERS = None
+
+
+def _counter_names() -> List[str]:
+    global _XCHG_COUNTERS
+    if _XCHG_COUNTERS is None:
+        from .. import metrics
+
+        _XCHG_COUNTERS = sorted(
+            v for k, v in vars(metrics).items()
+            if k.isupper() and isinstance(v, str))
+    return _XCHG_COUNTERS
+
+
+def _allgather_ragged(vec: np.ndarray) -> List[np.ndarray]:
+    """Gather a per-process int64 vector from every process.
+
+    ``process_allgather`` needs equal shapes, so lengths go first and the
+    payload is padded to the global max — two collective rounds total,
+    which is why callers pack everything they exchange into ONE vector.
+    """
+    from jax.experimental import multihost_utils
+
+    lens = multihost_utils.process_allgather(
+        np.asarray([len(vec)], dtype=np.int64))  # [P, 1]
+    m = max(int(lens.max()), 1)
+    padded = np.zeros(m, vec.dtype)
+    padded[: len(vec)] = vec
+    gathered = multihost_utils.process_allgather(padded)  # [P, m]
+    return [gathered[p][: int(lens[p, 0])]
+            for p in range(gathered.shape[0])]
+
+
+class ProcessPartitionedSampler:
+    """User-partitioned reservoir across multi-controller processes."""
+
+    process_partition = True  # checkpoint-format marker (see module doc)
+
+    def __init__(self, user_cut: int, seed: int, skip_cuts: bool,
+                 capacity: int = 1024,
+                 counters: Optional[Counters] = None) -> None:
+        import jax
+
+        self.pid = jax.process_index()
+        self.nproc = jax.process_count()
+        self.counters = counters if counters is not None else Counters()
+        # Local part over part-local compact ids (u // P), like the
+        # thread-partitioned sampler; private counters, exchanged+merged
+        # after every fire so every process sees the global totals.
+        self.part = UserReservoirSampler(
+            user_cut, seed, skip_cuts,
+            capacity=max(capacity // self.nproc, 16), counters=Counters())
+
+    def fire(self, users: np.ndarray, items: np.ndarray,
+             sampled: np.ndarray) -> Tuple[PairDeltaBatch, np.ndarray]:
+        mine = (users % self.nproc) == self.pid
+        pairs, feedback = self.part.fire(
+            users[mine] // self.nproc, items[mine], sampled[mine],
+            rng_users=users[mine])
+        if self.nproc == 1:
+            self.counters.merge(self.part.counters)
+            self.part.counters.replace_all({})
+            return pairs, feedback
+
+        # ONE exchange payload (2 collective rounds: lengths, then data):
+        # header [n_pairs, n_fb] | counter deltas [C] | src | dst | delta
+        # | feedback.
+        names = _counter_names()
+        n, nf = len(pairs), len(feedback)
+        vec = np.concatenate([
+            np.asarray([n, nf], dtype=np.int64),
+            np.asarray([self.part.counters.get(x) for x in names],
+                       dtype=np.int64),
+            pairs.src, pairs.dst, pairs.delta.astype(np.int64),
+            feedback.astype(np.int64),
+        ])
+        self.part.counters.replace_all({})
+
+        blocks, fb_l = [], []
+        totals = np.zeros(len(names), dtype=np.int64)
+        for v in _allgather_ragged(vec):
+            pn, pf = int(v[0]), int(v[1])
+            body = v[2 + len(names):]
+            totals += v[2: 2 + len(names)]
+            blocks.append(PairDeltaBatch(
+                body[:pn], body[pn: 2 * pn],
+                body[2 * pn: 3 * pn].astype(np.int32)))
+            fb_l.append(body[3 * pn: 3 * pn + pf])
+        for name, value in zip(names, totals.tolist()):
+            if value:
+                self.counters.add(name, value)
+        return PairDeltaBatch.concat(blocks), np.concatenate(fb_l)
+
+    # -- checkpoint (fixed global layout; local rows only) ----------------
+
+    def checkpoint_state(self, n_users: int) -> dict:
+        from .parallel import scatter_part_state
+
+        hist = np.zeros((n_users, self.part.hist.shape[1]), dtype=np.int32)
+        hist_len = np.zeros(n_users, dtype=np.int64)
+        total = np.zeros(n_users, dtype=np.int64)
+        draws = np.zeros(n_users, dtype=np.int64)
+        scatter_part_state(self.part, self.pid, self.nproc, n_users,
+                           hist, hist_len, total, draws)
+        return {"hist": hist, "hist_len": hist_len, "total": total,
+                "draws": draws,
+                "sampler_part": np.asarray([self.pid, self.nproc],
+                                           dtype=np.int64)}
+
+    def restore_state(self, st: dict, n_users: int) -> None:
+        from .parallel import restore_part_state
+
+        part_info = st.get("sampler_part")
+        if part_info is not None:
+            pid, nproc = int(part_info[0]), int(part_info[1])
+            if (pid, nproc) != (self.pid, self.nproc):
+                raise ValueError(
+                    f"sampler checkpoint is partition {pid}/{nproc} but "
+                    f"this process is {self.pid}/{self.nproc} — restore "
+                    f"under the writing run's layout")
+        restore_part_state(self.part, st, self.pid, self.nproc, n_users)

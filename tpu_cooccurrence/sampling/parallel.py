@@ -46,6 +46,35 @@ from ..metrics import Counters
 from .reservoir import PairDeltaBatch, UserReservoirSampler
 
 
+def scatter_part_state(part: UserReservoirSampler, p: int, P: int,
+                       n_users: int, hist, hist_len, total, draws) -> None:
+    """Write one part's reservoir arrays into the serial global-dense-id
+    layout (user ``u`` lives at part ``u % P``, local row ``u // P``) —
+    shared by the thread- and process-partitioned samplers so their
+    checkpoints stay interchangeable with the serial sampler's."""
+    n_local = (n_users - p + P - 1) // P
+    if n_local <= 0:
+        return
+    # The vocab can be ahead of the sampler (unfired buffered windows);
+    # size the part up before slicing.
+    part._ensure_rows(n_local - 1)
+    hist[p::P, : part.hist.shape[1]] = part.hist[:n_local]
+    hist_len[p::P] = part.hist_len[:n_local]
+    total[p::P] = part.total[:n_local]
+    draws[p::P] = part.draws[:n_local]
+
+
+def restore_part_state(part: UserReservoirSampler, st: dict, p: int,
+                       P: int, n_users: int) -> None:
+    """Inverse of :func:`scatter_part_state` for one part."""
+    n_local = (n_users - p + P - 1) // P
+    if n_local <= 0:
+        return
+    part.restore_state(
+        {k: st[k][p::P] for k in ("hist", "hist_len", "total", "draws")},
+        n_local)
+
+
 class PartitionedReservoirSampler:
     """W user-partitioned reservoir samplers fired concurrently."""
 
@@ -102,24 +131,11 @@ class PartitionedReservoirSampler:
         total = np.zeros(n_users, dtype=np.int64)
         draws = np.zeros(n_users, dtype=np.int64)
         for p, part in enumerate(self.parts):
-            n_local = (n_users - p + self.workers - 1) // self.workers
-            if n_local <= 0:
-                continue
-            # The vocab can be ahead of the sampler (unfired buffered
-            # windows); size each part up before slicing.
-            part._ensure_rows(n_local - 1)
-            hist[p::self.workers, : part.hist.shape[1]] = part.hist[:n_local]
-            hist_len[p::self.workers] = part.hist_len[:n_local]
-            total[p::self.workers] = part.total[:n_local]
-            draws[p::self.workers] = part.draws[:n_local]
+            scatter_part_state(part, p, self.workers, n_users,
+                               hist, hist_len, total, draws)
         return {"hist": hist, "hist_len": hist_len, "total": total,
                 "draws": draws}
 
     def restore_state(self, st: dict, n_users: int) -> None:
         for p, part in enumerate(self.parts):
-            n_local = (n_users - p + self.workers - 1) // self.workers
-            if n_local <= 0:
-                continue
-            part.restore_state(
-                {k: st[k][p::self.workers] for k in
-                 ("hist", "hist_len", "total", "draws")}, n_local)
+            restore_part_state(part, st, p, self.workers, n_users)

@@ -143,9 +143,17 @@ def restore(job, directory: str, source=None) -> None:
 
     s = job.sampler
     if hasattr(s, "restore_state") and "hist" in data:
-        s.restore_state({k: data[k] for k in
-                         ("hist", "hist_len", "total", "draws")},
-                        len(job.user_vocab))
+        st = {k: data[k] for k in ("hist", "hist_len", "total", "draws")}
+        if "sampler_part" in data:
+            # Partition-sampled snapshots hold only the writing process's
+            # users; a non-partitioned sampler would silently restore
+            # zeroed reservoirs for everyone else.
+            if not getattr(s, "process_partition", False):
+                raise ValueError(
+                    "checkpoint was written with --partition-sampling — "
+                    "restore with the same flag and process layout")
+            st["sampler_part"] = data["sampler_part"]
+        s.restore_state(st, len(job.user_vocab))
 
     job.engine.max_ts_seen = meta["max_ts_seen"]
     job.engine._buffers.clear()
