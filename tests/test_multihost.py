@@ -185,3 +185,13 @@ def test_multihost_partitioned_sampling_checkpoint_resume(tmp_path, stream):
     results = _spawn_pair(tmp_path, "resume", half, stream_path, ck_dir,
                           partition_sampling=True)
     _assert_matches_reference(results, users, items, ts)
+
+
+def test_multihost_sparse_with_partitioned_sampling(tmp_path, stream):
+    """Both scale axes at once: row-sharded HBM slabs across hosts AND the
+    user reservoir partitioned across the same processes."""
+    stream_path, users, items, ts = stream
+    results = _spawn_pair(tmp_path, "full", len(users), stream_path,
+                          checkpoint_dir=None, backend="sparse",
+                          partition_sampling=True)
+    _assert_matches_reference(results, users, items, ts, backend="sparse")
