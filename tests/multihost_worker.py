@@ -28,6 +28,7 @@ def main() -> None:
     backend = Backend(spec.get("backend", "sharded"))
     cfg = Config(
         window_size=spec["window_size"], seed=spec["seed"],
+        window_slide=spec.get("window_slide"),
         item_cut=spec["item_cut"], user_cut=spec["user_cut"],
         backend=backend, num_items=spec["num_items"],
         num_shards=spec.get("num_shards", 1) if backend == Backend.SPARSE

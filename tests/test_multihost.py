@@ -41,7 +41,8 @@ def _free_port() -> int:
 
 def _spawn_pair(tmp_path, phase: str, half: int, stream_path: str,
                 checkpoint_dir: str, backend: str = "sharded",
-                partition_sampling: bool = False):
+                partition_sampling: bool = False,
+                window_slide: int = None):
     """Launch both processes of one phase and return their parsed outputs."""
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -56,8 +57,10 @@ def _spawn_pair(tmp_path, phase: str, half: int, stream_path: str,
         spec = dict(STREAM_KW, stream=stream_path, coordinator=coordinator,
                     num_processes=2, process_id=pid, phase=phase, half=half,
                     checkpoint_dir=checkpoint_dir, backend=backend,
-                    num_shards=8, partition_sampling=partition_sampling)
-        tag = f"{backend}{'-ps' if partition_sampling else ''}"
+                    num_shards=8, partition_sampling=partition_sampling,
+                    window_slide=window_slide)
+        tag = (f"{backend}{'-ps' if partition_sampling else ''}"
+               f"{'-sl' if window_slide else ''}")
         spec_path = tmp_path / f"spec-{tag}-{phase}-{pid}.json"
         out_path = tmp_path / f"out-{tag}-{phase}-{pid}.json"
         spec_path.write_text(json.dumps(spec))
@@ -84,8 +87,10 @@ def _merge_latest(results):
     return merged
 
 
-def _reference_latest(users, items, ts, backend: str = "sharded"):
-    cfg = Config(**STREAM_KW, backend=Backend(backend), num_shards=8)
+def _reference_latest(users, items, ts, backend: str = "sharded",
+                      window_slide: int = None):
+    cfg = Config(**STREAM_KW, backend=Backend(backend), num_shards=8,
+                 window_slide=window_slide)
     job = run_production(cfg, users, items, ts)
     return ({item: job.latest[item] for item in job.latest},
             job.counters.as_dict())
@@ -100,8 +105,10 @@ def stream(tmp_path_factory):
 
 
 def _assert_matches_reference(results, users, items, ts,
-                              backend: str = "sharded"):
-    ref_latest, ref_counters = _reference_latest(users, items, ts, backend)
+                              backend: str = "sharded",
+                              window_slide: int = None):
+    ref_latest, ref_counters = _reference_latest(users, items, ts, backend,
+                                                 window_slide)
     merged = _merge_latest(results)
     assert set(merged) == set(ref_latest)
     for item in ref_latest:
@@ -195,3 +202,14 @@ def test_multihost_sparse_with_partitioned_sampling(tmp_path, stream):
                           checkpoint_dir=None, backend="sparse",
                           partition_sampling=True)
     _assert_matches_reference(results, users, items, ts, backend="sparse")
+
+
+def test_multihost_partitioned_sliding_matches_replicated(tmp_path, stream):
+    """Sliding mode under --partition-sampling: replicated cuts, user-
+    partitioned basket expansion, packed allgather — same results and
+    counters as the single-process sliding run."""
+    stream_path, users, items, ts = stream
+    results = _spawn_pair(tmp_path, "full", len(users), stream_path,
+                          checkpoint_dir=None, partition_sampling=True,
+                          window_slide=5)
+    _assert_matches_reference(results, users, items, ts, window_slide=5)

@@ -227,3 +227,23 @@ def test_native_sliding_scratch_reuse_across_windows():
         want = s_numpy._fire_numpy(users.copy(), items.copy())
         np.testing.assert_array_equal(got.src, want.src)
         np.testing.assert_array_equal(got.dst, want.dst)
+
+
+def test_native_cut_mask_matches_grouped_rank():
+    from tpu_cooccurrence import native
+    from tpu_cooccurrence.sampling.item_cut import grouped_rank
+
+    if native.get_lib() is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(0xCAFE)
+    scratch = native.SlidingScratch()
+    for f_max, k_max in [(500, 500), (3, 4), (1, 1)]:
+        for _ in range(4):
+            n = int(rng.integers(1, 500))
+            users = rng.integers(0, 20, n).astype(np.int64)
+            items = rng.integers(0, 60, n).astype(np.int64)
+            want = ((grouped_rank(items) < f_max)
+                    & (grouped_rank(users) < k_max))
+            got = native.sliding_cut_mask(users, items, f_max, k_max,
+                                          scratch)
+            np.testing.assert_array_equal(got, want)

@@ -111,10 +111,12 @@ class Config:
     coordinator: Optional[str] = None  # host:port of process 0
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
-    partition_sampling: bool = False  # split the user reservoir across
-    # processes (u % P) and allgather pair deltas per window — the
-    # reference's keyed-parallel ingest scaling (sampling/multihost.py);
-    # off = every process samples the full stream (replicated host state)
+    partition_sampling: bool = False  # split host-side sampling across
+    # processes by user (u % P) — the reservoir in tumbling mode, basket
+    # expansion in sliding mode (cuts stay replicated) — and allgather
+    # pair deltas per window: the reference's keyed-parallel ingest
+    # scaling (sampling/multihost.py); off = every process samples the
+    # full stream (replicated host state)
 
     def __post_init__(self):
         if self.seed is None:
@@ -140,10 +142,6 @@ class Config:
                 raise ValueError(
                     "--partition-sampling is a multi-host mode — it needs "
                     "--coordinator/--num-processes/--process-id")
-            if self.window_slide is not None:
-                raise ValueError(
-                    "--partition-sampling applies to the tumbling reservoir "
-                    "pipeline; the sliding sampler runs replicated")
             if self.sample_workers > 1:
                 raise ValueError(
                     "--partition-sampling and --sample-workers are separate "
@@ -234,10 +232,12 @@ class Config:
                        dest="process_continuously")
         p.add_argument("--partition-sampling", action="store_true",
                        dest="partition_sampling",
-                       help="Multi-host: partition the user reservoir "
-                            "across processes (u %% P) and allgather pair "
-                            "deltas per window instead of replicating all "
-                            "host sampling on every process")
+                       help="Multi-host: partition host-side sampling "
+                            "across processes by user (u %% P; reservoir "
+                            "in tumbling mode, basket expansion in sliding "
+                            "mode) and allgather pair deltas per window "
+                            "instead of replicating all host sampling on "
+                            "every process")
         p.add_argument("--coordinator", default=None,
                        help="Multi-host: host:port of process 0")
         p.add_argument("--num-processes", type=int, default=None,

@@ -59,9 +59,20 @@ class CooccurrenceJob:
         self.user_vocab = IdMap()
         self.item_cut = ItemInteractionCut(config.item_cut, capacity=1024)
         if self.sliding:
-            self.sampler = SlidingBasketSampler(
-                config.item_cut, config.user_cut, config.skip_cuts,
-                counters=self.counters)
+            if config.partition_sampling:
+                from .parallel.distributed import init_multihost
+                from .sampling.multihost import (
+                    ProcessPartitionedSlidingSampler)
+
+                init_multihost(config.coordinator, config.num_processes,
+                               config.process_id)
+                self.sampler = ProcessPartitionedSlidingSampler(
+                    config.item_cut, config.user_cut, config.skip_cuts,
+                    counters=self.counters)
+            else:
+                self.sampler = SlidingBasketSampler(
+                    config.item_cut, config.user_cut, config.skip_cuts,
+                    counters=self.counters)
         elif config.partition_sampling:
             # Needs the multi-controller runtime up before process_index()
             # is meaningful; idempotent with the scorer's own init.

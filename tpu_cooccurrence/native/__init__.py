@@ -86,6 +86,10 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
     lib.sliding_emit.argtypes = [
         i64p, i64p, ctypes.c_int64, i32p, i64p, ctypes.c_int64,
         i64p, i64p, i64p, i64p]
+    lib.sliding_cut_mask.restype = None
+    lib.sliding_cut_mask.argtypes = [
+        i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        i32p, i32p, ctypes.POINTER(ctypes.c_uint8)]
     _lib = lib
     return _lib
 
@@ -224,3 +228,26 @@ def sliding_expand(users: np.ndarray, items: np.ndarray, f_max: int,
         _ptr64(scratch.user_start), _ptr64(grouped), _ptr64(src),
         _ptr64(dst))
     return src, dst
+
+
+def sliding_cut_mask(users: np.ndarray, items: np.ndarray, f_max: int,
+                     k_max: int, scratch: SlidingScratch):
+    """Native grouped-rank cut mask (one O(n) counting pass); None if the
+    library is unavailable (callers fall back to argsort grouped_rank)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(users)
+    users = np.ascontiguousarray(users, dtype=np.int64)
+    items = np.ascontiguousarray(items, dtype=np.int64)
+    max_item = int(items.max())
+    max_user = int(users.max())
+    scratch._ensure(max_item, max_user)
+    scratch.item_count[: max_item + 1].fill(0)
+    scratch.user_count[: max_user + 1].fill(0)
+    keep = np.empty(n, dtype=np.uint8)
+    lib.sliding_cut_mask(
+        _ptr64(users), _ptr64(items), n, f_max, k_max,
+        _ptr32(scratch.item_count), _ptr32(scratch.user_count),
+        keep.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return keep.view(np.bool_)

@@ -129,3 +129,19 @@ void sliding_emit(
 }
 
 }  // extern "C"
+
+// Cut mask only (no grouping/emission): keep[e] = both pre-increment
+// ranks under their caps; both counters advance on EVERY event
+// (grouped_rank semantics — deliberately no short-circuit).
+// Used by the partitioned sliding sampler, whose cuts run replicated
+// while expansion is split by user.
+extern "C" void sliding_cut_mask(
+    const int64_t* users, const int64_t* items, int64_t n,
+    int64_t f_max, int64_t k_max,
+    int32_t* item_count, int32_t* user_count, uint8_t* keep) {
+  for (int64_t e = 0; e < n; ++e) {
+    const int32_t ir = item_count[items[e]]++;
+    const int32_t ur = user_count[users[e]]++;
+    keep[e] = (ir < f_max) & (ur < k_max);
+  }
+}

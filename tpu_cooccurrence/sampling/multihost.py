@@ -76,6 +76,41 @@ def _allgather_ragged(vec: np.ndarray) -> List[np.ndarray]:
             for p in range(gathered.shape[0])]
 
 
+def _exchange(counters: Counters, part_counters: Counters,
+              sections: List[np.ndarray]) -> List[List[np.ndarray]]:
+    """Pack ``sections`` + this process's counter deltas into one vector,
+    allgather it (2 collective rounds), merge the counter totals into
+    ``counters``, and return each process's unpacked sections.
+
+    The single wire format keeps every partitioned sampler's exchange
+    protocol identical: header = section lengths, then counter deltas,
+    then the section payloads, all int64.
+    """
+    names = _counter_names()
+    k = len(sections)
+    vec = np.concatenate(
+        [np.asarray([len(sec) for sec in sections], dtype=np.int64),
+         np.asarray([part_counters.get(x) for x in names], dtype=np.int64)]
+        + [sec.astype(np.int64, copy=False) for sec in sections])
+    part_counters.replace_all({})
+
+    per_process: List[List[np.ndarray]] = []
+    totals = np.zeros(len(names), dtype=np.int64)
+    for v in _allgather_ragged(vec):
+        lens = v[:k]
+        totals += v[k: k + len(names)]
+        body = v[k + len(names):]
+        out, lo = [], 0
+        for ln in lens.tolist():
+            out.append(body[lo: lo + ln])
+            lo += ln
+        per_process.append(out)
+    for name, value in zip(names, totals.tolist()):
+        if value:
+            counters.add(name, value)
+    return per_process
+
+
 class ProcessPartitionedSampler:
     """User-partitioned reservoir across multi-controller processes."""
 
@@ -107,34 +142,13 @@ class ProcessPartitionedSampler:
             self.part.counters.replace_all({})
             return pairs, feedback
 
-        # ONE exchange payload (2 collective rounds: lengths, then data):
-        # header [n_pairs, n_fb] | counter deltas [C] | src | dst | delta
-        # | feedback.
-        names = _counter_names()
-        n, nf = len(pairs), len(feedback)
-        vec = np.concatenate([
-            np.asarray([n, nf], dtype=np.int64),
-            np.asarray([self.part.counters.get(x) for x in names],
-                       dtype=np.int64),
-            pairs.src, pairs.dst, pairs.delta.astype(np.int64),
-            feedback.astype(np.int64),
-        ])
-        self.part.counters.replace_all({})
-
-        blocks, fb_l = [], []
-        totals = np.zeros(len(names), dtype=np.int64)
-        for v in _allgather_ragged(vec):
-            pn, pf = int(v[0]), int(v[1])
-            body = v[2 + len(names):]
-            totals += v[2: 2 + len(names)]
-            blocks.append(PairDeltaBatch(
-                body[:pn], body[pn: 2 * pn],
-                body[2 * pn: 3 * pn].astype(np.int32)))
-            fb_l.append(body[3 * pn: 3 * pn + pf])
-        for name, value in zip(names, totals.tolist()):
-            if value:
-                self.counters.add(name, value)
-        return PairDeltaBatch.concat(blocks), np.concatenate(fb_l)
+        per_process = _exchange(
+            self.counters, self.part.counters,
+            [pairs.src, pairs.dst, pairs.delta, feedback])
+        blocks = [PairDeltaBatch(src, dst, delta.astype(np.int32))
+                  for src, dst, delta, _ in per_process]
+        fb = np.concatenate([sec[3] for sec in per_process])
+        return PairDeltaBatch.concat(blocks), fb
 
     # -- checkpoint (fixed global layout; local rows only) ----------------
 
@@ -164,3 +178,72 @@ class ProcessPartitionedSampler:
                     f"this process is {self.pid}/{self.nproc} — restore "
                     f"under the writing run's layout")
         restore_part_state(self.part, st, self.pid, self.nproc, n_users)
+
+
+class ProcessPartitionedSlidingSampler:
+    """Sliding-mode ingest scaling: per-window basket expansion split by
+    user across processes.
+
+    The sliding sampler is stateless, so partitioning is simpler than the
+    reservoir's: the per-window cuts stay replicated (the ITEM cut is a
+    rank over ALL of the window's arrivals — partitioning it by user
+    would change semantics — and both cuts are O(n) counting passes),
+    then each process expands only its users' baskets (the O(pairs) hot
+    part) with cuts disabled, and the blocks + counter deltas ride the
+    same packed allgather as the reservoir path.
+    """
+
+    process_partition = True  # stateless: nothing to checkpoint, but the
+    # marker keeps restore-path expectations uniform
+
+    def __init__(self, item_cut: int, user_cut: int, skip_cuts: bool,
+                 counters: Optional[Counters] = None) -> None:
+        import jax
+
+        from .sliding import SlidingBasketSampler
+
+        self.pid = jax.process_index()
+        self.nproc = jax.process_count()
+        self.item_cut = item_cut
+        self.user_cut = user_cut
+        self.skip_cuts = skip_cuts
+        self.counters = counters if counters is not None else Counters()
+        # Cuts are applied here (replicated) — the expander never cuts.
+        self.expand = SlidingBasketSampler(item_cut, user_cut,
+                                           skip_cuts=True,
+                                           counters=Counters())
+        from ..native import SlidingScratch
+
+        self._cut_scratch = SlidingScratch()
+
+    def _cut(self, users: np.ndarray, items: np.ndarray):
+        """Replicated grouped-rank cuts: one native O(n) counting pass
+        when the library is available, argsort grouped_rank otherwise."""
+        from ..native import sliding_cut_mask
+
+        keep = sliding_cut_mask(users, items, self.item_cut,
+                                self.user_cut, self._cut_scratch)
+        if keep is None:
+            from .item_cut import grouped_rank
+
+            keep = ((grouped_rank(items) < self.item_cut)
+                    & (grouped_rank(users) < self.user_cut))
+        return users[keep], items[keep]
+
+    def fire(self, users: np.ndarray, items: np.ndarray) -> PairDeltaBatch:
+        if len(users) and not self.skip_cuts:
+            users, items = self._cut(users, items)
+        mine = (users % self.nproc) == self.pid
+        pairs = self.expand.fire(users[mine], items[mine])
+        if self.nproc == 1:
+            self.counters.merge(self.expand.counters)
+            self.expand.counters.replace_all({})
+            return pairs
+
+        # Sliding deltas are always +1 — ship only (src, dst) and rebuild
+        # the ones vector locally (a third of the exchange payload saved).
+        per_process = _exchange(self.counters, self.expand.counters,
+                                [pairs.src, pairs.dst])
+        return PairDeltaBatch.concat(
+            [PairDeltaBatch(src, dst, np.ones(len(src), dtype=np.int32))
+             for src, dst in per_process])
