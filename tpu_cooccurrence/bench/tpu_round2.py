@@ -1,0 +1,158 @@
+"""Round-2 TPU measurement pass: every pending on-chip number, one run.
+
+The tunneled chip comes and goes; this script captures all round-2
+TPU-gated measurements in one sitting and appends JSON lines to
+``TPU_ROUND2.jsonl`` at the repo root (one object per measurement, with
+failures recorded rather than aborting the pass):
+
+1. config4-sparse   — the 1M-item Zipfian north star on the sparse
+                      backend (target: >=458k pairs/s = 20x the measured
+                      22.9k host-oracle baseline, BASELINE.md).
+2. config4-hybrid   — the round-1 carrier, for the comparison row.
+3. ml25m-full       — the full 25M-event dense int16 device run +
+                      v5e-8 projection (bench/ml25m.py).
+4. pallas-bench     — --pallas on vs off on the int16 max-vocab shape
+                      (the kernel's earn-or-delete case, VERDICT item 8).
+5. configs          — the five BASELINE.md benchmark configs.
+
+Usage (on a TPU-attached interpreter — no JAX_PLATFORMS override):
+    python -m tpu_cooccurrence.bench.tpu_round2 [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+import traceback
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "TPU_ROUND2.jsonl")
+
+
+def emit(obj: dict) -> None:
+    obj["ts"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    with open(OUT, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+    print(json.dumps(obj), flush=True)
+
+
+def guard(name: str):
+    def deco(fn):
+        def run(*a, **k):
+            start = time.monotonic()
+            try:
+                res = fn(*a, **k)
+                emit({"name": name, "ok": True,
+                      "wall_s": round(time.monotonic() - start, 1), **res})
+            except Exception as exc:  # record and continue the pass
+                emit({"name": name, "ok": False, "error": repr(exc),
+                      "trace": traceback.format_exc()[-1500:]})
+        return run
+    return deco
+
+
+@guard("config4-sparse")
+def config4_sparse(quick: bool) -> dict:
+    from .configs import config4_zipfian_1m
+
+    n = 200_000 if quick else 1_000_000
+    # Warmup populates the jit caches; measure the second run.
+    config4_zipfian_1m(n_events=n)
+    r = config4_zipfian_1m(n_events=n)
+    d = r.as_dict()
+    d["vs_host_baseline_22.9k"] = round(r.pairs_per_sec / 22_900, 2)
+    return d
+
+
+@guard("config4-hybrid")
+def config4_hybrid(quick: bool) -> dict:
+    from ..config import Backend
+    from .configs import config4_zipfian_1m
+
+    n = 200_000 if quick else 1_000_000
+    return config4_zipfian_1m(backend=Backend.HYBRID, n_events=n).as_dict()
+
+
+@guard("ml25m-full")
+def ml25m_full(quick: bool) -> dict:
+    from .ml25m import run_full
+
+    return run_full(2_000_000 if quick else 25_000_000, host_only=False)
+
+
+@guard("pallas-bench")
+def pallas_bench(quick: bool) -> dict:
+    """The kernel's target case: int16 counts at a max-vocab shape, where
+    the XLA path's transient f32 score matrix doubles working HBM."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.device_scorer import _score
+    from ..ops.pallas_score import pallas_score_topk
+
+    num_items = 20_480 if quick else 61_440  # multiple of the 512 tile
+    s = 2048 if quick else 8192
+    top_k = 10
+    rng = np.random.default_rng(0)
+    C = jnp.asarray(rng.integers(0, 50, (num_items, num_items)),
+                    dtype=jnp.int16)
+    row_sums = jnp.asarray(rng.integers(1, 1 << 20, num_items),
+                           dtype=jnp.int32)
+    rows = jnp.asarray(rng.integers(0, num_items, s), dtype=jnp.int32)
+    observed = np.float32(1e9)
+
+    def timeit(fn, n=5):
+        fn()  # compile
+        start = time.monotonic()
+        for _ in range(n):
+            jax.block_until_ready(fn())
+        return (time.monotonic() - start) / n
+
+    xla_s = timeit(lambda: _score(C, row_sums, rows, observed,
+                                  top_k=top_k, packed=True))
+    pl_s = timeit(lambda: pallas_score_topk(C, row_sums, rows, observed,
+                                            top_k=top_k, packed=True))
+    return {"shape": [s, num_items], "count_dtype": "int16",
+            "xla_ms": round(xla_s * 1e3, 2),
+            "pallas_ms": round(pl_s * 1e3, 2),
+            "pallas_speedup": round(xla_s / pl_s, 3)}
+
+
+@guard("configs")
+def all_configs(quick: bool) -> dict:
+    from .configs import run_all
+
+    return {"results": [r.as_dict() for r in run_all()]}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes (tunnel sanity, not headline numbers)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of measurement names")
+    args = ap.parse_args()
+    passes = {
+        "config4-sparse": config4_sparse,
+        "config4-hybrid": config4_hybrid,
+        "ml25m-full": ml25m_full,
+        "pallas-bench": pallas_bench,
+        "configs": all_configs,
+    }
+    only = set(args.only.split(",")) if args.only else None
+    import jax
+
+    emit({"name": "env", "ok": True,
+          "devices": [str(d) for d in jax.devices()],
+          "backend": jax.default_backend(), "quick": args.quick})
+    for name, fn in passes.items():
+        if only is None or name in only:
+            fn(args.quick)
+
+
+if __name__ == "__main__":
+    main()

@@ -95,15 +95,17 @@ class CooccurrenceJob:
                 config.user_cut, config.seed, config.skip_cuts,
                 counters=self.counters)
         self.scorer = scorer if scorer is not None else self._make_scorer()
-        if config.partition_sampling:
+        if config.partition_sampling and not self.sliding:
+            # Sliding mode is exempt: its partitioned sampler is stateless
+            # (nothing partition-distinct ever reaches a checkpoint).
             import jax
 
             if (jax.process_count() > 1
                     and not getattr(self.scorer, "process_suffix", "")):
-                # Partitioned snapshots are per-process-distinct; a backend
-                # without per-process checkpoint files would have every
-                # process clobber the same state.npz (last writer wins,
-                # other partitions' reservoirs unrecoverable).
+                # Partitioned reservoir snapshots are per-process-distinct;
+                # a backend without per-process checkpoint files would have
+                # every process clobber the same state.npz (last writer
+                # wins, other partitions' reservoirs unrecoverable).
                 raise ValueError(
                     "--partition-sampling needs a backend with per-process "
                     "checkpoints: --backend sharded, or sparse with "
