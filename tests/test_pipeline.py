@@ -226,3 +226,25 @@ def test_sharded_int16_counts_match_oracle():
                               num_shards=8, count_dtype="int16"),
                        users, items, ts)
     assert_latest_equal(a.latest, b.latest, tol=dict(rtol=1e-4, atol=1e-4))
+
+
+def test_int16_counts_wrap_like_reference_shorts():
+    """--count-dtype int16 reproduces the reference's silent short
+    overflow (ItemRowAggregator.java:16 accumulates Java shorts): a cell
+    pushed past 32767 wraps negative instead of raising, and the run
+    keeps going."""
+    from tpu_cooccurrence.ops.device_scorer import DeviceScorer
+    from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
+
+    sc = DeviceScorer(8, top_k=2, count_dtype="int16")
+    # 3 windows x 20k on one cell: crosses 32767 -> wraps.
+    n = 20_000
+    batch = PairDeltaBatch(np.zeros(n, np.int64), np.ones(n, np.int64),
+                           np.ones(n, np.int32))
+    for ts in range(3):
+        sc.process_window(ts, batch)
+    sc.flush()
+    c = sc.checkpoint_state()["C"]
+    assert c.dtype == np.int16
+    assert c[0, 1] == 60_000 - 65_536  # wrapped into the negative range
+    assert c[0, 1] < 0
