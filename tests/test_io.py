@@ -145,3 +145,21 @@ def test_midfile_resume_with_shared_mtime(tmp_path):
     src2 = FileMonitorSource(str(tmp_path))
     src2.restore_state(state)
     assert list(src2.lines()) == ["b2", "b3"]
+
+
+def test_parse_lines_fast_path_rejects_divergent_inputs():
+    """The numpy fast parse must not silently accept what the reference's
+    per-line Integer.parseInt would reject (floats, comments, blanks,
+    overflow) — each falls back and raises, or parses identically."""
+    import pytest
+
+    from tpu_cooccurrence.io.parse import parse_lines
+
+    ok_u, ok_i, ok_t = parse_lines(["1,2,3", "-4,5,6"])
+    np.testing.assert_array_equal(ok_u, [1, -4])
+    for bad in (["1.9,2,3"], ["1e3,2,3"], ["#1,2,3"], ["1,2,3", ""],
+                ["1,2"], ["1,2,3,4"]):
+        with pytest.raises(ValueError):
+            parse_lines(bad)
+    with pytest.raises((ValueError, OverflowError)):
+        parse_lines(["99999999999999999999,1,2"])

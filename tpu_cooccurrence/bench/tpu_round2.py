@@ -140,18 +140,14 @@ def pallas_bench(quick: bool) -> dict:
 
 @guard("configs")
 def all_configs(quick: bool) -> dict:
-    from .configs import (config1_tiny_text, config2_ml100k,
-                          config3_ml25m_sliding, config4_zipfian_1m,
-                          config5_instacart)
+    from .configs import ALL_CONFIGS
 
-    results = [config1_tiny_text(), config2_ml100k()]
-    if not quick:
-        # The big configs only in a full pass (config 4 already ran twice
-        # as its own measurement; the tunnel session is the scarce
-        # resource in --quick mode).
-        results += [config3_ml25m_sliding(), config4_zipfian_1m(),
-                    config5_instacart()]
-    return {"results": [r.as_dict() for r in results]}
+    # --quick runs only the two small configs (the tunnel session is the
+    # scarce resource; config 4 already ran as its own measurement).
+    fns = [fn for _name, fn in ALL_CONFIGS]
+    if quick:
+        fns = fns[:2]
+    return {"results": [fn().as_dict() for fn in fns]}
 
 
 def main() -> None:

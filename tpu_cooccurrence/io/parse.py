@@ -9,6 +9,7 @@ arrays — the framework's record unit is a *batch*, not a record.
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -18,7 +19,33 @@ InteractionBatch = Tuple[np.ndarray, np.ndarray, np.ndarray]
 
 
 def parse_lines(lines: Iterable[str]) -> InteractionBatch:
-    """Parse an iterable of ``user,item,ts`` lines into an interaction batch."""
+    """Parse an iterable of ``user,item,ts`` lines into an interaction batch.
+
+    Fast path: numpy's C CSV parser (~7x the Python loop — at the 25M-line
+    scale parsing is otherwise a visible slice of wall-clock). Any parse
+    failure re-runs the Python loop so the raised error keeps the
+    reference's per-line ``String.split`` semantics
+    (``FlinkCooccurrences.java:213-218``), which tests pin.
+    """
+    if not isinstance(lines, list):
+        lines = list(lines)
+    if lines:
+        try:
+            with warnings.catch_warnings():
+                # numpy's parser accepts "1.9"/"1e3"/out-of-range values
+                # for an int dtype via a deprecated float parse (silent
+                # truncation/wraparound); promoting its warning to an
+                # error routes those lines to the strict fallback.
+                warnings.simplefilter("error", DeprecationWarning)
+                arr = np.atleast_2d(np.loadtxt(
+                    lines, delimiter=",", dtype=np.int64, comments=None))
+            # Shape checks: a wrong field count or silently-skipped blank
+            # lines mean the fast parse is not faithful — reject.
+            if arr.shape[1] == 3 and arr.shape[0] == len(lines):
+                return (arr[:, 0].copy(), arr[:, 1].copy(),
+                        arr[:, 2].copy())
+        except (ValueError, DeprecationWarning):
+            pass  # fall through for the parity error (or reject)
     users: List[int] = []
     items: List[int] = []
     tss: List[int] = []
