@@ -2,27 +2,19 @@
 
 The per-feature tests pin behaviors at fixed seeds; this sweep samples
 the config space (tumbling/sliding, cuts on/off and tiny, random top-k,
-random streams) and checks every backend against the float64 oracle:
-identical counters, identical updated-row sets, scores at float32
-tolerance, and ids wherever a position's score is untied — skipping the
-final top-K position, which can legitimately tie with the first
-*excluded* item (invisible to an in-list tie check) and then resolve by
-each backend's documented tie order.
+random streams) and checks every backend against the float64 oracle
+through the shared harness: identical counters and updated-row sets,
+plus ``assert_latest_close``'s score/id protocol (f32-tolerance scores;
+exact ids only for rows whose in-list score gaps dwarf the tolerance,
+final rank excluded — the unseen K+1'th score may near-tie it).
 """
 
 import numpy as np
 import pytest
 
 from tpu_cooccurrence.config import Backend, Config
-from tpu_cooccurrence.job import CooccurrenceJob
 
-
-def _run(cfg, users, items, ts):
-    job = CooccurrenceJob(cfg)
-    job.add_batch(users, items, ts)
-    job.finish()
-    return (dict(job.counters.as_dict()),
-            {i: job.latest[i] for i in job.latest})
+from test_pipeline import assert_latest_close, run_production
 
 
 @pytest.mark.parametrize("trial", range(6))
@@ -46,23 +38,15 @@ def test_randomized_backend_equivalence(trial):
         kw["window_size"] = base * int(rng.integers(2, 5))
         slide = base
 
-    ref_c, ref_r = _run(
+    oracle = run_production(
         Config(backend=Backend.ORACLE, window_slide=slide,
                development_mode=True, **kw), users, items, ts)
+    ref_latest = {i: oracle.latest[i] for i in oracle.latest}
     for backend in ("device", "sparse", "hybrid"):
-        c, r = _run(
+        job = run_production(
             Config(backend=Backend(backend), window_slide=slide,
                    num_items=n_items if backend == "device" else 0,
                    development_mode=True, **kw), users, items, ts)
-        assert c == ref_c, f"{backend} counters"
-        assert set(r) == set(ref_r), f"{backend} row set"
-        for item in ref_r:
-            rv = np.asarray([s for _, s in ref_r[item]])
-            bv = np.asarray([s for _, s in r[item]])
-            assert len(rv) == len(bv), (backend, item)
-            np.testing.assert_allclose(bv, rv, rtol=2e-4, atol=2e-4,
-                                       err_msg=f"{backend} item {item}")
-            for k in range(len(rv) - 1):
-                if np.isclose(rv, rv[k], rtol=1e-5, atol=1e-6).sum() == 1:
-                    assert ref_r[item][k][0] == r[item][k][0], \
-                        f"{backend} item {item} pos {k}"
+        assert job.counters.as_dict() == oracle.counters.as_dict(), backend
+        assert_latest_close(ref_latest,
+                            {i: job.latest[i] for i in job.latest})
