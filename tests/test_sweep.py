@@ -48,5 +48,9 @@ def test_randomized_backend_equivalence(trial):
                    num_items=n_items if backend == "device" else 0,
                    development_mode=True, **kw), users, items, ts)
         assert job.counters.as_dict() == oracle.counters.as_dict(), backend
+        # Tighter-than-default score tolerance (the harness default atol
+        # of 1e-3 is for adversarial row-sum magnitudes; these streams
+        # stay small). The gap-gated id protocol is the safe one.
         assert_latest_close(ref_latest,
-                            {i: job.latest[i] for i in job.latest})
+                            {i: job.latest[i] for i in job.latest},
+                            rtol=2e-4, atol=2e-4)
