@@ -209,3 +209,31 @@ def test_slab_index_fuzz_against_slab_simulation():
             slab = new_slab
             np.testing.assert_array_equal(slab[idx.g_slot], idx.g_key)
     assert idx.compactions > 0, "fuzz never hit the compaction path"
+
+
+@pytest.mark.parametrize("ladder", [2, 4, 16])
+def test_sparse_score_ladder_equivalence(ladder, monkeypatch):
+    """Every bucket-ladder base scores identically (padding is compute
+    only); coarser ladders exist to cut dispatches on high-latency links."""
+    monkeypatch.setenv("TPU_COOC_SCORE_LADDER", str(ladder))
+    users, items, ts = random_stream(5, n=1200, n_items=80)
+    cfg = Config(window_size=20, seed=9, item_cut=8, user_cut=5,
+                 backend=Backend.SPARSE, development_mode=True)
+    job = tiny_scorer_factory(cfg)
+    job.add_batch(users, items, ts)
+    job.finish()
+    assert job.scorer.score_ladder == ladder
+    monkeypatch.delenv("TPU_COOC_SCORE_LADDER")
+    ref_cfg = Config(window_size=20, seed=9, item_cut=8, user_cut=5,
+                     backend=Backend.ORACLE, development_mode=True)
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    ref = CooccurrenceJob(ref_cfg)
+    ref.add_batch(users, items, ts)
+    ref.finish()
+    assert job.counters.as_dict() == ref.counters.as_dict()
+    assert set(job.latest) == set(ref.latest)
+    for item in ref.latest:
+        np.testing.assert_allclose(
+            [s for _, s in job.latest[item]],
+            [s for _, s in ref.latest[item]], rtol=2e-4, atol=2e-4)

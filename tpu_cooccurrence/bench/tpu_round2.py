@@ -58,11 +58,30 @@ def config4_sparse(quick: bool) -> dict:
     from .configs import config4_zipfian_1m
 
     n = 200_000 if quick else 1_000_000
-    # Warmup populates the jit caches; measure the second run.
-    config4_zipfian_1m(n_events=n)
-    r = config4_zipfian_1m(n_events=n)
-    d = r.as_dict()
-    d["vs_host_baseline_22.9k"] = round(r.pairs_per_sec / 22_900, 2)
+    # Score-ladder sweep: pow-4 (tight padding, ~6 dispatches/window) vs
+    # pow-16 (<=16x padded device compute, ~half the dispatches) — on a
+    # high-RTT tunnel the dispatch count can dominate. Warmup populates
+    # the jit caches; measure the second run of each.
+    by_ladder = {}
+    best = None
+    prior = os.environ.get("TPU_COOC_SCORE_LADDER")
+    try:
+        for ladder in ("4", "16"):
+            os.environ["TPU_COOC_SCORE_LADDER"] = ladder
+            config4_zipfian_1m(n_events=n)
+            r = config4_zipfian_1m(n_events=n)
+            by_ladder[ladder] = round(r.pairs_per_sec, 1)
+            if best is None or r.pairs_per_sec > best.pairs_per_sec:
+                best = r
+    finally:
+        # Restore the operator's setting for the remaining passes.
+        if prior is None:
+            os.environ.pop("TPU_COOC_SCORE_LADDER", None)
+        else:
+            os.environ["TPU_COOC_SCORE_LADDER"] = prior
+    d = best.as_dict()
+    d["pairs_per_sec_by_ladder"] = by_ladder
+    d["vs_host_baseline_22.9k"] = round(best.pairs_per_sec / 22_900, 2)
     return d
 
 

@@ -20,8 +20,8 @@ keyed shuffle + broadcast, SURVEY §2.6):
     outright), exactly like the dense sharded backend.
 
 One program per step phase (``shard_map`` under ``jit``), fixed shapes
-via the same pow-4 ladders as the single-device sparse backend, host
-placement decisions per shard. Works identically on a virtual CPU mesh
+via the same configurable score ladders (default pow-4) as the
+single-device sparse backend, host placement decisions per shard. Works identically on a virtual CPU mesh
 and real TPU meshes.
 
 Single-process checkpoints use the canonical sparse-matrix format (global
@@ -36,6 +36,7 @@ process's chips own; restore requires the writing run's process layout.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -55,7 +56,8 @@ from ..ops.device_scorer import pad_pow2, pad_pow4
 from ..sampling.reservoir import PairDeltaBatch
 from ..state.results import TopKBatch
 from ..state.sparse_scorer import (_SENT, SlabIndex, _apply_cells,
-                                   _pow2ceil, _score_rect, score_buckets)
+                                   _pow2ceil, _score_rect, bucket_r,
+                                   ladder_bits, score_buckets)
 from .mesh import ITEM_AXIS, make_mesh
 
 
@@ -70,11 +72,16 @@ class ShardedSparseScorer:
                  development_mode: bool = False,
                  capacity: int = 1 << 14,
                  items_capacity: int = 1 << 10,
-                 compact_min_heap: int = 1 << 16) -> None:
+                 compact_min_heap: int = 1 << 16,
+                 score_ladder: Optional[int] = None) -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
         self.top_k = top_k
+        self.score_ladder = int(score_ladder if score_ladder is not None
+                                else os.environ.get(
+                                    "TPU_COOC_SCORE_LADDER", 4))
+        ladder_bits(self.score_ladder)  # validate at construction
         self.counters = counters if counters is not None else Counters()
         self.development_mode = development_mode
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
@@ -363,14 +370,14 @@ class ShardedSparseScorer:
             starts[sel] = self.indexes[d].row_start[local[sel]]
             lens[sel] = self.indexes[d].row_len[local[sel]]
         min_r = max(16, self.top_k)
-        bucket, order = score_buckets(lens, min_r)
+        bucket, order = score_buckets(lens, min_r, self.score_ladder)
         b_sorted = bucket[order]
         chunks: List[Tuple] = []
         pos = 0
         while pos < len(order):
             b = int(b_sorted[pos])
             end = int(np.searchsorted(b_sorted, b, side="right"))
-            R = min_r << (2 * b)
+            R = bucket_r(b, min_r, self.score_ladder)
             s_block = max(self.SCORE_BUDGET // R, 16)
             members = order[pos:end]
             counts = np.bincount(row_owner[members], minlength=D)

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -176,15 +177,41 @@ def _pow2ceil(x: np.ndarray, minimum: int) -> np.ndarray:
     return (1 << np.ceil(np.log2(v)).astype(np.int64)).astype(np.int32)
 
 
-def score_buckets(lens: np.ndarray, min_r: int):
-    """pow-4 length buckets: bucket b scores rows at ``R = min_r * 4^b``
-    (the smallest b with R >= len). Returns (bucket-per-row, order sorted
+def ladder_bits(ladder: int) -> int:
+    """Validate a score-bucket ladder base (power of two >= 2) and return
+    its log2. The single owner of the ladder contract — scorers validate
+    through this at construction, and :func:`bucket_r` / :func:`score_buckets` share it so bucket rounding and rectangle widths
+    cannot drift apart."""
+    k = ladder.bit_length() - 1
+    if k < 1 or ladder != (1 << k):
+        raise ValueError(
+            f"score ladder must be a power of two >= 2, got {ladder} "
+            f"(TPU_COOC_SCORE_LADDER)")
+    return k
+
+
+def bucket_r(b: int, min_r: int, ladder: int) -> int:
+    """Rectangle width of bucket ``b``: ``min_r * ladder^b``."""
+    return min_r << (ladder_bits(ladder) * b)
+
+
+def score_buckets(lens: np.ndarray, min_r: int, ladder: int = 4):
+    """Length buckets: bucket b scores rows at ``R = bucket_r(b)`` (the
+    smallest b with R >= len). Returns (bucket-per-row, order sorted
     by bucket). Integer math, exact at powers:
     ``shift = ceil(len / 2^floor(log2 min_r)) - 1``;
-    ``b = ceil(log2(shift+1) / 2)`` via frexp's exponent
-    (``frexp(s)[1] = floor(log2 s) + 1``, ``frexp(0) = 0``)."""
+    ``b = ceil(log2(shift+1) / k)`` for ``ladder = 2^k`` via frexp's
+    exponent (``frexp(s)[1] = floor(log2 s) + 1``, ``frexp(0) = 0``).
+
+    The ladder trades padded device compute for dispatch count: pow-4
+    (default) pads rows <=4x and yields ~5-6 dispatches per window on a
+    Zipfian length mix; pow-16 pads <=16x (device-only work) but about
+    halves the dispatches — the better point when every dispatch pays a
+    high-latency link round trip (tunneled chips, remote coordinators).
+    """
+    k = ladder_bits(ladder)
     shift = (np.maximum(lens, 1) - 1) >> (min_r.bit_length() - 1)
-    bucket = (np.frexp(shift.astype(np.float64))[1] + 1) // 2
+    bucket = (np.frexp(shift.astype(np.float64))[1] + k - 1) // k
     return bucket, np.argsort(bucket, kind="stable")
 
 
@@ -382,19 +409,28 @@ class SparseDeviceScorer:
     # Per-score-chunk padded-cell budget. Padding is device compute only —
     # it never crosses the wire in this backend — so the budget is sized
     # for HBM transients ([S, R] gather + scores), not transfer, and the
-    # length ladder is coarse (pow-4): fewer dispatches beats tighter
-    # padding when every dispatch pays tunnel round-trip latency.
+    # length ladder is coarse (default pow-4; TPU_COOC_SCORE_LADDER):
+    # fewer dispatches beats tighter padding when every dispatch pays
+    # tunnel round-trip latency.
     SCORE_BUDGET = 1 << 24
 
     def __init__(self, top_k: int, counters: Optional[Counters] = None,
                  development_mode: bool = False,
                  capacity: int = 1 << 16,
                  items_capacity: int = 1 << 10,
-                 compact_min_heap: int = 1 << 16) -> None:
+                 compact_min_heap: int = 1 << 16,
+                 score_ladder: Optional[int] = None) -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
         self.top_k = top_k
+        # Bucket-ladder base for the scoring dispatches (see score_buckets).
+        # Env-tunable so high-latency links can trade padding for fewer
+        # round trips without a config/API change.
+        self.score_ladder = int(score_ladder if score_ladder is not None
+                                else os.environ.get(
+                                    "TPU_COOC_SCORE_LADDER", 4))
+        ladder_bits(self.score_ladder)  # validate at construction
         self.counters = counters if counters is not None else Counters()
         self.development_mode = development_mode
         self.index = SlabIndex(rows_capacity=items_capacity)
@@ -522,14 +558,14 @@ class SparseDeviceScorer:
         starts = self.index.row_start[rows]
         lens = self.index.row_len[rows]
         min_r = max(16, self.top_k)  # lax.top_k needs k <= R
-        bucket, order = score_buckets(lens, min_r)
+        bucket, order = score_buckets(lens, min_r, self.score_ladder)
         b_sorted = bucket[order]
         chunks: List[Tuple[np.ndarray, int, object]] = []
         pos = 0
         while pos < len(order):
             b = int(b_sorted[pos])
             end = int(np.searchsorted(b_sorted, b, side="right"))
-            R = min_r << (2 * b)
+            R = bucket_r(b, min_r, self.score_ladder)
             s_block = max(self.SCORE_BUDGET // R, 16)
             for lo in range(pos, end, s_block):
                 chunk = order[lo: min(lo + s_block, end)]
