@@ -19,6 +19,12 @@ from .job import CooccurrenceJob
 LOG = logging.getLogger("tpu_cooccurrence")
 
 
+def _render_row(item, top) -> str:
+    """The output row format (stream and final dump share it)."""
+    return f"{item}	" + " ".join(f"{other}:{score:.4f}"
+                                  for other, score in top)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -45,6 +51,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             job.restore(source=source)
             LOG.info("restored checkpoint from %s (windows_fired=%d)",
                      config.checkpoint_dir, job.windows_fired)
+    if config.emit_updates:
+        from .state.results import TopKBatch
+
+        def _stream(window_out) -> None:
+            # One line per updated row, as windows materialize — the
+            # consumable form of the reference's continuous emission into
+            # its sink. on_update fires post-absorption, so job.latest
+            # already holds each row in final (external-id, finite-
+            # filtered) form — one shared renderer with the final dump.
+            if isinstance(window_out, TopKBatch):
+                dense_rows = window_out.rows.tolist()
+            else:
+                dense_rows = [dense for dense, _ in window_out]
+            to_ext = job.item_vocab.to_external
+            for dense in dense_rows:
+                item = to_ext(dense)
+                print(_render_row(item, job.latest[item]),
+                      flush=config.process_continuously)
+
+        job.on_update = _stream
+        if job.windows_fired:
+            # Resumed run: replay the restored state so the stream is
+            # complete (rows not re-updated after the checkpoint would
+            # otherwise never appear).
+            for item in sorted(job.latest):
+                print(_render_row(item, job.latest[item]))
+
     from .observability import xla_trace
 
     with xla_trace(config.profile_dir):
@@ -64,11 +97,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     # Print the latest top-K per item to stdout (the reference's result
     # stream ends in a no-op sink, FlinkCooccurrences.java:169-171; we make
-    # the results consumable instead).
-    for item in sorted(job.latest):
-        top = job.latest[item]
-        rendered = " ".join(f"{other}:{score:.4f}" for other, score in top)
-        print(f"{item}\t{rendered}")
+    # the results consumable instead). With --emit-updates the stream
+    # already carried every update; skip the duplicate final dump.
+    if not config.emit_updates:
+        for item in sorted(job.latest):
+            top = job.latest[item]
+            rendered = " ".join(f"{other}:{score:.4f}" for other, score in top)
+            print(f"{item}\t{rendered}")
     return 0
 
 

@@ -104,6 +104,9 @@ class Config:
     # (reference-style short counts incl. its wraparound, doubles the
     # dense/sharded vocab ceiling)
     development_mode: bool = False  # invariant checks (FlinkCooccurrences.java:34)
+    emit_updates: bool = False  # stream every window's updated top-K rows
+    # to stdout as they materialize (the consumable form of the
+    # reference's continuous sink emission); off = final state only
     process_continuously: bool = False  # PROCESS_ONCE vs PROCESS_CONTINUOUSLY
     # Multi-host (multi-controller JAX): run one process per host, each
     # consuming the same input stream; state shards over all hosts' chips
@@ -227,6 +230,11 @@ class Config:
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")
+        p.add_argument("--emit-updates", action="store_true",
+                       dest="emit_updates",
+                       help="Stream each window's updated top-K rows to "
+                            "stdout as they materialize (instead of one "
+                            "final dump)")
         p.add_argument("--development-mode", action="store_true", dest="development_mode")
         p.add_argument("--process-continuously", action="store_true",
                        dest="process_continuously")

@@ -113,6 +113,11 @@ class CooccurrenceJob:
         # results: external item id -> [(external other, score) desc];
         # array-backed, lazily materialized (state/results.py)
         self.latest = LatestResults(self.item_vocab)
+        # Optional streaming-result hook: called with every materialized
+        # window output (dense-id rows, post-absorption) — the consumable
+        # form of the reference's continuous emission into its sink
+        # (FlinkCooccurrences.java:169-171). None = final-state-only.
+        self.on_update = None
         self.emissions = 0
         self.windows_fired = 0
         self.step_timer = StepTimer()
@@ -288,10 +293,12 @@ class CooccurrenceJob:
         if isinstance(window_out, TopKBatch):
             self.latest.absorb_batch(window_out)
             self.emissions += len(window_out)
-            return
-        for dense_item, top in window_out:
-            self.latest.set_row(dense_item, top)
-            self.emissions += 1
+        else:
+            for dense_item, top in window_out:
+                self.latest.set_row(dense_item, top)
+                self.emissions += 1
+        if self.on_update is not None and len(window_out):
+            self.on_update(window_out)
 
     def checkpoint(self, source=None) -> None:
         from .state import checkpoint as ckpt
