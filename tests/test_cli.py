@@ -149,3 +149,51 @@ def test_cli_emit_updates_replays_restored_state(capsys, tmp_path):
     for line in (l for l in stream.splitlines() if l):
         last[line.split("\t")[0]] = line
     assert sorted(last.values()) == sorted(l for l in final.splitlines() if l)
+
+
+def test_cli_sigkill_resume_bit_identical(tmp_path):
+    """A real crash: SIGKILL the CLI mid-run (after its first periodic
+    checkpoint lands), rerun the same command, and require byte-identical
+    stdout to an uninterrupted run — the fault-tolerance property the
+    reference cannot offer (its rescorer state dies with the JVM)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    f = tmp_path / "in.csv"
+    write_stream(f, n=60_000)
+    ck = tmp_path / "ck"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = [sys.executable, "-m", "tpu_cooccurrence.cli", "-i", str(f),
+            "-ws", "20", "-ic", "8", "-uc", "5", "-s", "0xC0FFEE",
+            "--backend", "oracle", "--checkpoint-dir", str(ck),
+            "--checkpoint-every-windows", "5"]
+
+    clean = subprocess.run(args[:-4] + ["--checkpoint-dir",
+                                        str(tmp_path / "ck-clean"),
+                                        "--checkpoint-every-windows", "5"],
+                           capture_output=True, text=True, env=env,
+                           cwd=repo, timeout=300)
+    assert clean.returncode == 0, clean.stderr[-800:]
+
+    victim = subprocess.Popen(args, stdout=subprocess.PIPE,
+                              stderr=subprocess.DEVNULL, env=env, cwd=repo)
+    state = ck / "state.npz"
+    deadline = time.monotonic() + 240
+    while not state.exists() and time.monotonic() < deadline:
+        if victim.poll() is not None:
+            break
+        time.sleep(0.05)
+    if victim.poll() is None:
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=60)
+        assert victim.returncode == -signal.SIGKILL
+    assert state.exists(), "no checkpoint landed before the run ended"
+
+    resumed = subprocess.run(args, capture_output=True, text=True, env=env,
+                             cwd=repo, timeout=300)
+    assert resumed.returncode == 0, resumed.stderr[-800:]
+    assert resumed.stdout == clean.stdout

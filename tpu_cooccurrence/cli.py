@@ -76,7 +76,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # complete (rows not re-updated after the checkpoint would
             # otherwise never appear).
             for item in sorted(job.latest):
-                print(_render_row(item, job.latest[item]))
+                print(_render_row(item, job.latest[item]),
+                      flush=config.process_continuously)
 
     from .observability import xla_trace
 
@@ -101,9 +102,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # already carried every update; skip the duplicate final dump.
     if not config.emit_updates:
         for item in sorted(job.latest):
-            top = job.latest[item]
-            rendered = " ".join(f"{other}:{score:.4f}" for other, score in top)
-            print(f"{item}\t{rendered}")
+            print(_render_row(item, job.latest[item]))
     return 0
 
 
