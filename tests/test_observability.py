@@ -1,0 +1,78 @@
+"""Step timing, slow-window ranking, and the XLA trace wrapper."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.observability import (StepTimer, WindowStats, clock,
+                                            xla_trace)
+
+
+def stats(ts, sample, score, events=10, pairs=20, rows=5):
+    return WindowStats(timestamp=ts, events=events, pairs=pairs,
+                       rows_scored=rows, sample_seconds=sample,
+                       score_seconds=score)
+
+
+def test_step_timer_summary_aggregates():
+    t = StepTimer()
+    t.record(stats(0, 0.25, 0.75, events=100, pairs=1000))
+    t.record(stats(1, 0.5, 0.5, events=50, pairs=500))
+    s = t.summary()
+    assert s["windows"] == 2 and s["events"] == 150 and s["pairs"] == 1500
+    assert s["sample_seconds"] == pytest.approx(0.75)
+    assert s["score_seconds"] == pytest.approx(1.25)
+    assert s["pairs_per_sec"] == pytest.approx(750.0)
+    assert StepTimer().summary()["pairs_per_sec"] == 0.0  # no div-by-zero
+
+
+def test_step_timer_slowest_ranks_and_ring_bounds():
+    t = StepTimer(keep=4)
+    for i, dur in enumerate([0.1, 0.9, 0.2, 0.8, 0.3]):  # 0.1 evicted
+        t.record(stats(i, dur, 0.0))
+    slow = t.slowest(2)
+    assert [w.timestamp for w in slow] == [1, 3]
+    assert t.total_windows == 5 and len(t.windows) == 4
+
+
+def test_xla_trace_writes_profile(tmp_path):
+    """--profile-dir produces an on-disk trace consumable by TensorBoard."""
+    import jax.numpy as jnp
+
+    out = str(tmp_path / "trace")
+    with xla_trace(out):
+        jnp.arange(8).sum().block_until_ready()
+    found = [os.path.join(r, f) for r, _, fs in os.walk(out) for f in fs]
+    assert found, "no trace files written"
+
+
+def test_xla_trace_none_is_noop():
+    with xla_trace(None):
+        pass
+
+
+def test_clock_measures():
+    import time
+
+    with clock() as c:
+        time.sleep(0.01)
+    assert c.seconds >= 0.009
+
+
+def test_job_records_step_timing():
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    rng = np.random.default_rng(3)
+    users = rng.integers(0, 10, 500).astype(np.int64)
+    items = rng.integers(0, 30, 500).astype(np.int64)
+    ts = np.cumsum(rng.integers(0, 2, 500)).astype(np.int64)
+    job = CooccurrenceJob(Config(window_size=20, seed=1,
+                                 backend=Backend.ORACLE))
+    job.add_batch(users, items, ts)
+    job.finish()
+    s = job.step_timer.summary()
+    assert s["windows"] == job.windows_fired > 0
+    assert s["pairs"] > 0
+    assert job.step_timer.slowest(1)
