@@ -119,7 +119,7 @@ def test_restore_across_vocab_padding_change(tmp_path):
     from tpu_cooccurrence.ops.device_scorer import DeviceScorer
 
     rng = np.random.default_rng(5)
-    padded = DeviceScorer(40, 5, use_pallas="on")       # pads 40 -> 512
+    padded = DeviceScorer(40, 5, use_pallas="on")       # pads 40 -> tile
     assert padded.num_items > 40
     import jax.numpy as jnp
 

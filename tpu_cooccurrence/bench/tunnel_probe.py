@@ -1,0 +1,100 @@
+"""Tunnel/dispatch microbenchmark: where does a window's wall time go?
+
+On a tunneled single chip (axon) every dispatch, host->device transfer,
+and device->host fetch may pay link latency. This probe separates:
+
+1. enqueue cost    — is dispatch async (returns before completion)?
+2. dispatch RTT    — serialized tiny kernels, one blocking sync at end
+3. upload cost     — numpy -> device transfer of window-sized buffers
+4. fetch RTT       — device -> host of a top-K-result-sized buffer
+5. async fetch     — copy_to_host_async overlap effectiveness
+
+Prints one JSON object. Run on the TPU-attached interpreter:
+    python -m tpu_cooccurrence.bench.tunnel_probe
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    out = {"devices": [str(d) for d in jax.devices()],
+           "backend": jax.default_backend()}
+
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    x = jnp.zeros((8,), jnp.int32)
+    tiny(x).block_until_ready()  # compile
+
+    # 1+2: enqueue vs completion of N chained tiny kernels.
+    n = 50
+    start = time.monotonic()
+    y = x
+    for _ in range(n):
+        y = tiny(y)
+    enqueue_s = time.monotonic() - start
+    y.block_until_ready()
+    chain_s = time.monotonic() - start
+    out["enqueue_ms_per_dispatch"] = round(enqueue_s / n * 1e3, 3)
+    out["chained_ms_per_dispatch"] = round(chain_s / n * 1e3, 3)
+
+    # 2b: serialized round trips — block after EVERY tiny kernel.
+    start = time.monotonic()
+    y = x
+    for _ in range(n):
+        y = tiny(y).block_until_ready()
+    out["sync_ms_per_dispatch"] = round(
+        (time.monotonic() - start) / n * 1e3, 3)
+
+    # 3: upload of a window-sized packed update buffer (256 KB, 1 MB).
+    for kb in (256, 1024):
+        buf = np.zeros((2, kb * 128), dtype=np.int32)  # kb KiB total
+
+        @jax.jit
+        def consume(b):
+            return b.sum()
+
+        consume(jnp.asarray(buf)).block_until_ready()
+        reps = 10
+        start = time.monotonic()
+        for _ in range(reps):
+            consume(jnp.asarray(buf)).block_until_ready()
+        out[f"upload_{kb}kb_ms"] = round(
+            (time.monotonic() - start) / reps * 1e3, 2)
+
+    # 4: blocking fetch of a packed [2, 4096, 10] f32 result (~320 KB).
+    res = jnp.ones((2, 4096, 10), jnp.float32)
+    res.block_until_ready()
+    reps = 10
+    start = time.monotonic()
+    for _ in range(reps):
+        np.asarray(res)
+    out["fetch_320kb_ms"] = round((time.monotonic() - start) / reps * 1e3, 2)
+
+    # 5: async-copy overlap — start copy, do 50 ms of host work, then fetch.
+    start = time.monotonic()
+    for _ in range(reps):
+        r2 = tiny(res)
+        if hasattr(r2, "copy_to_host_async"):
+            r2.copy_to_host_async()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 0.05:
+            pass
+        np.asarray(r2)
+    out["fetch_320kb_after_50ms_host_work_ms"] = round(
+        (time.monotonic() - start) / reps * 1e3 - 50, 2)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

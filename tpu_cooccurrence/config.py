@@ -98,8 +98,9 @@ class Config:
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
-    pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = off:
-    # measured slower than XLA's fused path on current TPUs, see device_scorer)
+    pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = on
+    # for int16 counts on a real TPU where it wins 247x, off otherwise —
+    # measured, see ops/device_scorer.pallas_auto)
     count_dtype: str = "int32"  # dense C cell dtype; int16 halves HBM
     # (reference-style short counts incl. its wraparound, doubles the
     # dense/sharded vocab ceiling)
@@ -220,8 +221,8 @@ class Config:
                        help="Write a jax.profiler trace for TensorBoard")
         p.add_argument("--pallas", choices=["auto", "on", "off"],
                        default="auto",
-                       help="Fused Pallas score/top-K kernel (auto: off — XLA path "
-                            "measured faster on current TPUs)")
+                       help="Fused Pallas score/top-K kernel (auto: on for "
+                            "int16 counts on TPU, off otherwise — measured)")
         p.add_argument("--count-dtype", choices=["int32", "int16"],
                        default="int32", dest="count_dtype",
                        help="Dense count-matrix cell dtype (int16 halves "

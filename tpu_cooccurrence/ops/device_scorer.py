@@ -69,6 +69,25 @@ def pad_pow4(n: int, minimum: int = 256) -> int:
     return size
 
 
+def pallas_auto(count_dtype: np.dtype, backend: str, top_k: int = 1) -> bool:
+    """Default kernel choice for ``--pallas auto``, from on-chip measurement.
+
+    int16 counts on a real TPU: the fused Pallas scorer, decisively — the
+    XLA gather+LLR+top_k path collapses at int16 (44.3s vs the kernel's
+    0.18s on [8192, 61440], a 247x gap; TPU_ROUND2.jsonl pallas-bench,
+    v5e). int32: XLA, which wins ~5x there (23ms vs 120ms on
+    [8192, 20480] — lax.top_k lowers to an efficient built-in selection
+    while the in-kernel merge is VPU-sequential per tile). Off-TPU the
+    kernel only runs interpreted (test/debug), never by default. A
+    ``top_k`` beyond the kernel's output lane width falls back to XLA
+    (explicit ``--pallas on`` still reports the hard limit instead).
+    """
+    from .pallas_score import _K_PAD
+
+    return (backend == "tpu" and np.dtype(count_dtype).itemsize == 2
+            and top_k <= _K_PAD)
+
+
 def score_row_budget(num_items: int, cap: int) -> int:
     """Rows per score call keeping the [S, I] working set ≲ 1 GB int32.
 
@@ -173,7 +192,12 @@ def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
 class DeviceScorer:
     """Dense sharless device backend over a fixed item-vocab capacity."""
 
-    PALLAS_TILE = 512
+    # Column-tile width for the fused kernel. Swept on-chip at the int16
+    # max-vocab shape (TPU_ROUND2.jsonl pallas-bench, [8192, 61440]):
+    # 2048 -> 179ms, 1024 -> 224ms, 512 -> 300ms — wider tiles amortize
+    # the sequential top-K merge, and the (16, 2048) int16 block is still
+    # far under VMEM.
+    PALLAS_TILE = 2048
 
     def __init__(self, num_items: int, top_k: int,
                  counters: Optional[Counters] = None,
@@ -193,13 +217,18 @@ class DeviceScorer:
         self._max_score_rows_cap = max_score_rows_per_call
         self.max_pairs_per_step = max_pairs_per_step
         if use_pallas == "auto":
-            # Measured on the current v5e generation, XLA's fused
-            # gather+LLR+top_k beats the hand-rolled Pallas fold ~5x
-            # (23ms vs 120ms for [8192, 20480]): lax.top_k lowers to an
-            # efficient built-in selection while the in-kernel merge is
-            # VPU-sequential per tile. The kernel stays available for
-            # study/opt-in via --pallas on.
-            self.use_pallas = False
+            self.use_pallas = pallas_auto(self.count_dtype,
+                                          jax.default_backend(), top_k)
+            if (not self.use_pallas
+                    and pallas_auto(self.count_dtype,
+                                    jax.default_backend())):
+                import logging
+
+                logging.getLogger("tpu_cooccurrence").warning(
+                    "--top-k %d exceeds the fused kernel's %d-lane output; "
+                    "falling back to the XLA scorer, which is much slower "
+                    "at int16 counts (measured 247x, TPU_ROUND2.jsonl)",
+                    top_k, 128)
         else:
             self.use_pallas = use_pallas == "on"
         # Off-TPU the kernel can only run interpreted (test/debug use).
