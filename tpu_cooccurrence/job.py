@@ -171,8 +171,14 @@ class CooccurrenceJob:
                     "--num-shards > 1 (the sharded-sparse mesh)")
             from .state.sparse_scorer import SparseDeviceScorer
 
+            # Final-state consumption (no --emit-updates): keep results in
+            # a device-resident table and fetch once at flush — per-window
+            # result transfer drops to zero (the dominant wall cost of
+            # large windows on a high-latency link). Streaming consumers
+            # keep the per-window pipeline.
             return SparseDeviceScorer(self.config.top_k, self.counters,
-                                      self.config.development_mode)
+                                      self.config.development_mode,
+                                      defer_results=not self.config.emit_updates)
         if backend == Backend.SHARDED:
             from .parallel.sharded import ShardedScorer
 
@@ -213,7 +219,8 @@ class CooccurrenceJob:
         """End of stream — Watermark(MAX_VALUE) fires everything."""
         self._drain(final=True)
         if (self.config.development_mode
-                and not getattr(self.scorer, "process_suffix", "")):
+                and not getattr(self.scorer, "process_suffix", "")
+                and not getattr(self.scorer, "defer_results", False)):
             # Pipeline-drain invariant (the moral equivalent of the
             # reference's buffered-element balance counters,
             # UserInteractionCounterOneInputStreamOperator.java:134-137):
@@ -222,6 +229,10 @@ class CooccurrenceJob:
             # emits an in-flight window shows up as a mismatch here.
             # Multi-host processes are exempt: each materializes only the
             # rows its chips own while the dispatch counter sees all rows.
+            # Deferred-results backends are exempt too: the scatter into
+            # the device table rides the same dispatch as the scoring (no
+            # separate pipeline to lose), and a row rescored in N windows
+            # materializes once from the table, not N times.
             from .metrics import RESCORED_ITEMS
 
             rescored = self.counters.get(RESCORED_ITEMS)

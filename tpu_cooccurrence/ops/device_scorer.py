@@ -224,11 +224,13 @@ class DeviceScorer:
                                     jax.default_backend())):
                 import logging
 
+                from .pallas_score import _K_PAD
+
                 logging.getLogger("tpu_cooccurrence").warning(
                     "--top-k %d exceeds the fused kernel's %d-lane output; "
                     "falling back to the XLA scorer, which is much slower "
                     "at int16 counts (measured 247x, TPU_ROUND2.jsonl)",
-                    top_k, 128)
+                    top_k, _K_PAD)
         else:
             self.use_pallas = use_pallas == "on"
         # Off-TPU the kernel can only run interpreted (test/debug use).

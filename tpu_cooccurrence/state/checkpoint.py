@@ -24,6 +24,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..metrics import RESCORED_ITEMS
+
 
 def exists(job, directory: str) -> bool:
     """True when ``directory`` holds a checkpoint this job could restore
@@ -46,7 +48,14 @@ def save(job, directory: str, source=None) -> str:
         "window_slide": job.config.window_slide,
         "window_millis": job.config.window_millis,
         "windows_fired": job.windows_fired,
-        "emissions": job.emissions,
+        # A deferred-results scorer materializes each row once from its
+        # device table however many windows rescored it, so its emission
+        # count is not comparable with the rescored-rows counter; record
+        # the counter instead so a resume onto a per-window backend starts
+        # its drain invariant balanced.
+        "emissions": (job.counters.get(RESCORED_ITEMS)
+                      if getattr(job.scorer, "defer_results", False)
+                      else job.emissions),
         "max_ts_seen": job.engine.max_ts_seen,
         "counters": job.counters.as_dict(),
     }

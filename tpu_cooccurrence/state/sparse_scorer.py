@@ -158,6 +158,25 @@ _score_slab = functools.partial(jax.jit, static_argnames=("top_k", "R"))(
     _score_rect)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("top_k", "R"))
+def _score_into_table(tbl, cnt, dst, row_sums, meta, observed, *,
+                      top_k: int, R: int):
+    """Score one length bucket and scatter the packed result straight into
+    the device-resident latest-results table (``[2, items_cap, K]``) —
+    nothing returns to the host. The deferred-results mode's whole point:
+    on a high-latency link the per-window result downlink (tens of MB on
+    large windows) disappears; the host fetches the table once at flush.
+    """
+    packed = _score_rect(cnt, dst, row_sums, meta, observed, top_k, R)
+    rowids = jnp.where(meta[2] > 0, meta[0], _SENT)
+    return tbl.at[:, rowids].set(packed, mode="drop")
+
+
+@jax.jit
+def _gather_table(tbl, rows):
+    return tbl[:, rows]
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def _grow(arr, n: int):
     # No donation: the output is a different buffer size, so XLA could
@@ -419,7 +438,8 @@ class SparseDeviceScorer:
                  capacity: int = 1 << 16,
                  items_capacity: int = 1 << 10,
                  compact_min_heap: int = 1 << 16,
-                 score_ladder: Optional[int] = None) -> None:
+                 score_ladder: Optional[int] = None,
+                 defer_results: bool = False) -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -445,6 +465,20 @@ class SparseDeviceScorer:
         # One-window-deep result pipeline (see ops/device_scorer.py).
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
+        # Deferred-results mode: each score dispatch scatters its top-K
+        # into a device-resident [2, items_cap, K] table instead of
+        # returning it; ``flush()`` fetches the table's touched rows once.
+        # This is the final-state consumption mode (no --emit-updates):
+        # per-window result transfer drops to zero, which on a tunneled
+        # chip / DCN link is most of a large window's wall time. The
+        # reference has no analogue (its sink is a no-op, results ride the
+        # accumulator dump — FlinkCooccurrences.java:169-181).
+        self.defer_results = bool(defer_results)
+        self._table = None
+        # Rows scattered since the last flush. Flush fetches only these
+        # (and clears the set), so periodic checkpoints stay incremental —
+        # rows fetched earlier persist in the job's LatestResults.
+        self._table_dirty = np.zeros(self.items_cap, dtype=bool)
 
     # Back-compat introspection used by tests.
     @property
@@ -468,6 +502,13 @@ class SparseDeviceScorer:
         self.row_sums_host = grown
         self.row_sums = _grow(self.row_sums, n=new_cap)
         self.items_cap = new_cap
+        mask = np.zeros(new_cap, dtype=bool)
+        mask[: len(self._table_dirty)] = self._table_dirty
+        self._table_dirty = mask
+        if self._table is not None:
+            old = self._table
+            self._table = jnp.full((2, new_cap, self.top_k), -jnp.inf,
+                                   jnp.float32).at[:, : old.shape[1]].set(old)
 
     def _ensure_heap(self, need_end: int) -> None:
         if need_end <= self.capacity:
@@ -484,6 +525,10 @@ class SparseDeviceScorer:
     def process_window(self, ts: int, pairs: PairDeltaBatch):
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
+            if self.defer_results:
+                # Nothing in flight, and a flush here would fetch the whole
+                # table; results wait for the end-of-stream flush.
+                return TopKBatch.empty(self.top_k)
             # No new dispatch — drain any completed in-flight results now.
             return self.flush()
         # Reclaim freed slab regions once they dominate the heap. Runs
@@ -560,6 +605,9 @@ class SparseDeviceScorer:
         min_r = max(16, self.top_k)  # lax.top_k needs k <= R
         bucket, order = score_buckets(lens, min_r, self.score_ladder)
         b_sorted = bucket[order]
+        if self.defer_results and self._table is None:
+            self._table = jnp.full((2, self.items_cap, self.top_k),
+                                   -jnp.inf, jnp.float32)
         chunks: List[Tuple[np.ndarray, int, object]] = []
         pos = 0
         while pos < len(order):
@@ -578,6 +626,12 @@ class SparseDeviceScorer:
                 meta[0, :s] = rows[chunk]
                 meta[1, :s] = starts[chunk]
                 meta[2, :s] = lens[chunk]
+                if self.defer_results:
+                    self._table = _score_into_table(
+                        self._table, self.cnt, self.dst, self.row_sums,
+                        meta, np.float32(self.observed),
+                        top_k=self.top_k, R=R)
+                    continue
                 packed = _score_slab(self.cnt, self.dst, self.row_sums,
                                      meta, np.float32(self.observed),
                                      top_k=self.top_k, R=R)
@@ -585,6 +639,8 @@ class SparseDeviceScorer:
                     packed.copy_to_host_async()
                 chunks.append((rows[chunk], s, packed))
             pos = end
+        if self.defer_results:
+            self._table_dirty[rows] = True
         return chunks
 
     def _check_row_sums(self, rows: np.ndarray) -> None:
@@ -603,6 +659,23 @@ class SparseDeviceScorer:
     # -- results ----------------------------------------------------------
 
     def flush(self) -> TopKBatch:
+        if self.defer_results:
+            # Incremental drain: fetch only the rows scattered since the
+            # last flush, in one device gather — exact bytes, no
+            # slab-capacity padding on the wire. Earlier rows persist in
+            # the caller's LatestResults, so periodic checkpoints cost
+            # O(rows since last checkpoint), not O(all rows ever scored).
+            rows = np.flatnonzero(self._table_dirty)
+            if self._table is None or len(rows) == 0:
+                return TopKBatch.empty(self.top_k)
+            self._table_dirty[rows] = False
+            n = len(rows)
+            rows_pad = np.zeros(pad_pow2(n, minimum=16), np.int32)
+            rows_pad[:n] = rows
+            host = np.asarray(_gather_table(self._table,
+                                            jnp.asarray(rows_pad)))
+            return TopKBatch(rows.astype(np.int32),
+                             host[1, :n].view(np.int32), host[0, :n])
         prev, self._pending = self._pending, None
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
@@ -669,3 +742,8 @@ class SparseDeviceScorer:
         self.observed = int(st["observed"][0])
         # In-flight results belong to windows after the checkpoint.
         self._pending = None
+        # Deferred table restarts empty: rows materialized before the
+        # checkpoint already live in the job's LatestResults (the job
+        # flushes before every save); post-restore windows repopulate it.
+        self._table = None
+        self._table_dirty = np.zeros(self.items_cap, dtype=bool)
