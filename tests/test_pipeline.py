@@ -248,3 +248,61 @@ def test_int16_counts_wrap_like_reference_shorts():
     assert c.dtype == np.int16
     assert c[0, 1] == 60_000 - 65_536  # wrapped into the negative range
     assert c[0, 1] < 0
+
+
+def test_device_deferred_matches_pipelined():
+    """Dense-backend deferred-results mode (job default without
+    --emit-updates) matches the per-window pipeline's final state, for
+    both count dtypes and the pallas-on path."""
+    import jax.numpy as jnp
+
+    from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.ops.device_scorer import DeviceScorer
+
+    kw = dict(window_size=10, seed=0xD3, item_cut=5, user_cut=4,
+              num_items=40, development_mode=True)
+    users, items, ts = random_stream(43, n=1200)
+
+    def run(defer, **scorer_kw):
+        cfg = Config(**kw, backend=Backend.DEVICE)
+        scorer = DeviceScorer(cfg.num_items, cfg.top_k,
+                              defer_results=defer, **scorer_kw)
+        job = CooccurrenceJob(cfg, scorer=scorer)
+        scorer.counters = job.counters
+        emitted = []
+        job.on_update = lambda batch: emitted.append(len(batch))
+        job.add_batch(users, items, ts)
+        mid = list(emitted)
+        job.finish()
+        return job, mid
+
+    piped, mid_p = run(False)
+    assert sum(mid_p) > 0
+    for scorer_kw in (dict(), dict(count_dtype="int16"),
+                      dict(count_dtype="int16", use_pallas="on")):
+        deferred, mid_d = run(True, **scorer_kw)
+        assert mid_d == []
+        assert_latest_close(piped.latest, deferred.latest,
+                            rtol=1e-4, atol=1e-4)
+
+
+def test_device_deferred_auto_capacity_growth():
+    """Deferred table survives dense auto-capacity re-allocation
+    (--num-items omitted): rows scored before the growth keep their
+    entries."""
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    kw = dict(window_size=10, seed=0xD4, skip_cuts=True,
+              development_mode=True)
+    users, items, ts = random_stream(47, n=2500, n_items=1500)
+    a = run_production(Config(**kw, backend=Backend.ORACLE),
+                       users, items, ts)
+    cfg = Config(**kw, backend=Backend.DEVICE)  # num_items=0: derive
+    b = CooccurrenceJob(cfg)
+    assert b.scorer.defer_results
+    for lo in range(0, len(users), 500):
+        b.add_batch(users[lo:lo + 500], items[lo:lo + 500],
+                    ts[lo:lo + 500])
+    b.finish()
+    assert b.scorer.num_items > 1024  # growth actually happened
+    assert_latest_close(a.latest, b.latest)

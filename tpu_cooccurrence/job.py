@@ -143,10 +143,12 @@ class CooccurrenceJob:
             # doubles its dense C on growth); an explicit value is a hard
             # capacity check, enforced in add_batch.
             num_items = self.config.num_items
+            # defer_results: see the sparse branch below.
             return DeviceScorer(num_items, self.config.top_k, self.counters,
                                 max_pairs_per_step=self.config.max_pairs_per_step,
                                 use_pallas=self.config.pallas,
-                                count_dtype=self.config.count_dtype)
+                                count_dtype=self.config.count_dtype,
+                                defer_results=not self.config.emit_updates)
         if backend == Backend.HYBRID:
             from .state.hybrid_scorer import HybridScorer
 

@@ -189,6 +189,99 @@ def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
     return vals, idx
 
 
+# Result-table scatter sentinel for padded score rows: >= any vocab
+# capacity, dropped by mode="drop". Padded rows may not scatter under
+# their gather stand-in (row 0) — that would overwrite item 0's entry
+# with scores from a *later* matrix state than its last real emission.
+_SENT_ROW = np.int32(2**31 - 1)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_packed(tbl, packed, scatter_rows):
+    return tbl.at[:, scatter_rows].set(packed, mode="drop")
+
+
+@jax.jit
+def _gather_packed(tbl, rows):
+    return tbl[:, rows]
+
+
+class DeferredResultsTable:
+    """Device-resident latest-results table for deferred-results scorers.
+
+    Final-state consumption mode (no ``--emit-updates``): each window's
+    score dispatch scatters its packed ``[2, S_pad, K]`` top-K block into
+    ``tbl`` (``[2, items_cap, K]`` float32 on device) instead of
+    returning it to the host; :meth:`drain` fetches only the rows
+    scattered since the last drain, in one exact-bytes gather. Per-window
+    result downlink drops to zero — on a high-latency link the dominant
+    wall cost of large windows. Shared by the dense and sparse scorers;
+    the sparse scorer fuses the scatter into its scoring jit and so
+    reassigns :attr:`tbl` directly (it is donated there).
+
+    The caller owns — and must absorb — every drained row: rows fetched
+    earlier persist in the job's ``LatestResults``, which keeps periodic
+    checkpoints incremental (O(rows since last drain), not O(all rows)).
+    """
+
+    def __init__(self, top_k: int, items_cap: int) -> None:
+        self.top_k = top_k
+        self.tbl = None  # lazy: allocated at the first scoring dispatch
+        self.dirty = np.zeros(items_cap, dtype=bool)
+
+    def resize(self, items_cap: int) -> None:
+        """Track a vocab-capacity change, preserving entries and marks."""
+        m = min(items_cap, len(self.dirty))
+        dirty = np.zeros(items_cap, dtype=bool)
+        dirty[:m] = self.dirty[:m]
+        self.dirty = dirty
+        if self.tbl is not None and self.tbl.shape[1] != items_cap:
+            old = self.tbl
+            self.tbl = jnp.full((2, items_cap, self.top_k), -jnp.inf,
+                                jnp.float32).at[:, :m].set(old[:, :m])
+
+    def ensure(self) -> None:
+        """Allocate the device table (before a window's first scatter)."""
+        if self.tbl is None:
+            self.tbl = jnp.full((2, len(self.dirty), self.top_k),
+                                -jnp.inf, jnp.float32)
+
+    def scatter(self, packed, scatter_rows: np.ndarray) -> None:
+        """Scatter one packed block; padded entries must carry a sentinel
+        index (``_SENT_ROW``), not their row-0 gather stand-in."""
+        self.tbl = _scatter_packed(self.tbl, packed,
+                                   jnp.asarray(scatter_rows))
+
+    def mark(self, rows: np.ndarray) -> None:
+        self.dirty[rows] = True
+
+    def drain(self, float_ids: bool = False):
+        """Fetch rows scored since the last drain as a :class:`TopKBatch`.
+
+        ``float_ids``: ids were packed as float *values* (the Pallas
+        kernel's encoding) rather than an int32 bitcast.
+        """
+        from ..state.results import TopKBatch
+
+        rows = np.flatnonzero(self.dirty)
+        if self.tbl is None or len(rows) == 0:
+            return TopKBatch.empty(self.top_k)
+        self.dirty[rows] = False
+        n = len(rows)
+        rows_pad = np.zeros(pad_pow2(n, minimum=16), np.int32)
+        rows_pad[:n] = rows
+        host = np.asarray(_gather_packed(self.tbl, jnp.asarray(rows_pad)))
+        idx = (host[1, :n].astype(np.int32) if float_ids
+               else host[1, :n].view(np.int32))
+        return TopKBatch(rows.astype(np.int32), idx, host[0, :n])
+
+    def reset(self, items_cap: int) -> None:
+        """Restart empty (restore path: pre-checkpoint rows already live
+        in the job's LatestResults, flushed before every save)."""
+        self.tbl = None
+        self.dirty = np.zeros(items_cap, dtype=bool)
+
+
 class DeviceScorer:
     """Dense sharless device backend over a fixed item-vocab capacity."""
 
@@ -205,7 +298,8 @@ class DeviceScorer:
                  max_pairs_per_step: int = 1 << 20,
                  use_pallas: str = "auto",
                  count_dtype: str = "int32",
-                 device=None) -> None:
+                 device=None,
+                 defer_results: bool = False) -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -268,6 +362,11 @@ class DeviceScorer:
         # returns the final in-flight window.
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
+        # Deferred-results mode (final-state consumption, no streaming):
+        # see DeferredResultsTable.
+        self.defer_results = bool(defer_results)
+        self._results = (DeferredResultsTable(top_k, self.num_items)
+                         if self.defer_results else None)
 
     def _ensure_capacity(self, max_id: int) -> None:
         if max_id < self.num_items:
@@ -282,10 +381,15 @@ class DeviceScorer:
         self.C, self.row_sums = _grow_dense(self.C, self.row_sums, n=n)
         self.num_items = self.num_items_logical = n
         self.max_score_rows = score_row_budget(n, self._max_score_rows_cap)
+        if self._results is not None:
+            self._results.resize(n)
 
     def process_window(self, ts: int, pairs: PairDeltaBatch) -> TopKBatch:
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
+            if self.defer_results:
+                # Nothing in flight; results wait for the final flush.
+                return TopKBatch.empty(self.top_k)
             # No new dispatch this window — drain any completed in-flight
             # results now instead of withholding them behind idle windows.
             return self.flush()
@@ -333,6 +437,8 @@ class DeviceScorer:
         rows = distinct_sorted(src)
         self.counters.add(RESCORED_ITEMS, len(rows))
         self.last_dispatched_rows = len(rows)
+        if self.defer_results:
+            self._results.ensure()
         chunks: List[Tuple[np.ndarray, int, object]] = []
         for lo in range(0, len(rows), self.max_score_rows):
             chunk = rows[lo: lo + self.max_score_rows]
@@ -352,15 +458,31 @@ class DeviceScorer:
                 packed = _score(self.C, self.row_sums, rows_padded,
                                 np.float32(self.observed), top_k=self.top_k,
                                 packed=True)
+            if self.defer_results:
+                # Padded entries gather row 0 but must NOT scatter there.
+                scatter_rows = np.full(pad_s, _SENT_ROW, dtype=np.int32)
+                scatter_rows[:s] = chunk
+                self._results.scatter(packed, scatter_rows)
+                continue
             if hasattr(packed, "copy_to_host_async"):
                 packed.copy_to_host_async()
             chunks.append((chunk, s, packed))
+        if self.defer_results:
+            self._results.mark(rows)
+            return TopKBatch.empty(self.top_k)
         prev, self._pending = self._pending, chunks
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
 
     def flush(self) -> TopKBatch:
-        """Emit the final in-flight window's results (end of pipeline)."""
+        """Emit the final in-flight window's results (end of pipeline).
+
+        Deferred mode: drain rows scored since the last flush from the
+        device table in one exact-bytes gather (the caller owns — and must
+        absorb — the returned rows; see SparseDeviceScorer.flush)."""
+        if self.defer_results:
+            # Pallas packs ids as float values; XLA as an int32 bitcast.
+            return self._results.drain(float_ids=self.use_pallas)
         prev, self._pending = self._pending, None
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
@@ -430,3 +552,5 @@ class DeviceScorer:
         # In-flight results belong to windows after the checkpoint; a
         # restore that rolls back must not emit them.
         self._pending = None
+        if self._results is not None:
+            self._results.reset(self.num_items)

@@ -60,7 +60,7 @@ import jax.numpy as jnp
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              narrow_deltas_int32)
-from ..ops.device_scorer import pad_pow2, pad_pow4
+from ..ops.device_scorer import DeferredResultsTable, pad_pow2, pad_pow4
 from ..ops.llr import llr_stable
 from ..sampling.reservoir import PairDeltaBatch, _ragged_arange
 from .results import TopKBatch
@@ -170,11 +170,6 @@ def _score_into_table(tbl, cnt, dst, row_sums, meta, observed, *,
     packed = _score_rect(cnt, dst, row_sums, meta, observed, top_k, R)
     rowids = jnp.where(meta[2] > 0, meta[0], _SENT)
     return tbl.at[:, rowids].set(packed, mode="drop")
-
-
-@jax.jit
-def _gather_table(tbl, rows):
-    return tbl[:, rows]
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
@@ -474,11 +469,8 @@ class SparseDeviceScorer:
         # reference has no analogue (its sink is a no-op, results ride the
         # accumulator dump — FlinkCooccurrences.java:169-181).
         self.defer_results = bool(defer_results)
-        self._table = None
-        # Rows scattered since the last flush. Flush fetches only these
-        # (and clears the set), so periodic checkpoints stay incremental —
-        # rows fetched earlier persist in the job's LatestResults.
-        self._table_dirty = np.zeros(self.items_cap, dtype=bool)
+        self._results = (DeferredResultsTable(top_k, self.items_cap)
+                         if self.defer_results else None)
 
     # Back-compat introspection used by tests.
     @property
@@ -502,13 +494,8 @@ class SparseDeviceScorer:
         self.row_sums_host = grown
         self.row_sums = _grow(self.row_sums, n=new_cap)
         self.items_cap = new_cap
-        mask = np.zeros(new_cap, dtype=bool)
-        mask[: len(self._table_dirty)] = self._table_dirty
-        self._table_dirty = mask
-        if self._table is not None:
-            old = self._table
-            self._table = jnp.full((2, new_cap, self.top_k), -jnp.inf,
-                                   jnp.float32).at[:, : old.shape[1]].set(old)
+        if self._results is not None:
+            self._results.resize(new_cap)
 
     def _ensure_heap(self, need_end: int) -> None:
         if need_end <= self.capacity:
@@ -605,9 +592,8 @@ class SparseDeviceScorer:
         min_r = max(16, self.top_k)  # lax.top_k needs k <= R
         bucket, order = score_buckets(lens, min_r, self.score_ladder)
         b_sorted = bucket[order]
-        if self.defer_results and self._table is None:
-            self._table = jnp.full((2, self.items_cap, self.top_k),
-                                   -jnp.inf, jnp.float32)
+        if self.defer_results:
+            self._results.ensure()
         chunks: List[Tuple[np.ndarray, int, object]] = []
         pos = 0
         while pos < len(order):
@@ -627,9 +613,11 @@ class SparseDeviceScorer:
                 meta[1, :s] = starts[chunk]
                 meta[2, :s] = lens[chunk]
                 if self.defer_results:
-                    self._table = _score_into_table(
-                        self._table, self.cnt, self.dst, self.row_sums,
-                        meta, np.float32(self.observed),
+                    # Fused: the scatter rides the scoring dispatch (the
+                    # table is donated in and reassigned).
+                    self._results.tbl = _score_into_table(
+                        self._results.tbl, self.cnt, self.dst,
+                        self.row_sums, meta, np.float32(self.observed),
                         top_k=self.top_k, R=R)
                     continue
                 packed = _score_slab(self.cnt, self.dst, self.row_sums,
@@ -640,7 +628,7 @@ class SparseDeviceScorer:
                 chunks.append((rows[chunk], s, packed))
             pos = end
         if self.defer_results:
-            self._table_dirty[rows] = True
+            self._results.mark(rows)
         return chunks
 
     def _check_row_sums(self, rows: np.ndarray) -> None:
@@ -660,22 +648,7 @@ class SparseDeviceScorer:
 
     def flush(self) -> TopKBatch:
         if self.defer_results:
-            # Incremental drain: fetch only the rows scattered since the
-            # last flush, in one device gather — exact bytes, no
-            # slab-capacity padding on the wire. Earlier rows persist in
-            # the caller's LatestResults, so periodic checkpoints cost
-            # O(rows since last checkpoint), not O(all rows ever scored).
-            rows = np.flatnonzero(self._table_dirty)
-            if self._table is None or len(rows) == 0:
-                return TopKBatch.empty(self.top_k)
-            self._table_dirty[rows] = False
-            n = len(rows)
-            rows_pad = np.zeros(pad_pow2(n, minimum=16), np.int32)
-            rows_pad[:n] = rows
-            host = np.asarray(_gather_table(self._table,
-                                            jnp.asarray(rows_pad)))
-            return TopKBatch(rows.astype(np.int32),
-                             host[1, :n].view(np.int32), host[0, :n])
+            return self._results.drain()
         prev, self._pending = self._pending, None
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
@@ -742,8 +715,5 @@ class SparseDeviceScorer:
         self.observed = int(st["observed"][0])
         # In-flight results belong to windows after the checkpoint.
         self._pending = None
-        # Deferred table restarts empty: rows materialized before the
-        # checkpoint already live in the job's LatestResults (the job
-        # flushes before every save); post-restore windows repopulate it.
-        self._table = None
-        self._table_dirty = np.zeros(self.items_cap, dtype=bool)
+        if self._results is not None:
+            self._results.reset(self.items_cap)
