@@ -58,15 +58,16 @@ def config4_sparse(quick: bool) -> dict:
     from .configs import config4_zipfian_1m
 
     n = 200_000 if quick else 1_000_000
-    # Score-ladder sweep: pow-4 (tight padding, ~6 dispatches/window) vs
-    # pow-16 (<=16x padded device compute, ~half the dispatches) — on a
-    # high-RTT tunnel the dispatch count can dominate. Warmup populates
+    # Score-ladder sweep: pow-4 (tight padding, ~6 dispatches/window) to
+    # pow-64 (heavily padded device compute, fewest dispatches) — on a
+    # high-RTT tunnel the dispatch count can dominate (measured: ladder
+    # 16 > 4 by 10% before results were deferred). Warmup populates
     # the jit caches; measure the second run of each.
     by_ladder = {}
     best = None
     prior = os.environ.get("TPU_COOC_SCORE_LADDER")
     try:
-        for ladder in ("4", "16"):
+        for ladder in ("4", "16", "64"):
             os.environ["TPU_COOC_SCORE_LADDER"] = ladder
             config4_zipfian_1m(n_events=n)
             r = config4_zipfian_1m(n_events=n)

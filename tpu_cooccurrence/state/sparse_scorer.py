@@ -69,9 +69,8 @@ from .results import TopKBatch
 _SENT = np.int32(2**31 - 1)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("L",))
-def _apply_moves(cnt, dst, mv, L: int):
-    """Relocate outgrown rows inside the slab.
+def _moves_body(cnt, dst, mv, L: int):
+    """Relocate outgrown rows inside the slab (trace body).
 
     ``mv``: [3, Mv] int32 (old_start, new_start, len); padded rows carry
     len == 0. Reads and writes never overlap: new regions are freshly
@@ -87,9 +86,8 @@ def _apply_moves(cnt, dst, mv, L: int):
     return cnt, dst
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-def _apply_update(cnt, dst, row_sums, upd, bounds):
-    """Apply one window's state changes in a single fused dispatch.
+def _update_body(cnt, dst, row_sums, upd, bounds):
+    """Apply one window's state changes (trace body).
 
     ``upd``: [2, N] int32 — three concatenated sections along axis 1
     (boundaries in ``bounds``; intra-section padding uses sentinel
@@ -110,6 +108,23 @@ def _apply_update(cnt, dst, row_sums, upd, bounds):
     row_sums = row_sums.at[rs_idx].add(
         jnp.where(pos >= bounds[1], upd[1], 0), mode="drop")
     return cnt, dst, row_sums
+
+
+_apply_update = functools.partial(jax.jit, donate_argnums=(0, 1, 2))(
+    _update_body)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("L",))
+def _apply_moves_update(cnt, dst, row_sums, mv, upd, bounds, L: int):
+    """Row relocations + the window update in ONE dispatch.
+
+    Zipfian streams relocate rows nearly every window (hot rows keep
+    outgrowing their pow-2 caps), so fusing the two kernels removes a
+    per-window dispatch — on a high-latency tunnel each dispatch is wall
+    time. Moves run first: the window's new-cell slots already assume the
+    relocated layout."""
+    cnt, dst = _moves_body(cnt, dst, mv, L)
+    return _update_body(cnt, dst, row_sums, upd, bounds)
 
 
 def _apply_cells(cnt, dst, upd, bounds):
@@ -234,7 +249,7 @@ class AllocPlan:
     """Device-facing output of one window's :meth:`SlabIndex.apply`."""
 
     mv: Optional[np.ndarray]      # [3, Mv_pad] int32 move instructions
-    mv_len: int                   # static rectangle width for _apply_moves
+    mv_len: int                   # static rectangle width for the move kernel
     slots: np.ndarray             # slab slot per window cell (d_key order)
     new_sel: np.ndarray           # bool per window cell: newly inserted
 
@@ -569,10 +584,12 @@ class SparseDeviceScorer:
         bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
 
         if plan.mv is not None:
-            self.cnt, self.dst = _apply_moves(self.cnt, self.dst, plan.mv,
-                                              L=plan.mv_len)
-        self.cnt, self.dst, self.row_sums = _apply_update(
-            self.cnt, self.dst, self.row_sums, upd, bounds)
+            self.cnt, self.dst, self.row_sums = _apply_moves_update(
+                self.cnt, self.dst, self.row_sums, plan.mv, upd, bounds,
+                L=plan.mv_len)
+        else:
+            self.cnt, self.dst, self.row_sums = _apply_update(
+                self.cnt, self.dst, self.row_sums, upd, bounds)
 
         if self.development_mode:
             self._check_row_sums(rows)
