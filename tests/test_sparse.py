@@ -304,3 +304,71 @@ def test_sparse_deferred_flush_idempotent_and_checkpoint(tmp_path):
     b.add_batch(users[half:], items[half:], ts[half:])
     b.finish()
     assert_latest_close(ref.latest, b.latest, rtol=1e-6, atol=1e-6)
+
+
+def test_sparse_fixed_shapes_matches_variable():
+    """Fixed-shape scoring (constant per-bucket rectangles, TPU default)
+    produces identical results to the variable pow-4 ladder."""
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    kw = dict(window_size=10, seed=0xF5, item_cut=5, user_cut=4,
+              development_mode=True)
+    users, items, ts = random_stream(59, n=1200)
+
+    def run(fixed):
+        cfg = Config(**kw, backend=Backend.SPARSE)
+        scorer = SparseDeviceScorer(cfg.top_k, development_mode=True,
+                                    capacity=64, items_capacity=8,
+                                    compact_min_heap=256,
+                                    defer_results=True, fixed_shapes=fixed)
+        if fixed:
+            # Small fixed rectangles so the CPU test stays quick; the
+            # shape-constancy property is what is under test.
+            scorer.FIXED_BUDGET = 1 << 12
+            scorer.FIXED_ROW_CAP = 64
+        job = CooccurrenceJob(cfg, scorer=scorer)
+        scorer.counters = job.counters
+        job.add_batch(users, items, ts)
+        job.finish()
+        return job
+
+    var = run(False)
+    fix = run(True)
+    assert_latest_close(var.latest, fix.latest, rtol=1e-6, atol=1e-6)
+    for name in (OBSERVED_COOCCURRENCES, ROW_SUM_PROCESS_WINDOW,
+                 RESCORED_ITEMS):
+        assert var.counters.get(name) == fix.counters.get(name), name
+
+
+def test_sparse_fixed_shapes_dispatch_signature_constant():
+    """Every fixed-mode scoring dispatch of a bucket reuses one (R, S)
+    signature — the whole point (one compile, one program)."""
+    import tpu_cooccurrence.state.sparse_scorer as sp
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    shapes = set()
+    orig = sp._score_into_table
+
+    def spy(tbl, cnt, dst, row_sums, meta, observed, *, top_k, R):
+        shapes.add((R, meta.shape[1]))
+        return orig(tbl, cnt, dst, row_sums, meta, observed,
+                    top_k=top_k, R=R)
+
+    cfg = Config(window_size=10, seed=0xF6, item_cut=5, user_cut=4,
+                 backend=Backend.SPARSE, development_mode=True)
+    users, items, ts = random_stream(61, n=1500)
+    scorer = SparseDeviceScorer(cfg.top_k, development_mode=True,
+                                defer_results=True, fixed_shapes=True)
+    scorer.FIXED_BUDGET = 1 << 12
+    scorer.FIXED_ROW_CAP = 64
+    job = CooccurrenceJob(cfg, scorer=scorer)
+    scorer.counters = job.counters
+    sp._score_into_table = spy
+    try:
+        job.add_batch(users, items, ts)
+        job.finish()
+    finally:
+        sp._score_into_table = orig
+    # One signature per bucket R: S is a pure function of R in fixed mode.
+    rs = [r for r, _s in shapes]
+    assert len(rs) == len(set(rs)), shapes

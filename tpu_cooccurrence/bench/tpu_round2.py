@@ -58,30 +58,37 @@ def config4_sparse(quick: bool) -> dict:
     from .configs import config4_zipfian_1m
 
     n = 200_000 if quick else 1_000_000
-    # Score-ladder sweep: pow-4 (tight padding, ~6 dispatches/window) to
-    # pow-64 (heavily padded device compute, fewest dispatches) — on a
-    # high-RTT tunnel the dispatch count can dominate (measured: ladder
-    # 16 > 4 by 10% before results were deferred). Warmup populates
-    # the jit caches; measure the second run of each.
-    by_ladder = {}
+    # Two-axis sweep: score ladder x fixed-shape scoring. With fixed
+    # shapes ON (the TPU default) every bucket pads to its constant
+    # rectangle, so the ladder only decides the bucket set; the
+    # "L16/var" point re-measures the round-2 variable-padding mode
+    # (whose prior numbers were 71.9k @16 / 65.5k @4 before results
+    # were deferred). Warmup populates the jit caches; measure the
+    # second run of each.
+    by_mode = {}
     best = None
-    prior = os.environ.get("TPU_COOC_SCORE_LADDER")
+    prior = {k: os.environ.get(k) for k in
+             ("TPU_COOC_SCORE_LADDER", "TPU_COOC_FIXED_SCORE")}
     try:
-        for ladder in ("4", "16", "64"):
+        for ladder, fixed in (("4", "1"), ("16", "1"), ("64", "1"),
+                              ("16", "0")):
             os.environ["TPU_COOC_SCORE_LADDER"] = ladder
+            os.environ["TPU_COOC_FIXED_SCORE"] = fixed
             config4_zipfian_1m(n_events=n)
             r = config4_zipfian_1m(n_events=n)
-            by_ladder[ladder] = round(r.pairs_per_sec, 1)
+            key = f"L{ladder}/{'fixed' if fixed == '1' else 'var'}"
+            by_mode[key] = round(r.pairs_per_sec, 1)
             if best is None or r.pairs_per_sec > best.pairs_per_sec:
                 best = r
     finally:
-        # Restore the operator's setting for the remaining passes.
-        if prior is None:
-            os.environ.pop("TPU_COOC_SCORE_LADDER", None)
-        else:
-            os.environ["TPU_COOC_SCORE_LADDER"] = prior
+        # Restore the operator's settings for the remaining passes.
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     d = best.as_dict()
-    d["pairs_per_sec_by_ladder"] = by_ladder
+    d["pairs_per_sec_by_mode"] = by_mode
     d["vs_host_baseline_22.9k"] = round(best.pairs_per_sec / 22_900, 2)
     return d
 

@@ -442,6 +442,12 @@ class SparseDeviceScorer:
     # fewer dispatches beats tighter padding when every dispatch pays
     # tunnel round-trip latency.
     SCORE_BUDGET = 1 << 24
+    # Fixed-shape mode budget (smaller: every window pays the full padded
+    # rectangle, and its meta upload is wire bytes — see fixed_shapes).
+    FIXED_BUDGET = 1 << 22
+    # Per-bucket row cap in fixed-shape mode: bounds the [3, S_cap] meta
+    # upload (12 B/row; 65536 rows = 768 KB) that every window ships.
+    FIXED_ROW_CAP = 1 << 16
 
     def __init__(self, top_k: int, counters: Optional[Counters] = None,
                  development_mode: bool = False,
@@ -449,7 +455,8 @@ class SparseDeviceScorer:
                  items_capacity: int = 1 << 10,
                  compact_min_heap: int = 1 << 16,
                  score_ladder: Optional[int] = None,
-                 defer_results: bool = False) -> None:
+                 defer_results: bool = False,
+                 fixed_shapes: Optional[bool] = None) -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -486,6 +493,33 @@ class SparseDeviceScorer:
         self.defer_results = bool(defer_results)
         self._results = (DeferredResultsTable(top_k, self.items_cap)
                          if self.defer_results else None)
+        # Fixed-shape scoring: pad every bucket's meta to a constant
+        # per-bucket row cap so each window re-dispatches the SAME
+        # compiled programs — one compile per bucket ever, steady ~1
+        # dispatch per occupied bucket, no pow-4 shape ladder. The padded
+        # rows are dead device compute (bounded by FIXED_BUDGET) and a
+        # bounded meta upload; the win is dispatch/compile-count, which
+        # is what a high-latency tunnel and a freshly-started process
+        # actually pay for. Default: on for real TPUs, off elsewhere
+        # (CPU tests would crawl through the padding); env
+        # TPU_COOC_FIXED_SCORE=0/1 overrides.
+        if fixed_shapes is None:
+            env = os.environ.get("TPU_COOC_FIXED_SCORE", "auto")
+            env = env.strip().lower()
+            if env in ("1", "on", "true", "yes"):
+                fixed_shapes = True
+            elif env in ("0", "off", "false", "no"):
+                fixed_shapes = False
+            elif env in ("auto", ""):
+                fixed_shapes = jax.default_backend() == "tpu"
+            else:
+                raise ValueError(
+                    f"TPU_COOC_FIXED_SCORE must be 0/1/auto, got {env!r}")
+        # Fixed rectangles only make sense when results stay on device:
+        # the pipelined path fetches each packed block, and a full
+        # [2, s_block, K] fetch per bucket would ship megabytes of
+        # padding over the very link this mode exists to spare.
+        self.fixed_shapes = bool(fixed_shapes) and self.defer_results
 
     # Back-compat introspection used by tests.
     @property
@@ -617,14 +651,21 @@ class SparseDeviceScorer:
             b = int(b_sorted[pos])
             end = int(np.searchsorted(b_sorted, b, side="right"))
             R = bucket_r(b, min_r, self.score_ladder)
-            s_block = max(self.SCORE_BUDGET // R, 16)
+            if self.fixed_shapes:
+                s_block = max(min(self.FIXED_BUDGET // R,
+                                  self.FIXED_ROW_CAP), 16)
+            else:
+                s_block = max(self.SCORE_BUDGET // R, 16)
             for lo in range(pos, end, s_block):
                 chunk = order[lo: min(lo + s_block, end)]
                 s = len(chunk)
-                # pow-4 row padding: each (R, s_pad) combination is one
-                # trace + compile per process; a coarse ladder keeps the
-                # program count (and per-process retrace time) small.
-                s_pad = min(pad_pow4(s, minimum=16), s_block)
+                # Fixed mode: always the full per-bucket rectangle — the
+                # same program every window. Otherwise pow-4 row padding:
+                # each (R, s_pad) combination is one trace + compile per
+                # process; a coarse ladder keeps the program count (and
+                # per-process retrace time) small.
+                s_pad = (s_block if self.fixed_shapes
+                         else min(pad_pow4(s, minimum=16), s_block))
                 meta = np.zeros((3, s_pad), dtype=np.int32)
                 meta[0, :s] = rows[chunk]
                 meta[1, :s] = starts[chunk]
