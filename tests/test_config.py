@@ -66,3 +66,52 @@ def test_backend_parse():
                             "--num-shards", "4", "--num-items", "100"])
     assert cfg.backend is Backend.SHARDED
     assert cfg.num_shards == 4
+
+
+def test_score_ladder_and_fixed_score_flags():
+    from tpu_cooccurrence.config import Config
+
+    cfg = Config.from_args(["-i", "x.csv", "-ws", "100",
+                            "--backend", "sparse",
+                            "--score-ladder", "16", "--fixed-score", "on"])
+    assert cfg.score_ladder == 16
+    assert cfg.fixed_score == "on"
+    # Defaults: ladder deferred to the scorer (env or 4), fixed-score auto.
+    cfg2 = Config.from_args(["-i", "x.csv", "-ws", "100"])
+    assert cfg2.score_ladder is None
+    assert cfg2.fixed_score == "auto"
+
+
+def test_invalid_score_ladder_rejected_at_job_construction():
+    import pytest
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    cfg = Config(window_size=10, seed=1, backend=Backend.SPARSE,
+                 score_ladder=3)
+    with pytest.raises(ValueError, match="power of two"):
+        CooccurrenceJob(cfg)
+
+
+def test_fixed_score_conflicts_rejected():
+    import pytest
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    # Explicit on + per-window emission: refuse, don't silently downgrade.
+    cfg = Config(window_size=10, seed=1, backend=Backend.SPARSE,
+                 fixed_score="on", emit_updates=True)
+    with pytest.raises(ValueError, match="emit-updates"):
+        CooccurrenceJob(cfg)
+    # Explicit on + sharded-sparse: unsupported, refuse.
+    cfg2 = Config(window_size=10, seed=1, backend=Backend.SPARSE,
+                  fixed_score="on", num_shards=2)
+    with pytest.raises(ValueError, match="num-shards"):
+        CooccurrenceJob(cfg2)
+    # Bogus value: descriptive error, not a KeyError.
+    cfg3 = Config(window_size=10, seed=1, backend=Backend.SPARSE,
+                  fixed_score="yes")
+    with pytest.raises(ValueError, match="auto|on|off"):
+        CooccurrenceJob(cfg3)

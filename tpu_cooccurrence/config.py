@@ -98,6 +98,12 @@ class Config:
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
+    score_ladder: Optional[int] = None  # sparse score-bucket ladder base
+    # (power of two >= 2); None = env TPU_COOC_SCORE_LADDER or 4. Coarser
+    # = fewer dispatches, more padding — the high-latency-link lever.
+    fixed_score: str = "auto"  # sparse fixed-shape scoring: auto|on|off
+    # (auto = on for real TPUs when results are deferred; constant
+    # per-bucket rectangles -> one compile + one dispatch per bucket)
     pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = on
     # for int16 counts on a real TPU where it wins 247x, off otherwise —
     # measured, see ops/device_scorer.pallas_auto)
@@ -228,6 +234,17 @@ class Config:
                        help="Dense count-matrix cell dtype (int16 halves "
                             "device memory; counts then wrap like the "
                             "reference's Java shorts)")
+        p.add_argument("--score-ladder", type=int, default=None,
+                       dest="score_ladder",
+                       help="Sparse-backend score-bucket ladder base "
+                            "(power of two >= 2; default 4 or env "
+                            "TPU_COOC_SCORE_LADDER). Coarser = fewer "
+                            "dispatches, more padding")
+        p.add_argument("--fixed-score", choices=["auto", "on", "off"],
+                       default="auto", dest="fixed_score",
+                       help="Sparse-backend fixed-shape scoring (constant "
+                            "per-bucket rectangles; auto = on for real "
+                            "TPUs when results are deferred)")
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")

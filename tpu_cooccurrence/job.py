@@ -155,7 +155,18 @@ class CooccurrenceJob:
             return HybridScorer(self.config.top_k, self.counters,
                                 self.config.development_mode)
         if backend == Backend.SPARSE:
+            fixed = {"auto": None, "on": True,
+                     "off": False}.get(self.config.fixed_score, KeyError)
+            if fixed is KeyError:
+                raise ValueError(
+                    f"fixed_score must be auto|on|off, got "
+                    f"{self.config.fixed_score!r}")
             if self.config.num_shards > 1:
+                if fixed:
+                    raise ValueError(
+                        "--fixed-score on is not supported with "
+                        "--num-shards > 1 (the sharded-sparse scorer "
+                        "dispatches per-shard variable rectangles)")
                 from .parallel.distributed import maybe_multihost_mesh
                 from .parallel.sharded_sparse import ShardedSparseScorer
 
@@ -163,7 +174,8 @@ class CooccurrenceJob:
                     self.config.top_k, num_shards=self.config.num_shards,
                     counters=self.counters,
                     mesh=maybe_multihost_mesh(self.config),
-                    development_mode=self.config.development_mode)
+                    development_mode=self.config.development_mode,
+                    score_ladder=self.config.score_ladder)
             if self.config.coordinator is not None:
                 # A coordinator with the default single shard would run one
                 # full independent job per process (and clobber a shared
@@ -180,7 +192,9 @@ class CooccurrenceJob:
             # keep the per-window pipeline.
             return SparseDeviceScorer(self.config.top_k, self.counters,
                                       self.config.development_mode,
-                                      defer_results=not self.config.emit_updates)
+                                      score_ladder=self.config.score_ladder,
+                                      defer_results=not self.config.emit_updates,
+                                      fixed_shapes=fixed)
         if backend == Backend.SHARDED:
             from .parallel.sharded import ShardedScorer
 

@@ -511,15 +511,25 @@ class SparseDeviceScorer:
             elif env in ("0", "off", "false", "no"):
                 fixed_shapes = False
             elif env in ("auto", ""):
-                fixed_shapes = jax.default_backend() == "tpu"
+                # Fixed rectangles only make sense when results stay on
+                # device: the pipelined path fetches each packed block,
+                # and a full [2, s_block, K] fetch per bucket would ship
+                # megabytes of padding over the very link this mode
+                # exists to spare.
+                fixed_shapes = (jax.default_backend() == "tpu"
+                                and self.defer_results)
             else:
                 raise ValueError(
                     f"TPU_COOC_FIXED_SCORE must be 0/1/auto, got {env!r}")
-        # Fixed rectangles only make sense when results stay on device:
-        # the pipelined path fetches each packed block, and a full
-        # [2, s_block, K] fetch per bucket would ship megabytes of
-        # padding over the very link this mode exists to spare.
-        self.fixed_shapes = bool(fixed_shapes) and self.defer_results
+        if fixed_shapes and not self.defer_results:
+            # An explicit request that cannot take effect must not be
+            # silently downgraded — a fixed-vs-variable A/B would then
+            # compare two identical variable runs.
+            raise ValueError(
+                "fixed-shape scoring needs deferred results (it is "
+                "incompatible with --emit-updates: the per-window result "
+                "fetch would ship the padded rectangles)")
+        self.fixed_shapes = bool(fixed_shapes)
 
     # Back-compat introspection used by tests.
     @property
