@@ -106,3 +106,76 @@ def test_sharded_sparse_checkpoint_interchange(tmp_path):
         b.add_batch(users[half:], items[half:], ts[half:])
         b.finish()
         assert_latest_close(ref.latest, b.latest, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_sparse_deferred_matches_pipelined():
+    """Deferred results (job default) == per-window pipeline (the
+    --emit-updates path) on the virtual mesh, and no mid-stream
+    emissions under deferral."""
+    kw = dict(window_size=10, seed=0xA7, item_cut=5, user_cut=4,
+              development_mode=True)
+    users, items, ts = random_stream(67, n=1500)
+
+    def run(emit):
+        cfg = Config(**kw, backend=Backend.SPARSE, num_shards=8,
+                     emit_updates=emit)
+        job = CooccurrenceJob(cfg)
+        mid = []
+        job.on_update = lambda batch: mid.append(len(batch))
+        job.add_batch(users, items, ts)
+        n_mid = sum(mid)
+        job.finish()
+        return job, n_mid
+
+    piped, mid_p = run(True)
+    assert not piped.scorer.defer_results
+    deferred, mid_d = run(False)
+    assert deferred.scorer.defer_results
+    assert mid_p > 0
+    assert mid_d == 0
+    assert_latest_close(piped.latest, deferred.latest,
+                        rtol=1e-6, atol=1e-6)
+
+
+def test_sharded_sparse_deferred_growth_and_checkpoint(tmp_path):
+    """Deferred table survives items-capacity growth; periodic checkpoint
+    + restore matches an uninterrupted run."""
+    kw = dict(window_size=10, seed=0xA8, item_cut=5, user_cut=3,
+              backend=Backend.SPARSE, num_shards=4,
+              checkpoint_dir=str(tmp_path / "ck"), development_mode=True)
+    rng = np.random.default_rng(17)
+    n = 2600
+    users = relabel_first_appearance(rng.integers(0, 15, n))
+    items = relabel_first_appearance(rng.integers(0, 6000, n))
+    ts = np.cumsum(rng.integers(0, 2, n)).astype(np.int64)
+    half = 1300
+
+    ref = CooccurrenceJob(Config(**kw))
+    # Tiny capacity so the stream forces table growth mid-run.
+    from tpu_cooccurrence.parallel.sharded_sparse import ShardedSparseScorer
+
+    def tiny(cfg):
+        sc = ShardedSparseScorer(cfg.top_k, num_shards=4,
+                                 development_mode=True,
+                                 items_capacity=1024,
+                                 defer_results=True)
+        job = CooccurrenceJob(cfg, scorer=sc)
+        sc.counters = job.counters
+        return job
+
+    ref2 = tiny(Config(**kw))
+    ref2.add_batch(users, items, ts)
+    ref2.finish()
+    assert ref2.scorer.items_cap > 1024  # growth actually happened
+    ref.add_batch(users, items, ts)
+    ref.finish()
+    assert_latest_close(ref.latest, ref2.latest, rtol=1e-6, atol=1e-6)
+
+    a = CooccurrenceJob(Config(**kw))
+    a.add_batch(users[:half], items[:half], ts[:half])
+    a.checkpoint()
+    b = CooccurrenceJob(Config(**kw))
+    b.restore()
+    b.add_batch(users[half:], items[half:], ts[half:])
+    b.finish()
+    assert_latest_close(ref.latest, b.latest, rtol=1e-6, atol=1e-6)

@@ -175,7 +175,8 @@ class CooccurrenceJob:
                     counters=self.counters,
                     mesh=maybe_multihost_mesh(self.config),
                     development_mode=self.config.development_mode,
-                    score_ladder=self.config.score_ladder)
+                    score_ladder=self.config.score_ladder,
+                    defer_results=not self.config.emit_updates)
             if self.config.coordinator is not None:
                 # A coordinator with the default single shard would run one
                 # full independent job per process (and clobber a shared

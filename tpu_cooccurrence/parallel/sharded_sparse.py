@@ -73,7 +73,8 @@ class ShardedSparseScorer:
                  capacity: int = 1 << 14,
                  items_capacity: int = 1 << 10,
                  compact_min_heap: int = 1 << 16,
-                 score_ladder: Optional[int] = None) -> None:
+                 score_ladder: Optional[int] = None,
+                 defer_results: bool = False) -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -97,6 +98,24 @@ class ShardedSparseScorer:
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
         self._score_fns: Dict[int, object] = {}  # R -> jitted shard_map fn
+        # Deferred-results mode (same design as the single-device scorers,
+        # ops/device_scorer.DeferredResultsTable, here sharded): each
+        # shard scatters its rows' packed top-K into a mesh-sharded
+        # [D, 2, local_cap, K] table inside the scoring dispatch; flush
+        # drains only rows dirty since the last flush, each process
+        # fetching its addressable shards. Per-window result downlink
+        # drops to zero. The lifecycle (lazy ensure, resize-on-growth,
+        # mark/drain-pop, reset-on-restore) deliberately parallels
+        # DeferredResultsTable rather than reusing it: the sharded table
+        # shape, the shard_map scatter/gather, and the per-process
+        # addressable-shard drain replace every method body — keep the
+        # two in sync when changing mask semantics (see that class's
+        # docstring for the contract).
+        self.defer_results = bool(defer_results)
+        self._tbl = None          # lazy [D, 2, local_cap, K] device array
+        self._tbl_dirty = np.zeros(self.items_cap, dtype=bool)
+        self._score_into_fns: Dict[int, object] = {}
+        self._tbl_gather_fns: Dict[int, object] = {}
 
         from .distributed import put_global
 
@@ -189,6 +208,63 @@ class ShardedSparseScorer:
             self._score_fns[R] = fn
         return fn
 
+    @property
+    def _local_cap(self) -> int:
+        """Per-shard row capacity of the deferred-results table."""
+        return -(-self.items_cap // self.n_shards)
+
+    def _score_into_fn(self, R: int):
+        """Scoring dispatch that scatters straight into the sharded
+        deferred-results table (rows are shard-local: global // D)."""
+        fn = self._score_into_fns.get(R)
+        if fn is None:
+            top_k = self.top_k
+            D = self.n_shards
+
+            def _score_into(tbl_loc, cnt_loc, dst_loc, row_sums, meta_loc,
+                            observed):
+                out = _score_rect(cnt_loc[0], dst_loc[0], row_sums,
+                                  meta_loc[0], observed, top_k, R)
+                rowids, lens = meta_loc[0][0], meta_loc[0][2]
+                local = jnp.where(lens > 0, rowids // D, _SENT)
+                return tbl_loc[0].at[:, local].set(out, mode="drop")[None]
+
+            fn = jax.jit(shard_map(
+                _score_into, mesh=self.mesh,
+                in_specs=(P(ITEM_AXIS), P(ITEM_AXIS, None),
+                          P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
+                out_specs=P(ITEM_AXIS),
+            ), donate_argnums=(0,))
+            self._score_into_fns[R] = fn
+        return fn
+
+    def _tbl_gather_fn(self, rp: int):
+        fn = self._tbl_gather_fns.get(rp)
+        if fn is None:
+            def _g(tbl_loc, rows_loc):
+                return tbl_loc[0][:, rows_loc[0]][None]
+
+            fn = jax.jit(shard_map(
+                _g, mesh=self.mesh,
+                in_specs=(P(ITEM_AXIS), P(ITEM_AXIS)),
+                out_specs=P(ITEM_AXIS),
+            ))
+            self._tbl_gather_fns[rp] = fn
+        return fn
+
+    def _ensure_tbl(self) -> None:
+        if self._tbl is None:
+            self._tbl = self._put_global(
+                np.full((self.n_shards, 2, self._local_cap, self.top_k),
+                        -np.inf, np.float32),
+                self.mesh, P(ITEM_AXIS))
+
+    def _reset_deferred(self) -> None:
+        """Restore path: pre-checkpoint rows already live in the job's
+        LatestResults (flushed before every save)."""
+        self._tbl = None
+        self._tbl_dirty = np.zeros(self.items_cap, dtype=bool)
+
     def _grow_fn(self, n: int):
         fn = self._grow_fns.get(n)
         if fn is None:
@@ -242,6 +318,21 @@ class ShardedSparseScorer:
         self.row_sums = self._put_global(
             self.row_sums_host.astype(np.int32), self.mesh, P())
         self._build_update()  # items_cap is baked into the psum scatter
+        dirty = np.zeros(new_cap, dtype=bool)
+        m = min(new_cap, len(self._tbl_dirty))
+        dirty[:m] = self._tbl_dirty[:m]
+        self._tbl_dirty = dirty
+        if self._tbl is not None:
+            old = self._tbl
+            lc = self._local_cap
+
+            def _gt(tbl_loc):
+                z = jnp.full((1, 2, lc, self.top_k), -jnp.inf, jnp.float32)
+                return z.at[:, :, : tbl_loc.shape[2]].set(tbl_loc)
+
+            self._tbl = jax.jit(shard_map(
+                _gt, mesh=self.mesh, in_specs=P(ITEM_AXIS),
+                out_specs=P(ITEM_AXIS)), donate_argnums=(0,))(old)
 
     def _ensure_heap(self, need_end: int) -> None:
         if need_end <= self.capacity:
@@ -261,6 +352,9 @@ class ShardedSparseScorer:
         self.last_dispatched_rows = 0
         D = self.n_shards
         if len(pairs) == 0:
+            if self.defer_results:
+                # Nothing in flight; results wait for the final flush.
+                return TopKBatch.empty(self.top_k)
             return self.flush()
         if any(ix.needs_compaction(self.compact_min_heap)
                for ix in self.indexes):
@@ -395,14 +489,22 @@ class ShardedSparseScorer:
                     meta[d, 0, : len(p)] = rows[p]
                     meta[d, 1, : len(p)] = starts[p]
                     meta[d, 2, : len(p)] = lens[p]
+                meta_g = self._put_global(meta, self.mesh, P(ITEM_AXIS))
+                if self.defer_results:
+                    self._ensure_tbl()
+                    self._tbl = self._score_into_fn(R)(
+                        self._tbl, self.cnt, self.dst, self.row_sums,
+                        meta_g, np.float32(self.observed))
+                    continue
                 packed = self._score_fn(R)(
-                    self.cnt, self.dst, self.row_sums,
-                    self._put_global(meta, self.mesh, P(ITEM_AXIS)),
+                    self.cnt, self.dst, self.row_sums, meta_g,
                     np.float32(self.observed))
                 if hasattr(packed, "copy_to_host_async"):
                     packed.copy_to_host_async()
                 chunks.append(([rows[p] for p in parts], packed))
             pos = end
+        if self.defer_results:
+            self._tbl_dirty[rows] = True
         return chunks
 
     def _compact_all(self) -> None:
@@ -440,6 +542,40 @@ class ShardedSparseScorer:
     # -- results ----------------------------------------------------------
 
     def flush(self) -> TopKBatch:
+        if self.defer_results:
+            # Incremental drain, one sharded gather: each process fetches
+            # its addressable shards' dirty rows (multi-host emission
+            # contract unchanged — a process emits the rows its chips
+            # own; the dirty mask is host-replicated so every process
+            # clears the same rows).
+            rows = np.flatnonzero(self._tbl_dirty)
+            if self._tbl is None or len(rows) == 0:
+                return TopKBatch.empty(self.top_k)
+            self._tbl_dirty[rows] = False
+            D = self.n_shards
+            owner = (rows % D).astype(np.int64)
+            counts = np.bincount(owner, minlength=D)
+            rp = pad_pow2(int(counts.max()), minimum=16)
+            rows_b = np.zeros((D, rp), dtype=np.int32)
+            per_shard: List[np.ndarray] = []
+            for d in range(D):
+                sel = rows[owner == d]
+                rows_b[d, : len(sel)] = (sel // D).astype(np.int32)
+                per_shard.append(sel)
+            packed = self._tbl_gather_fn(rp)(
+                self._tbl,
+                self._put_global(rows_b, self.mesh, P(ITEM_AXIS)))
+            rows_l, idx_l, vals_l = [], [], []
+            for shard in packed.addressable_shards:
+                d = shard.index[0].start or 0
+                n = len(per_shard[d])
+                if not n:
+                    continue
+                host = np.asarray(shard.data)[0]  # [2, rp, K]
+                rows_l.append(per_shard[d])
+                vals_l.append(host[0, :n])
+                idx_l.append(host[1, :n].view(np.int32))
+            return TopKBatch.concatenate(rows_l, idx_l, vals_l, self.top_k)
         prev, self._pending = self._pending, None
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
@@ -559,6 +695,7 @@ class ShardedSparseScorer:
             self.row_sums_host.astype(np.int32), self.mesh, P())
         self.observed = int(st["observed"][0])
         self._pending = None
+        self._reset_deferred()
 
     def _restore_multihost(self, st: dict) -> None:
         """Restore a per-process snapshot (same process layout required).
@@ -621,3 +758,4 @@ class ShardedSparseScorer:
             self.row_sums_host.astype(np.int32), self.mesh, P())
         self.observed = int(st["observed"][0])
         self._pending = None
+        self._reset_deferred()
