@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
-from ..ops.aggregate import aggregate_window_coo, distinct_sorted
+from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
+                             merge_sorted_insert)
 from ..ops.llr import llr_stable
 from ..ops.device_scorer import pad_pow2
 from ..sampling.reservoir import PairDeltaBatch
@@ -139,8 +140,9 @@ class HybridScorer:
                 # Keys inserted with a net-zero window delta (e.g. +1 then
                 # -1 within one window) are zero entries from birth.
                 self._zeros += int((d_val[miss] == 0).sum())
-                self.g_key = np.insert(self.g_key, idx[miss], d_key[miss])
-                self.g_cnt = np.insert(self.g_cnt, idx[miss], d_val[miss])
+                self.g_key, self.g_cnt = merge_sorted_insert(
+                    self.g_key, self.g_cnt, idx[miss], d_key[miss],
+                    d_val[miss])
         else:
             self.g_key = d_key
             self.g_cnt = d_val

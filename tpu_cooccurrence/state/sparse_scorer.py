@@ -59,7 +59,7 @@ import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
-                             narrow_deltas_int32)
+                             merge_sorted_insert, narrow_deltas_int32)
 from ..ops.device_scorer import DeferredResultsTable, pad_pow2, pad_pow4
 from ..ops.llr import llr_stable
 from ..sampling.reservoir import PairDeltaBatch, _ragged_arange
@@ -318,8 +318,8 @@ class SlabIndex:
         slots[exists] = self.g_slot[pos[exists]]
         if len(new_key):
             slots[~exists] = new_slots
-            self.g_key = np.insert(self.g_key, pos[~exists], new_key)
-            self.g_slot = np.insert(self.g_slot, pos[~exists], new_slots)
+            self.g_key, self.g_slot = merge_sorted_insert(
+                self.g_key, self.g_slot, pos[~exists], new_key, new_slots)
         return AllocPlan(mv, mv_len, slots, ~exists)
 
     def _allocate(self, new_key: np.ndarray):

@@ -13,6 +13,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..ops.aggregate import merge_sorted_insert
+
 
 class IdMap:
     """Grow-only external->dense id mapping with batch lookup.
@@ -118,8 +120,8 @@ class IdMap:
             self._rev.extend(new_ext.tolist())
             # Merge the (sorted) new keys into the sorted lookup arrays.
             ins = pos[miss]  # miss is sorted, so uniq[miss] is sorted too
-            self._keys = np.insert(self._keys, ins, uniq[miss])
-            self._vals = np.insert(self._vals, ins, dense_uniq[miss])
+            self._keys, self._vals = merge_sorted_insert(
+                self._keys, self._vals, ins, uniq[miss], dense_uniq[miss])
         return dense_uniq[inverse]
 
     def to_external(self, dense: int) -> int:
