@@ -88,11 +88,13 @@ def test_sparse_index_invariants():
     job.finish()
     sc = job.scorer
     idx = sc.index
-    assert np.all(np.diff(idx.g_key) > 0)  # strictly sorted, unique
-    assert len(idx.g_slot) == len(idx.g_key)
-    rows = (idx.g_key >> 32).astype(np.int64)
+    g_key, g_slot = idx.keys_and_slots()
+    assert len(g_key) > 0              # the invariants below must bite
+    assert np.all(np.diff(g_key) > 0)  # strictly sorted, unique
+    assert len(g_slot) == len(g_key)
+    rows = (g_key >> 32).astype(np.int64)
     for r in np.unique(rows):
-        slots = np.sort(idx.g_slot[rows == r])
+        slots = np.sort(g_slot[rows == r])
         start, ln = idx.row_start[r], idx.row_len[r]
         assert ln == len(slots)
         np.testing.assert_array_equal(slots, np.arange(start, start + ln))
@@ -372,3 +374,43 @@ def test_sparse_fixed_shapes_dispatch_signature_constant():
     # One signature per bucket R: S is a pure function of R in fixed mode.
     rs = [r for r, _s in shapes]
     assert len(rs) == len(set(rs)), shapes
+
+
+def test_hash_index_matches_sorted_index():
+    """The native hash index and the sorted fallback must be plan-for-plan
+    identical across appends, relocations, compactions, and rebuilds."""
+    import pytest
+
+    from tpu_cooccurrence.native import get_lib
+    from tpu_cooccurrence.state.sparse_scorer import (HashSlabIndex,
+                                                      SlabIndex)
+
+    if get_lib() is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(0xF00D)
+    a = SlabIndex(rows_capacity=8)
+    b = HashSlabIndex(rows_capacity=8)
+    for window in range(120):
+        n = int(rng.integers(1, 120))
+        rows = rng.integers(0, 50, n).astype(np.int64)
+        dsts = rng.integers(0, 1 + int(rng.integers(1, 200)), n)
+        d_key = np.unique((rows << 32) | dsts)
+        pa = a.apply(d_key.copy())
+        pb = b.apply(d_key.copy())
+        np.testing.assert_array_equal(pa.new_sel, pb.new_sel)
+        np.testing.assert_array_equal(pa.slots, pb.slots)
+        assert a.heap_end == b.heap_end
+        if pa.mv is not None or pb.mv is not None:
+            np.testing.assert_array_equal(pa.mv, pb.mv)
+        if a.needs_compaction(256):
+            np.testing.assert_array_equal(a.compact(), b.compact())
+    ka, sa = a.keys_and_slots()
+    kb, sb = b.keys_and_slots()
+    np.testing.assert_array_equal(ka, kb)
+    np.testing.assert_array_equal(sa, sb)
+    # Restore path: both rebuild to the same layout and keep agreeing.
+    np.testing.assert_array_equal(a.rebuild_from_keys(ka.copy()),
+                                  b.rebuild_from_keys(ka.copy()))
+    pa = a.apply(ka[:7].copy())
+    pb = b.apply(ka[:7].copy())
+    np.testing.assert_array_equal(pa.slots, pb.slots)

@@ -20,7 +20,8 @@ LOG = logging.getLogger("tpu_cooccurrence.native")
 
 _HERE = os.path.dirname(__file__)
 _SRCS = [os.path.join(_HERE, "reservoir_expand.cpp"),
-         os.path.join(_HERE, "sliding_expand.cpp")]
+         os.path.join(_HERE, "sliding_expand.cpp"),
+         os.path.join(_HERE, "slab_hash.cpp")]
 _LIB = os.path.join(_HERE, "libreservoir_expand.so")
 
 _lib: Optional[ctypes.CDLL] = None
@@ -70,6 +71,27 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
         return None
     i64p = ctypes.POINTER(ctypes.c_int64)
     i32p = ctypes.POINTER(ctypes.c_int32)
+    try:
+        _bind_prototypes(lib, i64p, i32p)
+    except AttributeError:
+        # The .so on disk passed the staleness check but predates a newer
+        # symbol set (e.g. installed by a concurrent older-version build
+        # winning the atomic-rename race). Rebuild once; degrade to the
+        # NumPy fallback if the fresh build still lacks the symbols.
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            _bind_prototypes(lib, i64p, i32p)
+        except (OSError, AttributeError) as exc:
+            LOG.info("native symbols unavailable (%s); using NumPy "
+                     "fallback", exc)
+            return None
+    _lib = lib
+    return _lib
+
+
+def _bind_prototypes(lib, i64p, i32p) -> None:
     lib.expand_replacements.restype = ctypes.c_int64
     lib.expand_replacements.argtypes = [
         i32p, ctypes.c_int64, i64p, i64p, i64p, ctypes.c_int64,
@@ -90,8 +112,16 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
     lib.sliding_cut_mask.argtypes = [
         i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         i32p, i32p, ctypes.POINTER(ctypes.c_uint8)]
-    _lib = lib
-    return _lib
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.slab_hash_lookup.restype = None
+    lib.slab_hash_lookup.argtypes = [
+        i64p, i32p, ctypes.c_int64, i64p, ctypes.c_int64, i32p, u8p]
+    lib.slab_hash_insert.restype = None
+    lib.slab_hash_insert.argtypes = [
+        i64p, i32p, ctypes.c_int64, i64p, i32p, ctypes.c_int64]
+    lib.slab_hash_update.restype = None
+    lib.slab_hash_update.argtypes = [
+        i64p, i32p, ctypes.c_int64, i64p, i32p, ctypes.c_int64]
 
 
 def _ptr64(a: np.ndarray):
@@ -100,6 +130,10 @@ def _ptr64(a: np.ndarray):
 
 def _ptr32(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _ptr8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
 def expand_appends(hist: np.ndarray, users: np.ndarray, items: np.ndarray,

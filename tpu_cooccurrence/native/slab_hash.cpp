@@ -1,0 +1,81 @@
+// Open-addressing int64 -> int32 hash table for the sparse backends'
+// cell index (key = row << 32 | dst, value = device slab slot).
+//
+// Why native: the sorted-array SlabIndex pays an O(total cells) merge
+// per window (measured 90 s of a 463 s full ML-25M CPU run at 14M
+// cells); hashing makes the per-window cost O(window cells). Batched
+// flat-array API so Python holds the storage (NumPy arrays) and ctypes
+// passes pointers — no ownership crosses the boundary.
+//
+// Table contract: capacity is a power of two (mask = cap - 1); empty
+// buckets hold key -1 (packed keys are non-negative: row and dst are
+// < 2^31). Linear probing; the caller keeps the load factor below the
+// grow threshold, so probes terminate.
+
+#include <cstdint>
+
+namespace {
+inline uint64_t mix(uint64_t x) {
+  // splitmix64 finalizer: full-avalanche over the packed key's bits
+  // (row ids cluster in the high word; identity hashing would chain).
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+}  // namespace
+
+extern "C" {
+
+// Probe each key: out_slots[i] = value when present (out_new[i] = 0),
+// otherwise out_new[i] = 1 (out_slots[i] untouched).
+void slab_hash_lookup(const int64_t* tkeys, const int32_t* tvals,
+                      int64_t mask, const int64_t* keys, int64_t n,
+                      int32_t* out_slots, uint8_t* out_new) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t key = keys[i];
+    uint64_t h = mix((uint64_t)key) & (uint64_t)mask;
+    for (;;) {
+      const int64_t k = tkeys[h];
+      if (k == key) {
+        out_slots[i] = tvals[h];
+        out_new[i] = 0;
+        break;
+      }
+      if (k == -1) {
+        out_new[i] = 1;
+        break;
+      }
+      h = (h + 1) & (uint64_t)mask;
+    }
+  }
+}
+
+// Insert (key, slot) pairs known to be absent (fresh from a lookup miss,
+// or a rebuild). The caller has already grown the table if needed.
+void slab_hash_insert(int64_t* tkeys, int32_t* tvals, int64_t mask,
+                      const int64_t* keys, const int32_t* slots,
+                      int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t key = keys[i];
+    uint64_t h = mix((uint64_t)key) & (uint64_t)mask;
+    while (tkeys[h] != -1) h = (h + 1) & (uint64_t)mask;
+    tkeys[h] = key;
+    tvals[h] = slots[i];
+  }
+}
+
+// Overwrite the slot of keys known to be present (row relocations and
+// compaction re-laying).
+void slab_hash_update(int64_t* tkeys, int32_t* tvals, int64_t mask,
+                      const int64_t* keys, const int32_t* slots,
+                      int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t key = keys[i];
+    uint64_t h = mix((uint64_t)key) & (uint64_t)mask;
+    while (tkeys[h] != key) h = (h + 1) & (uint64_t)mask;
+    tvals[h] = slots[i];
+  }
+}
+
+}  // extern "C"

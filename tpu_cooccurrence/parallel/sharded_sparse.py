@@ -57,7 +57,8 @@ from ..sampling.reservoir import PairDeltaBatch
 from ..state.results import TopKBatch
 from ..state.sparse_scorer import (_SENT, SlabIndex, _apply_cells,
                                    _pow2ceil, _score_rect, bucket_r,
-                                   ladder_bits, score_buckets)
+                                   ladder_bits, make_slab_index,
+                                   score_buckets)
 from .mesh import ITEM_AXIS, make_mesh
 
 
@@ -87,8 +88,8 @@ class ShardedSparseScorer:
         self.development_mode = development_mode
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         self.n_shards = self.mesh.devices.size
-        self.indexes = [SlabIndex(rows_capacity=max(items_capacity
-                                                    // self.n_shards, 16))
+        self.indexes = [make_slab_index(rows_capacity=max(
+                            items_capacity // self.n_shards, 16))
                         for _ in range(self.n_shards)]
         self.items_cap = int(items_capacity)
         self.row_sums_host = np.zeros(self.items_cap, dtype=np.int64)
@@ -601,10 +602,10 @@ class ShardedSparseScorer:
         """Checkpoint filename suffix: multi-host runs save per process."""
         return f".p{jax.process_index()}" if jax.process_count() > 1 else ""
 
-    def _global_key(self, d: int, ix: SlabIndex) -> np.ndarray:
-        local_rows = (ix.g_key >> 32).astype(np.int64)
+    def _global_key(self, d: int, local_key: np.ndarray) -> np.ndarray:
+        local_rows = (local_key >> 32).astype(np.int64)
         return ((local_rows * self.n_shards + d) << 32) | (
-            ix.g_key & 0xFFFFFFFF)
+            local_key & 0xFFFFFFFF)
 
     def checkpoint_state(self) -> dict:
         local = self._local_slabs()
@@ -615,12 +616,13 @@ class ShardedSparseScorer:
             # rebuild every shard's SlabIndex from its own file. The slab
             # *counts* live on chips; each process saves only its
             # addressable shards' (ascending shard id, g_key order).
-            keys_l = [self._global_key(d, ix)
-                      for d, ix in enumerate(self.indexes) if len(ix.g_key)]
+            views = [ix.keys_and_slots() for ix in self.indexes]
+            keys_l = [self._global_key(d, k)
+                      for d, (k, _s) in enumerate(views) if len(k)]
             keys = (np.sort(np.concatenate(keys_l)) if keys_l
                     else np.zeros(0, dtype=np.int64))
             shard_ids = sorted(local)
-            cnt_l = [local[d][self.indexes[d].g_slot] for d in shard_ids]
+            cnt_l = [local[d][views[d][1]] for d in shard_ids]
             return {
                 "mh_rows_key": keys,
                 "mh_local_shards": np.asarray(shard_ids, dtype=np.int64),
@@ -632,10 +634,11 @@ class ShardedSparseScorer:
         D = self.n_shards
         keys_l, vals_l = [], []
         for d, ix in enumerate(self.indexes):
-            if not len(ix.g_key):
+            k, sl = ix.keys_and_slots()
+            if not len(k):
                 continue
-            keys_l.append(self._global_key(d, ix))
-            vals_l.append(local[d][ix.g_slot])
+            keys_l.append(self._global_key(d, k))
+            vals_l.append(local[d][sl])
         if keys_l:
             keys = np.concatenate(keys_l)
             vals = np.concatenate(vals_l)

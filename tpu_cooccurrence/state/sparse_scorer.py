@@ -322,6 +322,19 @@ class SlabIndex:
                 self.g_key, self.g_slot, pos[~exists], new_key, new_slots)
         return AllocPlan(mv, mv_len, slots, ~exists)
 
+    def _shift_moved(self, rows: np.ndarray, old_starts: np.ndarray,
+                     lens: np.ndarray, new_starts: np.ndarray) -> None:
+        """Re-point the index at relocated rows' new slots (their g_key
+        segment is contiguous in the sorted layout)."""
+        seg_lo = np.searchsorted(self.g_key, rows.astype(np.int64) << 32)
+        idx = np.repeat(seg_lo, lens) + _ragged_arange(lens)
+        self.g_slot[idx] += np.repeat(new_starts - old_starts, lens)
+
+    def keys_and_slots(self):
+        """(sorted packed cell keys, matching slots) — the checkpoint
+        view. The sorted index holds exactly this already."""
+        return self.g_key, self.g_slot
+
     def _allocate(self, new_key: np.ndarray):
         n_src = (new_key >> 32).astype(np.int64)
         rows_new, first_idx, counts = np.unique(
@@ -344,14 +357,8 @@ class SlabIndex:
             self.garbage += int(self.row_cap[grow_rows].sum())
             moved = old_len > 0
             if moved.any():
-                # Shift the index's slots for every existing cell of each
-                # moved row (their g_key segment is contiguous).
-                seg_lo = np.searchsorted(
-                    self.g_key, grow_rows[moved].astype(np.int64) << 32)
-                seg_len = old_len[moved]
-                shift = offs[moved] - old_start[moved]
-                idx = np.repeat(seg_lo, seg_len) + _ragged_arange(seg_len)
-                self.g_slot[idx] += np.repeat(shift, seg_len)
+                self._shift_moved(grow_rows[moved], old_start[moved],
+                                  old_len[moved], offs[moved])
                 mv_count = int(moved.sum())
                 mv_len = int(pad_pow4(int(old_len[moved].max()), minimum=8))
                 mv_pad = pad_pow4(mv_count, minimum=8)
@@ -432,6 +439,205 @@ class SlabIndex:
         return self.g_slot
 
 
+
+class HashSlabIndex(SlabIndex):
+    """Native hash-table cell index: O(window cells) per window.
+
+    The sorted base index pays an O(total cells) merge every window —
+    measured at 90 s of a 463 s full-ML-25M CPU run once the matrix held
+    14M cells. This variant keys cells in a C++ open-addressing table
+    (``native/slab_hash.cpp``) plus a slot -> key reverse array (needed to
+    re-point moved rows, which the sorted layout found by segment); the
+    sorted view the checkpoints want is built on demand. Same public
+    interface and allocator as the base class; use
+    :func:`make_slab_index` to pick the best available implementation.
+    """
+
+    GROW_NUM, GROW_DEN = 3, 2  # grow when 3*n > 2*cap (load ~0.67)
+
+    def __init__(self, rows_capacity: int = 1 << 10,
+                 table_capacity: int = 1 << 14) -> None:
+        from ..native import _ptr8, _ptr32, _ptr64, get_lib
+
+        super().__init__(rows_capacity)
+        self._p64, self._p32, self._p8 = _ptr64, _ptr32, _ptr8
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError(
+                "HashSlabIndex needs the native library; use "
+                "make_slab_index() to fall back to the sorted index")
+        self._cap = int(table_capacity)
+        self._tkeys = np.full(self._cap, -1, dtype=np.int64)
+        self._tvals = np.zeros(self._cap, dtype=np.int32)
+        self._n = 0
+        self.slot_key = np.full(1 << 10, -1, dtype=np.int64)
+        self._moved_rows = np.zeros(0, dtype=np.int64)  # last _shift_moved
+
+    def __len__(self) -> int:
+        return self._n
+
+
+    def _grow_table(self, need: int) -> None:
+        if self.GROW_NUM * need <= self.GROW_DEN * self._cap:
+            return
+        cap = self._cap
+        while self.GROW_NUM * need > self.GROW_DEN * cap:
+            cap *= 2
+        live = self._tkeys != -1
+        keys = np.ascontiguousarray(self._tkeys[live])
+        vals = np.ascontiguousarray(self._tvals[live])
+        self._cap = cap
+        self._tkeys = np.full(cap, -1, dtype=np.int64)
+        self._tvals = np.zeros(cap, dtype=np.int32)
+        self._lib.slab_hash_insert(self._p64(self._tkeys),
+                                   self._p32(self._tvals), cap - 1,
+                                   self._p64(keys), self._p32(vals),
+                                   len(keys))
+
+    def _ensure_slot_key(self, need: int) -> None:
+        if need <= len(self.slot_key):
+            return
+        n = len(self.slot_key)
+        while n < need:
+            n *= 2
+        grown = np.full(n, -1, dtype=np.int64)
+        grown[: len(self.slot_key)] = self.slot_key
+        self.slot_key = grown
+
+    def apply(self, d_key: np.ndarray) -> AllocPlan:
+        d_key = np.ascontiguousarray(d_key, dtype=np.int64)
+        n = len(d_key)
+        slots = np.empty(n, dtype=np.int32)
+        is_new = np.empty(n, dtype=np.uint8)
+        self._lib.slab_hash_lookup(
+            self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
+            self._p64(d_key), n, self._p32(slots), self._p8(is_new))
+        new_sel = is_new.view(bool)
+        new_key = d_key[new_sel]
+        mv = None
+        mv_len = 0
+        if len(new_key):
+            mv, mv_len, new_slots = self._allocate(new_key)
+            slots[new_sel] = new_slots
+            self._ensure_slot_key(self.heap_end)
+            self.slot_key[new_slots] = new_key
+            self._grow_table(self._n + len(new_key))
+            new_slots = np.ascontiguousarray(new_slots)
+            self._lib.slab_hash_insert(
+                self._p64(self._tkeys), self._p32(self._tvals),
+                self._cap - 1, self._p64(new_key), self._p32(new_slots),
+                len(new_key))
+            self._n += len(new_key)
+            if mv is not None and not new_sel.all():
+                # Allocation relocated rows, so the pre-allocation lookup
+                # above returned stale slots for existing cells of MOVED
+                # rows (the sorted index reads g_slot AFTER the shift) —
+                # re-probe exactly those against the updated table.
+                # Relocations fire nearly every window on Zipfian
+                # streams, so the re-probe is masked to the moved rows'
+                # cells, not the whole window.
+                ex_pos = np.flatnonzero(~new_sel)
+                stale = ex_pos[np.isin(d_key[ex_pos] >> 32,
+                                       self._moved_rows.astype(np.int64))]
+                if len(stale):
+                    ex_keys = np.ascontiguousarray(d_key[stale])
+                    ex_slots = np.empty(len(ex_keys), dtype=np.int32)
+                    scratch = np.empty(len(ex_keys), dtype=np.uint8)
+                    self._lib.slab_hash_lookup(
+                        self._p64(self._tkeys), self._p32(self._tvals),
+                        self._cap - 1, self._p64(ex_keys), len(ex_keys),
+                        self._p32(ex_slots), self._p8(scratch))
+                    slots[stale] = ex_slots
+        return AllocPlan(mv, mv_len, slots, new_sel.copy())
+
+    def _shift_moved(self, rows: np.ndarray, old_starts: np.ndarray,
+                     lens: np.ndarray, new_starts: np.ndarray) -> None:
+        # The reverse map recovers the moved cells' keys (the sorted
+        # index found them by key-segment instead).
+        self._moved_rows = rows  # apply() re-probes only these rows' cells
+        old_idx = np.repeat(old_starts, lens) + _ragged_arange(lens)
+        keys = np.ascontiguousarray(self.slot_key[old_idx])
+        new_idx = (np.repeat(new_starts, lens)
+                   + _ragged_arange(lens)).astype(np.int32)
+        self._ensure_slot_key(self.heap_end)
+        self.slot_key[new_idx] = keys
+        self._lib.slab_hash_update(
+            self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
+            self._p64(keys), self._p32(np.ascontiguousarray(new_idx)),
+            len(keys))
+
+    def compact(self) -> np.ndarray:
+        alloc = np.flatnonzero(self.row_cap > 0).astype(np.int32)
+        lens = self.row_len[alloc]
+        old_starts = self.row_start[alloc]
+        new_caps = _pow2ceil(lens, minimum=4)
+        new_starts = np.concatenate(
+            [[0], np.cumsum(new_caps)[:-1]]).astype(np.int32)
+        new_end = int(new_caps.sum())
+        within = _ragged_arange(lens).astype(np.int32)
+        old_idx = np.repeat(old_starts, lens) + within
+        new_idx = np.repeat(new_starts, lens) + within
+        gmap = np.zeros(max(new_end, 1), dtype=np.int32)
+        gmap[new_idx] = old_idx
+        keys = np.ascontiguousarray(self.slot_key[old_idx])
+        fresh = np.full(len(self.slot_key), -1, dtype=np.int64)
+        fresh[new_idx] = keys
+        self.slot_key = fresh
+        self._lib.slab_hash_update(
+            self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
+            self._p64(keys),
+            self._p32(np.ascontiguousarray(new_idx.astype(np.int32))),
+            len(keys))
+        self.row_start[alloc] = new_starts
+        self.row_cap[alloc] = new_caps
+        self.heap_end = new_end
+        self.garbage = 0
+        self.compactions += 1
+        return gmap
+
+    def rebuild_from_keys(self, keys: np.ndarray) -> np.ndarray:
+        slots = super().rebuild_from_keys(keys)
+        # The base rebuilt the registry and the sorted arrays; the hash
+        # variant keeps the table + reverse map instead.
+        keys = np.ascontiguousarray(self.g_key)
+        slots = np.ascontiguousarray(self.g_slot)
+        self.g_key = np.zeros(0, dtype=np.int64)
+        self.g_slot = np.zeros(0, dtype=np.int32)
+        cap = 1 << 14
+        while self.GROW_NUM * len(keys) > self.GROW_DEN * cap:
+            cap *= 2
+        self._cap = cap
+        self._tkeys = np.full(self._cap, -1, dtype=np.int64)
+        self._tvals = np.zeros(self._cap, dtype=np.int32)
+        if len(keys):
+            self._lib.slab_hash_insert(
+                self._p64(self._tkeys), self._p32(self._tvals),
+                self._cap - 1, self._p64(keys), self._p32(slots), len(keys))
+        self._n = len(keys)
+        self.slot_key = np.full(max(1 << 10, _pow2ceil(
+            np.asarray([max(self.heap_end, 1)]), 1024)[0]), -1,
+            dtype=np.int64)
+        if len(keys):
+            self.slot_key[slots] = keys
+        return slots
+
+    def keys_and_slots(self):
+        live = self._tkeys != -1
+        keys = self._tkeys[live]
+        slots = self._tvals[live]
+        order = np.argsort(keys, kind="stable")
+        return keys[order], slots[order]
+
+
+def make_slab_index(rows_capacity: int = 1 << 10) -> SlabIndex:
+    """Best available cell index: the native hash table, else sorted."""
+    from ..native import get_lib
+
+    if get_lib() is not None:
+        return HashSlabIndex(rows_capacity=rows_capacity)
+    return SlabIndex(rows_capacity=rows_capacity)
+
+
 class SparseDeviceScorer:
     """Single-device scorer over a :class:`SlabIndex`-managed HBM slab."""
 
@@ -470,7 +676,7 @@ class SparseDeviceScorer:
         ladder_bits(self.score_ladder)  # validate at construction
         self.counters = counters if counters is not None else Counters()
         self.development_mode = development_mode
-        self.index = SlabIndex(rows_capacity=items_capacity)
+        self.index = make_slab_index(rows_capacity=items_capacity)
         self.items_cap = int(items_capacity)
         self.row_sums_host = np.zeros(self.items_cap, dtype=np.int64)
         self.compact_min_heap = int(compact_min_heap)
@@ -735,16 +941,16 @@ class SparseDeviceScorer:
     def checkpoint_state(self) -> dict:
         """Canonical sparse-matrix snapshot — same keys as the hybrid
         backend, so checkpoints are interchangeable between the two."""
-        idx = self.index
-        if len(idx.g_slot):
+        keys, slots = self.index.keys_and_slots()
+        if len(slots):
             # Gather live cells ON DEVICE so the fetch is nnz values, not
             # the whole slab (capacity >= 2x nnz from pow-2 slack+garbage).
-            vals = np.asarray(self.cnt[jnp.asarray(idx.g_slot)])
+            vals = np.asarray(self.cnt[jnp.asarray(slots)])
         else:
             vals = np.zeros(0, np.int64)
         nz = vals != 0
         return {
-            "rows_key": idx.g_key[nz],
+            "rows_key": keys[nz],
             "rows_cnt": vals[nz].astype(np.int64),
             "row_sums": self.row_sums_host.copy(),
             "observed": np.asarray([self.observed], dtype=np.int64),
