@@ -68,12 +68,20 @@ class NullScorer:
         return TopKBatch.empty(self.top_k)
 
 
-def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000) -> dict:
+def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000,
+             backend: Backend = Backend.DEVICE) -> dict:
+    """``backend``: DEVICE is the dense int16 carrier; SPARSE scores only
+    nonzero cells (~60x fewer at this shape — 54M pairs over a 62k vocab
+    leave most of each dense row empty) at the price of host index work,
+    so the chip decides which carries config 3 (bench/tpu_round2.py
+    measures both)."""
     users, items, ts, standin = _movielens_25m(limit=n_events)
     n = len(users)
+    dense = backend == Backend.DEVICE
     cfg = Config(window_size=4000, window_slide=1000, seed=3,
-                 item_cut=500, user_cut=500, backend=Backend.DEVICE,
-                 count_dtype="int16", num_items=int(items.max()) + 1)
+                 item_cut=500, user_cut=500, backend=backend,
+                 count_dtype="int16" if dense else "int32",
+                 num_items=int(items.max()) + 1 if dense else 0)
     job = CooccurrenceJob(
         cfg, scorer=NullScorer(cfg.top_k) if host_only else None)
     start = time.monotonic()
@@ -88,7 +96,8 @@ def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000) -> dict:
     device_s = summary["score_seconds"]
     windows = summary["windows"]
     out = {
-        "name": "ml25m-full" + ("-hostonly" if host_only else ""),
+        "name": ("ml25m-full" + ("-hostonly" if host_only else "")
+                 + ("" if dense else "-sparse")),
         "backend": "null" if host_only else cfg.backend.value,
         "events": n,
         "pairs": int(pairs),

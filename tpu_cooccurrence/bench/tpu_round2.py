@@ -111,6 +111,18 @@ def ml25m_full(quick: bool) -> dict:
     return run_full(2_000_000 if quick else 25_000_000, host_only=False)
 
 
+@guard("ml25m-sparse")
+def ml25m_sparse(quick: bool) -> dict:
+    """The sparse carrier candidate: scores only nonzero cells (~60x
+    fewer than dense at this shape) for more host index work — the chip
+    decides which backend carries config 3."""
+    from ..config import Backend
+    from .ml25m import run_full
+
+    return run_full(2_000_000 if quick else 25_000_000, host_only=False,
+                    backend=Backend.SPARSE)
+
+
 @guard("pallas-bench")
 def pallas_bench(quick: bool) -> dict:
     """The kernel's target case: int16 counts at a max-vocab shape, where
@@ -188,6 +200,7 @@ def main() -> None:
         "config4-sparse": config4_sparse,
         "config4-hybrid": config4_hybrid,
         "ml25m-full": ml25m_full,
+        "ml25m-sparse": ml25m_sparse,
         "pallas-bench": pallas_bench,
         "configs": all_configs,
     }

@@ -400,9 +400,10 @@ class SlabIndex:
         gmap = np.zeros(max(new_end, 1), dtype=np.int32)
         gmap[np.repeat(new_starts, lens) + within] = (
             np.repeat(old_starts, lens) + within)
-        # g_key is row-major sorted, so its per-row segments line up with
-        # ``alloc`` (every allocated row has len >= 1 cells in the index).
-        self.g_slot += np.repeat(new_starts - old_starts, lens)
+        # Re-point the index at the compacted layout (the hook reads all
+        # old positions before writing, so overlapping old/new regions of
+        # different rows are safe).
+        self._shift_moved(alloc, old_starts, lens, new_starts)
         self.row_start[alloc] = new_starts
         self.row_cap[alloc] = new_caps
         self.heap_end = new_end
@@ -467,6 +468,10 @@ class HashSlabIndex(SlabIndex):
                 "HashSlabIndex needs the native library; use "
                 "make_slab_index() to fall back to the sorted index")
         self._cap = int(table_capacity)
+        if self._cap < 2 or self._cap & (self._cap - 1):
+            raise ValueError(
+                f"table_capacity must be a power of two >= 2, got "
+                f"{table_capacity} (the probe mask is capacity - 1)")
         self._tkeys = np.full(self._cap, -1, dtype=np.int64)
         self._tvals = np.zeros(self._cap, dtype=np.int32)
         self._n = 0
@@ -565,35 +570,6 @@ class HashSlabIndex(SlabIndex):
             self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
             self._p64(keys), self._p32(np.ascontiguousarray(new_idx)),
             len(keys))
-
-    def compact(self) -> np.ndarray:
-        alloc = np.flatnonzero(self.row_cap > 0).astype(np.int32)
-        lens = self.row_len[alloc]
-        old_starts = self.row_start[alloc]
-        new_caps = _pow2ceil(lens, minimum=4)
-        new_starts = np.concatenate(
-            [[0], np.cumsum(new_caps)[:-1]]).astype(np.int32)
-        new_end = int(new_caps.sum())
-        within = _ragged_arange(lens).astype(np.int32)
-        old_idx = np.repeat(old_starts, lens) + within
-        new_idx = np.repeat(new_starts, lens) + within
-        gmap = np.zeros(max(new_end, 1), dtype=np.int32)
-        gmap[new_idx] = old_idx
-        keys = np.ascontiguousarray(self.slot_key[old_idx])
-        fresh = np.full(len(self.slot_key), -1, dtype=np.int64)
-        fresh[new_idx] = keys
-        self.slot_key = fresh
-        self._lib.slab_hash_update(
-            self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
-            self._p64(keys),
-            self._p32(np.ascontiguousarray(new_idx.astype(np.int32))),
-            len(keys))
-        self.row_start[alloc] = new_starts
-        self.row_cap[alloc] = new_caps
-        self.heap_end = new_end
-        self.garbage = 0
-        self.compactions += 1
-        return gmap
 
     def rebuild_from_keys(self, keys: np.ndarray) -> np.ndarray:
         slots = super().rebuild_from_keys(keys)
