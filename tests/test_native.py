@@ -43,3 +43,52 @@ def test_native_matches_numpy_fallback(monkeypatch):
         np.testing.assert_array_equal(nf, ff)
     np.testing.assert_array_equal(nat_hist, fall_hist)
     np.testing.assert_array_equal(nat_len, fall_len)
+
+
+def test_grouped_rank_native_matches_numpy():
+    import pytest
+
+    import tpu_cooccurrence.native as native
+    from tpu_cooccurrence.sampling.item_cut import grouped_rank
+
+    if native.get_lib() is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(0x6E0)
+    for n, hi in ((513, 3), (2000, 50), (5000, 5000), (600, 1)):
+        keys = rng.integers(0, hi, n).astype(np.int64)
+        got = grouped_rank(keys)           # native path (n > 512)
+        saved = native.grouped_rank_dense
+        native.grouped_rank_dense = lambda *a: None
+        try:
+            want = grouped_rank(keys)      # argsort fallback
+        finally:
+            native.grouped_rank_dense = saved
+        np.testing.assert_array_equal(got, want)
+
+
+def test_grouped_rank_guards_sparse_and_negative_keys():
+    """Negative or huge-sparse key spaces must take the argsort fallback
+    (the native pass indexes a scratch array by key)."""
+    from tpu_cooccurrence.sampling.item_cut import grouped_rank
+
+    rng = np.random.default_rng(0x6E1)
+    neg = rng.integers(-5, 5, 1000).astype(np.int64)
+    got = grouped_rank(neg)
+    # Oracle by dict counting.
+    seen = {}
+    want = np.array([seen.setdefault(k, 0) or 0 for k in neg.tolist()])
+    counts = {}
+    want = np.empty(len(neg), dtype=np.int64)
+    for i, k in enumerate(neg.tolist()):
+        want[i] = counts.get(k, 0)
+        counts[k] = want[i] + 1
+    np.testing.assert_array_equal(got, want)
+
+    sparse_keys = rng.integers(0, 2**40, 1000).astype(np.int64)
+    got = grouped_rank(sparse_keys)  # must not allocate a 2^40 scratch
+    counts = {}
+    want = np.empty(len(sparse_keys), dtype=np.int64)
+    for i, k in enumerate(sparse_keys.tolist()):
+        want[i] = counts.get(k, 0)
+        counts[k] = want[i] + 1
+    np.testing.assert_array_equal(got, want)

@@ -21,7 +21,8 @@ LOG = logging.getLogger("tpu_cooccurrence.native")
 _HERE = os.path.dirname(__file__)
 _SRCS = [os.path.join(_HERE, "reservoir_expand.cpp"),
          os.path.join(_HERE, "sliding_expand.cpp"),
-         os.path.join(_HERE, "slab_hash.cpp")]
+         os.path.join(_HERE, "slab_hash.cpp"),
+         os.path.join(_HERE, "grouped_rank.cpp")]
 _LIB = os.path.join(_HERE, "libreservoir_expand.so")
 
 _lib: Optional[ctypes.CDLL] = None
@@ -122,6 +123,8 @@ def _bind_prototypes(lib, i64p, i32p) -> None:
     lib.slab_hash_update.restype = None
     lib.slab_hash_update.argtypes = [
         i64p, i32p, ctypes.c_int64, i64p, i32p, ctypes.c_int64]
+    lib.grouped_rank_dense.restype = None
+    lib.grouped_rank_dense.argtypes = [i64p, ctypes.c_int64, i32p, i32p]
 
 
 def _ptr64(a: np.ndarray):
@@ -285,3 +288,22 @@ def sliding_cut_mask(users: np.ndarray, items: np.ndarray, f_max: int,
         _ptr32(scratch.item_count), _ptr32(scratch.user_count),
         keep.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return keep.view(np.bool_)
+
+
+def grouped_rank_dense(keys: np.ndarray, max_key: int):
+    """Native stable grouped rank for dense non-negative int64 keys.
+
+    ``max_key`` is an inclusive bound on ``keys`` (callers track it —
+    vocab size / user count); returns int64 ranks, or None when the
+    native library is unavailable (callers fall back to the argsort
+    form in sampling/item_cut.py).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    scratch = np.zeros(max_key + 1, dtype=np.int32)
+    out = np.empty(len(keys), dtype=np.int32)
+    lib.grouped_rank_dense(_ptr64(keys), len(keys), _ptr32(scratch),
+                           _ptr32(out))
+    return out.astype(np.int64)

@@ -1,0 +1,19 @@
+// Stable grouped rank over dense non-negative keys: out[i] = number of
+// earlier events with the same key. One O(n) pass with an O(max_key)
+// counter scratch — replaces a stable argsort + segment scan (the numpy
+// fallback), which showed up as the sampler's largest remaining host
+// cost once pair expansion went native.
+
+#include <cstdint>
+
+extern "C" {
+
+// scratch: int32[scratch_len], zeroed by the caller; keys[i] < scratch_len.
+void grouped_rank_dense(const int64_t* keys, int64_t n, int32_t* scratch,
+                        int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = scratch[keys[i]]++;
+  }
+}
+
+}  // extern "C"

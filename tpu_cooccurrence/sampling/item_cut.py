@@ -21,10 +21,28 @@ def grouped_rank(keys: np.ndarray) -> np.ndarray:
     """Rank (0-based) of each element within its key group, by position.
 
     ``grouped_rank([5, 3, 5, 5, 3]) == [0, 0, 1, 2, 1]``.
+
+    Hot path: one native O(n) counting pass (keys here are always dense
+    non-negative ids — users or items); fallback: stable argsort +
+    segment scan.
     """
     n = len(keys)
     if n == 0:
         return np.zeros(0, dtype=np.int64)
+    if n > 512:  # native pays off past the ctypes call overhead
+        kmin = int(keys.min())
+        kmax = int(keys.max())
+        # The native pass costs O(n + max_key) (it zeroes a counter per
+        # key id): only take it for non-negative keys whose id space is
+        # comparable to the batch — a negative key would write out of
+        # bounds in C, and a huge sparse key space would allocate its
+        # size in scratch while the argsort fallback stays O(n log n).
+        if kmin >= 0 and kmax < 32 * n + (1 << 16):
+            from .. import native
+
+            ranks = native.grouped_rank_dense(keys, kmax)
+            if ranks is not None:
+                return ranks
     order = np.argsort(keys, kind="stable")
     sorted_keys = keys[order]
     group_start = np.zeros(n, dtype=np.int64)
