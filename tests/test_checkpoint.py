@@ -201,3 +201,31 @@ def test_restore_across_count_dtype(tmp_path):
     st2 = big.checkpoint_state()
     with pytest.raises(ValueError, match="int16"):
         DeviceScorer(32, 5, count_dtype="int16").restore_state(st2)
+
+
+def test_deferred_resume_keeps_real_emission_count(tmp_path):
+    """Defer-to-defer resume restores the real emission count; only a
+    per-window-backend resume takes the rescored-rows substitution."""
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.metrics import RESCORED_ITEMS
+    from test_pipeline import random_stream
+
+    kw = dict(window_size=10, seed=11, item_cut=5, user_cut=3,
+              backend=Backend.SPARSE, checkpoint_dir=str(tmp_path / "ck"))
+    users, items, ts = random_stream(71, n=600)
+    a = CooccurrenceJob(Config(**kw))
+    assert a.scorer.defer_results
+    a.add_batch(users, items, ts)
+    a.checkpoint()
+    rescored = a.counters.get(RESCORED_ITEMS)
+    real = a.emissions
+    assert rescored > real  # rows rescored across windows, drained once
+
+    b = CooccurrenceJob(Config(**kw))          # deferred again
+    b.restore()
+    assert b.emissions == real
+
+    c = CooccurrenceJob(Config(**kw, emit_updates=True))  # per-window
+    c.restore()
+    assert c.emissions == rescored

@@ -115,3 +115,15 @@ def test_fixed_score_conflicts_rejected():
                   fixed_score="yes")
     with pytest.raises(ValueError, match="auto|on|off"):
         CooccurrenceJob(cfg3)
+
+
+def test_fixed_score_rejected_on_non_sparse_backends():
+    import pytest
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    cfg = Config(window_size=10, seed=1, backend=Backend.DEVICE,
+                 num_items=16, fixed_score="on")
+    with pytest.raises(ValueError, match="only applies"):
+        CooccurrenceJob(cfg)

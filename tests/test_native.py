@@ -75,8 +75,6 @@ def test_grouped_rank_guards_sparse_and_negative_keys():
     neg = rng.integers(-5, 5, 1000).astype(np.int64)
     got = grouped_rank(neg)
     # Oracle by dict counting.
-    seen = {}
-    want = np.array([seen.setdefault(k, 0) or 0 for k in neg.tolist()])
     counts = {}
     want = np.empty(len(neg), dtype=np.int64)
     for i, k in enumerate(neg.tolist()):

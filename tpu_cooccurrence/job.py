@@ -131,8 +131,24 @@ class CooccurrenceJob:
         if not config.skip_cuts and not self.sliding:
             self.counters.add(FEEDBACK_QUEUES, 1)
 
+    def _parse_fixed_score(self):
+        fixed = {"auto": None, "on": True,
+                 "off": False}.get(self.config.fixed_score, KeyError)
+        if fixed is KeyError:
+            raise ValueError(
+                f"fixed_score must be auto|on|off, got "
+                f"{self.config.fixed_score!r}")
+        return fixed
+
     def _make_scorer(self):
         backend = self.config.backend
+        if backend != Backend.SPARSE and self._parse_fixed_score() is not None:
+            # An explicit setting the backend cannot honor must not be
+            # silently ignored (same rule as the sparse branch's
+            # emit-updates conflict).
+            raise ValueError(
+                f"--fixed-score {self.config.fixed_score} only applies to "
+                f"--backend sparse (got {backend.value})")
         if backend == Backend.ORACLE:
             return HostRescorer(self.config.top_k, self.counters,
                                 self.config.development_mode)
@@ -155,12 +171,7 @@ class CooccurrenceJob:
             return HybridScorer(self.config.top_k, self.counters,
                                 self.config.development_mode)
         if backend == Backend.SPARSE:
-            fixed = {"auto": None, "on": True,
-                     "off": False}.get(self.config.fixed_score, KeyError)
-            if fixed is KeyError:
-                raise ValueError(
-                    f"fixed_score must be auto|on|off, got "
-                    f"{self.config.fixed_score!r}")
+            fixed = self._parse_fixed_score()
             if self.config.num_shards > 1:
                 if fixed:
                     raise ValueError(

@@ -79,15 +79,28 @@ def _get_lib_locked() -> Optional[ctypes.CDLL]:
         # symbol set (e.g. installed by a concurrent older-version build
         # winning the atomic-rename race). Rebuild once; degrade to the
         # NumPy fallback if the fresh build still lacks the symbols.
+        # dlopen caches handles BY PATHNAME, so re-CDLL'ing the replaced
+        # canonical path would return the stale handle — load the fresh
+        # build through a unique path instead (the unlink below is safe:
+        # the handle keeps the inode alive).
         if not _build():
             return None
+        reload_path = f"{_LIB}.{os.getpid()}.reload.so"
         try:
-            lib = ctypes.CDLL(_LIB)
+            import shutil
+
+            shutil.copy2(_LIB, reload_path)
+            lib = ctypes.CDLL(reload_path)
             _bind_prototypes(lib, i64p, i32p)
         except (OSError, AttributeError) as exc:
             LOG.info("native symbols unavailable (%s); using NumPy "
                      "fallback", exc)
             return None
+        finally:
+            try:
+                os.unlink(reload_path)
+            except OSError:
+                pass
     _lib = lib
     return _lib
 

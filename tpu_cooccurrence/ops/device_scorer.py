@@ -266,11 +266,14 @@ class DeferredResultsTable:
         rows = np.flatnonzero(self.dirty)
         if self.tbl is None or len(rows) == 0:
             return TopKBatch.empty(self.top_k)
-        self.dirty[rows] = False
         n = len(rows)
         rows_pad = np.zeros(pad_pow2(n, minimum=16), np.int32)
         rows_pad[:n] = rows
         host = np.asarray(_gather_packed(self.tbl, jnp.asarray(rows_pad)))
+        # Clear marks only once the host copy is in hand: a transient
+        # fetch failure (tunneled links drop) must leave the rows dirty
+        # so a retrying caller can still drain them.
+        self.dirty[rows] = False
         idx = (host[1, :n].astype(np.int32) if float_ids
                else host[1, :n].view(np.int32))
         return TopKBatch(rows.astype(np.int32), idx, host[0, :n])

@@ -552,7 +552,6 @@ class ShardedSparseScorer:
             rows = np.flatnonzero(self._tbl_dirty)
             if self._tbl is None or len(rows) == 0:
                 return TopKBatch.empty(self.top_k)
-            self._tbl_dirty[rows] = False
             D = self.n_shards
             owner = (rows % D).astype(np.int64)
             counts = np.bincount(owner, minlength=D)
@@ -573,9 +572,12 @@ class ShardedSparseScorer:
                 if not n:
                     continue
                 host = np.asarray(shard.data)[0]  # [2, rp, K]
-                rows_l.append(per_shard[d])
+                rows_l.append(per_shard[d].astype(np.int32))
                 vals_l.append(host[0, :n])
                 idx_l.append(host[1, :n].view(np.int32))
+            # Clear marks only after the host copies are in hand (a
+            # transient fetch failure must leave the rows drainable).
+            self._tbl_dirty[rows] = False
             return TopKBatch.concatenate(rows_l, idx_l, vals_l, self.top_k)
         prev, self._pending = self._pending, None
         return (self._materialize(prev) if prev is not None

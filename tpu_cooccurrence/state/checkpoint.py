@@ -48,14 +48,17 @@ def save(job, directory: str, source=None) -> str:
         "window_slide": job.config.window_slide,
         "window_millis": job.config.window_millis,
         "windows_fired": job.windows_fired,
+        "emissions": job.emissions,
         # A deferred-results scorer materializes each row once from its
         # device table however many windows rescored it, so its emission
-        # count is not comparable with the rescored-rows counter; record
-        # the counter instead so a resume onto a per-window backend starts
-        # its drain invariant balanced.
-        "emissions": (job.counters.get(RESCORED_ITEMS)
-                      if getattr(job.scorer, "defer_results", False)
-                      else job.emissions),
+        # count is not comparable with the rescored-rows counter. Record
+        # the count a PER-WINDOW backend should resume with (the rescored
+        # total keeps its drain invariant balanced) alongside the real
+        # one, and let restore pick by the restoring scorer's mode.
+        "emissions_per_window_resume": (
+            job.counters.get(RESCORED_ITEMS)
+            if getattr(job.scorer, "defer_results", False)
+            else job.emissions),
         "max_ts_seen": job.engine.max_ts_seen,
         "counters": job.counters.as_dict(),
     }
@@ -179,7 +182,10 @@ def restore(job, directory: str, source=None) -> None:
          if k.startswith("scorer_")})
 
     job.windows_fired = meta["windows_fired"]
-    job.emissions = meta["emissions"]
+    job.emissions = (meta["emissions"]
+                     if getattr(job.scorer, "defer_results", False)
+                     else meta.get("emissions_per_window_resume",
+                                   meta["emissions"]))
     job.counters.replace_all(meta["counters"])
 
     # The store keeps dense ids; the .npz holds external ids (the public

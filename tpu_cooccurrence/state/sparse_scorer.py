@@ -122,7 +122,13 @@ def _apply_moves_update(cnt, dst, row_sums, mv, upd, bounds, L: int):
     outgrowing their pow-2 caps), so fusing the two kernels removes a
     per-window dispatch — on a high-latency tunnel each dispatch is wall
     time. Moves run first: the window's new-cell slots already assume the
-    relocated layout."""
+    relocated layout.
+
+    Trade-off, deliberate: the fused program is keyed by the cartesian
+    (mv_pad, L, n_pad) where the split kernels were keyed by the two
+    sums — more cold-start compiles, amortized by the coarse pow-4
+    ladders and the on-disk XLA cache, in exchange for one fewer
+    dispatch on nearly every window."""
     cnt, dst = _moves_body(cnt, dst, mv, L)
     return _update_body(cnt, dst, row_sums, upd, bounds)
 
