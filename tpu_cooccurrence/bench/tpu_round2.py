@@ -53,6 +53,33 @@ def guard(name: str):
     return deco
 
 
+@guard("tunnel-probe")
+def tunnel_probe_pass(quick: bool) -> dict:
+    """First thing in the pass: ~2 minutes of dispatch/transfer-latency
+    separation (enqueue vs sync RTT, upload bandwidth, fetch overlap) —
+    the numbers every ladder/deferral decision keys on. Runs before the
+    long measurements so a short grant still captures them."""
+    from .tunnel_probe import probe
+
+    return probe()
+
+
+@guard("config5-sparse")
+def config5_sparse(quick: bool) -> dict:
+    """Instacart shape on the sparse backend (50k vocab): the same
+    nonzero-cells-only argument as ml25m-sparse — the chip picks the
+    config-5 carrier."""
+    from ..config import Backend
+    from .configs import config5_instacart
+
+    if quick:
+        # Quick mode exists to sanity-check the tunnel cheaply; the
+        # Instacart shape takes minutes (same rule as all_configs).
+        return {"skipped": "config 5 takes minutes; run without --quick"}
+    config5_instacart(backend=Backend.SPARSE)
+    return config5_instacart(backend=Backend.SPARSE).as_dict()
+
+
 @guard("config4-sparse")
 def config4_sparse(quick: bool) -> dict:
     from .configs import config4_zipfian_1m
@@ -197,10 +224,12 @@ def main() -> None:
                     help="comma-separated subset of measurement names")
     args = ap.parse_args()
     passes = {
+        "tunnel-probe": tunnel_probe_pass,
         "config4-sparse": config4_sparse,
         "config4-hybrid": config4_hybrid,
         "ml25m-full": ml25m_full,
         "ml25m-sparse": ml25m_sparse,
+        "config5-sparse": config5_sparse,
         "pallas-bench": pallas_bench,
         "configs": all_configs,
     }

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 
-def main() -> None:
+def probe() -> dict:
+    """Run all probe sections and return the result dict."""
     out = {"devices": [str(d) for d in jax.devices()],
            "backend": jax.default_backend()}
 
@@ -97,8 +98,11 @@ def main() -> None:
         np.asarray(r2)
     out["fetch_320kb_after_50ms_host_work_ms"] = round(
         ((time.monotonic() - start) - spun) / reps * 1e3, 2)
+    return out
 
-    print(json.dumps(out))
+
+def main() -> None:
+    print(json.dumps(probe()))
 
 
 if __name__ == "__main__":
