@@ -85,3 +85,35 @@ def test_reset_clears_everything():
     t.reset(8)
     assert t.tbl is None
     assert len(t.drain()) == 0
+
+
+def test_drain_survives_transient_fetch_failure(monkeypatch):
+    """A fetch failure must leave the dirty marks set so a retrying
+    caller still drains the rows (failure-atomic drain)."""
+    import tpu_cooccurrence.ops.device_scorer as ds
+
+    t = DeferredResultsTable(top_k=2, items_cap=8)
+    t.ensure()
+    t.scatter(_packed([([4.0, 1.0], [2, 5])], 2), np.asarray([3], np.int32))
+    t.mark(np.asarray([3]))
+
+    real = ds._gather_packed
+    calls = {"n": 0}
+
+    def flaky(tbl, rows):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient link failure")
+        return real(tbl, rows)
+
+    monkeypatch.setattr(ds, "_gather_packed", flaky)
+    try:
+        t.drain()
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("expected the injected failure to propagate")
+    b = t.drain()  # retry: rows are still dirty
+    assert list(b.rows) == [3]
+    np.testing.assert_allclose(b.vals[0, :2], [4.0, 1.0])
+    assert len(t.drain()) == 0
