@@ -343,18 +343,27 @@ def test_sparse_fixed_shapes_matches_variable():
 
 
 def test_sparse_fixed_shapes_dispatch_signature_constant():
-    """Every fixed-mode scoring dispatch of a bucket reuses one (R, S)
-    signature — the whole point (one compile, one program)."""
+    """Fixed mode scores a whole window in ONE dispatch whose static plan
+    gives each bucket R a single constant S — the whole point (a handful
+    of programs, one scoring dispatch per window)."""
     import tpu_cooccurrence.state.sparse_scorer as sp
     from tpu_cooccurrence.job import CooccurrenceJob
 
-    shapes = set()
-    orig = sp._score_into_table
+    plans = []
+    calls = {"window": 0, "per_bucket": 0}
+    orig_window = sp._score_window_into_table
+    orig_bucket = sp._score_into_table
 
-    def spy(tbl, cnt, dst, row_sums, meta, observed, *, top_k, R):
-        shapes.add((R, meta.shape[1]))
-        return orig(tbl, cnt, dst, row_sums, meta, observed,
-                    top_k=top_k, R=R)
+    def spy_window(tbl, cnt, dst, row_sums, meta_all, observed, *,
+                   top_k, plan):
+        calls["window"] += 1
+        plans.append(plan)
+        return orig_window(tbl, cnt, dst, row_sums, meta_all, observed,
+                           top_k=top_k, plan=plan)
+
+    def spy_bucket(*a, **k):
+        calls["per_bucket"] += 1
+        return orig_bucket(*a, **k)
 
     cfg = Config(window_size=10, seed=0xF6, item_cut=5, user_cut=4,
                  backend=Backend.SPARSE, development_mode=True)
@@ -365,15 +374,32 @@ def test_sparse_fixed_shapes_dispatch_signature_constant():
     scorer.FIXED_ROW_CAP = 64
     job = CooccurrenceJob(cfg, scorer=scorer)
     scorer.counters = job.counters
-    sp._score_into_table = spy
+    sp._score_window_into_table = spy_window
+    sp._score_into_table = spy_bucket
     try:
         job.add_batch(users, items, ts)
         job.finish()
     finally:
-        sp._score_into_table = orig
-    # One signature per bucket R: S is a pure function of R in fixed mode.
-    rs = [r for r, _s in shapes]
-    assert len(rs) == len(set(rs)), shapes
+        sp._score_window_into_table = orig_window
+        sp._score_into_table = orig_bucket
+    assert calls["window"] > 0       # fixed mode used the fused dispatch
+    assert calls["per_bucket"] == 0  # never the per-bucket path
+    # S is a pure function of R across EVERY dispatch of the stream
+    # (constant rectangles — the invariant that bounds program count).
+    s_by_r = {}
+    for plan in plans:
+        rs = [r for r, _s, _o in plan]
+        assert len(rs) == len(set(rs)), plan  # one rect per bucket here
+        for r, s, _o in plan:
+            assert s_by_r.setdefault(r, s) == s, (r, s, s_by_r)
+    # The monotone high-water plan only ever grows: each plan extends
+    # its predecessor's bucket set.
+    seen = set()
+    for plan in plans:
+        buckets = {r for r, _s, _o in plan}
+        assert seen <= buckets, (seen, buckets)
+        seen = buckets
+    assert len(set(plans)) <= len(s_by_r)  # <= one program per bucket
 
 
 def test_hash_index_matches_sorted_index():
