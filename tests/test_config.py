@@ -105,10 +105,11 @@ def test_fixed_score_conflicts_rejected():
                  fixed_score="on", emit_updates=True)
     with pytest.raises(ValueError, match="emit-updates"):
         CooccurrenceJob(cfg)
-    # Explicit on + sharded-sparse: unsupported, refuse.
+    # Explicit on + sharded-sparse + emit-updates: refuse (the fused
+    # rectangles are defer-only there too).
     cfg2 = Config(window_size=10, seed=1, backend=Backend.SPARSE,
-                  fixed_score="on", num_shards=2)
-    with pytest.raises(ValueError, match="num-shards"):
+                  fixed_score="on", num_shards=2, emit_updates=True)
+    with pytest.raises(ValueError, match="emit-updates"):
         CooccurrenceJob(cfg2)
     # Bogus value: descriptive error, not a KeyError.
     cfg3 = Config(window_size=10, seed=1, backend=Backend.SPARSE,

@@ -179,3 +179,35 @@ def test_sharded_sparse_deferred_growth_and_checkpoint(tmp_path):
     b.add_batch(users[half:], items[half:], ts[half:])
     b.finish()
     assert_latest_close(ref.latest, b.latest, rtol=1e-6, atol=1e-6)
+
+
+def test_sharded_sparse_fixed_shapes_matches_variable():
+    """Sharded fixed-shape scoring (one fused shard_map dispatch per
+    window over a shard-uniform monotone plan) == the variable ladder."""
+    from tpu_cooccurrence.parallel.sharded_sparse import ShardedSparseScorer
+
+    kw = dict(window_size=10, seed=0xF7, item_cut=5, user_cut=4,
+              development_mode=True)
+    users, items, ts = random_stream(73, n=1500)
+
+    def run(fixed):
+        cfg = Config(**kw, backend=Backend.SPARSE, num_shards=8)
+        scorer = ShardedSparseScorer(cfg.top_k, num_shards=8,
+                                     development_mode=True,
+                                     defer_results=True,
+                                     fixed_shapes=fixed)
+        if fixed:
+            scorer.FIXED_BUDGET = 1 << 12
+            scorer.FIXED_ROW_CAP = 64
+        job = CooccurrenceJob(cfg, scorer=scorer)
+        scorer.counters = job.counters
+        job.add_batch(users, items, ts)
+        job.finish()
+        return job
+
+    var = run(False)
+    fix = run(True)
+    assert_latest_close(var.latest, fix.latest, rtol=1e-6, atol=1e-6)
+    for name in (OBSERVED_COOCCURRENCES, ROW_SUM_PROCESS_WINDOW,
+                 RESCORED_ITEMS):
+        assert var.counters.get(name) == fix.counters.get(name), name

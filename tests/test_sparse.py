@@ -388,18 +388,60 @@ def test_sparse_fixed_shapes_dispatch_signature_constant():
     # (constant rectangles — the invariant that bounds program count).
     s_by_r = {}
     for plan in plans:
-        rs = [r for r, _s, _o in plan]
-        assert len(rs) == len(set(rs)), plan  # one rect per bucket here
         for r, s, _o in plan:
             assert s_by_r.setdefault(r, s) == s, (r, s, s_by_r)
-    # The monotone high-water plan only ever grows: each plan extends
-    # its predecessor's bucket set.
-    seen = set()
+    # The monotone high-water plan only ever grows: each plan's
+    # (R -> chunk count) multiset extends its predecessor's.
+    seen = {}
     for plan in plans:
-        buckets = {r for r, _s, _o in plan}
-        assert seen <= buckets, (seen, buckets)
-        seen = buckets
-    assert len(set(plans)) <= len(s_by_r)  # <= one program per bucket
+        counts = {}
+        for r, _s, _o in plan:
+            counts[r] = counts.get(r, 0) + 1
+        for r, n in seen.items():
+            assert counts.get(r, 0) >= n, (seen, counts)
+        seen = counts
+    # Program count bounded by the final plan count, not window count.
+    assert len(set(plans)) <= sum(seen.values())
+
+
+def test_sparse_fixed_shapes_chunk_overflow_plan_persists():
+    """A bucket overflowing its per-dispatch row cap adds chunk-rank
+    entries to the plan; later smaller windows RETAIN them (all-padding)
+    so the fused program never retraces."""
+    import tpu_cooccurrence.state.sparse_scorer as sp
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    plans = []
+    orig = sp._score_window_into_table
+
+    def spy(*a, **k):
+        plans.append(k["plan"])
+        return orig(*a, **k)
+
+    cfg = Config(window_size=10, seed=2, skip_cuts=True,
+                 development_mode=True)
+    sc = sp.SparseDeviceScorer(cfg.top_k, development_mode=True,
+                               defer_results=True, fixed_shapes=True)
+    sc.FIXED_BUDGET = 1 << 10
+    sc.FIXED_ROW_CAP = 16   # force chunk overflow on the busy window
+    job = CooccurrenceJob(cfg, scorer=sc)
+    sc.counters = job.counters
+    u1 = np.zeros(40, np.int64)
+    i1 = np.arange(40, dtype=np.int64)
+    u2 = np.zeros(5, np.int64)
+    i2 = np.arange(5, dtype=np.int64)
+    sp._score_window_into_table = spy
+    try:
+        job.add_batch(np.concatenate([u1, u2]),
+                      np.concatenate([i1, i2]),
+                      np.concatenate([np.full(40, 5, np.int64),
+                                      np.full(5, 15, np.int64)]))
+        job.finish()
+    finally:
+        sp._score_window_into_table = orig
+    assert len(plans) >= 2
+    assert len(plans[0]) >= 3          # the busy window overflowed
+    assert len(set(plans)) == 1        # one program for the whole stream
 
 
 def test_hash_index_matches_sorted_index():

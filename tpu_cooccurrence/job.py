@@ -173,11 +173,6 @@ class CooccurrenceJob:
         if backend == Backend.SPARSE:
             fixed = self._parse_fixed_score()
             if self.config.num_shards > 1:
-                if fixed:
-                    raise ValueError(
-                        "--fixed-score on is not supported with "
-                        "--num-shards > 1 (the sharded-sparse scorer "
-                        "dispatches per-shard variable rectangles)")
                 from .parallel.distributed import maybe_multihost_mesh
                 from .parallel.sharded_sparse import ShardedSparseScorer
 
@@ -187,7 +182,8 @@ class CooccurrenceJob:
                     mesh=maybe_multihost_mesh(self.config),
                     development_mode=self.config.development_mode,
                     score_ladder=self.config.score_ladder,
-                    defer_results=not self.config.emit_updates)
+                    defer_results=not self.config.emit_updates,
+                    fixed_shapes=fixed)
             if self.config.coordinator is not None:
                 # A coordinator with the default single shard would run one
                 # full independent job per process (and clobber a shared

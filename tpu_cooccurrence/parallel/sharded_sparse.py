@@ -57,7 +57,8 @@ from ..sampling.reservoir import PairDeltaBatch
 from ..state.results import TopKBatch
 from ..state.sparse_scorer import (_SENT, SlabIndex, _apply_cells,
                                    _pow2ceil, _score_rect, bucket_r,
-                                   ladder_bits, make_slab_index,
+                                   fixed_block, ladder_bits,
+                                   make_slab_index, resolve_fixed_shapes,
                                    score_buckets)
 from .mesh import ITEM_AXIS, make_mesh
 
@@ -66,6 +67,10 @@ class ShardedSparseScorer:
     """Modulo-row-sharded sparse slabs + replicated row sums via psum."""
 
     SCORE_BUDGET = 1 << 24  # per-shard padded-cell budget per score call
+    # Fixed-shape mode budgets (PER SHARD — every shard pads to the same
+    # rectangle; see state/sparse_scorer.SparseDeviceScorer).
+    FIXED_BUDGET = 1 << 22
+    FIXED_ROW_CAP = 1 << 16
 
     def __init__(self, top_k: int, num_shards: Optional[int] = None,
                  counters: Optional[Counters] = None,
@@ -75,7 +80,8 @@ class ShardedSparseScorer:
                  items_capacity: int = 1 << 10,
                  compact_min_heap: int = 1 << 16,
                  score_ladder: Optional[int] = None,
-                 defer_results: bool = False) -> None:
+                 defer_results: bool = False,
+                 fixed_shapes: Optional[bool] = None) -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -116,7 +122,14 @@ class ShardedSparseScorer:
         self._tbl = None          # lazy [D, 2, local_cap, K] device array
         self._tbl_dirty = np.zeros(self.items_cap, dtype=bool)
         self._score_into_fns: Dict[int, object] = {}
+        self._score_window_fns: Dict[tuple, object] = {}
         self._tbl_gather_fns: Dict[int, object] = {}
+        # Fixed-shape scoring (same contract and env override as the
+        # single-device sparse scorer — constant per-bucket rectangles,
+        # one fused window dispatch over a monotone high-water plan).
+        self.fixed_shapes = resolve_fixed_shapes(fixed_shapes,
+                                                 self.defer_results)
+        self._plan_buckets = {}  # bucket -> high-water chunk count
 
         from .distributed import put_global
 
@@ -239,6 +252,35 @@ class ShardedSparseScorer:
             self._score_into_fns[R] = fn
         return fn
 
+    def _score_window_into_fn(self, plan: tuple):
+        """Fused window scoring into the sharded table: one shard_map
+        dispatch runs every plan rectangle on each shard (same static
+        plan on all shards — the caller pads every shard's meta to the
+        common per-bucket cap)."""
+        fn = self._score_window_fns.get(plan)
+        if fn is None:
+            top_k = self.top_k
+            D = self.n_shards
+
+            def _f(tbl_loc, cnt_loc, dst_loc, row_sums, meta_loc, observed):
+                tbl = tbl_loc[0]
+                for R, S, off in plan:
+                    meta = jax.lax.slice(meta_loc[0], (0, off), (3, off + S))
+                    out = _score_rect(cnt_loc[0], dst_loc[0], row_sums,
+                                      meta, observed, top_k, R)
+                    local = jnp.where(meta[2] > 0, meta[0] // D, _SENT)
+                    tbl = tbl.at[:, local].set(out, mode="drop")
+                return tbl[None]
+
+            fn = jax.jit(shard_map(
+                _f, mesh=self.mesh,
+                in_specs=(P(ITEM_AXIS), P(ITEM_AXIS, None),
+                          P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
+                out_specs=P(ITEM_AXIS),
+            ), donate_argnums=(0,))
+            self._score_window_fns[plan] = fn
+        return fn
+
     def _tbl_gather_fn(self, rp: int):
         fn = self._tbl_gather_fns.get(rp)
         if fn is None:
@@ -265,6 +307,7 @@ class ShardedSparseScorer:
         LatestResults (flushed before every save)."""
         self._tbl = None
         self._tbl_dirty = np.zeros(self.items_cap, dtype=bool)
+        self._plan_buckets = {}
 
     def _grow_fn(self, n: int):
         fn = self._grow_fns.get(n)
@@ -468,12 +511,31 @@ class ShardedSparseScorer:
         bucket, order = score_buckets(lens, min_r, self.score_ladder)
         b_sorted = bucket[order]
         chunks: List[Tuple] = []
+        rects: List[Tuple[int, int, List[np.ndarray]]] = []  # (R, S, parts)
+        if self.fixed_shapes:
+            # Monotone plan over every (bucket, chunk-rank) ever occupied
+            # on ANY shard (the shard_map program is shared, so the plan
+            # must be shard-uniform); absent ones ride as all-padding.
+            occupied = np.unique(bucket)
+            for bb in occupied.tolist():
+                members = order[bucket[order] == bb]
+                R = bucket_r(bb, min_r, self.score_ladder)
+                S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
+                per_shard_max = int(np.bincount(
+                    row_owner[members], minlength=D).max())
+                n_chunks = max(1, -(-per_shard_max // S))
+                self._plan_buckets[bb] = max(
+                    self._plan_buckets.get(bb, 0), n_chunks)
         pos = 0
         while pos < len(order):
             b = int(b_sorted[pos])
             end = int(np.searchsorted(b_sorted, b, side="right"))
             R = bucket_r(b, min_r, self.score_ladder)
-            s_block = max(self.SCORE_BUDGET // R, 16)
+            if self.fixed_shapes:
+                s_block = fixed_block(R, self.FIXED_BUDGET,
+                                      self.FIXED_ROW_CAP)
+            else:
+                s_block = max(self.SCORE_BUDGET // R, 16)
             members = order[pos:end]
             counts = np.bincount(row_owner[members], minlength=D)
             # Per-shard chunking: split the bucket so no shard exceeds
@@ -483,6 +545,9 @@ class ShardedSparseScorer:
             for i in range(n_dispatch):
                 parts = [p[i * s_block: (i + 1) * s_block]
                          for p in per_shard]
+                if self.fixed_shapes:
+                    rects.append((R, s_block, parts))
+                    continue
                 s_max = max((len(p) for p in parts), default=0)
                 s_pad = min(pad_pow4(max(s_max, 1), minimum=16), s_block)
                 meta = np.zeros((D, 3, s_pad), dtype=np.int32)
@@ -504,6 +569,40 @@ class ShardedSparseScorer:
                     packed.copy_to_host_async()
                 chunks.append(([rows[p] for p in parts], packed))
             pos = end
+        if self.fixed_shapes:
+            # Top up to the high-water plan (absent (bucket, chunk-rank)
+            # entries dispatch as all-padding).
+            have = {}
+            for R, _S, _p in rects:
+                have[R] = have.get(R, 0) + 1
+            for bb, n_chunks in self._plan_buckets.items():
+                R = bucket_r(bb, min_r, self.score_ladder)
+                S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
+                for _ in range(n_chunks - have.get(R, 0)):
+                    rects.append((R, S, [order[:0]] * D))
+        if rects:
+            # One packed [D, 3, sum(S)] upload + ONE fused dispatch for
+            # the whole window (fixed mode is defer-only, enforced at
+            # construction); canonical R order keeps the plan identical
+            # regardless of which buckets were empty this window.
+            rects.sort(key=lambda t: t[0])
+            total = sum(S for _R, S, _p in rects)
+            meta_all = np.zeros((D, 3, total), dtype=np.int32)
+            plan = []
+            off = 0
+            for R, S, parts in rects:
+                for d, p in enumerate(parts):
+                    n = len(p)
+                    meta_all[d, 0, off: off + n] = rows[p]
+                    meta_all[d, 1, off: off + n] = starts[p]
+                    meta_all[d, 2, off: off + n] = lens[p]
+                plan.append((R, S, off))
+                off += S
+            self._ensure_tbl()
+            self._tbl = self._score_window_into_fn(tuple(plan))(
+                self._tbl, self.cnt, self.dst, self.row_sums,
+                self._put_global(meta_all, self.mesh, P(ITEM_AXIS)),
+                np.float32(self.observed))
         if self.defer_results:
             self._tbl_dirty[rows] = True
         return chunks

@@ -240,6 +240,45 @@ def _pow2ceil(x: np.ndarray, minimum: int) -> np.ndarray:
     return (1 << np.ceil(np.log2(v)).astype(np.int64)).astype(np.int32)
 
 
+def resolve_fixed_shapes(fixed_shapes, defer_results: bool) -> bool:
+    """Resolve a fixed-shape request (None = env TPU_COOC_FIXED_SCORE or
+    auto) and enforce the defer-only contract — shared by the
+    single-device and sharded sparse scorers."""
+    if fixed_shapes is None:
+        env = os.environ.get("TPU_COOC_FIXED_SCORE", "auto")
+        env = env.strip().lower()
+        if env in ("1", "on", "true", "yes"):
+            fixed_shapes = True
+        elif env in ("0", "off", "false", "no"):
+            fixed_shapes = False
+        elif env in ("auto", ""):
+            # Fixed rectangles only make sense when results stay on
+            # device: the pipelined path fetches each packed block, and
+            # a full [2, s_block, K] fetch per bucket would ship
+            # megabytes of padding over the very link this mode exists
+            # to spare.
+            fixed_shapes = (jax.default_backend() == "tpu"
+                            and defer_results)
+        else:
+            raise ValueError(
+                f"TPU_COOC_FIXED_SCORE must be 0/1/auto, got {env!r}")
+    if fixed_shapes and not defer_results:
+        # An explicit request that cannot take effect must not be
+        # silently downgraded — a fixed-vs-variable A/B would then
+        # compare two identical variable runs.
+        raise ValueError(
+            "fixed-shape scoring needs deferred results (it is "
+            "incompatible with --emit-updates: the per-window result "
+            "fetch would ship the padded rectangles)")
+    return bool(fixed_shapes)
+
+
+def fixed_block(R: int, budget: int, row_cap: int) -> int:
+    """Fixed-mode rectangle rows for bucket width ``R``: budget-bounded,
+    upload-capped, and >= the top_k-compatible minimum."""
+    return max(min(budget // R, row_cap), 16)
+
+
 def ladder_bits(ladder: int) -> int:
     """Validate a score-bucket ladder base (power of two >= 2) and return
     its log2. The single owner of the ladder contract — scorers validate
@@ -719,34 +758,12 @@ class SparseDeviceScorer:
         # actually pay for. Default: on for real TPUs, off elsewhere
         # (CPU tests would crawl through the padding); env
         # TPU_COOC_FIXED_SCORE=0/1 overrides.
-        if fixed_shapes is None:
-            env = os.environ.get("TPU_COOC_FIXED_SCORE", "auto")
-            env = env.strip().lower()
-            if env in ("1", "on", "true", "yes"):
-                fixed_shapes = True
-            elif env in ("0", "off", "false", "no"):
-                fixed_shapes = False
-            elif env in ("auto", ""):
-                # Fixed rectangles only make sense when results stay on
-                # device: the pipelined path fetches each packed block,
-                # and a full [2, s_block, K] fetch per bucket would ship
-                # megabytes of padding over the very link this mode
-                # exists to spare.
-                fixed_shapes = (jax.default_backend() == "tpu"
-                                and self.defer_results)
-            else:
-                raise ValueError(
-                    f"TPU_COOC_FIXED_SCORE must be 0/1/auto, got {env!r}")
-        if fixed_shapes and not self.defer_results:
-            # An explicit request that cannot take effect must not be
-            # silently downgraded — a fixed-vs-variable A/B would then
-            # compare two identical variable runs.
-            raise ValueError(
-                "fixed-shape scoring needs deferred results (it is "
-                "incompatible with --emit-updates: the per-window result "
-                "fetch would ship the padded rectangles)")
-        self.fixed_shapes = bool(fixed_shapes)
-        self._plan_buckets = set()  # buckets ever occupied (monotone plan)
+        self.fixed_shapes = resolve_fixed_shapes(fixed_shapes,
+                                                 self.defer_results)
+        # bucket -> high-water chunk count (monotone plan: the fused
+        # program's static plan only ever grows, so compile count stays
+        # bounded even when a bucket occasionally overflows s_block).
+        self._plan_buckets = {}
 
     # Back-compat introspection used by tests.
     @property
@@ -875,24 +892,26 @@ class SparseDeviceScorer:
         chunks: List[Tuple[np.ndarray, int, object]] = []
         rects: List[Tuple[int, int, np.ndarray]] = []  # fixed: (R, S, chunk)
         if self.fixed_shapes:
-            # Monotone plan: dispatch every bucket ever occupied (empty
-            # ones as all-padding rectangles) so the fused program's
-            # static plan only grows — no per-window subset churn.
-            self._plan_buckets.update(np.unique(bucket).tolist())
-            for b in sorted(self._plan_buckets):
-                if not np.any(bucket == b):
-                    R = bucket_r(b, min_r, self.score_ladder)
-                    S = max(min(self.FIXED_BUDGET // R,
-                                self.FIXED_ROW_CAP), 16)
-                    rects.append((R, S, order[:0]))
+            # Monotone plan: dispatch every (bucket, chunk-rank) ever
+            # occupied (absent ones as all-padding rectangles), so the
+            # fused program's static plan only grows — no churn from
+            # per-window bucket subsets OR from a bucket occasionally
+            # overflowing its per-dispatch row cap.
+            occupied, occ_counts = np.unique(bucket, return_counts=True)
+            for b, n_rows in zip(occupied.tolist(), occ_counts.tolist()):
+                R = bucket_r(b, min_r, self.score_ladder)
+                S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
+                n_chunks = -(-n_rows // S)
+                self._plan_buckets[b] = max(
+                    self._plan_buckets.get(b, 0), n_chunks)
         pos = 0
         while pos < len(order):
             b = int(b_sorted[pos])
             end = int(np.searchsorted(b_sorted, b, side="right"))
             R = bucket_r(b, min_r, self.score_ladder)
             if self.fixed_shapes:
-                s_block = max(min(self.FIXED_BUDGET // R,
-                                  self.FIXED_ROW_CAP), 16)
+                s_block = fixed_block(R, self.FIXED_BUDGET,
+                                      self.FIXED_ROW_CAP)
             else:
                 s_block = max(self.SCORE_BUDGET // R, 16)
             for lo in range(pos, end, s_block):
@@ -926,6 +945,17 @@ class SparseDeviceScorer:
                     packed.copy_to_host_async()
                 chunks.append((rows[chunk], s, packed))
             pos = end
+        if self.fixed_shapes:
+            # Top up to the high-water plan: every (bucket, chunk-rank)
+            # ever seen dispatches, absent ones as all-padding.
+            have = {}
+            for R, _S, _c in rects:
+                have[R] = have.get(R, 0) + 1
+            for b, n_chunks in self._plan_buckets.items():
+                R = bucket_r(b, min_r, self.score_ladder)
+                S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
+                for _ in range(n_chunks - have.get(R, 0)):
+                    rects.append((R, S, order[:0]))
         if rects:
             # One packed [3, sum(S)] meta upload + one dispatch for the
             # whole window (fixed mode is defer-only, enforced at
@@ -1037,4 +1067,4 @@ class SparseDeviceScorer:
         self._pending = None
         if self._results is not None:
             self._results.reset(self.items_cap)
-        self._plan_buckets = set()
+        self._plan_buckets = {}
