@@ -65,6 +65,39 @@ def _accelerator_reachable(timeout_s: float = 240.0) -> bool:
         return False
 
 
+_HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_history.jsonl")
+
+
+def _record_onchip(value: float, vs_baseline: float, backend: str) -> None:
+    """Append a successful on-chip measurement to the bench history."""
+    entry = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+             "pairs_per_sec": value, "vs_baseline": vs_baseline,
+             "backend": backend}
+    with open(_HISTORY, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def _last_onchip():
+    """Most recent recorded on-chip measurement, or None. Skips corrupt
+    lines (e.g. a truncated append from a crashed run) — a bad history
+    must not take down the fallback path it exists to serve."""
+    try:
+        last = None
+        with open(_HISTORY) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    last = json.loads(line)
+                except ValueError:
+                    continue
+        return last
+    except OSError:
+        return None
+
+
 def main() -> None:
     # Default to CPU JAX when no real accelerator platform is reachable; the
     # driver's TPU environment leaves JAX_PLATFORMS as configured.
@@ -130,6 +163,19 @@ def main() -> None:
     }
     if platform == "cpu-fallback" or backend == "cpu":
         out["platform"] = platform if platform == "cpu-fallback" else backend
+        # A dead tunnel must not read as a ~20x perf regression: carry the
+        # most recent real on-chip measurement alongside the fallback
+        # number, clearly dated and marked stale (VERDICT r2, Missing #3).
+        prior = _last_onchip()
+        if prior is not None:
+            out["last_onchip"] = {
+                "value": prior["pairs_per_sec"],
+                "vs_baseline": prior["vs_baseline"],
+                "ts": prior["ts"],
+                "stale": True,
+            }
+    else:
+        _record_onchip(out["value"], out["vs_baseline"], backend)
     print(json.dumps(out))
 
 

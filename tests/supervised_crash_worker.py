@@ -1,0 +1,41 @@
+"""Fault-injection worker for the supervisor test.
+
+Runs the real CLI, but on the FIRST attempt (marker file absent) arms a
+watcher thread that SIGKILLs the process the moment the first periodic
+checkpoint lands — a hard crash the in-process code cannot intercept.
+Subsequent attempts run clean. Usage:
+
+    python supervised_crash_worker.py <ckpt_dir> <marker> <cli args...>
+"""
+
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def main() -> int:
+    ck, marker = sys.argv[1], sys.argv[2]
+    if not os.path.exists(marker):
+        with open(marker, "w"):
+            pass
+
+        def watch() -> None:
+            state = os.path.join(ck, "state.npz")
+            while not os.path.exists(state):
+                time.sleep(0.05)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        threading.Thread(target=watch, daemon=True).start()
+    # Run as a plain script: the package lives in the repo root, one
+    # level above this file's directory.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tpu_cooccurrence.cli import main as cli_main
+
+    return cli_main(sys.argv[3:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

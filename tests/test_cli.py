@@ -197,3 +197,23 @@ def test_cli_sigkill_resume_bit_identical(tmp_path):
                              cwd=repo, timeout=300)
     assert resumed.returncode == 0, resumed.stderr[-800:]
     assert resumed.stdout == clean.stdout
+
+
+def test_bench_history_skips_corrupt_lines(tmp_path, monkeypatch):
+    """bench._last_onchip must survive a truncated append (crashed run):
+    corrupt lines are skipped, the last good record wins."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text('{"ts": "t1", "pairs_per_sec": 1.0, "vs_baseline": 1}\n'
+                    '{"ts": "t2", "pairs_per_sec": 2.0, "vs_ba')
+    monkeypatch.setattr(bench, "_HISTORY", str(hist))
+    assert bench._last_onchip()["ts"] == "t1"
+    hist.write_text("not json at all\n")
+    assert bench._last_onchip() is None

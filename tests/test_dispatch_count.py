@@ -1,0 +1,177 @@
+"""Pin the sparse backend's per-window device-dispatch COUNT.
+
+The round-2 performance claim ("a steady-state sparse window is two
+device dispatches: one fused moves+update, one fused-window scoring" —
+docs/PERFORMANCE.md) is behaviorally invisible on CPU: an accidental
+extra dispatch or a plan-churn recompile would still produce correct
+results, just 10x slower on a high-latency tunnel. These tests wrap the
+module-level jitted callables with counters and assert the counts, so a
+dispatch-count regression fails CI on CPU (VERDICT r2, Next #5).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import tpu_cooccurrence.state.sparse_scorer as ss
+from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
+
+
+class DispatchCounter:
+    """Counting shims around the sparse scorer's jitted entry points."""
+
+    TRACKED = ("_apply_update", "_apply_moves_update", "_score_slab",
+               "_score_into_table", "_score_window_into_table", "_grow",
+               "_compact_gather")
+
+    def __init__(self, monkeypatch):
+        self.counts = {name: 0 for name in self.TRACKED}
+        self.plans = []  # static plan of every fused-window dispatch
+        for name in self.TRACKED:
+            monkeypatch.setattr(ss, name, self._wrap(name, getattr(ss, name)))
+
+    def _wrap(self, name, fn):
+        def counted(*args, **kwargs):
+            self.counts[name] += 1
+            if name == "_score_window_into_table":
+                self.plans.append(kwargs["plan"])
+            return fn(*args, **kwargs)
+        return counted
+
+    def reset(self):
+        for name in self.TRACKED:
+            self.counts[name] = 0
+
+    @property
+    def updates(self):
+        return self.counts["_apply_update"] + self.counts["_apply_moves_update"]
+
+    @property
+    def window_scores(self):
+        return self.counts["_score_window_into_table"]
+
+    @property
+    def bucket_scores(self):
+        return self.counts["_score_slab"] + self.counts["_score_into_table"]
+
+
+def _window(rng, n_pairs, vocab):
+    src = rng.integers(0, vocab, n_pairs)
+    dst = rng.integers(0, vocab, n_pairs)
+    move = dst == src
+    dst[move] = (dst[move] + 1) % vocab
+    return PairDeltaBatch(src.astype(np.int64), dst.astype(np.int64),
+                          np.ones(n_pairs, dtype=np.int32))
+
+
+def test_fixed_shape_window_is_two_dispatches(monkeypatch):
+    """Steady state, fixed shapes: 1 update (+moves fused) + 1 scoring."""
+    counter = DispatchCounter(monkeypatch)
+    # Capacity sized so the slab/heap never outgrows it over the whole
+    # stream (<= 20k distinct cells): steady state means NO growth or
+    # compaction dispatches, only the two hot ones.
+    scorer = ss.SparseDeviceScorer(
+        top_k=5, defer_results=True, fixed_shapes=True,
+        capacity=1 << 18, items_capacity=1 << 10)
+    rng = np.random.default_rng(42)
+    vocab = 300
+
+    # Warmup: capacity growth, first compactions, and plan discovery are
+    # allowed to cost extra dispatches while shapes are still being seen.
+    for w in range(5):
+        scorer.process_window(w * 10, _window(rng, 800, vocab))
+
+    for w in range(5, 25):
+        counter.reset()
+        scorer.process_window(w * 10, _window(rng, 800, vocab))
+        assert counter.updates == 1, (
+            f"window {w}: {counter.updates} update dispatches "
+            f"(moves must ride the update)")
+        assert counter.window_scores == 1, (
+            f"window {w}: {counter.window_scores} fused-window score "
+            f"dispatches (expected exactly 1)")
+        assert counter.bucket_scores == 0, (
+            f"window {w}: per-bucket score dispatch leaked into "
+            f"fixed-shape mode")
+        assert counter.counts["_grow"] == 0, (
+            f"window {w}: slab regrew in steady state")
+        assert counter.counts["_compact_gather"] == 0, (
+            f"window {w}: compaction ran in steady state")
+
+
+def test_fixed_shape_plan_is_monotone_and_bounded(monkeypatch):
+    """The fused program's static plan only grows; compile count (== number
+    of distinct plans XLA sees) is bounded by the final plan's rectangle
+    count — at most one program per (bucket, chunk-rank) ever occupied."""
+    counter = DispatchCounter(monkeypatch)
+    scorer = ss.SparseDeviceScorer(
+        top_k=5, defer_results=True, fixed_shapes=True,
+        capacity=1 << 15, items_capacity=1 << 10)
+    rng = np.random.default_rng(7)
+
+    # Vary the window size and vocab reach so buckets appear over time.
+    for w, (n, vocab) in enumerate(
+            [(100, 40), (100, 40), (2000, 300), (400, 300), (4000, 600),
+             (50, 600), (4000, 600), (800, 600), (3000, 600), (100, 40)]):
+        scorer.process_window(w * 10, _window(rng, n, vocab))
+
+    assert counter.plans, "fixed-shape mode never used the fused dispatch"
+    # Monotone: each plan change strictly adds rectangles, never churns.
+    prev = None
+    distinct = []
+    for plan in counter.plans:
+        if plan != prev:
+            if prev is not None and plan != prev:
+                assert len(plan) > len(prev) or plan == prev, (
+                    f"plan churned without growing: {prev} -> {plan}")
+            distinct.append(plan)
+            prev = plan
+    final = counter.plans[-1]
+    assert len(distinct) <= len(final), (
+        f"{len(distinct)} distinct plans (compiles) for a final plan of "
+        f"{len(final)} rectangles — plan churn means recompiles")
+    # Every distinct plan is a prefix-extension of the previous: same
+    # rectangles in canonical R order, new ones appended/merged in order.
+    for a, b in zip(distinct, distinct[1:]):
+        assert len(b) > len(a)
+
+
+def test_variable_mode_defer_still_one_update(monkeypatch):
+    """Variable (non-fixed) deferred mode: still exactly one update dispatch
+    per window; scoring is one fused dispatch per occupied (bucket, chunk)."""
+    counter = DispatchCounter(monkeypatch)
+    scorer = ss.SparseDeviceScorer(
+        top_k=5, defer_results=True, fixed_shapes=False,
+        capacity=1 << 15, items_capacity=1 << 10)
+    rng = np.random.default_rng(3)
+    for w in range(5):
+        scorer.process_window(w * 10, _window(rng, 800, 300))
+    for w in range(5, 15):
+        counter.reset()
+        scorer.process_window(w * 10, _window(rng, 800, 300))
+        assert counter.updates == 1
+        assert counter.window_scores == 0
+        assert counter.counts["_score_slab"] == 0  # defer: no downlink
+        assert counter.counts["_score_into_table"] >= 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_sparse_program_cache_is_monotone():
+    """The sharded-sparse fused-window program cache grows monotonically and
+    stays bounded by the plan count (no per-window recompiles)."""
+    from tpu_cooccurrence.parallel.mesh import make_mesh
+    from tpu_cooccurrence.parallel.sharded_sparse import ShardedSparseScorer
+
+    mesh = make_mesh(8, devices=jax.devices()[:8])
+    scorer = ShardedSparseScorer(5, mesh=mesh, defer_results=True,
+                                 fixed_shapes=True)
+    rng = np.random.default_rng(11)
+    sizes = []
+    for w in range(12):
+        scorer.process_window(w * 10, _window(rng, 600, 200))
+        sizes.append(len(scorer._score_window_fns))
+    assert sizes == sorted(sizes), "program cache shrank (cache churn)"
+    # Steady state: the last windows add no new programs.
+    assert sizes[-1] == sizes[-4], (
+        f"program cache still growing at window 12: {sizes}")

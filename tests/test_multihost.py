@@ -39,28 +39,37 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_pair(tmp_path, phase: str, half: int, stream_path: str,
-                checkpoint_dir: str, backend: str = "sharded",
-                partition_sampling: bool = False,
-                window_slide: int = None):
-    """Launch both processes of one phase and return their parsed outputs."""
+def _spawn_procs(tmp_path, phase: str, half: int, stream_path: str,
+                 checkpoint_dir: str, backend: str = "sharded",
+                 partition_sampling: bool = False,
+                 window_slide: int = None, nproc: int = 2,
+                 expect_failure: bool = False):
+    """Launch all ``nproc`` processes of one phase; return parsed outputs
+    (or, with ``expect_failure``, the list of (rc, stderr) per process).
+
+    The global mesh is always 8 devices: each process gets ``8 // nproc``
+    virtual local devices, so 2- and 4-process runs shard the same state
+    over the same mesh size with different host boundaries."""
+    assert 8 % nproc == 0
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={8 // nproc}")
     env["PALLAS_AXON_POOL_IPS"] = ""  # skip any accelerator plugin probe
     # `python path/to/worker.py` puts tests/ on sys.path, not the repo root.
     repo_root = os.path.dirname(os.path.dirname(WORKER))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs, outs = [], []
-    for pid in (0, 1):
+    for pid in range(nproc):
         spec = dict(STREAM_KW, stream=stream_path, coordinator=coordinator,
-                    num_processes=2, process_id=pid, phase=phase, half=half,
-                    checkpoint_dir=checkpoint_dir, backend=backend,
-                    num_shards=8, partition_sampling=partition_sampling,
+                    num_processes=nproc, process_id=pid, phase=phase,
+                    half=half, checkpoint_dir=checkpoint_dir,
+                    backend=backend, num_shards=8,
+                    partition_sampling=partition_sampling,
                     window_slide=window_slide)
         tag = (f"{backend}{'-ps' if partition_sampling else ''}"
-               f"{'-sl' if window_slide else ''}")
+               f"{'-sl' if window_slide else ''}-n{nproc}")
         spec_path = tmp_path / f"spec-{tag}-{phase}-{pid}.json"
         out_path = tmp_path / f"out-{tag}-{phase}-{pid}.json"
         spec_path.write_text(json.dumps(spec))
@@ -69,12 +78,24 @@ def _spawn_pair(tmp_path, phase: str, half: int, stream_path: str,
             [sys.executable, WORKER, str(spec_path), str(out_path)],
             env=env, cwd=os.path.dirname(os.path.dirname(WORKER)),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    results = []
+    results, failures = [], []
     for p, out_path in zip(procs, outs):
         stdout, stderr = p.communicate(timeout=300)
+        if expect_failure:
+            failures.append((p.returncode, stderr))
+            continue
         assert p.returncode == 0, f"worker failed:\n{stdout}\n{stderr}"
         results.append(json.loads(out_path.read_text()))
-    return results
+    return failures if expect_failure else results
+
+
+def _spawn_pair(tmp_path, phase, half, stream_path, checkpoint_dir,
+                backend="sharded", partition_sampling=False,
+                window_slide=None):
+    return _spawn_procs(tmp_path, phase, half, stream_path, checkpoint_dir,
+                        backend=backend,
+                        partition_sampling=partition_sampling,
+                        window_slide=window_slide, nproc=2)
 
 
 def _merge_latest(results):
@@ -202,6 +223,71 @@ def test_multihost_sparse_with_partitioned_sampling(tmp_path, stream):
                           checkpoint_dir=None, backend="sparse",
                           partition_sampling=True)
     _assert_matches_reference(results, users, items, ts, backend="sparse")
+
+
+def test_multihost_four_processes_sharded(tmp_path, stream):
+    """4 coordinated processes x 2 local devices = the same 8-device mesh
+    with host boundaries every 2 shards; merged results and counters must
+    still match the single-process reference."""
+    stream_path, users, items, ts = stream
+    results = _spawn_procs(tmp_path, "full", len(users), stream_path,
+                           checkpoint_dir=None, nproc=4)
+    _assert_matches_reference(results, users, items, ts)
+
+
+def test_multihost_four_processes_sharded_sparse_with_ps(tmp_path, stream):
+    """Both scale axes at 4 processes: row-sharded HBM slabs AND the
+    user reservoir partitioned 4 ways."""
+    stream_path, users, items, ts = stream
+    results = _spawn_procs(tmp_path, "full", len(users), stream_path,
+                           checkpoint_dir=None, backend="sparse",
+                           partition_sampling=True, nproc=4)
+    _assert_matches_reference(results, users, items, ts, backend="sparse")
+
+
+def test_multihost_four_process_checkpoint_resume(tmp_path, stream):
+    stream_path, users, items, ts = stream
+    ck_dir = str(tmp_path / "ck-n4")
+    half = 250
+    _spawn_procs(tmp_path, "first-half", half, stream_path, ck_dir, nproc=4)
+    for pid in range(4):
+        assert os.path.exists(os.path.join(ck_dir, f"state.p{pid}.npz"))
+    results = _spawn_procs(tmp_path, "resume", half, stream_path, ck_dir,
+                           nproc=4)
+    _assert_matches_reference(results, users, items, ts)
+
+
+def test_multihost_layout_mismatch_restore_fails(tmp_path, stream):
+    """A checkpoint written by a 2-process run must REFUSE to restore
+    under a 4-process layout (both backends validate; garbage slices
+    would otherwise corrupt state silently)."""
+    stream_path, users, items, ts = stream
+    ck_dir = str(tmp_path / "ck-mismatch")
+    half = 250
+    _spawn_pair(tmp_path, "first-half", half, stream_path, ck_dir)
+    failures = _spawn_procs(tmp_path, "resume", half, stream_path, ck_dir,
+                            nproc=4, expect_failure=True)
+    # p2/p3 find no state.p{2,3}.npz; p0/p1 find blocks for the wrong row
+    # span. Every process must fail, none silently.
+    assert all(rc != 0 for rc, _ in failures)
+    assert any("layout" in err or "checkpoint" in err
+               for _, err in failures)
+
+
+def test_multihost_partitioned_sampling_layout_mismatch_fails(tmp_path,
+                                                              stream):
+    """--partition-sampling checkpoints record their (pid, nproc); a
+    4-process resume of a 2-process snapshot fails with the layout
+    error, not silent reservoir corruption."""
+    stream_path, users, items, ts = stream
+    ck_dir = str(tmp_path / "ck-ps-mismatch")
+    half = 250
+    _spawn_pair(tmp_path, "first-half", half, stream_path, ck_dir,
+                partition_sampling=True)
+    failures = _spawn_procs(tmp_path, "resume", half, stream_path, ck_dir,
+                            nproc=4, partition_sampling=True,
+                            expect_failure=True)
+    assert all(rc != 0 for rc, _ in failures)
 
 
 def test_multihost_partitioned_sliding_matches_replicated(tmp_path, stream):

@@ -1,94 +1,42 @@
-"""User-partitioned parallel sampling: bit-identical to the serial path.
+"""The retired ``--sample-workers`` flag: accepted, ignored, serial.
 
-The partitioned sampler must be indistinguishable from the serial one —
-same accept/replace/reject decisions (the RNG hashes global user ids),
-same pair multiset, same counters, interchangeable checkpoints.
+The thread-partitioned sampler was removed in round 3 (VERDICT r2, Weak
+#6): it measured ~0.9x serial on this image — per-window work is
+dominated by small GIL-holding NumPy kernels, and the native serial
+kernels (``native/``) had already taken the host-side wins. The flag
+stays accepted for CLI compatibility and must behave exactly like the
+serial default; process-level ``--partition-sampling``
+(``sampling/multihost.py``, ``tests/test_multihost.py``) is the ingest
+scale-out axis.
 """
-
-import numpy as np
-import pytest
 
 from tpu_cooccurrence.config import Backend, Config
 from tpu_cooccurrence.job import CooccurrenceJob
-from tpu_cooccurrence.metrics import OBSERVED_COOCCURRENCES
+from tpu_cooccurrence.sampling.reservoir import UserReservoirSampler
 
 from test_pipeline import assert_latest_equal, random_stream, run_production
 
 
-@pytest.mark.parametrize("workers", [2, 4])
-@pytest.mark.parametrize("overrides", [
-    dict(item_cut=5, user_cut=4),
-    dict(skip_cuts=True),
-    dict(item_cut=500, user_cut=3),  # heavy replace/reject traffic
-])
-def test_partitioned_sampler_bit_identical_to_serial(workers, overrides):
-    kw = dict(window_size=10, seed=0xFA11, development_mode=True,
-              backend=Backend.ORACLE)
-    kw.update(overrides)
+def test_sample_workers_flag_is_serial_alias():
+    kw = dict(window_size=10, seed=0xFA11, item_cut=5, user_cut=4,
+              development_mode=True, backend=Backend.ORACLE)
     users, items, ts = random_stream(71, n=800, n_users=23)
     a = run_production(Config(**kw), users, items, ts)
-    b = run_production(Config(**kw, sample_workers=workers),
-                       users, items, ts)
+    b = run_production(Config(**kw, sample_workers=4), users, items, ts)
+    assert isinstance(b.sampler, UserReservoirSampler)
     assert_latest_equal(a.latest, b.latest)
     assert a.counters.as_dict() == b.counters.as_dict()
 
 
-def test_partitioned_checkpoint_interchange(tmp_path):
-    """Serial checkpoint -> partitioned resume (and the reverse) both
-    continue bit-identically: the on-disk layout is worker-count-free."""
-    users, items, ts = random_stream(73, n=600, n_users=17)
-    half = 300
-    for first, second in [(1, 4), (4, 1), (2, 3)]:
-        kw = dict(window_size=10, seed=0xCC, item_cut=5, user_cut=3,
-                  backend=Backend.ORACLE, development_mode=True,
-                  checkpoint_dir=str(tmp_path / f"ck-{first}-{second}"))
-        ref = CooccurrenceJob(Config(**kw))
-        ref.add_batch(users, items, ts)
-        ref.finish()
-
-        a = CooccurrenceJob(Config(**kw, sample_workers=first))
-        a.add_batch(users[:half], items[:half], ts[:half])
-        a.checkpoint()
-        b = CooccurrenceJob(Config(**kw, sample_workers=second))
-        b.restore()
-        b.add_batch(users[half:], items[half:], ts[half:])
-        b.finish()
-        assert_latest_equal(ref.latest, b.latest)
-        assert ref.counters.as_dict() == b.counters.as_dict()
+def test_sample_workers_cli_flag_still_parses():
+    cfg = Config.from_args(["-i", "x.csv", "-ws", "10",
+                            "--sample-workers", "8"])
+    assert cfg.sample_workers == 8  # parsed, then ignored by the job
 
 
-def test_checkpoint_with_vocab_ahead_of_sampler(tmp_path):
-    """The vocab can be ahead of the sampler (users of still-buffered,
-    unfired windows); checkpointing then must not truncate or crash."""
-    for workers in (1, 4):
-        kw = dict(window_size=1000, seed=2, item_cut=5, user_cut=3,
-                  backend=Backend.ORACLE, sample_workers=workers,
-                  checkpoint_dir=str(tmp_path / f"ck-{workers}"))
-        users, items, ts = random_stream(75, n=400, n_users=40)
-        a = CooccurrenceJob(Config(**kw))
-        # Nothing fires (one giant in-flight window), so the sampler has
-        # never seen any user while the vocab holds all of them.
-        a.add_batch(users, items, ts)
-        assert a.windows_fired == 0
-        a.checkpoint()
-        b = CooccurrenceJob(Config(**kw))
-        b.restore()
-        b.finish()
-        ref = CooccurrenceJob(Config(**kw))
-        ref.add_batch(users, items, ts)
-        ref.finish()
-        assert_latest_equal(ref.latest, b.latest)
-
-
-def test_sample_workers_rejected_in_sliding_mode():
-    with pytest.raises(ValueError):
-        Config(window_size=10, window_slide=5, seed=1, sample_workers=4)
-
-
-def test_partitioned_counters_accumulate_once():
-    users, items, ts = random_stream(74, n=500)
-    kw = dict(window_size=10, seed=1, skip_cuts=True, backend=Backend.ORACLE)
-    a = run_production(Config(**kw), users, items, ts)
-    b = run_production(Config(**kw, sample_workers=3), users, items, ts)
-    assert (a.counters.get(OBSERVED_COOCCURRENCES)
-            == b.counters.get(OBSERVED_COOCCURRENCES) > 0)
+def test_sample_workers_allowed_with_sliding_windows():
+    # The old thread sampler rejected sliding mode; the retired no-op
+    # flag must not.
+    cfg = Config(window_size=20, window_slide=10, seed=1, sample_workers=4)
+    job = CooccurrenceJob(cfg)
+    assert job.sliding

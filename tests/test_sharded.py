@@ -66,6 +66,88 @@ def test_sharded_matches_oracle():
 
 
 @requires_mesh
+def test_sharded_derives_vocab_from_data():
+    """No --num-items: the sharded backend starts at its auto capacity
+    (64 rows/shard) and doubles-with-reshard on growth; a 700-item stream
+    forces at least one growth past the 512-row initial mesh capacity and
+    the results still match the (also derive-from-data) dense backend."""
+    kw = dict(window_size=10, seed=0x5EED, item_cut=6, user_cut=4)
+    users, items, ts = random_stream(9, n=1500, n_users=20, n_items=700)
+    single = run_production(Config(**kw, backend=Backend.DEVICE), users, items, ts)
+    sharded = run_production(
+        Config(**kw, backend=Backend.SHARDED, num_shards=8), users, items, ts)
+    assert sharded.scorer.auto_grow
+    assert sharded.scorer.num_items > sharded.scorer.AUTO_INITIAL_ROWS * 8
+    assert set(single.latest) == set(sharded.latest)
+    for item in single.latest:
+        s, m = single.latest[item], sharded.latest[item]
+        assert [j for j, _ in s] == [j for j, _ in m]
+        np.testing.assert_allclose(
+            np.array([v for _, v in m]), np.array([v for _, v in s]),
+            rtol=1e-6, atol=1e-6)
+
+
+@requires_mesh
+def test_sharded_autogrow_checkpoint_roundtrip(tmp_path):
+    """Checkpoint an auto-grown sharded run mid-stream; restore into a
+    fresh derive-from-data job (which starts at the small initial
+    capacity and must adopt the checkpoint's) and finish identically."""
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    kw = dict(window_size=10, seed=3, item_cut=6, user_cut=4,
+              backend=Backend.SHARDED, num_shards=8,
+              checkpoint_dir=str(tmp_path / "ck"))
+    users, items, ts = random_stream(10, n=2000, n_users=20, n_items=700)
+    half = 1500  # deep enough that growth fired before the checkpoint
+
+    ref = CooccurrenceJob(Config(**kw))
+    ref.add_batch(users, items, ts)
+    ref.finish()
+
+    a = CooccurrenceJob(Config(**kw))
+    a.add_batch(users[:half], items[:half], ts[:half])
+    a.checkpoint()
+    assert a.scorer.num_items > a.scorer.AUTO_INITIAL_ROWS * 8
+
+    b = CooccurrenceJob(Config(**kw))
+    b.restore()
+    b.add_batch(users[half:], items[half:], ts[half:])
+    b.finish()
+    assert set(ref.latest) == set(b.latest)
+    for item in ref.latest:
+        np.testing.assert_allclose(
+            np.array([v for _, v in b.latest[item]]),
+            np.array([v for _, v in ref.latest[item]]),
+            rtol=1e-6, atol=1e-6)
+
+
+@requires_mesh
+def test_sharded_restore_never_shrinks_below_configured_capacity(tmp_path):
+    """Restoring a small checkpoint into a job with a larger --num-items
+    must keep the configured capacity (items past the checkpoint's vocab
+    would otherwise map to out-of-range shard owners mid-stream)."""
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    users, items, ts = random_stream(14, n=300, n_items=20)
+    small = CooccurrenceJob(Config(
+        window_size=10, seed=5, skip_cuts=True, backend=Backend.SHARDED,
+        num_shards=8, num_items=32, checkpoint_dir=str(tmp_path / "ck")))
+    small.add_batch(users, items, ts)
+    small.checkpoint()
+
+    big = CooccurrenceJob(Config(
+        window_size=10, seed=5, skip_cuts=True, backend=Backend.SHARDED,
+        num_shards=8, num_items=1000, checkpoint_dir=str(tmp_path / "ck")))
+    big.restore()
+    assert big.scorer.num_items >= 1000
+    # And the tail of the configured vocab is actually usable.
+    users2, items2, ts2 = random_stream(15, n=300, n_items=900)
+    big.add_batch(users2, items2, ts2 + int(ts[-1]) + 20)
+    big.finish()
+    assert big.latest
+
+
+@requires_mesh
 def test_sharded_vocab_padding():
     # num_items not divisible by shards: padded internally, results unchanged.
     kw = dict(window_size=10, seed=5, skip_cuts=True, num_items=27)

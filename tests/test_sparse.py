@@ -131,8 +131,9 @@ def test_sparse_checkpoint_roundtrip(tmp_path):
 
 
 def test_sparse_hybrid_checkpoint_interchange(tmp_path):
-    """The canonical sparse-matrix checkpoint restores across backends:
-    write from hybrid, resume on sparse (and the reverse)."""
+    """The migration path for the retired hybrid backend: a job configured
+    with ``--backend hybrid`` (now the sparse alias) writes/restores the
+    same canonical sparse-matrix checkpoint, in both directions."""
     from tpu_cooccurrence.job import CooccurrenceJob
 
     users, items, ts = random_stream(35, n=400)

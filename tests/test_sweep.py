@@ -42,7 +42,7 @@ def test_randomized_backend_equivalence(trial):
         Config(backend=Backend.ORACLE, window_slide=slide,
                development_mode=True, **kw), users, items, ts)
     ref_latest = {i: oracle.latest[i] for i in oracle.latest}
-    for backend in ("device", "sparse", "hybrid"):
+    for backend in ("device", "sparse"):
         job = run_production(
             Config(backend=Backend(backend), window_slide=slide,
                    num_items=n_items if backend == "device" else 0,

@@ -8,12 +8,14 @@ failures recorded rather than aborting the pass):
 1. config4-sparse   — the 1M-item Zipfian north star on the sparse
                       backend (target: >=458k pairs/s = 20x the measured
                       22.9k host-oracle baseline, BASELINE.md).
-2. config4-hybrid   — the round-1 carrier, for the comparison row.
-3. ml25m-full       — the full 25M-event dense int16 device run +
+2. ml25m-full       — the full 25M-event dense int16 device run +
                       v5e-8 projection (bench/ml25m.py).
-4. pallas-bench     — --pallas on vs off on the int16 max-vocab shape
+3. pallas-bench     — --pallas on vs off on the int16 max-vocab shape
                       (the kernel's earn-or-delete case, VERDICT item 8).
-5. configs          — the five BASELINE.md benchmark configs.
+4. configs          — the five BASELINE.md benchmark configs.
+
+(config4-hybrid was the round-1 carrier comparison row; the hybrid
+backend lost it 2.2x on-chip and was retired round 3.)
 
 Usage (on a TPU-attached interpreter — no JAX_PLATFORMS override):
     python -m tpu_cooccurrence.bench.tpu_round2 [--quick]
@@ -76,7 +78,9 @@ def config5_sparse(quick: bool) -> dict:
         # Quick mode exists to sanity-check the tunnel cheaply; the
         # Instacart shape takes minutes (same rule as all_configs).
         return {"skipped": "config 5 takes minutes; run without --quick"}
-    config5_instacart(backend=Backend.SPARSE)
+    # Single measured run (grant time is the scarce resource): unlike
+    # config4's per-ladder warmups this shape runs minutes, so the
+    # one-time jit compile it absorbs is noise, not signal.
     return config5_instacart(backend=Backend.SPARSE).as_dict()
 
 
@@ -118,17 +122,6 @@ def config4_sparse(quick: bool) -> dict:
     d["pairs_per_sec_by_mode"] = by_mode
     d["vs_host_baseline_22.9k"] = round(best.pairs_per_sec / 22_900, 2)
     return d
-
-
-@guard("config4-hybrid")
-def config4_hybrid(quick: bool) -> dict:
-    from ..config import Backend
-    from .configs import config4_zipfian_1m
-
-    n = 200_000 if quick else 1_000_000
-    # Warm like the sparse measurement so the comparison is like-for-like.
-    config4_zipfian_1m(backend=Backend.HYBRID, n_events=n)
-    return config4_zipfian_1m(backend=Backend.HYBRID, n_events=n).as_dict()
 
 
 @guard("ml25m-full")
@@ -226,7 +219,6 @@ def main() -> None:
     passes = {
         "tunnel-probe": tunnel_probe_pass,
         "config4-sparse": config4_sparse,
-        "config4-hybrid": config4_hybrid,
         "ml25m-full": ml25m_full,
         "ml25m-sparse": ml25m_sparse,
         "config5-sparse": config5_sparse,

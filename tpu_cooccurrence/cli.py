@@ -32,6 +32,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s - %(message)s",
     )
     config = Config.from_args(argv)
+
+    if config.restart_on_failure > 0:
+        # Supervisor mode (Flink restart-strategy analogue, SURVEY §5):
+        # respawn the job as a child process on abnormal exit; the child
+        # resumes from --checkpoint-dir by itself via the restore path
+        # below. The child runs WITHOUT the restart flags.
+        from .supervisor import child_argv, supervise
+
+        raw = list(argv) if argv is not None else sys.argv[1:]
+        cmd = [sys.executable, "-m", "tpu_cooccurrence.cli"] + child_argv(raw)
+        LOG.info("supervising job (up to %d restart(s), delay %d ms)",
+                 config.restart_on_failure, config.restart_delay_ms)
+        return supervise(cmd, config.restart_on_failure,
+                         delay_s=config.restart_delay_ms / 1000.0)
+
     config.log_configuration(LOG)
 
     job = CooccurrenceJob(config)

@@ -52,7 +52,9 @@ class Backend(enum.Enum):
     ORACLE = "oracle"
     DEVICE = "device"
     SHARDED = "sharded"
-    HYBRID = "hybrid"  # host sparse rows + device batched scoring (big vocab)
+    HYBRID = "hybrid"  # RETIRED (round 3): alias for SPARSE, which beat it
+    # 2.2x on its flagship config and covers the same vocab range;
+    # checkpoints are interchangeable so old flags/state keep working
     SPARSE = "sparse"  # device-resident sparse slab, host index (big vocab,
     # minimal host<->device transfer — see state/sparse_scorer.py)
 
@@ -88,15 +90,23 @@ class Config:
     # --- TPU-framework extensions (no reference analogue) ---
     backend: Backend = Backend.DEVICE
     num_items: int = 0  # dense device vocab capacity; 0 = derive from the
-    # data (the device backend doubles its C on vocab growth; sharded
-    # still requires an explicit capacity — resharding is not automatic)
+    # data (the device backend doubles its C on vocab growth; the sharded
+    # backend doubles-with-reshard the same way, except multi-host runs,
+    # which still need an explicit capacity agreed across processes)
     num_shards: int = 1  # item-axis shards over the device mesh
     window_slide: Optional[int] = None  # sliding windows; None = tumbling
     max_pairs_per_step: int = 1 << 20  # COO padding bucket (recompile guard)
-    sample_workers: int = 1  # host sampling threads (user-partitioned; the
-    # keyed-parallelism analogue of the reference's P user-operator subtasks)
+    sample_workers: int = 1  # RETIRED (round 3): thread-partitioned host
+    # sampling measured ~0.9x serial (GIL-bound); accepted but ignored —
+    # --partition-sampling is the ingest scale-out axis
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
+    restart_on_failure: int = 0  # supervisor: respawn the job up to N
+    # times on abnormal exit, resuming from --checkpoint-dir when set
+    # (the reference delegates this to Flink's restart strategies,
+    # SURVEY §5); 0 = no supervision
+    restart_delay_ms: int = 1000  # fixed delay between restart attempts
+    # (the analogue of Flink's fixed-delay restart strategy)
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
     score_ladder: Optional[int] = None  # sparse score-bucket ladder base
     # (power of two >= 2); None = env TPU_COOC_SCORE_LADDER or 4. Coarser
@@ -133,10 +143,13 @@ class Config:
             self.seed = time.time_ns()  # reference: System.nanoTime()
         if self.top_k <= 0:
             raise ValueError(f"{self.top_k} is <= 0")
-        if self.sample_workers > 1 and self.window_slide is not None:
+        if self.restart_on_failure > 0 and self.process_continuously:
             raise ValueError(
-                "--sample-workers applies to the tumbling reservoir path; "
-                "the sliding sampler is stateless and runs serially")
+                "--restart-on-failure buffers each attempt's stdout until "
+                "it exits cleanly; a --process-continuously job never "
+                "exits, so the combination would stream nothing and grow "
+                "without bound — supervise continuous jobs externally "
+                "(systemd/k8s) instead")
         multihost = (self.coordinator, self.num_processes, self.process_id)
         if any(v is not None for v in multihost):
             if any(v is None for v in multihost):
@@ -152,10 +165,6 @@ class Config:
                 raise ValueError(
                     "--partition-sampling is a multi-host mode — it needs "
                     "--coordinator/--num-processes/--process-id")
-            if self.sample_workers > 1:
-                raise ValueError(
-                    "--partition-sampling and --sample-workers are separate "
-                    "scale-out axes; combine is not supported yet")
 
     @property
     def window_millis(self) -> int:
@@ -188,6 +197,12 @@ class Config:
         p = argparse.ArgumentParser(
             prog="tpu-cooccurrence",
             description="TPU-native streaming item-item co-occurrence (LLR) recommender",
+            # No prefix abbreviations: the supervisor strips its own flags
+            # from the child argv by exact name, and an abbreviated
+            # `--restart-on` would survive the strip and recurse into a
+            # nested supervisor (also matches commons-cli, which has no
+            # abbreviation).
+            allow_abbrev=False,
         )
         p.add_argument("-i", "--input", required=True,
                        help="Input file/directory to consume (expected format 'user,item,timestamp')")
@@ -221,8 +236,9 @@ class Config:
                        help="Slide (same unit as window) for sliding windows")
         p.add_argument("--sample-workers", type=int, default=1,
                        dest="sample_workers",
-                       help="Host sampling worker threads (user-partitioned; "
-                            "default 1 = serial)")
+                       help="Retired (ignored): host sampling is serial + "
+                            "native; use --partition-sampling for "
+                            "multi-process ingest scale-out")
         p.add_argument("--profile-dir", default=None, dest="profile_dir",
                        help="Write a jax.profiler trace for TensorBoard")
         p.add_argument("--pallas", choices=["auto", "on", "off"],
@@ -248,6 +264,15 @@ class Config:
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")
+        p.add_argument("--restart-on-failure", type=int, default=0,
+                       dest="restart_on_failure",
+                       help="Supervise the run: respawn the job up to N "
+                            "times on abnormal exit, resuming from "
+                            "--checkpoint-dir when set (Flink restart-"
+                            "strategy analogue)")
+        p.add_argument("--restart-delay-ms", type=int, default=1000,
+                       dest="restart_delay_ms",
+                       help="Fixed delay between restart attempts")
         p.add_argument("--emit-updates", action="store_true",
                        dest="emit_updates",
                        help="Stream each window's updated top-K rows to "

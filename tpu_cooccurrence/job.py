@@ -58,6 +58,16 @@ class CooccurrenceJob:
         self.item_vocab = IdMap()
         self.user_vocab = IdMap()
         self.item_cut = ItemInteractionCut(config.item_cut, capacity=1024)
+        if config.sample_workers > 1:
+            # Retired round 3: thread-partitioned sampling measured ~0.9x
+            # serial on this image (GIL-bound small NumPy kernels; the
+            # native serial kernels already took the wins). The flag stays
+            # accepted on every sampler path; ingest scale-out is
+            # --partition-sampling (process-level, multi-host).
+            LOG.warning(
+                "--sample-workers is retired and has no effect; the "
+                "serial native sampler runs (use --partition-sampling "
+                "for multi-process ingest scale-out)")
         if self.sliding:
             if config.partition_sampling:
                 from .parallel.distributed import init_multihost
@@ -84,12 +94,6 @@ class CooccurrenceJob:
             self.sampler = ProcessPartitionedSampler(
                 config.user_cut, config.seed, config.skip_cuts,
                 counters=self.counters)
-        elif config.sample_workers > 1:
-            from .sampling.parallel import PartitionedReservoirSampler
-
-            self.sampler = PartitionedReservoirSampler(
-                config.user_cut, config.seed, config.skip_cuts,
-                workers=config.sample_workers, counters=self.counters)
         else:
             self.sampler = UserReservoirSampler(
                 config.user_cut, config.seed, config.skip_cuts,
@@ -166,10 +170,16 @@ class CooccurrenceJob:
                                 count_dtype=self.config.count_dtype,
                                 defer_results=not self.config.emit_updates)
         if backend == Backend.HYBRID:
-            from .state.hybrid_scorer import HybridScorer
-
-            return HybridScorer(self.config.top_k, self.counters,
-                                self.config.development_mode)
+            # Retired round 3: on its flagship config (1M-item Zipfian) the
+            # sparse backend measured 2.2x the hybrid's on-chip throughput
+            # (TPU_ROUND2.jsonl 2026-07-30: 71.9k vs 32.1k pairs/s) and
+            # covers the same beyond-dense-ceiling vocabularies. The flag
+            # stays accepted: checkpoints were interchangeable by design
+            # (state/sparse_scorer.py snapshot docstring), so a hybrid
+            # checkpoint restores under sparse unchanged.
+            LOG.warning("--backend hybrid is retired; running the sparse "
+                        "backend (checkpoints are interchangeable)")
+            backend = Backend.SPARSE
         if backend == Backend.SPARSE:
             fixed = self._parse_fixed_score()
             if self.config.num_shards > 1:
@@ -207,9 +217,10 @@ class CooccurrenceJob:
             from .parallel.sharded import ShardedScorer
 
             num_items = self.config.num_items
-            if num_items <= 0:
-                raise ValueError(
-                    "sharded backend needs --num-items (dense vocab capacity)")
+            # num_items == 0 derives the vocab from the data: the scorer
+            # starts small and doubles (resharding) on growth, like the
+            # dense backend. Multi-host still needs an explicit capacity
+            # (ShardedScorer raises: capacity must agree across processes).
             from .parallel.distributed import maybe_multihost_mesh
 
             return ShardedScorer(num_items, self.config.top_k,

@@ -127,13 +127,15 @@ def _bind_prototypes(lib, i64p, i32p) -> None:
         i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         i32p, i32p, ctypes.POINTER(ctypes.c_uint8)]
     u8p = ctypes.POINTER(ctypes.c_uint8)
-    lib.slab_hash_lookup.restype = None
+    # The slab-hash entry points return the number of keys whose bounded
+    # probe exhausted the table (contract violation); callers raise on it.
+    lib.slab_hash_lookup.restype = ctypes.c_int64
     lib.slab_hash_lookup.argtypes = [
         i64p, i32p, ctypes.c_int64, i64p, ctypes.c_int64, i32p, u8p]
-    lib.slab_hash_insert.restype = None
+    lib.slab_hash_insert.restype = ctypes.c_int64
     lib.slab_hash_insert.argtypes = [
         i64p, i32p, ctypes.c_int64, i64p, i32p, ctypes.c_int64]
-    lib.slab_hash_update.restype = None
+    lib.slab_hash_update.restype = ctypes.c_int64
     lib.slab_hash_update.argtypes = [
         i64p, i32p, ctypes.c_int64, i64p, i32p, ctypes.c_int64]
     lib.grouped_rank_dense.restype = None

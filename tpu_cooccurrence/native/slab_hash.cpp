@@ -27,15 +27,23 @@ inline uint64_t mix(uint64_t x) {
 
 extern "C" {
 
+// All probe loops are bounded at capacity (mask + 1) steps so a violated
+// contract (key absent where presence is promised, or a 100%-full table)
+// fails loudly instead of spinning forever on a corrupted reverse map.
+// Each function returns the number of keys whose probe exhausted the
+// table; callers raise on any nonzero return.
+
 // Probe each key: out_slots[i] = value when present (out_new[i] = 0),
 // otherwise out_new[i] = 1 (out_slots[i] untouched).
-void slab_hash_lookup(const int64_t* tkeys, const int32_t* tvals,
-                      int64_t mask, const int64_t* keys, int64_t n,
-                      int32_t* out_slots, uint8_t* out_new) {
+int64_t slab_hash_lookup(const int64_t* tkeys, const int32_t* tvals,
+                         int64_t mask, const int64_t* keys, int64_t n,
+                         int32_t* out_slots, uint8_t* out_new) {
+  int64_t exhausted = 0;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t key = keys[i];
     uint64_t h = mix((uint64_t)key) & (uint64_t)mask;
-    for (;;) {
+    int64_t left = mask + 1;
+    for (; left > 0; --left) {
       const int64_t k = tkeys[h];
       if (k == key) {
         out_slots[i] = tvals[h];
@@ -48,34 +56,59 @@ void slab_hash_lookup(const int64_t* tkeys, const int32_t* tvals,
       }
       h = (h + 1) & (uint64_t)mask;
     }
+    if (left == 0) {
+      out_new[i] = 1;
+      ++exhausted;  // table 100% full and key absent: contract violation
+    }
   }
+  return exhausted;
 }
 
 // Insert (key, slot) pairs known to be absent (fresh from a lookup miss,
 // or a rebuild). The caller has already grown the table if needed.
-void slab_hash_insert(int64_t* tkeys, int32_t* tvals, int64_t mask,
-                      const int64_t* keys, const int32_t* slots,
-                      int64_t n) {
+int64_t slab_hash_insert(int64_t* tkeys, int32_t* tvals, int64_t mask,
+                         const int64_t* keys, const int32_t* slots,
+                         int64_t n) {
+  int64_t exhausted = 0;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t key = keys[i];
     uint64_t h = mix((uint64_t)key) & (uint64_t)mask;
-    while (tkeys[h] != -1) h = (h + 1) & (uint64_t)mask;
+    int64_t left = mask + 1;
+    while (left > 0 && tkeys[h] != -1) {
+      h = (h + 1) & (uint64_t)mask;
+      --left;
+    }
+    if (left == 0) {
+      ++exhausted;  // no empty bucket: caller failed to grow the table
+      continue;
+    }
     tkeys[h] = key;
     tvals[h] = slots[i];
   }
+  return exhausted;
 }
 
 // Overwrite the slot of keys known to be present (row relocations and
 // compaction re-laying).
-void slab_hash_update(int64_t* tkeys, int32_t* tvals, int64_t mask,
-                      const int64_t* keys, const int32_t* slots,
-                      int64_t n) {
+int64_t slab_hash_update(int64_t* tkeys, int32_t* tvals, int64_t mask,
+                         const int64_t* keys, const int32_t* slots,
+                         int64_t n) {
+  int64_t exhausted = 0;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t key = keys[i];
     uint64_t h = mix((uint64_t)key) & (uint64_t)mask;
-    while (tkeys[h] != key) h = (h + 1) & (uint64_t)mask;
+    int64_t left = mask + 1;
+    while (left > 0 && tkeys[h] != key) {
+      h = (h + 1) & (uint64_t)mask;
+      --left;
+    }
+    if (left == 0) {
+      ++exhausted;  // key absent: promised-present contract violated
+      continue;
+    }
     tvals[h] = slots[i];
   }
+  return exhausted;
 }
 
 }  // extern "C"

@@ -51,6 +51,11 @@ from .mesh import ITEM_AXIS, make_mesh, pad_to_multiple
 class ShardedScorer:
     """Item-row-sharded dense co-occurrence state over a 1-D device mesh."""
 
+    #: Initial per-shard row capacity in derive-from-data mode
+    #: (``num_items == 0``): the vocab grows with the stream like the
+    #: dense backend's, doubling on overflow.
+    AUTO_INITIAL_ROWS = 64
+
     def __init__(self, num_items: int, top_k: int, num_shards: Optional[int] = None,
                  counters: Optional[Counters] = None,
                  mesh: Optional[Mesh] = None,
@@ -65,13 +70,17 @@ class ShardedScorer:
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         self.n_shards = self.mesh.devices.size
         self.num_items_logical = num_items
-        self.num_items = pad_to_multiple(num_items, self.n_shards)
-        self.rows_per_shard = self.num_items // self.n_shards
+        self.auto_grow = num_items <= 0
+        if self.auto_grow:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "multi-host sharded runs need --num-items: the vocab "
+                    "capacity must agree across processes before any "
+                    "window fires")
+            num_items = self.AUTO_INITIAL_ROWS * self.n_shards
         self.top_k = top_k
         self.counters = counters if counters is not None else Counters()
-        # Bound each shard's per-call [S, I] score working set.
-        self.max_score_rows = score_row_budget(self.num_items,
-                                               max_score_rows_per_call)
+        self._max_score_rows_per_call = max_score_rows_per_call
         self.observed = 0  # exact host-side total
         # One-window-deep result pipeline (see ops/device_scorer.py): the
         # device->host fetch of window N's top-K overlaps window N+1's host
@@ -82,11 +91,23 @@ class ShardedScorer:
         from .distributed import put_global
 
         self._put_global = put_global
+        self._build(num_items)
         self.C = put_global(
             np.zeros((self.num_items, self.num_items), dtype=self.count_dtype),
             self.mesh, P(ITEM_AXIS, None))
         self.row_sums = put_global(
             np.zeros((self.num_items,), dtype=np.int32), self.mesh, P())
+
+    def _build(self, num_items: int) -> None:
+        """(Re)build the capacity-dependent pieces: shard geometry and the
+        jitted ``shard_map`` programs (their row arithmetic closes over the
+        per-shard row count)."""
+        self.num_items = pad_to_multiple(num_items, self.n_shards)
+        self.rows_per_shard = self.num_items // self.n_shards
+        # Bound each shard's per-call [S, I] score working set.
+        self.max_score_rows = score_row_budget(
+            self.num_items, self._max_score_rows_per_call)
+        top_k = self.top_k
 
         num_items_c = self.num_items
         rows_per_shard_c = self.rows_per_shard
@@ -131,6 +152,26 @@ class ShardedScorer:
             out_specs=P(ITEM_AXIS),
         ))
 
+    def _grow(self, need: int) -> None:
+        """Double (at least) the vocab capacity and reshard the state.
+
+        Derive-from-data mode only. Growth changes every row's owning
+        shard (rows_per_shard changes), so the old state is materialized
+        on host, zero-padded, and re-placed under the new geometry — a
+        rare event (doubling) whose cost is one full C round-trip,
+        exactly like the dense backend's reallocation."""
+        old_items = self.num_items
+        C_host = np.asarray(self.C)
+        rs_host = np.asarray(self.row_sums)
+        self._build(max(2 * old_items, int(need)))
+        C_new = np.zeros((self.num_items, self.num_items),
+                         dtype=self.count_dtype)
+        C_new[:old_items, :old_items] = C_host
+        rs_new = np.zeros((self.num_items,), dtype=np.int32)
+        rs_new[:old_items] = rs_host
+        self.C = self._put_global(C_new, self.mesh, P(ITEM_AXIS, None))
+        self.row_sums = self._put_global(rs_new, self.mesh, P())
+
     # ------------------------------------------------------------------
 
     def _partition_by_owner(self, values: np.ndarray, owners: np.ndarray,
@@ -165,6 +206,10 @@ class ShardedScorer:
         src, dst, delta64 = aggregate_window_coo(
             pairs.src, pairs.dst, pairs.delta)
         delta = narrow_deltas_int32(delta64)
+        if self.auto_grow:
+            max_id = int(max(src.max(), dst.max()))
+            if max_id >= self.num_items:
+                self._grow(max_id + 1)
         owners = (src // self.rows_per_shard).astype(np.int64)
 
         # Owner-partitioned [D, P] blocks; padding rows point at each shard's
@@ -279,6 +324,20 @@ class ShardedScorer:
 
             c_local = self._fit_count_dtype(st["C_local"])
             row_lo = int(st["row_lo"][0])
+            # Validate the snapshot's row block against the rows this
+            # process's chips actually own under the current layout — a
+            # different process count/placement must fail loudly, not
+            # slice garbage.
+            spans = [s.index[0] for s in self.C.addressable_shards]
+            own_lo = min(sp.start or 0 for sp in spans)
+            own_hi = max(sp.stop if sp.stop is not None else self.num_items
+                         for sp in spans)
+            if row_lo != own_lo or len(c_local) != own_hi - own_lo:
+                raise ValueError(
+                    f"checkpoint holds rows [{row_lo}, "
+                    f"{row_lo + len(c_local)}) but this process owns "
+                    f"[{own_lo}, {own_hi}) — restore under the writing "
+                    f"run's process layout")
 
             def _local_block(idx):
                 rows = idx[0]
@@ -289,10 +348,27 @@ class ShardedScorer:
                 (self.num_items, self.num_items),
                 NamedSharding(self.mesh, P(ITEM_AXIS, None)), _local_block)
         else:
-            self.C = self._put_global(self._fit_count_dtype(st["C"]),
-                                      self.mesh, P(ITEM_AXIS, None))
-        self.row_sums = self._put_global(
-            np.asarray(st["row_sums"], dtype=np.int32), self.mesh, P())
+            C = self._fit_count_dtype(st["C"])
+            if C.shape[0] != self.num_items:
+                # The writing run's capacity (already padded to ITS shard
+                # count) may differ from this scorer's — e.g. a restore
+                # into a derive-from-data run, or a different mesh size.
+                # Rebuild at the larger of the two (never shrink below the
+                # configured --num-items: the vocab bound the operator
+                # asked for must survive the restore) and zero-pad.
+                cap = pad_to_multiple(max(C.shape[0], self.num_items),
+                                      self.n_shards)
+                self._build(cap)
+                grown = np.zeros((self.num_items, self.num_items), C.dtype)
+                grown[: C.shape[0], : C.shape[1]] = C
+                C = grown
+            self.C = self._put_global(C, self.mesh, P(ITEM_AXIS, None))
+        rs = np.asarray(st["row_sums"], dtype=np.int32)
+        if len(rs) != self.num_items:
+            grown_rs = np.zeros((self.num_items,), dtype=np.int32)
+            grown_rs[: len(rs)] = rs
+            rs = grown_rs
+        self.row_sums = self._put_global(rs, self.mesh, P())
         self.observed = int(st["observed"][0])
         # In-flight results belong to windows after the checkpoint; a
         # restore that rolls back must not emit them.

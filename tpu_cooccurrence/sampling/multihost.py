@@ -17,13 +17,15 @@ gloo/DCN fabric as the collectives. Item cuts stay
 replicated (they are global per-item ranks over the window, vectorized
 and cheap; partitioning them would change semantics).
 
-Bit-identical to serial by the same argument as the thread-partitioned
-sampler (``sampling/parallel.py``): reservoir state is strictly per-user,
-the partition mask preserves each user's arrival order, and the draw RNG
+Bit-identical to serial: reservoir state is strictly per-user, the
+partition mask preserves each user's arrival order, and the draw RNG
 hashes ``(seed, global user id, per-user draw index)`` — partition- and
 order-independent. Block concatenation in process order is deterministic,
 and every consumer folds blocks per cell, so inter-block order is
-immaterial to scores.
+immaterial to scores. (A thread-partitioned variant of the same scheme,
+``sampling/parallel.py``, was removed in round 3: measured ~0.9x serial
+on this image — the per-window work is dominated by small GIL-holding
+NumPy kernels, and the native serial kernels had already taken the wins.)
 
 Checkpoints: each process snapshots only its own users' reservoir state
 (the others are zeros in the fixed global layout) plus a
@@ -40,6 +42,35 @@ import numpy as np
 
 from ..metrics import Counters
 from .reservoir import PairDeltaBatch, UserReservoirSampler
+
+
+def scatter_part_state(part: UserReservoirSampler, p: int, P: int,
+                       n_users: int, hist, hist_len, total, draws) -> None:
+    """Write one part's reservoir arrays into the serial global-dense-id
+    layout (user ``u`` lives at part ``u % P``, local row ``u // P``), so
+    partitioned checkpoints stay interchangeable with the serial
+    sampler's."""
+    n_local = (n_users - p + P - 1) // P
+    if n_local <= 0:
+        return
+    # The vocab can be ahead of the sampler (unfired buffered windows);
+    # size the part up before slicing.
+    part._ensure_rows(n_local - 1)
+    hist[p::P, : part.hist.shape[1]] = part.hist[:n_local]
+    hist_len[p::P] = part.hist_len[:n_local]
+    total[p::P] = part.total[:n_local]
+    draws[p::P] = part.draws[:n_local]
+
+
+def restore_part_state(part: UserReservoirSampler, st: dict, p: int,
+                       P: int, n_users: int) -> None:
+    """Inverse of :func:`scatter_part_state` for one part."""
+    n_local = (n_users - p + P - 1) // P
+    if n_local <= 0:
+        return
+    part.restore_state(
+        {k: st[k][p::P] for k in ("hist", "hist_len", "total", "draws")},
+        n_local)
 
 # Fixed exchange order for counter deltas (names resolved lazily to avoid
 # hard-coding the metric strings here).
@@ -153,8 +184,6 @@ class ProcessPartitionedSampler:
     # -- checkpoint (fixed global layout; local rows only) ----------------
 
     def checkpoint_state(self, n_users: int) -> dict:
-        from .parallel import scatter_part_state
-
         hist = np.zeros((n_users, self.part.hist.shape[1]), dtype=np.int32)
         hist_len = np.zeros(n_users, dtype=np.int64)
         total = np.zeros(n_users, dtype=np.int64)
@@ -167,8 +196,6 @@ class ProcessPartitionedSampler:
                                            dtype=np.int64)}
 
     def restore_state(self, st: dict, n_users: int) -> None:
-        from .parallel import restore_part_state
-
         part_info = st.get("sampler_part")
         if part_info is not None:
             pid, nproc = int(part_info[0]), int(part_info[1])

@@ -554,6 +554,15 @@ class HashSlabIndex(SlabIndex):
     def __len__(self) -> int:
         return self._n
 
+    @staticmethod
+    def _check_probe(exhausted: int) -> None:
+        """Fail loudly on a bounded-probe exhaustion (contract violation:
+        promised-present key absent, or a table the caller never grew)."""
+        if exhausted:
+            raise RuntimeError(
+                f"slab hash probe exhausted the table for {exhausted} "
+                f"keys — cell-index contract violated (corrupted reverse "
+                f"map or un-grown table)")
 
     def _grow_table(self, need: int) -> None:
         if self.GROW_NUM * need <= self.GROW_DEN * self._cap:
@@ -567,10 +576,9 @@ class HashSlabIndex(SlabIndex):
         self._cap = cap
         self._tkeys = np.full(cap, -1, dtype=np.int64)
         self._tvals = np.zeros(cap, dtype=np.int32)
-        self._lib.slab_hash_insert(self._p64(self._tkeys),
-                                   self._p32(self._tvals), cap - 1,
-                                   self._p64(keys), self._p32(vals),
-                                   len(keys))
+        self._check_probe(self._lib.slab_hash_insert(
+            self._p64(self._tkeys), self._p32(self._tvals), cap - 1,
+            self._p64(keys), self._p32(vals), len(keys)))
 
     def _ensure_slot_key(self, need: int) -> None:
         if need <= len(self.slot_key):
@@ -584,12 +592,16 @@ class HashSlabIndex(SlabIndex):
 
     def apply(self, d_key: np.ndarray) -> AllocPlan:
         d_key = np.ascontiguousarray(d_key, dtype=np.int64)
+        # The stale-slot re-probe below is only valid for rows moved by
+        # THIS window's _allocate; drop last window's record up front so
+        # staleness can never leak across windows.
+        self._moved_rows = np.zeros(0, dtype=np.int64)
         n = len(d_key)
         slots = np.empty(n, dtype=np.int32)
         is_new = np.empty(n, dtype=np.uint8)
-        self._lib.slab_hash_lookup(
+        self._check_probe(self._lib.slab_hash_lookup(
             self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
-            self._p64(d_key), n, self._p32(slots), self._p8(is_new))
+            self._p64(d_key), n, self._p32(slots), self._p8(is_new)))
         new_sel = is_new.view(bool)
         new_key = d_key[new_sel]
         mv = None
@@ -601,10 +613,10 @@ class HashSlabIndex(SlabIndex):
             self.slot_key[new_slots] = new_key
             self._grow_table(self._n + len(new_key))
             new_slots = np.ascontiguousarray(new_slots)
-            self._lib.slab_hash_insert(
+            self._check_probe(self._lib.slab_hash_insert(
                 self._p64(self._tkeys), self._p32(self._tvals),
                 self._cap - 1, self._p64(new_key), self._p32(new_slots),
-                len(new_key))
+                len(new_key)))
             self._n += len(new_key)
             if mv is not None and not new_sel.all():
                 # Allocation relocated rows, so the pre-allocation lookup
@@ -621,10 +633,10 @@ class HashSlabIndex(SlabIndex):
                     ex_keys = np.ascontiguousarray(d_key[stale])
                     ex_slots = np.empty(len(ex_keys), dtype=np.int32)
                     scratch = np.empty(len(ex_keys), dtype=np.uint8)
-                    self._lib.slab_hash_lookup(
+                    self._check_probe(self._lib.slab_hash_lookup(
                         self._p64(self._tkeys), self._p32(self._tvals),
                         self._cap - 1, self._p64(ex_keys), len(ex_keys),
-                        self._p32(ex_slots), self._p8(scratch))
+                        self._p32(ex_slots), self._p8(scratch)))
                     slots[stale] = ex_slots
         return AllocPlan(mv, mv_len, slots, new_sel.copy())
 
@@ -639,10 +651,10 @@ class HashSlabIndex(SlabIndex):
                    + _ragged_arange(lens)).astype(np.int32)
         self._ensure_slot_key(self.heap_end)
         self.slot_key[new_idx] = keys
-        self._lib.slab_hash_update(
+        self._check_probe(self._lib.slab_hash_update(
             self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
             self._p64(keys), self._p32(np.ascontiguousarray(new_idx)),
-            len(keys))
+            len(keys)))
 
     def rebuild_from_keys(self, keys: np.ndarray) -> np.ndarray:
         slots = super().rebuild_from_keys(keys)
@@ -659,9 +671,9 @@ class HashSlabIndex(SlabIndex):
         self._tkeys = np.full(self._cap, -1, dtype=np.int64)
         self._tvals = np.zeros(self._cap, dtype=np.int32)
         if len(keys):
-            self._lib.slab_hash_insert(
+            self._check_probe(self._lib.slab_hash_insert(
                 self._p64(self._tkeys), self._p32(self._tvals),
-                self._cap - 1, self._p64(keys), self._p32(slots), len(keys))
+                self._cap - 1, self._p64(keys), self._p32(slots), len(keys)))
         self._n = len(keys)
         self.slot_key = np.full(max(1 << 10, _pow2ceil(
             np.asarray([max(self.heap_end, 1)]), 1024)[0]), -1,
@@ -806,8 +818,11 @@ class SparseDeviceScorer:
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
             if self.defer_results:
-                # Nothing in flight, and a flush here would fetch the whole
-                # table; results wait for the end-of-stream flush.
+                # Idle window: results are intentionally held on device for
+                # the end-of-stream/checkpoint flush (the drain itself is
+                # incremental — dirty rows only — but draining on every
+                # idle window would still cost a dispatch + downlink for
+                # rows nobody asked for yet).
                 return TopKBatch.empty(self.top_k)
             # No new dispatch — drain any completed in-flight results now.
             return self.flush()

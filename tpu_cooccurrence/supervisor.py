@@ -1,0 +1,96 @@
+"""Process supervisor: restart-on-failure for the CLI job.
+
+The reference delegates failure recovery entirely to Flink's restart
+strategies — the JobManager respawns the job graph on task failure
+(SURVEY §5 "Failure detection / elastic recovery: delegated entirely to
+Flink restarts"). This is the standalone analogue: a parent process
+respawns the job child on abnormal exit (crash, OOM-kill, SIGKILL), and
+the child resumes from the latest checkpoint on its own
+(``state/checkpoint.py`` restores all state including the source's
+mid-file position), so recovery needs zero operator action.
+
+Output discipline: each attempt's stdout is buffered and only forwarded
+when that attempt exits cleanly, so a crashed attempt's partial output
+is discarded and the supervised run's total stdout is identical to an
+uninterrupted run's. (In ``--emit-updates`` mode the resumed child
+replays restored rows itself — ``cli.py`` — so the successful attempt's
+stream alone is complete.) stderr streams through live: it carries the
+operator-facing logs.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+LOG = logging.getLogger("tpu_cooccurrence.supervisor")
+
+#: Flags the supervisor strips from the child's argv (the child must run
+#: the job directly, not recurse into supervision).
+_SUPERVISOR_FLAGS = ("--restart-on-failure", "--restart-delay-ms")
+
+
+def child_argv(argv: Sequence[str]) -> List[str]:
+    """``argv`` minus the supervisor's own flags (both ``--flag value``
+    and ``--flag=value`` spellings)."""
+    out: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in _SUPERVISOR_FLAGS:
+            skip = True
+            continue
+        if any(a.startswith(f + "=") for f in _SUPERVISOR_FLAGS):
+            continue
+        out.append(a)
+    return out
+
+
+def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
+              stdout=None, timeout_s: Optional[float] = None) -> int:
+    """Run ``cmd`` to successful completion, restarting up to ``attempts``
+    times on abnormal exit. Returns the final exit code (0 on success,
+    the last failure's code once attempts are exhausted).
+
+    ``stdout`` (default ``sys.stdout``) receives the successful attempt's
+    buffered output; failed attempts' partial output is discarded with a
+    log line so at-least-once execution still yields exactly-once output.
+    """
+    sink = stdout if stdout is not None else sys.stdout
+    restarts = 0
+    while True:
+        try:
+            proc = subprocess.run(list(cmd), stdout=subprocess.PIPE,
+                                  timeout=timeout_s)
+            rc, out = proc.returncode, proc.stdout or b""
+        except subprocess.TimeoutExpired as e:
+            # A hung attempt counts as a failed one (subprocess.run has
+            # already killed the child); 124 matches timeout(1).
+            rc, out = 124, e.stdout or b""
+        if rc == 0:
+            text = out.decode("utf-8", errors="replace")
+            if hasattr(sink, "buffer"):
+                sink.buffer.write(out)
+                sink.flush()
+            else:
+                sink.write(text)
+            if restarts:
+                LOG.info("job completed after %d restart(s)", restarts)
+            return 0
+        restarts += 1
+        if restarts > attempts:
+            LOG.error("job failed with rc=%d; restart attempts exhausted "
+                      "(%d)", rc, attempts)
+            return rc
+        LOG.warning(
+            "job attempt %d failed with rc=%d; discarding %d bytes of "
+            "partial output and restarting in %.1fs (%d attempt(s) left)",
+            restarts, rc, len(out), delay_s,
+            attempts - restarts)
+        if delay_s > 0:
+            time.sleep(delay_s)
