@@ -128,3 +128,17 @@ def test_fixed_score_rejected_on_non_sparse_backends():
                  num_items=16, fixed_score="on")
     with pytest.raises(ValueError, match="only applies"):
         CooccurrenceJob(cfg)
+
+
+def test_fixed_score_honored_under_hybrid_alias():
+    """--backend hybrid is a full sparse alias: sparse-only flags must be
+    accepted (the alias is applied before flag validation)."""
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.state.sparse_scorer import SparseDeviceScorer
+
+    cfg = Config(window_size=10, seed=1, backend=Backend.HYBRID,
+                 fixed_score="off")
+    job = CooccurrenceJob(cfg)
+    assert isinstance(job.scorer, SparseDeviceScorer)
+    assert job.scorer.fixed_shapes is False

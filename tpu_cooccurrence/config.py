@@ -150,6 +150,13 @@ class Config:
                 "exits, so the combination would stream nothing and grow "
                 "without bound — supervise continuous jobs externally "
                 "(systemd/k8s) instead")
+        if self.restart_on_failure > 0 and self.coordinator is not None:
+            raise ValueError(
+                "--restart-on-failure supervises one process; in a "
+                "multi-host run a respawned child would re-join the "
+                "coordinator while surviving peers are blocked "
+                "mid-collective — supervise multi-host jobs externally "
+                "(restart all processes together) instead")
         multihost = (self.coordinator, self.num_processes, self.process_id)
         if any(v is not None for v in multihost):
             if any(v is None for v in multihost):

@@ -146,6 +146,19 @@ class CooccurrenceJob:
 
     def _make_scorer(self):
         backend = self.config.backend
+        if backend == Backend.HYBRID:
+            # Retired round 3: on its flagship config (1M-item Zipfian) the
+            # sparse backend measured 2.2x the hybrid's on-chip throughput
+            # (TPU_ROUND2.jsonl 2026-07-30: 71.9k vs 32.1k pairs/s) and
+            # covers the same beyond-dense-ceiling vocabularies. The flag
+            # stays accepted: checkpoints were interchangeable by design
+            # (state/sparse_scorer.py snapshot docstring), so a hybrid
+            # checkpoint restores under sparse unchanged. Aliased before
+            # any validation so every sparse flag (e.g. --fixed-score)
+            # works identically under the alias.
+            LOG.warning("--backend hybrid is retired; running the sparse "
+                        "backend (checkpoints are interchangeable)")
+            backend = Backend.SPARSE
         if backend != Backend.SPARSE and self._parse_fixed_score() is not None:
             # An explicit setting the backend cannot honor must not be
             # silently ignored (same rule as the sparse branch's
@@ -169,17 +182,6 @@ class CooccurrenceJob:
                                 use_pallas=self.config.pallas,
                                 count_dtype=self.config.count_dtype,
                                 defer_results=not self.config.emit_updates)
-        if backend == Backend.HYBRID:
-            # Retired round 3: on its flagship config (1M-item Zipfian) the
-            # sparse backend measured 2.2x the hybrid's on-chip throughput
-            # (TPU_ROUND2.jsonl 2026-07-30: 71.9k vs 32.1k pairs/s) and
-            # covers the same beyond-dense-ceiling vocabularies. The flag
-            # stays accepted: checkpoints were interchangeable by design
-            # (state/sparse_scorer.py snapshot docstring), so a hybrid
-            # checkpoint restores under sparse unchanged.
-            LOG.warning("--backend hybrid is retired; running the sparse "
-                        "backend (checkpoints are interchangeable)")
-            backend = Backend.SPARSE
         if backend == Backend.SPARSE:
             fixed = self._parse_fixed_score()
             if self.config.num_shards > 1:

@@ -9,10 +9,13 @@ the child resumes from the latest checkpoint on its own
 (``state/checkpoint.py`` restores all state including the source's
 mid-file position), so recovery needs zero operator action.
 
-Output discipline: each attempt's stdout is buffered and only forwarded
-when that attempt exits cleanly, so a crashed attempt's partial output
-is discarded and the supervised run's total stdout is identical to an
-uninterrupted run's. (In ``--emit-updates`` mode the resumed child
+Output discipline: each attempt's stdout is spooled to an anonymous
+temp file and only forwarded when that attempt exits cleanly, so a
+crashed attempt's partial output is discarded and the supervised run's
+total stdout is identical to an uninterrupted run's. Spooling to disk
+(not a PIPE buffer) keeps supervisor RSS independent of the stream
+size — a 25M-event ``--emit-updates`` dump is GBs that must not live in
+the parent's memory. (In ``--emit-updates`` mode the resumed child
 replays restored rows itself — ``cli.py`` — so the successful attempt's
 stream alone is complete.) stderr streams through live: it carries the
 operator-facing logs.
@@ -20,9 +23,13 @@ operator-facing logs.
 
 from __future__ import annotations
 
+import io
 import logging
+import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 from typing import List, Optional, Sequence
 
@@ -58,30 +65,48 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
     the last failure's code once attempts are exhausted).
 
     ``stdout`` (default ``sys.stdout``) receives the successful attempt's
-    buffered output; failed attempts' partial output is discarded with a
+    spooled output; failed attempts' partial output is discarded with a
     log line so at-least-once execution still yields exactly-once output.
+    Each attempt spools to an anonymous temp file (deleted on close
+    regardless of outcome), so supervisor memory stays O(1) in the
+    child's output size.
     """
     sink = stdout if stdout is not None else sys.stdout
     restarts = 0
     while True:
-        try:
-            proc = subprocess.run(list(cmd), stdout=subprocess.PIPE,
-                                  timeout=timeout_s)
-            rc, out = proc.returncode, proc.stdout or b""
-        except subprocess.TimeoutExpired as e:
-            # A hung attempt counts as a failed one (subprocess.run has
-            # already killed the child); 124 matches timeout(1).
-            rc, out = 124, e.stdout or b""
-        if rc == 0:
-            text = out.decode("utf-8", errors="replace")
-            if hasattr(sink, "buffer"):
-                sink.buffer.write(out)
-                sink.flush()
-            else:
-                sink.write(text)
-            if restarts:
-                LOG.info("job completed after %d restart(s)", restarts)
-            return 0
+        # One anonymous spool per attempt: auto-deleted on close, so a
+        # failed attempt's partial output vanishes without cleanup code.
+        with tempfile.TemporaryFile() as spool:
+            try:
+                proc = subprocess.run(list(cmd), stdout=spool,
+                                      timeout=timeout_s)
+                rc = proc.returncode
+            except subprocess.TimeoutExpired:
+                # A hung attempt counts as a failed one (subprocess.run
+                # has already killed the child); 124 matches timeout(1).
+                rc = 124
+            # The child wrote through the shared fd; our handle's position
+            # never moved, so size comes from the file, not tell().
+            out_bytes = os.fstat(spool.fileno()).st_size
+            if rc == 0:
+                spool.seek(0)
+                if hasattr(sink, "buffer"):
+                    shutil.copyfileobj(spool, sink.buffer)
+                    sink.flush()
+                else:
+                    # Text sink: incremental decode (TextIOWrapper keeps
+                    # multi-byte sequences intact across chunk reads).
+                    # newline="" disables universal-newline translation —
+                    # the byte-identical-output contract includes \r\n.
+                    reader = io.TextIOWrapper(spool, encoding="utf-8",
+                                              errors="replace", newline="")
+                    try:
+                        shutil.copyfileobj(reader, sink)
+                    finally:
+                        reader.detach()  # the with-block owns the close
+                if restarts:
+                    LOG.info("job completed after %d restart(s)", restarts)
+                return 0
         restarts += 1
         if restarts > attempts:
             LOG.error("job failed with rc=%d; restart attempts exhausted "
@@ -90,7 +115,7 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
         LOG.warning(
             "job attempt %d failed with rc=%d; discarding %d bytes of "
             "partial output and restarting in %.1fs (%d attempt(s) left)",
-            restarts, rc, len(out), delay_s,
+            restarts, rc, out_bytes, delay_s,
             attempts - restarts)
         if delay_s > 0:
             time.sleep(delay_s)
