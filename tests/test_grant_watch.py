@@ -1,0 +1,109 @@
+"""Unattended grant watcher (VERDICT r3, Next #1).
+
+The loop logic runs against stub probe/stage subprocesses — the real
+probe code path (subprocess + hard timeout + GRANT- marker parse) is
+exercised as-is; only the code string the probe child runs is swapped,
+so a dead tunnel can be simulated without jax or a tunnel.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from tpu_cooccurrence.bench import grant_watch
+
+
+def _read_log(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_probe_cpu_backend_is_not_a_grant(monkeypatch):
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-cpu')")
+    assert grant_watch.probe_once(timeout_s=60) is False
+
+
+def test_probe_accelerator_backend_is_a_grant(monkeypatch):
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    assert grant_watch.probe_once(timeout_s=60) is True
+
+
+def test_probe_hang_times_out_false(monkeypatch):
+    monkeypatch.setattr(grant_watch, "PROBE_CODE",
+                        "import time; time.sleep(600)")
+    assert grant_watch.probe_once(timeout_s=2) is False
+
+
+def test_watch_no_grant_keeps_watching(monkeypatch, tmp_path):
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-cpu')")
+    log = str(tmp_path / "watch.jsonl")
+    captures = grant_watch.watch(interval_s=0, probe_timeout_s=60,
+                                 max_cycles=3, log_path=log,
+                                 stages=[], heartbeat_every=2)
+    assert captures == 0
+    events = [e["event"] for e in _read_log(log)]
+    # Heartbeat throttle: cycles 1 and 3 log, cycle 2 is silent.
+    assert events.count("no-grant") == 2
+    assert "grant" not in events
+
+
+def test_watch_captures_on_grant(monkeypatch, tmp_path):
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    log = str(tmp_path / "watch.jsonl")
+    marker = tmp_path / "stage-ran"
+    stage_cmd = [sys.executable, "-c",
+                 f"open({str(marker)!r}, 'w').write('ok'); print('done')"]
+    captures = grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_captures=1, log_path=log,
+        stages=[("stub", stage_cmd, 60.0)])
+    assert captures == 1
+    assert marker.read_text() == "ok"
+    log_events = _read_log(log)
+    by_event = {e["event"]: e for e in log_events}
+    assert by_event["stage-end"]["ok"] is True
+    assert "done" in by_event["stage-end"]["stdout_tail"]
+    assert by_event["capture-done"]["complete"] is True
+
+
+def test_watch_stage_timeout_then_grant_lost(monkeypatch, tmp_path):
+    """A stage that outlives its deadline is killed; the re-probe sees
+    the grant gone and the remaining stages are skipped, not hung."""
+    flag = tmp_path / "grant-up"
+    flag.write_text("1")
+    # Probe keyed on the flag file; the hanging stage removes it first,
+    # simulating a grant that dies mid-capture.
+    monkeypatch.setattr(
+        grant_watch, "PROBE_CODE",
+        f"import os; print('GRANT-tpu' if os.path.exists({str(flag)!r}) "
+        f"else 'GRANT-cpu')")
+    hang_cmd = [sys.executable, "-c",
+                f"import os, time; os.remove({str(flag)!r}); "
+                f"time.sleep(600)"]
+    never = tmp_path / "never"
+    after_cmd = [sys.executable, "-c",
+                 f"open({str(never)!r}, 'w').close()"]
+    log = str(tmp_path / "watch.jsonl")
+    captures = grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
+        stages=[("hang", hang_cmd, 2.0), ("after", after_cmd, 60.0)])
+    assert captures == 0  # incomplete sessions don't count as captures
+    assert not never.exists(), "stages after grant-loss must be skipped"
+    events = [e["event"] for e in _read_log(log)]
+    assert "stage-timeout" in events
+    assert "grant-lost" in events
+    done = [e for e in _read_log(log) if e["event"] == "capture-done"]
+    assert done and done[0]["complete"] is False
+    assert done[0]["sessions"] == 1
+
+
+def test_default_stages_shape():
+    stages = grant_watch.default_stages()
+    names = [n for n, _argv, _t in stages]
+    assert names == ["tpu_round2", "bench.py"]
+    for _n, argv, deadline in stages:
+        assert argv[0] == sys.executable
+        assert deadline > 0
+    quick = grant_watch.default_stages(quick=True)
+    assert "--quick" in quick[0][1]
