@@ -26,13 +26,13 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 _HISTORY = os.path.join(REPO, "bench_history.jsonl")
 
-#: Hard deadline for the accelerator measurement child. Generous: first
-#: tunnel contact + compiles legitimately take minutes; the measured
-#: stream itself is ~1-2 min/run on chip.
-ACCEL_DEADLINE_S = float(os.environ.get("BENCH_ACCEL_DEADLINE_S", 2400))
-#: Deadline for the CPU-fallback child (no tunnel involved, but the run
-#: must terminate regardless).
-CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", 3600))
+# Child deadlines live in grant_watch (single owner: its watch-stage
+# backstop is derived from the same values, so the two can never drift
+# apart). Accel: generous — first tunnel contact + compiles legitimately
+# take minutes. CPU: no tunnel involved, but the run must terminate.
+from tpu_cooccurrence.bench.grant_watch import (
+    BENCH_ACCEL_DEADLINE_S as ACCEL_DEADLINE_S,
+    BENCH_CPU_DEADLINE_S as CPU_DEADLINE_S)
 
 
 def run(backend: str, users, items, ts, num_items: int, window_ms: int):

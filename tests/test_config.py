@@ -130,6 +130,22 @@ def test_fixed_score_rejected_on_non_sparse_backends():
         CooccurrenceJob(cfg)
 
 
+def test_pallas_on_rejected_on_sharded_backends():
+    """Explicit --pallas on cannot be honored by the sharded scorers
+    (the fused kernels are single-chip) — refuse, don't silently run XLA."""
+    import pytest
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    for cfg in (Config(window_size=10, seed=1, backend=Backend.SHARDED,
+                       num_items=64, num_shards=2, pallas="on"),
+                Config(window_size=10, seed=1, backend=Backend.SPARSE,
+                       num_shards=2, pallas="on")):
+        with pytest.raises(ValueError, match="sharded"):
+            CooccurrenceJob(cfg)
+
+
 def test_fixed_score_honored_under_hybrid_alias():
     """--backend hybrid is a full sparse alias: sparse-only flags must be
     accepted (the alias is applied before flag validation)."""

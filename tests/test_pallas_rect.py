@@ -1,0 +1,200 @@
+"""Fused Pallas sparse-rectangle scorer vs the XLA `_score_rect` path.
+
+Interpret mode on CPU (the standard way to validate Pallas TPU kernels
+without hardware). The kernel must be a drop-in for
+``state/sparse_scorer._score_rect``: same packed [2, S, K] wire format
+(ids as int32 bitcast), same tie semantics (earliest slab slot wins),
+same zero-cell masking. (VERDICT r3, Next #2 — reference hot loop 4:
+ItemRowRescorerTwoInputStreamOperator.java:158-228.)
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_cooccurrence.ops.pallas_score import (pallas_score_rect,
+                                               rect_supported, rect_tile)
+from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
+from tpu_cooccurrence.state.sparse_scorer import (SparseDeviceScorer,
+                                                  _score_rect)
+
+
+def _random_slab(rng, n_rows, num_items, R, zero_frac=0.1,
+                 count_hi=50):
+    """Synthetic slab: ``n_rows`` rows with random lens in [0, R],
+    contiguous starts, random partner ids / counts (some zero =
+    cancelled cells), plus 3 all-padding meta rows (len 0)."""
+    lens = rng.integers(0, R + 1, n_rows).astype(np.int32)
+    starts = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int32)
+    cap = int(lens.sum()) + 8
+    cnt = rng.integers(1, count_hi, cap).astype(np.int32)
+    cnt[rng.random(cap) < zero_frac] = 0
+    dst = rng.integers(0, num_items, cap).astype(np.int32)
+    rowids = rng.choice(num_items, n_rows, replace=False).astype(np.int32)
+    meta = np.zeros((3, n_rows + 3), dtype=np.int32)  # 3 padding rows
+    meta[0, :n_rows] = rowids
+    meta[1, :n_rows] = starts
+    meta[2, :n_rows] = lens
+    row_sums = rng.integers(1, 1 << 16, num_items).astype(np.int32)
+    observed = np.float32(1e7)
+    return cnt, dst, row_sums, meta, observed
+
+
+def _unpack(packed, s):
+    host = np.asarray(packed)
+    return host[0, :s], host[1, :s].view(np.int32)
+
+
+@pytest.mark.parametrize("seed,R,n_rows", [
+    (0, 256, 13),    # single column tile, non-multiple-of-8 rows
+    (1, 512, 24),    # tile == R
+    (2, 1024, 9),    # two column tiles: running merge across tiles
+])
+def test_rect_kernel_matches_score_rect(seed, R, n_rows):
+    rng = np.random.default_rng(seed)
+    num_items = 2048
+    top_k = 10
+    cnt, dst, row_sums, meta, observed = _random_slab(
+        rng, n_rows, num_items, R)
+
+    ref = _score_rect(jnp.asarray(cnt), jnp.asarray(dst),
+                      jnp.asarray(row_sums), jnp.asarray(meta), observed,
+                      top_k, R)
+    got = pallas_score_rect(jnp.asarray(cnt), jnp.asarray(dst),
+                            jnp.asarray(row_sums), jnp.asarray(meta),
+                            observed, top_k=top_k, R=R, interpret=True)
+    s = meta.shape[1]
+    ref_vals, ref_idx = _unpack(ref, s)
+    got_vals, got_idx = _unpack(got, s)
+    np.testing.assert_allclose(got_vals, ref_vals, rtol=1e-5, atol=1e-5)
+    # Ids must agree exactly wherever the score is not tied (ties keep
+    # set equality — checked via the score match above plus the
+    # untied-position identity here).
+    for r in range(s):
+        for k in range(top_k):
+            if not np.isfinite(ref_vals[r, k]):
+                continue
+            if np.isclose(ref_vals[r], ref_vals[r, k]).sum() == 1:
+                assert got_idx[r, k] == ref_idx[r, k], (r, k)
+
+
+def test_rect_kernel_tie_prefers_earliest_slot():
+    """Equal scores: the earliest-inserted slab cell (lowest slot) wins,
+    matching lax.top_k in _score_rect and the reference heap's
+    keep-earlier rule (IntDoublePriorityQueue.java:146-150)."""
+    num_items = 512
+    R = 256
+    top_k = 4
+    # One row, 6 live cells; partners chosen with IDENTICAL row sums and
+    # counts so all six scores tie exactly.
+    lens = np.asarray([6], dtype=np.int32)
+    meta = np.zeros((3, 8), dtype=np.int32)
+    meta[0, 0] = 7
+    meta[1, 0] = 0
+    meta[2, 0] = lens[0]
+    cnt = np.zeros(R, dtype=np.int32)
+    cnt[:6] = 5
+    dst = np.zeros(R, dtype=np.int32)
+    partners = np.asarray([40, 30, 20, 10, 50, 60], dtype=np.int32)
+    dst[:6] = partners
+    row_sums = np.full(num_items, 1000, dtype=np.int32)
+    observed = np.float32(1e6)
+
+    ref = _score_rect(jnp.asarray(cnt), jnp.asarray(dst),
+                      jnp.asarray(row_sums), jnp.asarray(meta), observed,
+                      top_k, R)
+    got = pallas_score_rect(jnp.asarray(cnt), jnp.asarray(dst),
+                            jnp.asarray(row_sums), jnp.asarray(meta),
+                            observed, top_k=top_k, R=R, interpret=True)
+    _, ref_idx = _unpack(ref, 1)
+    _, got_idx = _unpack(got, 1)
+    # Both keep slot order among the all-tied cells: first 4 partners.
+    np.testing.assert_array_equal(ref_idx[0], partners[:top_k])
+    np.testing.assert_array_equal(got_idx[0], partners[:top_k])
+
+
+def test_rect_supported_gating():
+    assert rect_supported(256, 10)
+    assert rect_supported(1024, 10)
+    assert not rect_supported(64, 10)       # narrow: XLA carries it
+    assert not rect_supported(16, 10)
+    assert not rect_supported(256, 200)     # top_k beyond lane width
+    assert rect_tile(4096) == 512
+    assert rect_tile(256) == 256
+    with pytest.raises(ValueError, match="rect_supported"):
+        pallas_score_rect(jnp.zeros(8, jnp.int32), jnp.zeros(8, jnp.int32),
+                          jnp.zeros(16, jnp.int32),
+                          jnp.zeros((3, 4), jnp.int32), np.float32(0),
+                          top_k=10, R=64, interpret=True)
+
+
+def test_rect_rejects_vocab_beyond_float32_exact():
+    import functools
+
+    import jax
+
+    big = (1 << 24) + 128
+    with pytest.raises(ValueError, match="2\\^24"):
+        jax.eval_shape(
+            functools.partial(pallas_score_rect, top_k=5, R=256,
+                              interpret=True),
+            jax.ShapeDtypeStruct((1024,), jnp.int32),
+            jax.ShapeDtypeStruct((1024,), jnp.int32),
+            jax.ShapeDtypeStruct((big,), jnp.int32),
+            jax.ShapeDtypeStruct((3, 8), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32))
+
+
+def _dense_stream(seed=11, n=60_000, items=512):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, items, n).astype(np.int64)
+    dst = rng.integers(0, items, n).astype(np.int64)
+    keep = src != dst
+    return PairDeltaBatch(src[keep], dst[keep],
+                          np.ones(int(keep.sum()), dtype=np.int32))
+
+
+@pytest.mark.parametrize("mode", ["pipelined", "deferred-fixed"])
+def test_sparse_scorer_pallas_end_to_end(mode):
+    """SparseDeviceScorer --pallas on matches off, through both dispatch
+    forms. The dense random stream pushes rows past 64 partners so the
+    R=256 bucket (kernel-carried) is actually exercised."""
+    pairs = _dense_stream()
+    out = {}
+    for pl in ("on", "off"):
+        kw = (dict(defer_results=True, fixed_shapes=True)
+              if mode == "deferred-fixed" else dict(defer_results=False))
+        sc = SparseDeviceScorer(10, use_pallas=pl, **kw)
+        sc.process_window(0, pairs)
+        batches = [sc.flush()]
+        if mode == "pipelined":
+            batches.append(sc.flush())  # drain the one-window pipeline
+        got = {int(r): (v.copy(), i.copy())
+               for b in batches
+               for r, i, v in zip(b.rows, b.idx, b.vals)}
+        out[pl] = got
+        # Sanity: the kernel path actually carried a wide bucket.
+        if pl == "on":
+            assert sc._rect_pallas(256), "R=256 bucket should be kernel-carried"
+    assert set(out["on"]) == set(out["off"])
+    for r in out["on"]:
+        v_on, i_on = out["on"][r]
+        v_off, i_off = out["off"][r]
+        np.testing.assert_allclose(v_on, v_off, rtol=1e-5, atol=1e-5)
+        for k in range(len(v_off)):
+            if np.isfinite(v_off[k]) and np.isclose(v_off, v_off[k]).sum() == 1:
+                assert i_on[k] == i_off[k], (r, k)
+
+
+def test_sparse_scorer_rejects_bad_pallas_value():
+    with pytest.raises(ValueError, match="auto|on|off"):
+        SparseDeviceScorer(10, use_pallas="yes")
+
+
+def test_sparse_pallas_auto_defaults_off_on_cpu():
+    """auto resolves OFF for the int32 slab (measured: XLA wins dense
+    int32 ~5x; the sparse-pallas tpu_round2 row re-decides on chip)."""
+    sc = SparseDeviceScorer(10, use_pallas="auto")
+    assert sc.use_pallas is False
+    assert not sc._rect_pallas(1024)

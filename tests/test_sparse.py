@@ -356,11 +356,11 @@ def test_sparse_fixed_shapes_dispatch_signature_constant():
     orig_bucket = sp._score_into_table
 
     def spy_window(tbl, cnt, dst, row_sums, meta_all, observed, *,
-                   top_k, plan):
+                   top_k, plan, interpret=False):
         calls["window"] += 1
         plans.append(plan)
         return orig_window(tbl, cnt, dst, row_sums, meta_all, observed,
-                           top_k=top_k, plan=plan)
+                           top_k=top_k, plan=plan, interpret=interpret)
 
     def spy_bucket(*a, **k):
         calls["per_bucket"] += 1
@@ -389,14 +389,14 @@ def test_sparse_fixed_shapes_dispatch_signature_constant():
     # (constant rectangles — the invariant that bounds program count).
     s_by_r = {}
     for plan in plans:
-        for r, s, _o in plan:
+        for r, s, _o, _pl in plan:
             assert s_by_r.setdefault(r, s) == s, (r, s, s_by_r)
     # The monotone high-water plan only ever grows: each plan's
     # (R -> chunk count) multiset extends its predecessor's.
     seen = {}
     for plan in plans:
         counts = {}
-        for r, _s, _o in plan:
+        for r, _s, _o, _pl in plan:
             counts[r] = counts.get(r, 0) + 1
         for r, n in seen.items():
             assert counts.get(r, 0) >= n, (seen, counts)

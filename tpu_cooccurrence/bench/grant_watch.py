@@ -51,6 +51,13 @@ REPO = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 LOG_PATH = os.path.join(REPO, "GRANT_WATCH.jsonl")
 
+#: bench.py's internal child deadlines (it imports these back — single
+#: owner, so the watcher's stage backstop can never fall below the
+#: child's own budget).
+BENCH_ACCEL_DEADLINE_S = float(os.environ.get("BENCH_ACCEL_DEADLINE_S",
+                                              2400))
+BENCH_CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", 3600))
+
 #: Code the probe child runs. Executes a real op: the axon plugin can
 #: enumerate a device whose pool has no capacity, and then the first
 #: dispatch (not the listing) is what hangs.
@@ -110,9 +117,7 @@ def default_stages(quick: bool = False) -> List[Tuple[str, List[str], float]]:
     # child + cpu-fallback child, env-tunable); the stage deadline is a
     # strict backstop ABOVE that budget so the watcher never kills a
     # capture bench.py itself still considers legitimate.
-    bench_budget = (240.0
-                    + float(os.environ.get("BENCH_ACCEL_DEADLINE_S", 2400))
-                    + float(os.environ.get("BENCH_CPU_DEADLINE_S", 3600))
+    bench_budget = (240.0 + BENCH_ACCEL_DEADLINE_S + BENCH_CPU_DEADLINE_S
                     + 360.0)
     return [
         ("tpu_round2", round2, 900.0 if quick else 5400.0),

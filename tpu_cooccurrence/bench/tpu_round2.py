@@ -143,6 +143,74 @@ def ml25m_sparse(quick: bool) -> dict:
                     backend=Backend.SPARSE)
 
 
+@guard("sparse-pallas")
+def sparse_pallas(quick: bool) -> dict:
+    """A/B the sparse rectangle scorer: XLA gather+LLR+top_k vs the fused
+    Pallas kernel, at the fixed-shape rectangle sizes config 4 actually
+    dispatches (VERDICT r3, Next #2 — pre-built so a 247x-style cliff
+    like dense int16's costs a measurement, not a grant cycle). The
+    result decides whether SparseDeviceScorer's pallas auto rule stays
+    OFF for int32 slabs or flips on."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..state.sparse_scorer import (SparseDeviceScorer, _score_slab,
+                                       _score_slab_pallas, fixed_block)
+
+    rng = np.random.default_rng(0)
+    num_items = 1 << 20 if not quick else 1 << 16  # config-4 vocab scale
+    top_k = 10
+    row_sums = jnp.asarray(rng.integers(1, 1 << 20, num_items),
+                           dtype=jnp.int32)
+    observed = np.float32(1e9)
+    budget = SparseDeviceScorer.FIXED_BUDGET
+    row_cap = SparseDeviceScorer.FIXED_ROW_CAP
+
+    def timeit(fn, n=5):
+        jax.block_until_ready(fn())  # compile
+        start = time.monotonic()
+        for _ in range(n):
+            jax.block_until_ready(fn())
+        return (time.monotonic() - start) / n
+
+    by_rect = {}
+    for R in (256, 1024, 4096):
+        S = fixed_block(R, budget, row_cap)
+        if quick:
+            S = min(S, 512)
+        # Rows at ~R/2 occupancy (post-pow-4-bucketing typical fill).
+        lens = rng.integers(R // 4, R + 1, S).astype(np.int32)
+        starts = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int32)
+        cap = int(lens.sum()) + 8
+        cnt = jnp.asarray(rng.integers(0, 50, cap), dtype=jnp.int32)
+        dst = jnp.asarray(rng.integers(0, num_items, cap), dtype=jnp.int32)
+        meta = np.zeros((3, S), dtype=np.int32)
+        meta[0] = rng.choice(num_items, S, replace=False)
+        meta[1] = starts
+        meta[2] = lens
+        meta_j = jnp.asarray(meta)
+        xla_s = timeit(lambda: _score_slab(
+            cnt, dst, row_sums, meta_j, observed, top_k=top_k, R=R))
+        try:
+            pl_s = timeit(lambda: _score_slab_pallas(
+                cnt, dst, row_sums, meta_j, observed, top_k=top_k, R=R,
+                interpret=jax.default_backend() != "tpu"))
+            by_rect[f"R{R}xS{S}"] = {
+                "xla_ms": round(xla_s * 1e3, 2),
+                "pallas_ms": round(pl_s * 1e3, 2),
+                "pallas_speedup": round(xla_s / pl_s, 3),
+            }
+        except Exception as exc:
+            by_rect[f"R{R}xS{S}"] = {
+                "xla_ms": round(xla_s * 1e3, 2),
+                "pallas_error": repr(exc)[:200],
+            }
+    return {"count_dtype": "int32", "vocab": num_items,
+            "by_rect": by_rect}
+
+
 @guard("pallas-bench")
 def pallas_bench(quick: bool) -> dict:
     """The kernel's target case: int16 counts at a max-vocab shape, where
@@ -216,11 +284,16 @@ def main() -> None:
     ap.add_argument("--only", default=None,
                     help="comma-separated subset of measurement names")
     args = ap.parse_args()
+    # Scarce-first order: the probe (projection constants) and the two
+    # north stars run before the long tails, so a short grant still
+    # settles the headline questions; sparse-pallas right after decides
+    # the config-4 carrier kernel in the same sitting.
     passes = {
         "tunnel-probe": tunnel_probe_pass,
         "config4-sparse": config4_sparse,
-        "ml25m-full": ml25m_full,
         "ml25m-sparse": ml25m_sparse,
+        "sparse-pallas": sparse_pallas,
+        "ml25m-full": ml25m_full,
         "config5-sparse": config5_sparse,
         "pallas-bench": pallas_bench,
         "configs": all_configs,

@@ -128,6 +128,180 @@ def _score_topk_kernel(g_ref, rsj_ref, rsi_ref, obs_ref,
         idx_ref[...] = run_idx[...]
 
 
+def _rect_topk_kernel(k11_ref, dsf_ref, rsj_ref, rsi_ref, obs_ref,
+                      vals_ref, idx_ref, run_vals, run_idx, *, top_k,
+                      tile, block):
+    """Sparse-rectangle variant of :func:`_score_topk_kernel`.
+
+    Same streaming top-K structure; differences: the contingency columns
+    are slab cells, so the partner row sums arrive as a full
+    ``[R, TILE]`` tile (gathered by partner id in XLA — the dense kernel
+    broadcasts one ``[1, TILE]`` row-sum slice), and the candidate ids
+    are the gathered partner ids (as float32 values), not a column iota.
+    Tie-breaking still picks the lowest candidate *position* — position
+    order is slab-slot order, which is exactly
+    ``state/sparse_scorer._score_rect``'s ``lax.top_k`` tie rule
+    (earliest-inserted cell of the row wins).
+    """
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    R = block
+
+    @pl.when(j == 0)
+    def _init():
+        run_vals[...] = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
+        run_idx[...] = jnp.zeros((R, _K_PAD), dtype=jnp.float32)
+
+    k11i = k11_ref[...]                                     # [R, TILE] counts
+    k11 = k11i.astype(jnp.float32)
+    rsj = rsj_ref[...]                                      # [R, TILE]
+    rsi = rsi_ref[...]                                      # [R, 1]
+    observed = obs_ref[0, 0]
+
+    k12 = rsi - k11
+    k21 = rsj - k11
+    k22 = observed + k11 - k12 - k21
+    scores = llr_stable(k11, k12, k21, k22)
+    scores = jnp.where(k11i != 0, scores, -jnp.inf)         # [R, TILE]
+
+    # Threshold skip — see _score_topk_kernel.
+    thresh = run_vals[:, top_k - 1:top_k]
+    tile_max = jnp.max(scores, axis=1, keepdims=True)
+    need_merge = jnp.any(tile_max > thresh)
+
+    @pl.when((j == 0) | need_merge)
+    def _merge():
+        cand_vals = jnp.concatenate([run_vals[...], scores], axis=1)
+        cand_idx = jnp.concatenate([run_idx[...], dsf_ref[...]], axis=1)
+        width = _K_PAD + tile
+        positions = jax.lax.broadcasted_iota(jnp.int32, (R, width),
+                                             dimension=1)
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (R, _K_PAD), dimension=1)
+
+        new_vals = jnp.full((R, _K_PAD), -jnp.inf, dtype=jnp.float32)
+        new_idx = jnp.zeros((R, _K_PAD), dtype=jnp.float32)
+        for k in range(top_k):  # static unroll; top_k is small
+            m = jnp.max(cand_vals, axis=1, keepdims=True)
+            pos = jnp.min(jnp.where(cand_vals == m, positions, width),
+                          axis=1, keepdims=True)
+            sel = positions == pos
+            chosen = jnp.max(jnp.where(sel, cand_idx, 0.0),
+                             axis=1, keepdims=True)
+            lane_k = lanes == k
+            new_vals = jnp.where(lane_k, m, new_vals)
+            new_idx = jnp.where(lane_k, chosen, new_idx)
+            cand_vals = jnp.where(sel, -jnp.inf, cand_vals)
+
+        run_vals[...] = new_vals
+        run_idx[...] = new_idx
+
+    @pl.when(j == n_j - 1)
+    def _emit():
+        vals_ref[...] = run_vals[...]
+        idx_ref[...] = run_idx[...]
+
+
+def rect_tile(R: int) -> int:
+    """Column-tile width for a rectangle of width ``R`` (lane-aligned)."""
+    return min(512, R)
+
+
+def rect_supported(R: int, top_k: int) -> bool:
+    """Whether the fused rectangle kernel can carry this bucket.
+
+    Narrow rectangles (R < 256) don't tile the 128-lane VPU cleanly and
+    are cheap for XLA anyway; ``top_k`` must fit the output lane width.
+    """
+    t = rect_tile(R)
+    return R >= 256 and R % t == 0 and t % 128 == 0 and top_k <= _K_PAD
+
+
+def pallas_score_rect(cnt, dst, row_sums, meta, observed, *, top_k: int,
+                      R: int, interpret: bool = False):
+    """Fused LLR + top-K over one slab length-bucket rectangle.
+
+    Drop-in replacement for ``state/sparse_scorer._score_rect`` (same
+    arguments, same packed ``[2, S_pad, K]`` float32 output with ids as
+    an int32 *bitcast*, same tie semantics), for use inside a jit — the
+    slab/row-sum gathers stay in XLA exactly like the dense kernel's
+    ``C[rows]`` gather; the kernel fuses away the ``[S, R]`` float32
+    score materialization and ``top_k``'s second full pass over it.
+
+    cnt/dst   [cap]  int32 — slab cells (counts / partner ids)
+    row_sums  [I]    int32
+    meta      [3, S] int32 — (row id, slab start, row len); len==0 pads
+    observed  scalar float32
+    """
+    if not rect_supported(R, top_k):
+        raise ValueError(
+            f"rectangle R={R} top_k={top_k} unsupported by the fused "
+            f"kernel; gate callers on rect_supported()")
+    num_items = row_sums.shape[0]
+    if num_items > 1 << 24:
+        raise ValueError(
+            f"vocab {num_items} exceeds 2^24: partner ids ride the kernel "
+            f"as exact float32 (int32 scratch miscompiles on Mosaic); use "
+            f"the XLA rectangle scorer beyond that")
+    tile = rect_tile(R)
+    blk = 8  # int32 sublane tile
+    rowids, starts, lens = meta[0], meta[1], meta[2]
+    S = meta.shape[1]
+    pad_s = (-S) % blk
+    if pad_s:
+        z = jnp.zeros((3, pad_s), dtype=meta.dtype)
+        rowids = jnp.concatenate([rowids, z[0]])
+        starts = jnp.concatenate([starts, z[1]])
+        lens = jnp.concatenate([lens, z[2]])
+    sp = S + pad_s
+
+    # XLA pre-gathers (the kernel reads rectangles, Mosaic can't index
+    # arbitrary slab offsets from inside a block).
+    col = jnp.arange(R, dtype=jnp.int32)[None, :]
+    in_row = col < lens[:, None]
+    idx = jnp.where(in_row, starts[:, None] + col, 0)
+    k11 = jnp.where(in_row, cnt[idx], 0)                 # [Sp, R] int32
+    valid = k11 != 0  # zero cells (cancelled counts) are not scored
+    ds = jnp.where(valid, dst[idx], 0)
+    dsf = ds.astype(jnp.float32)                         # exact < 2^24
+    rsj = jnp.where(valid, row_sums[ds], 0).astype(jnp.float32)
+    rsi = row_sums[rowids].astype(jnp.float32).reshape(sp, 1)
+    obs = jnp.full((1, 1), observed, dtype=jnp.float32)
+
+    kernel = functools.partial(_rect_topk_kernel, top_k=top_k, tile=tile,
+                               block=blk)
+    vals, idxf = pl.pallas_call(
+        kernel,
+        grid=(sp // blk, R // tile),
+        in_specs=[
+            pl.BlockSpec((blk, tile), lambda i, j: (i, j)),
+            pl.BlockSpec((blk, tile), lambda i, j: (i, j)),
+            pl.BlockSpec((blk, tile), lambda i, j: (i, j)),
+            pl.BlockSpec((blk, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((blk, _K_PAD), lambda i, j: (i, 0)),
+            pl.BlockSpec((blk, _K_PAD), lambda i, j: (i, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk, _K_PAD), jnp.float32),
+            pltpu.VMEM((blk, _K_PAD), jnp.float32),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
+            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
+        ),
+        interpret=interpret,
+    )(k11, dsf, rsj, rsi, obs)
+    # Same wire format as _score_rect: ids as an int32 BITCAST (the
+    # float->int conversion happens here in XLA, where it is exact and
+    # immune to the Mosaic carried-scratch issue the value-space
+    # encoding works around inside the kernel).
+    ids = idxf[:S, :top_k].astype(jnp.int32)
+    return jnp.stack([vals[:S, :top_k],
+                      jax.lax.bitcast_convert_type(ids, jnp.float32)])
+
+
 @functools.partial(jax.jit,
                    static_argnames=("top_k", "tile", "interpret", "packed"))
 def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,

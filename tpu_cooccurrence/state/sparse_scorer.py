@@ -179,18 +179,41 @@ _score_slab = functools.partial(jax.jit, static_argnames=("top_k", "R"))(
     _score_rect)
 
 
+@functools.partial(jax.jit, static_argnames=("top_k", "R", "interpret"))
+def _score_slab_pallas(cnt, dst, row_sums, meta, observed, *,
+                       top_k: int, R: int, interpret: bool = False):
+    """Jitted fused-kernel counterpart of :data:`_score_slab` (pipelined,
+    non-deferred path): same packed [2, S, K] return."""
+    from ..ops.pallas_score import pallas_score_rect
+
+    return pallas_score_rect(cnt, dst, row_sums, meta, observed,
+                             top_k=top_k, R=R, interpret=interpret)
+
+
 def _rect_into_table(tbl, cnt, dst, row_sums, meta, observed,
-                     top_k: int, R: int):
+                     top_k: int, R: int, pallas: bool = False,
+                     interpret: bool = False):
     """Score one rectangle and scatter it into the results table (trace
-    body shared by the per-bucket and fused-window dispatch forms)."""
-    packed = _score_rect(cnt, dst, row_sums, meta, observed, top_k, R)
+    body shared by the per-bucket and fused-window dispatch forms).
+    ``pallas`` routes the rectangle through the fused LLR+top-K kernel
+    (``ops/pallas_score.pallas_score_rect``, same packed wire format);
+    the scatter is identical either way."""
+    if pallas:
+        from ..ops.pallas_score import pallas_score_rect
+
+        packed = pallas_score_rect(cnt, dst, row_sums, meta, observed,
+                                   top_k=top_k, R=R, interpret=interpret)
+    else:
+        packed = _score_rect(cnt, dst, row_sums, meta, observed, top_k, R)
     rowids = jnp.where(meta[2] > 0, meta[0], _SENT)
     return tbl.at[:, rowids].set(packed, mode="drop")
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("top_k", "R"))
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("top_k", "R", "pallas", "interpret"))
 def _score_into_table(tbl, cnt, dst, row_sums, meta, observed, *,
-                      top_k: int, R: int):
+                      top_k: int, R: int, pallas: bool = False,
+                      interpret: bool = False):
     """Score one length bucket and scatter the packed result straight into
     the device-resident latest-results table (``[2, items_cap, K]``) —
     nothing returns to the host. The deferred-results mode's whole point:
@@ -198,26 +221,29 @@ def _score_into_table(tbl, cnt, dst, row_sums, meta, observed, *,
     large windows) disappears; the host fetches the table once at flush.
     """
     return _rect_into_table(tbl, cnt, dst, row_sums, meta, observed,
-                            top_k, R)
+                            top_k, R, pallas, interpret)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,),
-                   static_argnames=("top_k", "plan"))
+                   static_argnames=("top_k", "plan", "interpret"))
 def _score_window_into_table(tbl, cnt, dst, row_sums, meta_all, observed, *,
-                             top_k: int, plan):
+                             top_k: int, plan, interpret: bool = False):
     """ALL of a window's scoring in one dispatch (fixed-shape mode).
 
-    ``plan``: static tuple of ``(R, S, offset)`` rectangles; ``meta_all``
-    is their [3, sum(S)] concatenation (one upload). Fixed shapes make
-    the rectangle sizes pure functions of R, and the caller dispatches a
-    monotone high-water set of buckets (empty ones as all-padding), so
-    the plan only ever GROWS — at most one program per bucket the stream
-    ever occupies (measured: 3 over both benchmark streams), and the
-    per-window dispatch count drops from one-per-bucket to one."""
-    for R, S, off in plan:
+    ``plan``: static tuple of ``(R, S, offset, pallas)`` rectangles;
+    ``meta_all`` is their [3, sum(S)] concatenation (one upload). Fixed
+    shapes make the rectangle sizes pure functions of R, and the caller
+    dispatches a monotone high-water set of buckets (empty ones as
+    all-padding), so the plan only ever GROWS — at most one program per
+    bucket the stream ever occupied (measured: 3 over both benchmark
+    streams), and the per-window dispatch count drops from
+    one-per-bucket to one. ``pallas`` per rectangle: wide buckets can
+    ride the fused kernel while narrow ones stay XLA, inside the same
+    dispatch."""
+    for R, S, off, use_pl in plan:
         meta = jax.lax.slice(meta_all, (0, off), (3, off + S))
         tbl = _rect_into_table(tbl, cnt, dst, row_sums, meta, observed,
-                               top_k, R)
+                               top_k, R, use_pl, interpret)
     return tbl
 
 
@@ -723,7 +749,8 @@ class SparseDeviceScorer:
                  compact_min_heap: int = 1 << 16,
                  score_ladder: Optional[int] = None,
                  defer_results: bool = False,
-                 fixed_shapes: Optional[bool] = None) -> None:
+                 fixed_shapes: Optional[bool] = None,
+                 use_pallas: str = "auto") -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -776,6 +803,32 @@ class SparseDeviceScorer:
         # program's static plan only ever grows, so compile count stays
         # bounded even when a bucket occasionally overflows s_block).
         self._plan_buckets = {}
+        # Fused-kernel routing for wide rectangles (--pallas). auto: OFF
+        # for now — slab counts are int32, where the measured dense A/B
+        # favored XLA ~5x (TPU_ROUND2.jsonl pallas-bench, v5e); the
+        # sparse-pallas tpu_round2 row re-decides this on chip (VERDICT
+        # r3 Next #2) and this default flips if the rectangle form
+        # cliffs like dense int16 did (247x). 'on' forces the kernel for
+        # every rectangle rect_supported() can carry; narrow buckets
+        # (R < 256) stay XLA either way — they don't tile the 128-lane
+        # VPU and are cheap for XLA.
+        if use_pallas not in ("auto", "on", "off"):
+            raise ValueError(
+                f"use_pallas must be auto|on|off, got {use_pallas!r}")
+        self.use_pallas = use_pallas == "on"
+        self._pallas_interpret = jax.default_backend() != "tpu"
+
+    def _rect_pallas(self, R: int) -> bool:
+        """Whether bucket width ``R`` routes through the fused kernel.
+
+        The vocab bound mirrors the kernel's own guard (partner ids ride
+        as exact float32); a vocab growing past it simply reroutes new
+        plans to XLA instead of raising mid-stream.
+        """
+        from ..ops.pallas_score import rect_supported
+
+        return (self.use_pallas and rect_supported(R, self.top_k)
+                and self.items_cap <= 1 << 24)
 
     # Back-compat introspection used by tests.
     @property
@@ -951,11 +1004,17 @@ class SparseDeviceScorer:
                     self._results.tbl = _score_into_table(
                         self._results.tbl, self.cnt, self.dst,
                         self.row_sums, meta, np.float32(self.observed),
-                        top_k=self.top_k, R=R)
+                        top_k=self.top_k, R=R,
+                        pallas=self._rect_pallas(R),
+                        interpret=self._pallas_interpret)
                     continue
-                packed = _score_slab(self.cnt, self.dst, self.row_sums,
-                                     meta, np.float32(self.observed),
-                                     top_k=self.top_k, R=R)
+                score = (_score_slab_pallas if self._rect_pallas(R)
+                         else _score_slab)
+                kw = ({"interpret": self._pallas_interpret}
+                      if self._rect_pallas(R) else {})
+                packed = score(self.cnt, self.dst, self.row_sums,
+                               meta, np.float32(self.observed),
+                               top_k=self.top_k, R=R, **kw)
                 if hasattr(packed, "copy_to_host_async"):
                     packed.copy_to_host_async()
                 chunks.append((rows[chunk], s, packed))
@@ -986,12 +1045,13 @@ class SparseDeviceScorer:
                 meta_all[0, off: off + s] = rows[chunk]
                 meta_all[1, off: off + s] = starts[chunk]
                 meta_all[2, off: off + s] = lens[chunk]
-                plan.append((R, S, off))
+                plan.append((R, S, off, self._rect_pallas(R)))
                 off += S
             self._results.tbl = _score_window_into_table(
                 self._results.tbl, self.cnt, self.dst, self.row_sums,
                 meta_all, np.float32(self.observed),
-                top_k=self.top_k, plan=tuple(plan))
+                top_k=self.top_k, plan=tuple(plan),
+                interpret=self._pallas_interpret)
         if self.defer_results:
             self._results.mark(rows)
         return chunks
