@@ -1,0 +1,150 @@
+"""Per-window wire-byte accounting (VERDICT r3, Next #3).
+
+On the tunneled chip (and DCN hosts generally) transfer volume is wall
+time. These tests pin the steady-state transfer contract against the
+observability ledger, so a stray blocking fetch (a `np.asarray` of a
+device buffer inside process_window) or an uplink-size regression fails
+CI instead of silently doubling tunnel time:
+
+* deferred sparse window  = aggregated-delta uplink ONLY, zero downlink
+* flush                   = dirty rows only, one exact-bytes gather
+* pipelined (emit) window = one packed result fetch per scored chunk
+
+Reference: the serialization boundaries being replaced,
+FlinkCooccurrences.java:89-167 (every keyBy/broadcast hop).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.observability import LEDGER
+from tpu_cooccurrence.ops.aggregate import aggregate_window_coo
+from tpu_cooccurrence.ops.device_scorer import (DeviceScorer, pad_pow2,
+                                                pad_pow4)
+from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
+from tpu_cooccurrence.state.sparse_scorer import (SparseDeviceScorer,
+                                                  bucket_r, fixed_block)
+
+
+@pytest.fixture(autouse=True)
+def _reset_ledger():
+    LEDGER.reset()
+    yield
+    LEDGER.reset()
+
+
+def _pairs(seed=5, n=8000, items=256):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, items, n).astype(np.int64)
+    dst = rng.integers(0, items, n).astype(np.int64)
+    keep = src != dst
+    return PairDeltaBatch(src[keep], dst[keep],
+                          np.ones(int(keep.sum()), dtype=np.int32))
+
+
+def _expected_update_bytes(pairs):
+    """upd [2, pad_pow4(n, 4096)] int32 + bounds [2] int32, where n =
+    new cells (0 in steady state) + aggregated cells + distinct rows."""
+    src_d, _dst, _val, d_key = aggregate_window_coo(
+        pairs.src, pairs.dst, pairs.delta.astype(np.int64),
+        return_key=True)
+    n_cells = len(d_key)
+    n_rows = len(np.unique(src_d))
+    n_pad = pad_pow4(n_cells + n_rows, minimum=1 << 12)
+    return 2 * 4 * n_pad + 8
+
+
+def _expected_window_meta_bytes(scorer):
+    """meta_all [3, sum(S)] int32 over the monotone fixed-shape plan."""
+    min_r = max(16, scorer.top_k)
+    total = 0
+    for b, n_chunks in scorer._plan_buckets.items():
+        R = bucket_r(b, min_r, scorer.score_ladder)
+        total += n_chunks * fixed_block(R, scorer.FIXED_BUDGET,
+                                        scorer.FIXED_ROW_CAP)
+    return 3 * 4 * total
+
+
+def test_deferred_sparse_steady_window_uplink_only():
+    """Steady state (no new cells, no moves, no plan growth): exactly one
+    update upload + one meta upload, ZERO downlink."""
+    pairs = _pairs()
+    sc = SparseDeviceScorer(5, defer_results=True, fixed_shapes=True)
+    sc.process_window(0, pairs)  # warmup: allocs, moves, plan discovery
+
+    LEDGER.reset()
+    sc.process_window(10, pairs)  # same cells: pure steady state
+    assert LEDGER.labels("d2h") == [], (
+        "a deferred window must fetch NOTHING from the device")
+    assert LEDGER.labels("h2d") == ["update", "window-meta"]
+    up_b, meta_b = [e.nbytes for e in LEDGER.events]
+    assert up_b == _expected_update_bytes(pairs)
+    assert meta_b == _expected_window_meta_bytes(sc)
+
+
+def test_deferred_flush_fetches_dirty_rows_only():
+    pairs = _pairs()
+    sc = SparseDeviceScorer(5, defer_results=True, fixed_shapes=True)
+    sc.process_window(0, pairs)
+    n_dirty = int(sc._results.dirty.sum())
+    assert n_dirty > 0
+
+    LEDGER.reset()
+    batch = sc.flush()
+    assert len(batch.rows) == n_dirty
+    rows_pad = pad_pow2(n_dirty, minimum=16)
+    assert LEDGER.labels("h2d") == ["drain-rows"]
+    assert LEDGER.labels("d2h") == ["results-drain"]
+    up, down = LEDGER.events
+    assert up.nbytes == 4 * rows_pad
+    assert down.nbytes == 2 * rows_pad * sc.top_k * 4
+
+    # Nothing new scored: a second flush moves zero bytes.
+    LEDGER.reset()
+    assert len(sc.flush().rows) == 0
+    assert LEDGER.summary() == {"h2d_bytes": 0, "h2d_calls": 0,
+                                "d2h_bytes": 0, "d2h_calls": 0}
+
+
+def test_deferred_idle_window_moves_nothing():
+    sc = SparseDeviceScorer(5, defer_results=True, fixed_shapes=True)
+    sc.process_window(0, _pairs())
+    LEDGER.reset()
+    sc.process_window(10, PairDeltaBatch(np.zeros(0, np.int64),
+                                         np.zeros(0, np.int64),
+                                         np.zeros(0, np.int32)))
+    assert LEDGER.summary()["h2d_calls"] == 0
+    assert LEDGER.summary()["d2h_calls"] == 0
+
+
+def test_pipelined_sparse_window_fetches_packed_results_once():
+    """The emit-updates path fetches exactly the packed [2, S, K] blocks
+    of the PREVIOUS window (one-deep pipeline), nothing else."""
+    pairs = _pairs()
+    sc = SparseDeviceScorer(5, defer_results=False)
+    sc.process_window(0, pairs)   # fills the pipeline
+    LEDGER.reset()
+    sc.process_window(10, pairs)  # steady: uplink + drain of window 0
+    down = LEDGER.labels("d2h")
+    assert down and set(down) == {"results"}
+    up = LEDGER.labels("h2d")
+    assert up[0] == "update"
+    assert set(up[1:]) == {"bucket-meta"}
+
+
+def test_deferred_dense_steady_window_uplink_only():
+    pairs = _pairs(items=128)
+    sc = DeviceScorer(128, 5, defer_results=True)
+    sc.process_window(0, pairs)
+    LEDGER.reset()
+    sc.process_window(10, pairs)
+    assert LEDGER.labels("d2h") == []
+    up = LEDGER.labels("h2d")
+    assert set(up) == {"coo", "score-rows"}
+    # uplink bytes: one packed [3, pad] COO block (u16 at this vocab)
+    # + one padded score-rows vector.
+    src, _dst, agg = aggregate_window_coo(pairs.src, pairs.dst, pairs.delta)
+    coo_pad = pad_pow2(len(src), minimum=1 << 14)
+    rows = len(np.unique(src))
+    rows_pad = min(pad_pow4(rows, minimum=64), sc.max_score_rows)
+    assert LEDGER.h2d_bytes == 3 * 2 * coo_pad + 4 * rows_pad

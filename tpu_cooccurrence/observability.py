@@ -67,6 +67,70 @@ class StepTimer:
         return sorted(self.windows, key=lambda w: -w.seconds)[:n]
 
 
+@dataclasses.dataclass
+class TransferEvent:
+    direction: str  # "h2d" | "d2h"
+    label: str      # call-site tag ("update", "window-meta", ...)
+    nbytes: int
+
+
+class TransferLedger:
+    """Host<->device wire-byte accounting (VERDICT r3, Next #3).
+
+    The scorers record every host-constructed buffer they ship up and
+    every device buffer they fetch down, at the call site, with a label.
+    On the tunneled single chip (and DCN-attached hosts in general)
+    transfer volume IS wall time, so the steady-state contract — a
+    deferred sparse window is aggregated-delta uplink only, ZERO
+    downlink; a flush fetches dirty rows only — is pinned by CI
+    (``tests/test_wire_bytes.py``) against this ledger, and a stray
+    blocking fetch or an uplink-size regression fails the build instead
+    of silently doubling tunnel wall time.
+
+    Replaces-by-accounting the serialization boundaries the reference
+    crosses at every keyBy/broadcast (FlinkCooccurrences.java:89-167).
+    One module-level instance (:data:`LEDGER`); events are a bounded
+    ring so unbounded streams can't grow host memory.
+    """
+
+    def __init__(self, keep_events: int = 4096) -> None:
+        self.events: Deque[TransferEvent] = collections.deque(
+            maxlen=keep_events)
+        self.reset()
+
+    def reset(self) -> None:
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.h2d_calls = 0
+        self.d2h_calls = 0
+        self.events.clear()
+
+    def up(self, label: str, *arrays) -> None:
+        """Record one host->device upload (all buffers of one dispatch)."""
+        n = sum(int(a.nbytes) for a in arrays)
+        self.h2d_bytes += n
+        self.h2d_calls += 1
+        self.events.append(TransferEvent("h2d", label, n))
+
+    def down(self, label: str, *arrays) -> None:
+        """Record one device->host fetch."""
+        n = sum(int(a.nbytes) for a in arrays)
+        self.d2h_bytes += n
+        self.d2h_calls += 1
+        self.events.append(TransferEvent("d2h", label, n))
+
+    def labels(self, direction: str) -> list:
+        return [e.label for e in self.events if e.direction == direction]
+
+    def summary(self) -> Dict[str, int]:
+        return {"h2d_bytes": self.h2d_bytes, "h2d_calls": self.h2d_calls,
+                "d2h_bytes": self.d2h_bytes, "d2h_calls": self.d2h_calls}
+
+
+#: Process-wide ledger the scorers record into.
+LEDGER = TransferLedger()
+
+
 @contextlib.contextmanager
 def xla_trace(profile_dir: Optional[str]) -> Iterator[None]:
     """Wrap a run in a ``jax.profiler`` trace when a directory is given."""

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from ..observability import LEDGER
 from ..sampling.reservoir import PairDeltaBatch
 from ..state.results import TopKBatch
 from .aggregate import (aggregate_window_coo, distinct_sorted,
@@ -269,7 +270,9 @@ class DeferredResultsTable:
         n = len(rows)
         rows_pad = np.zeros(pad_pow2(n, minimum=16), np.int32)
         rows_pad[:n] = rows
+        LEDGER.up("drain-rows", rows_pad)
         host = np.asarray(_gather_packed(self.tbl, jnp.asarray(rows_pad)))
+        LEDGER.down("results-drain", host)
         # Clear marks only once the host copy is in hand: a transient
         # fetch failure (tunneled links drop) must leave the rows dirty
         # so a retrying caller can still drain them.
@@ -430,6 +433,7 @@ class DeviceScorer:
                 update = _update_coo
             coo[0, :n] = src[lo: lo + n]
             coo[1, :n] = dst[lo: lo + n]
+            LEDGER.up("coo", coo)
             self.C, self.row_sums = update(
                 self.C, self.row_sums, coo, num_items=self.num_items)
 
@@ -449,6 +453,7 @@ class DeviceScorer:
             pad_s = min(pad_pow4(s, minimum=64), self.max_score_rows)
             rows_padded = np.zeros(pad_s, dtype=np.int32)
             rows_padded[:s] = chunk
+            LEDGER.up("score-rows", rows_padded)
             if self.use_pallas:
                 from .pallas_score import pallas_score_topk
 
@@ -494,6 +499,7 @@ class DeviceScorer:
         rows_l, idx_l, vals_l = [], [], []
         for chunk, s, packed in chunks:
             host = np.asarray(packed)  # single [2, S, K] fetch
+            LEDGER.down("results", host)
             rows_l.append(chunk)
             vals_l.append(host[0, :s])
             if self.use_pallas:

@@ -255,16 +255,13 @@ def pallas_score_rect(cnt, dst, row_sums, meta, observed, *, top_k: int,
     sp = S + pad_s
 
     # XLA pre-gathers (the kernel reads rectangles, Mosaic can't index
-    # arbitrary slab offsets from inside a block).
-    col = jnp.arange(R, dtype=jnp.int32)[None, :]
-    in_row = col < lens[:, None]
-    idx = jnp.where(in_row, starts[:, None] + col, 0)
-    k11 = jnp.where(in_row, cnt[idx], 0)                 # [Sp, R] int32
-    valid = k11 != 0  # zero cells (cancelled counts) are not scored
-    ds = jnp.where(valid, dst[idx], 0)
+    # arbitrary slab offsets from inside a block) — the SAME gather/mask
+    # code as the XLA scorer, so the two paths cannot drift.
+    from ..state.sparse_scorer import gather_rect
+
+    meta_p = jnp.stack([rowids, starts, lens])
+    k11, _valid, ds, rsj, rsi = gather_rect(cnt, dst, row_sums, meta_p, R)
     dsf = ds.astype(jnp.float32)                         # exact < 2^24
-    rsj = jnp.where(valid, row_sums[ds], 0).astype(jnp.float32)
-    rsi = row_sums[rowids].astype(jnp.float32).reshape(sp, 1)
     obs = jnp.full((1, 1), observed, dtype=jnp.float32)
 
     kernel = functools.partial(_rect_topk_kernel, top_k=top_k, tile=tile,

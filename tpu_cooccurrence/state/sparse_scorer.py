@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from ..observability import LEDGER
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              merge_sorted_insert, narrow_deltas_int32)
 from ..ops.device_scorer import DeferredResultsTable, pad_pow2, pad_pow4
@@ -148,6 +149,28 @@ def _apply_cells(cnt, dst, upd, bounds):
     return cnt, dst
 
 
+def gather_rect(cnt, dst, row_sums, meta, R: int):
+    """XLA rectangle gather shared by the XLA and Pallas scorers.
+
+    Returns ``(k11i, valid, ds, rsj, rsi)``: counts [S, R] int32, the
+    live-cell mask (zero cells — cancelled counts — are not scored),
+    partner ids (0 where invalid), partner row sums f32 (0 where
+    invalid), and the scored rows' own sums as an f32 column. One
+    definition so the kernel's drop-in contract cannot drift from
+    ``_score_rect``'s masking rules.
+    """
+    rowids, starts, lens = meta[0], meta[1], meta[2]
+    col = jnp.arange(R, dtype=jnp.int32)[None, :]
+    in_row = col < lens[:, None]
+    idx = jnp.where(in_row, starts[:, None] + col, 0)
+    k11i = jnp.where(in_row, cnt[idx], 0)
+    valid = k11i != 0
+    ds = jnp.where(valid, dst[idx], 0)
+    rsj = jnp.where(valid, row_sums[ds], 0).astype(jnp.float32)
+    rsi = row_sums[rowids].astype(jnp.float32)[:, None]
+    return k11i, valid, ds, rsj, rsi
+
+
 def _score_rect(cnt, dst, row_sums, meta, observed, top_k: int, R: int):
     """LLR + top-K over one length bucket of updated rows (trace body).
 
@@ -155,16 +178,8 @@ def _score_rect(cnt, dst, row_sums, meta, observed, top_k: int, R: int):
     carry len == 0 and score all -inf. ``meta[0]`` row ids index
     ``row_sums`` (global id space); starts index the local slab.
     """
-    rowids, starts, lens = meta[0], meta[1], meta[2]
-    col = jnp.arange(R, dtype=jnp.int32)[None, :]
-    in_row = col < lens[:, None]
-    idx = jnp.where(in_row, starts[:, None] + col, 0)
-    k11i = jnp.where(in_row, cnt[idx], 0)
-    valid = k11i != 0  # zero cells (cancelled counts) are not scored
-    ds = jnp.where(valid, dst[idx], 0)
+    k11i, valid, ds, rsj, rsi = gather_rect(cnt, dst, row_sums, meta, R)
     k11 = k11i.astype(jnp.float32)
-    rsj = jnp.where(valid, row_sums[ds], 0).astype(jnp.float32)
-    rsi = row_sums[rowids].astype(jnp.float32)[:, None]
     k12 = rsi - k11
     k21 = rsj - k11
     k22 = observed + k11 - k12 - k21
@@ -887,6 +902,7 @@ class SparseDeviceScorer:
             gmap_pad = np.zeros(min(pad_pow2(len(gmap), minimum=1 << 10),
                                     self.capacity), dtype=np.int32)
             gmap_pad[: len(gmap)] = gmap
+            LEDGER.up("compact-gather", gmap_pad)
             self.cnt, self.dst = _compact_gather(self.cnt, self.dst,
                                                  gmap_pad, cap=self.capacity)
         delta64 = pairs.delta.astype(np.int64)
@@ -930,10 +946,12 @@ class SparseDeviceScorer:
         bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
 
         if plan.mv is not None:
+            LEDGER.up("update", upd, bounds, plan.mv)
             self.cnt, self.dst, self.row_sums = _apply_moves_update(
                 self.cnt, self.dst, self.row_sums, plan.mv, upd, bounds,
                 L=plan.mv_len)
         else:
+            LEDGER.up("update", upd, bounds)
             self.cnt, self.dst, self.row_sums = _apply_update(
                 self.cnt, self.dst, self.row_sums, upd, bounds)
 
@@ -998,6 +1016,7 @@ class SparseDeviceScorer:
                 meta[0, :s] = rows[chunk]
                 meta[1, :s] = starts[chunk]
                 meta[2, :s] = lens[chunk]
+                LEDGER.up("bucket-meta", meta)
                 if self.defer_results:
                     # Fused: the scatter rides the scoring dispatch (the
                     # table is donated in and reassigned).
@@ -1047,6 +1066,7 @@ class SparseDeviceScorer:
                 meta_all[2, off: off + s] = lens[chunk]
                 plan.append((R, S, off, self._rect_pallas(R)))
                 off += S
+            LEDGER.up("window-meta", meta_all)
             self._results.tbl = _score_window_into_table(
                 self._results.tbl, self.cnt, self.dst, self.row_sums,
                 meta_all, np.float32(self.observed),
@@ -1082,6 +1102,7 @@ class SparseDeviceScorer:
         rows_l, idx_l, vals_l = [], [], []
         for rows, s, packed in chunks:
             host = np.asarray(packed)  # single [2, S_pad, K] fetch
+            LEDGER.down("results", host)
             rows_l.append(rows)
             vals_l.append(host[0, :s])
             idx_l.append(host[1, :s].view(np.int32))
@@ -1096,7 +1117,9 @@ class SparseDeviceScorer:
         if len(slots):
             # Gather live cells ON DEVICE so the fetch is nnz values, not
             # the whole slab (capacity >= 2x nnz from pow-2 slack+garbage).
+            LEDGER.up("checkpoint-slots", slots)
             vals = np.asarray(self.cnt[jnp.asarray(slots)])
+            LEDGER.down("checkpoint-cells", vals)
         else:
             vals = np.zeros(0, np.int64)
         nz = vals != 0
@@ -1126,6 +1149,7 @@ class SparseDeviceScorer:
         dst_host = np.zeros(self.capacity, dtype=np.int32)
         cnt_host[slots] = cnt_vals.astype(np.int32)
         dst_host[slots] = (key & 0xFFFFFFFF).astype(np.int32)
+        LEDGER.up("restore-slab", cnt_host, dst_host)
         self.cnt = jnp.asarray(cnt_host)
         self.dst = jnp.asarray(dst_host)
         rs = np.asarray(st["row_sums"], dtype=np.int64)
