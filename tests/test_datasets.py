@@ -1,0 +1,118 @@
+"""Real-dataset adapters against checked-in fixture slices.
+
+Every ML-25M / Instacart number so far ran on shape-matched stand-ins;
+these tests make the REAL loaders (`movielens_interactions`,
+`instacart_interactions`) and the env-path selection in bench/configs
+run in CI on tiny checked-in slices, so a dataset drop-in cannot fail
+for the first time inside a scarce TPU grant window (VERDICT r3,
+Next #4). Reference ingest/parse: FlinkCooccurrences.java:207-219.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config
+from tpu_cooccurrence.io.synthetic import (instacart_interactions,
+                                           movielens_interactions)
+from tpu_cooccurrence.job import CooccurrenceJob
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+RATINGS = os.path.join(FIXTURES, "ratings.csv")
+UDATA = os.path.join(FIXTURES, "u.data")
+ORDERS = os.path.join(FIXTURES, "orders.csv")
+ORDER_PRODUCTS = os.path.join(FIXTURES, "order_products.csv")
+
+
+def test_movielens_25m_csv_format():
+    (users, items, ts), = movielens_interactions(RATINGS)
+    assert len(users) == 40
+    # Sorted by timestamp, seconds -> ms.
+    assert (np.diff(ts) >= 0).all()
+    assert ts.min() == 1141415790 * 1000
+    # The earliest event is user 2 rating movie 318.
+    assert users[0] == 2 and items[0] == 318
+
+
+def test_movielens_min_rating_filter():
+    (users, items, _ts), = movielens_interactions(RATINGS, min_rating=1.0)
+    # Two 0.5-star rows (user 2 x 110, user 4 x 318) drop out.
+    assert len(users) == 38
+    pairs = set(zip(users.tolist(), items.tolist()))
+    assert (2, 110) not in pairs and (4, 318) not in pairs
+
+
+def test_movielens_100k_udata_format():
+    (users, items, ts), = movielens_interactions(UDATA)
+    assert len(users) == 30
+    assert (np.diff(ts) >= 0).all()
+    assert ts[0] == 874833878 * 1000   # user 291, item 118
+    assert users[0] == 291 and items[0] == 118
+
+
+def test_instacart_join_and_order():
+    (users, items, ts), = instacart_interactions(ORDERS, ORDER_PRODUCTS)
+    assert len(users) == 26
+    assert (np.diff(ts) >= 0).all()   # ordered by order_number
+    # Product 43633 appears only in order 3367565 -> user 2, order_number 3.
+    mask = items == 43633
+    assert users[mask].tolist() == [2] and ts[mask].tolist() == [3]
+    # user 1's first basket holds products 196, 14084, 12427 at ts 1.
+    first = items[(users == 1) & (ts == 1)]
+    assert set(first.tolist()) == {196, 14084, 12427}
+
+
+@pytest.mark.parametrize("loader,args", [
+    (movielens_interactions, (RATINGS,)),
+    (movielens_interactions, (UDATA,)),
+    (instacart_interactions, (ORDERS, ORDER_PRODUCTS)),
+])
+def test_adapters_end_to_end_through_job(loader, args):
+    """The real-loader output drives a full job to results (the id space
+    is raw dataset ids — the job's vocab layer maps them)."""
+    (users, items, ts), = loader(*args)
+    cfg = Config(window_size=10_000_000, seed=0xC0FFEE, item_cut=500,
+                 user_cut=500, backend=Backend.ORACLE)
+    job = CooccurrenceJob(cfg)
+    job.add_batch(users, items, ts)
+    job.finish()
+    assert job.latest, "fixture stream produced no recommendations"
+    assert job.windows_fired > 0
+
+
+def test_bench_env_path_selection(monkeypatch):
+    """bench/configs picks the real dataset exactly when the env points
+    at an existing file, and reports synthetic_standin accordingly."""
+    from tpu_cooccurrence.bench import configs
+
+    monkeypatch.setenv("MOVIELENS_100K", UDATA)
+    users, items, ts, standin = configs._movielens_100k()
+    assert standin is False and len(users) == 30
+
+    monkeypatch.setenv("MOVIELENS_25M", RATINGS)
+    users, items, ts, standin = configs._movielens_25m(limit=20)
+    assert standin is False and len(users) == 20
+
+    monkeypatch.setenv("INSTACART_ORDERS", ORDERS)
+    monkeypatch.setenv("INSTACART_ORDER_PRODUCTS", ORDER_PRODUCTS)
+    users, items, ts, standin = configs._instacart()
+    assert standin is False and len(users) == 26
+
+    # Missing path -> stand-in, clearly labeled.
+    monkeypatch.setenv("MOVIELENS_100K", "/nonexistent/u.data")
+    *_ignore, standin = configs._movielens_100k()
+    assert standin is True
+
+
+def test_bench_config_runs_real_fixture(monkeypatch):
+    """A whole benchmark config on the real loader path: the BenchResult
+    must carry synthetic_standin=False."""
+    from tpu_cooccurrence.bench import configs
+
+    monkeypatch.setenv("MOVIELENS_100K", UDATA)
+    res = configs.config2_ml100k(backend=Backend.ORACLE)
+    d = res.as_dict()
+    assert d["synthetic_standin"] is False
+    assert d["pairs"] >= 0
