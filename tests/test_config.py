@@ -130,20 +130,26 @@ def test_fixed_score_rejected_on_non_sparse_backends():
         CooccurrenceJob(cfg)
 
 
-def test_pallas_on_rejected_on_sharded_backends():
-    """Explicit --pallas on cannot be honored by the sharded scorers
-    (the fused kernels are single-chip) — refuse, don't silently run XLA."""
-    import pytest
-
+def test_pallas_flag_plumbed_to_sharded_backends():
+    """--pallas reaches both sharded scorers (the kernels run per shard
+    inside their shard_map bodies)."""
     from tpu_cooccurrence.config import Backend, Config
     from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.parallel.sharded import ShardedScorer
+    from tpu_cooccurrence.parallel.sharded_sparse import ShardedSparseScorer
 
-    for cfg in (Config(window_size=10, seed=1, backend=Backend.SHARDED,
-                       num_items=64, num_shards=2, pallas="on"),
-                Config(window_size=10, seed=1, backend=Backend.SPARSE,
-                       num_shards=2, pallas="on")):
-        with pytest.raises(ValueError, match="sharded"):
-            CooccurrenceJob(cfg)
+    cfg = Config(window_size=10, seed=1, backend=Backend.SHARDED,
+                 num_items=64, num_shards=2, pallas="on")
+    job = CooccurrenceJob(cfg)
+    assert isinstance(job.scorer, ShardedScorer)
+    assert job.scorer.use_pallas is True
+    # With pallas the vocab pads to a kernel-tile multiple.
+    assert job.scorer.num_items % job.scorer.PALLAS_TILE == 0
+    sp = Config(window_size=10, seed=1, backend=Backend.SPARSE,
+                num_shards=2, pallas="on")
+    job2 = CooccurrenceJob(sp)
+    assert isinstance(job2.scorer, ShardedSparseScorer)
+    assert job2.scorer.use_pallas is True
 
 
 def test_fixed_score_honored_under_hybrid_alias():

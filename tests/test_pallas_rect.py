@@ -155,6 +155,20 @@ def _dense_stream(seed=11, n=60_000, items=512):
                           np.ones(int(keep.sum()), dtype=np.int32))
 
 
+def _assert_topk_match(out_on, out_off):
+    """Kernel vs XLA result dicts {row: (vals, idx)}: scores allclose,
+    ids identical wherever a row's score is untied (the shared protocol
+    of every pallas parity test)."""
+    assert set(out_on) == set(out_off) and out_on
+    for r in out_on:
+        v_on, i_on = out_on[r]
+        v_off, i_off = out_off[r]
+        np.testing.assert_allclose(v_on, v_off, rtol=1e-5, atol=1e-5)
+        for k in range(len(v_off)):
+            if np.isfinite(v_off[k]) and np.isclose(v_off, v_off[k]).sum() == 1:
+                assert i_on[k] == i_off[k], (r, k)
+
+
 @pytest.mark.parametrize("mode", ["pipelined", "deferred-fixed"])
 def test_sparse_scorer_pallas_end_to_end(mode):
     """SparseDeviceScorer --pallas on matches off, through both dispatch
@@ -177,14 +191,51 @@ def test_sparse_scorer_pallas_end_to_end(mode):
         # Sanity: the kernel path actually carried a wide bucket.
         if pl == "on":
             assert sc._rect_pallas(256), "R=256 bucket should be kernel-carried"
-    assert set(out["on"]) == set(out["off"])
-    for r in out["on"]:
-        v_on, i_on = out["on"][r]
-        v_off, i_off = out["off"][r]
-        np.testing.assert_allclose(v_on, v_off, rtol=1e-5, atol=1e-5)
-        for k in range(len(v_off)):
-            if np.isfinite(v_off[k]) and np.isclose(v_off, v_off[k]).sum() == 1:
-                assert i_on[k] == i_off[k], (r, k)
+    _assert_topk_match(out["on"], out["off"])
+
+
+def test_sharded_sparse_pallas_matches_xla():
+    """ShardedSparseScorer --pallas on == off over the virtual 8-device
+    mesh: the rectangle kernel runs per shard inside shard_map."""
+    from tpu_cooccurrence.parallel.sharded_sparse import ShardedSparseScorer
+
+    pairs = _dense_stream(seed=13, n=40_000, items=384)
+    out = {}
+    for pl in ("on", "off"):
+        sc = ShardedSparseScorer(10, num_shards=8, defer_results=True,
+                                 fixed_shapes=True, use_pallas=pl)
+        # Small fixed rectangles: interpret-mode pallas across 8 shards
+        # is minutes at the default budget, seconds at this one.
+        sc.FIXED_BUDGET = 1 << 13
+        sc.FIXED_ROW_CAP = 32
+        sc.process_window(0, pairs)
+        b = sc.flush()
+        out[pl] = {int(r): (v.copy(), i.copy())
+                   for r, i, v in zip(b.rows, b.idx, b.vals)}
+        if pl == "on":
+            assert sc._rect_pallas(256)
+    _assert_topk_match(out["on"], out["off"])
+
+
+def test_sharded_dense_pallas_matches_xla():
+    """ShardedScorer --pallas on == off over the virtual 8-device mesh
+    (the dense kernel gathers from each shard's local row block against
+    the replicated row sums). Small tile keeps interpret mode fast."""
+    from tpu_cooccurrence.parallel.sharded import ShardedScorer
+
+    class SmallTile(ShardedScorer):
+        PALLAS_TILE = 128
+
+    pairs = _dense_stream(seed=17, n=20_000, items=250)
+    out = {}
+    for pl in ("on", "off"):
+        sc = SmallTile(250, 10, num_shards=8, use_pallas=pl,
+                       count_dtype="int16")
+        sc.process_window(0, pairs)
+        b = sc.flush()
+        out[pl] = {int(r): (v.copy(), i.copy())
+                   for r, i, v in zip(b.rows, b.idx, b.vals)}
+    _assert_topk_match(out["on"], out["off"])
 
 
 def test_sparse_scorer_rejects_bad_pallas_value():

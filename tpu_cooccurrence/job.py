@@ -182,18 +182,6 @@ class CooccurrenceJob:
                                 use_pallas=self.config.pallas,
                                 count_dtype=self.config.count_dtype,
                                 defer_results=not self.config.emit_updates)
-        if (self.config.pallas == "on"
-                and (backend == Backend.SHARDED
-                     or (backend == Backend.SPARSE
-                         and self.config.num_shards > 1))):
-            # Same rule as --fixed-score: an explicit setting the backend
-            # cannot honor must not be silently ignored. The fused
-            # kernels are single-chip so far; the sharded scorers always
-            # score through XLA.
-            raise ValueError(
-                "--pallas on is not supported by the sharded backends "
-                "(the fused kernels are single-chip); use --num-shards 1 "
-                "or --pallas auto/off")
         if backend == Backend.SPARSE:
             fixed = self._parse_fixed_score()
             if self.config.num_shards > 1:
@@ -207,7 +195,8 @@ class CooccurrenceJob:
                     development_mode=self.config.development_mode,
                     score_ladder=self.config.score_ladder,
                     defer_results=not self.config.emit_updates,
-                    fixed_shapes=fixed)
+                    fixed_shapes=fixed,
+                    use_pallas=self.config.pallas)
             if self.config.coordinator is not None:
                 # A coordinator with the default single shard would run one
                 # full independent job per process (and clobber a shared
@@ -242,7 +231,8 @@ class CooccurrenceJob:
                                  num_shards=self.config.num_shards,
                                  counters=self.counters,
                                  mesh=maybe_multihost_mesh(self.config),
-                                 count_dtype=self.config.count_dtype)
+                                 count_dtype=self.config.count_dtype,
+                                 use_pallas=self.config.pallas)
         raise ValueError(f"unknown backend {backend}")
 
     # ------------------------------------------------------------------

@@ -89,6 +89,29 @@ def pallas_auto(count_dtype: np.dtype, backend: str, top_k: int = 1) -> bool:
             and top_k <= _K_PAD)
 
 
+def resolve_pallas_flag(use_pallas: str, count_dtype, top_k: int) -> bool:
+    """Resolve an ``auto|on|off`` --pallas request for a DENSE scorer
+    (single-chip or sharded): the measured :func:`pallas_auto` rule,
+    with the top-k-overflow fallback warned rather than silent."""
+    if use_pallas == "auto":
+        backend = jax.default_backend()
+        on = pallas_auto(count_dtype, backend, top_k)
+        if not on and pallas_auto(count_dtype, backend):
+            import logging
+
+            from .pallas_score import _K_PAD
+
+            logging.getLogger("tpu_cooccurrence").warning(
+                "--top-k %d exceeds the fused kernel's %d-lane output; "
+                "falling back to the XLA scorer, which is much slower "
+                "at int16 counts (measured 247x, TPU_ROUND2.jsonl)",
+                top_k, _K_PAD)
+        return on
+    if use_pallas in ("on", "off"):
+        return use_pallas == "on"
+    raise ValueError(f"use_pallas must be auto|on|off, got {use_pallas!r}")
+
+
 def score_row_budget(num_items: int, cap: int) -> int:
     """Rows per score call keeping the [S, I] working set ≲ 1 GB int32.
 
@@ -316,23 +339,8 @@ class DeviceScorer:
         self.counters = counters if counters is not None else Counters()
         self._max_score_rows_cap = max_score_rows_per_call
         self.max_pairs_per_step = max_pairs_per_step
-        if use_pallas == "auto":
-            self.use_pallas = pallas_auto(self.count_dtype,
-                                          jax.default_backend(), top_k)
-            if (not self.use_pallas
-                    and pallas_auto(self.count_dtype,
-                                    jax.default_backend())):
-                import logging
-
-                from .pallas_score import _K_PAD
-
-                logging.getLogger("tpu_cooccurrence").warning(
-                    "--top-k %d exceeds the fused kernel's %d-lane output; "
-                    "falling back to the XLA scorer, which is much slower "
-                    "at int16 counts (measured 247x, TPU_ROUND2.jsonl)",
-                    top_k, _K_PAD)
-        else:
-            self.use_pallas = use_pallas == "on"
+        self.use_pallas = resolve_pallas_flag(use_pallas, self.count_dtype,
+                                              top_k)
         # Off-TPU the kernel can only run interpreted (test/debug use).
         self._pallas_interpret = jax.default_backend() != "tpu"
         # num_items == 0: derive the vocab from the data — start at a

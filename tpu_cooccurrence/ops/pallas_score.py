@@ -128,6 +128,88 @@ def _score_topk_kernel(g_ref, rsj_ref, rsi_ref, obs_ref,
         idx_ref[...] = run_idx[...]
 
 
+def _pallas_topk_gathered(gathered, rs2d, rsi, observed, *, top_k: int,
+                          tile: int, blk: int, interpret: bool):
+    """The dense kernel's pallas_call on pre-gathered inputs.
+
+    gathered [Sp, I] int32|int16 (Sp % blk == 0, I % tile == 0),
+    rs2d [1, I] int32, rsi [Sp, 1] int32, observed scalar f32.
+    Returns (vals [Sp, _K_PAD] f32, idx [Sp, _K_PAD] f32 — ids as exact
+    float values). Shared by the single-chip wrapper (which gathers
+    ``C[rows]``) and the sharded backend (which gathers from its local
+    row block but passes the replicated global row sums).
+    """
+    sp, num_items = gathered.shape
+    obs = jnp.full((1, 1), observed, dtype=jnp.float32)
+    kernel = functools.partial(_score_topk_kernel, top_k=top_k, tile=tile,
+                               block=blk)
+    return pl.pallas_call(
+        kernel,
+        grid=(sp // blk, num_items // tile),
+        in_specs=[
+            pl.BlockSpec((blk, tile), lambda i, j: (i, j)),
+            pl.BlockSpec((1, tile), lambda i, j: (0, j)),
+            pl.BlockSpec((blk, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((blk, _K_PAD), lambda i, j: (i, 0)),
+            pl.BlockSpec((blk, _K_PAD), lambda i, j: (i, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk, _K_PAD), jnp.float32),
+            pltpu.VMEM((blk, _K_PAD), jnp.float32),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
+            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
+        ),
+        interpret=interpret,
+    )(gathered, rs2d, rsi, obs)
+
+
+def pallas_score_topk_local(C_loc, row_sums, rows_global, lo, observed, *,
+                            top_k: int, tile: int = 512,
+                            interpret: bool = False):
+    """Sharded-dense form: score global ``rows_global`` out of a LOCAL row
+    block ``C_loc`` (`[rows_per_shard, I]`, rows ``[lo, lo+rows_per_shard)``)
+    against the replicated global ``row_sums``. For use inside a
+    ``shard_map`` body (pallas_call is an ordinary per-device op there).
+
+    Returns packed [2, S, top_k] float32 with ids as float *values*
+    (decode with astype — same contract as ``pallas_score_topk(packed=
+    True)``). Padded rows may repeat a real row; the caller drops them.
+    """
+    num_items = C_loc.shape[1]
+    if C_loc.dtype not in (jnp.int32, jnp.int16):
+        raise ValueError(
+            f"pallas scorer supports int32|int16 counts, got {C_loc.dtype}")
+    if num_items % tile != 0:
+        raise ValueError(
+            f"num_items {num_items} must be a multiple of tile {tile}")
+    if num_items > 1 << 24:
+        raise ValueError(
+            f"num_items {num_items} exceeds 2^24: column ids ride as exact "
+            f"float32; use the XLA scorer beyond that")
+    if top_k > _K_PAD:
+        raise ValueError(
+            f"top_k {top_k} exceeds the kernel's lane width {_K_PAD}")
+    blk = row_block(C_loc.dtype)
+    S = rows_global.shape[0]
+    pad_s = (-S) % blk
+    if pad_s:
+        rows_global = jnp.concatenate(
+            [rows_global, jnp.full(pad_s, lo, dtype=rows_global.dtype)])
+    sp = S + pad_s
+    gathered = C_loc[rows_global - lo]                   # [Sp, I]
+    rsi = row_sums[rows_global].reshape(sp, 1)
+    rs2d = row_sums.reshape(1, num_items)
+    vals, idxf = _pallas_topk_gathered(gathered, rs2d, rsi, observed,
+                                       top_k=top_k, tile=tile, blk=blk,
+                                       interpret=interpret)
+    return jnp.stack([vals[:S, :top_k], idxf[:S, :top_k]])
+
+
 def _rect_topk_kernel(k11_ref, dsf_ref, rsj_ref, rsi_ref, obs_ref,
                       vals_ref, idx_ref, run_vals, run_idx, *, top_k,
                       tile, block):
@@ -214,6 +296,31 @@ def rect_supported(R: int, top_k: int) -> bool:
     """
     t = rect_tile(R)
     return R >= 256 and R % t == 0 and t % 128 == 0 and top_k <= _K_PAD
+
+
+def rect_routed(enabled: bool, R: int, top_k: int, items_cap: int) -> bool:
+    """THE routing rule for sparse rectangles, shared by the
+    single-device and sharded sparse scorers: kernel iff requested,
+    the bucket is kernel-carriable, and the vocab fits the float32-id
+    encoding (partner ids ride as exact f32 below 2^24) — a vocab
+    growing past the bound reroutes new plans to XLA instead of
+    raising mid-stream."""
+    return enabled and rect_supported(R, top_k) and items_cap <= 1 << 24
+
+
+def resolve_sparse_pallas_flag(use_pallas: str) -> bool:
+    """Resolve an ``auto|on|off`` --pallas request for a SPARSE scorer.
+
+    auto is OFF for now: slab counts are int32, where the measured dense
+    A/B favored XLA ~5x (TPU_ROUND2.jsonl pallas-bench, v5e); the
+    sparse-pallas tpu_round2 row re-decides this on chip, and this
+    default flips if the rectangle form cliffs like dense int16 did
+    (247x). 'on' forces the kernel for every rectangle
+    :func:`rect_supported` can carry; narrow buckets stay XLA either
+    way."""
+    if use_pallas not in ("auto", "on", "off"):
+        raise ValueError(f"use_pallas must be auto|on|off, got {use_pallas!r}")
+    return use_pallas == "on"
 
 
 def pallas_score_rect(cnt, dst, row_sums, meta, observed, *, top_k: int,
@@ -339,33 +446,9 @@ def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,
     gathered = C[rows]                                   # [Sp, I] count dtype
     rsi = row_sums[rows].reshape(sp, 1)
     rs2d = row_sums.reshape(1, num_items)
-    obs = jnp.full((1, 1), observed, dtype=jnp.float32)
-
-    kernel = functools.partial(_score_topk_kernel, top_k=top_k, tile=tile,
-                               block=blk)
-    vals, idx = pl.pallas_call(
-        kernel,
-        grid=(sp // blk, num_items // tile),
-        in_specs=[
-            pl.BlockSpec((blk, tile), lambda i, j: (i, j)),
-            pl.BlockSpec((1, tile), lambda i, j: (0, j)),
-            pl.BlockSpec((blk, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((blk, _K_PAD), lambda i, j: (i, 0)),
-            pl.BlockSpec((blk, _K_PAD), lambda i, j: (i, 0)),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((blk, _K_PAD), jnp.float32),
-            pltpu.VMEM((blk, _K_PAD), jnp.float32),
-        ],
-        out_shape=(
-            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
-            jax.ShapeDtypeStruct((sp, _K_PAD), jnp.float32),
-        ),
-        interpret=interpret,
-    )(gathered, rs2d, rsi, obs)
+    vals, idx = _pallas_topk_gathered(gathered, rs2d, rsi, observed,
+                                      top_k=top_k, tile=tile, blk=blk,
+                                      interpret=interpret)
     vals = vals[:S, :top_k]
     if packed:
         # Value-space packing: ids stay exact float32 (wrapper guard caps

@@ -26,6 +26,7 @@ Works identically on a virtual CPU mesh
 
 from __future__ import annotations
 
+import math
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -43,9 +44,11 @@ from ..state.results import TopKBatch
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              narrow_deltas_int32)
 from ..ops.llr import llr_stable
-from ..ops.device_scorer import pad_pow2, score_row_budget
+from ..ops.device_scorer import (pad_pow2, resolve_pallas_flag,
+                                 score_row_budget)
 from ..sampling.reservoir import PairDeltaBatch
-from .mesh import ITEM_AXIS, make_mesh, pad_to_multiple
+from .mesh import (ITEM_AXIS, make_mesh, pad_to_multiple,
+                   shard_map_maybe_relaxed)
 
 
 class ShardedScorer:
@@ -56,11 +59,16 @@ class ShardedScorer:
     #: dense backend's, doubling on overflow.
     AUTO_INITIAL_ROWS = 64
 
+    #: Column-tile width for the fused kernel (same measured choice as
+    #: DeviceScorer.PALLAS_TILE — swept on-chip, TPU_ROUND2.jsonl).
+    PALLAS_TILE = 2048
+
     def __init__(self, num_items: int, top_k: int, num_shards: Optional[int] = None,
                  counters: Optional[Counters] = None,
                  mesh: Optional[Mesh] = None,
                  max_score_rows_per_call: int = 8192,
-                 count_dtype: str = "int32") -> None:
+                 count_dtype: str = "int32",
+                 use_pallas: str = "auto") -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -69,6 +77,17 @@ class ShardedScorer:
         self.count_dtype = np.dtype(count_dtype)
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         self.n_shards = self.mesh.devices.size
+        # Fused-kernel routing: same auto rule (and top-k-overflow
+        # warning) as the dense single-chip scorer — the kernel exactly
+        # when int16 counts meet a real TPU (XLA collapses 247x there,
+        # TPU_ROUND2.jsonl pallas-bench), per shard inside the shard_map
+        # body. With pallas on, the vocab pads to a tile multiple so the
+        # kernel's column grid divides evenly.
+        self.use_pallas = resolve_pallas_flag(use_pallas, self.count_dtype,
+                                              top_k)
+        self._pallas_interpret = jax.default_backend() != "tpu"
+        self._pad_unit = (math.lcm(self.n_shards, self.PALLAS_TILE)
+                          if self.use_pallas else self.n_shards)
         self.num_items_logical = num_items
         self.auto_grow = num_items <= 0
         if self.auto_grow:
@@ -102,7 +121,7 @@ class ShardedScorer:
         """(Re)build the capacity-dependent pieces: shard geometry and the
         jitted ``shard_map`` programs (their row arithmetic closes over the
         per-shard row count)."""
-        self.num_items = pad_to_multiple(num_items, self.n_shards)
+        self.num_items = pad_to_multiple(num_items, self._pad_unit)
         self.rows_per_shard = self.num_items // self.n_shards
         # Bound each shard's per-call [S, I] score working set.
         self.max_score_rows = score_row_budget(
@@ -124,8 +143,22 @@ class ShardedScorer:
             row_sums = row_sums + jax.lax.psum(rs_part, ITEM_AXIS)
             return C_loc, row_sums
 
+        use_pallas = self.use_pallas
+        interpret = self._pallas_interpret
+        tile = self.PALLAS_TILE
+
         def _score(C_loc, row_sums, rows, observed):
             lo = jax.lax.axis_index(ITEM_AXIS) * rows_per_shard_c
+            if use_pallas:
+                from ..ops.pallas_score import pallas_score_topk_local
+
+                # Fused LLR+top-K per shard; ids ride as float values
+                # (decoded with astype in _materialize, like the dense
+                # single-chip pallas path).
+                packed = pallas_score_topk_local(
+                    C_loc, row_sums, rows[0], lo, observed,
+                    top_k=top_k, tile=tile, interpret=interpret)
+                return packed[None]
             counts = C_loc[rows[0] - lo]  # [S, I] int32 (shard-local rows)
             k11 = counts.astype(jnp.float32)
             rs = row_sums.astype(jnp.float32)
@@ -146,11 +179,10 @@ class ShardedScorer:
             in_specs=(P(ITEM_AXIS, None), P(), P(ITEM_AXIS)),
             out_specs=(P(ITEM_AXIS, None), P()),
         ), donate_argnums=(0, 1))
-        self._score = jax.jit(shard_map(
-            _score, mesh=self.mesh,
-            in_specs=(P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
-            out_specs=P(ITEM_AXIS),
-        ))
+        self._score = jax.jit(shard_map_maybe_relaxed(
+            _score, self.mesh,
+            (P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
+            P(ITEM_AXIS), relaxed=use_pallas))
 
     def _grow(self, need: int) -> None:
         """Double (at least) the vocab capacity and reshard the state.
@@ -277,7 +309,11 @@ class ShardedScorer:
                     continue
                 rows_l.append(rb[d, :n_valid])
                 vals_l.append(host[0, :n_valid])
-                idx_l.append(host[1, :n_valid].view(np.int32))
+                # Pallas packs ids as float values (astype), XLA as an
+                # int32 bitcast (view) — see ops/pallas_score.py.
+                idx_l.append(host[1, :n_valid].astype(np.int32)
+                             if self.use_pallas
+                             else host[1, :n_valid].view(np.int32))
         return TopKBatch.concatenate(rows_l, idx_l, vals_l, self.top_k)
 
     # -- checkpoint ------------------------------------------------------
@@ -357,7 +393,7 @@ class ShardedScorer:
                 # configured --num-items: the vocab bound the operator
                 # asked for must survive the restore) and zero-pad.
                 cap = pad_to_multiple(max(C.shape[0], self.num_items),
-                                      self.n_shards)
+                                      self._pad_unit)
                 self._build(cap)
                 grown = np.zeros((self.num_items, self.num_items), C.dtype)
                 grown[: C.shape[0], : C.shape[1]] = C

@@ -60,7 +60,7 @@ from ..state.sparse_scorer import (_SENT, SlabIndex, _apply_cells,
                                    fixed_block, ladder_bits,
                                    make_slab_index, resolve_fixed_shapes,
                                    score_buckets)
-from .mesh import ITEM_AXIS, make_mesh
+from .mesh import ITEM_AXIS, make_mesh, shard_map_maybe_relaxed
 
 
 class ShardedSparseScorer:
@@ -81,7 +81,8 @@ class ShardedSparseScorer:
                  compact_min_heap: int = 1 << 16,
                  score_ladder: Optional[int] = None,
                  defer_results: bool = False,
-                 fixed_shapes: Optional[bool] = None) -> None:
+                 fixed_shapes: Optional[bool] = None,
+                 use_pallas: str = "auto") -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -104,7 +105,8 @@ class ShardedSparseScorer:
         self.observed = 0
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
-        self._score_fns: Dict[int, object] = {}  # R -> jitted shard_map fn
+        # (R, pallas-routed) -> jitted shard_map fn
+        self._score_fns: Dict[tuple, object] = {}
         # Deferred-results mode (same design as the single-device scorers,
         # ops/device_scorer.DeferredResultsTable, here sharded): each
         # shard scatters its rows' packed top-K into a mesh-sharded
@@ -121,8 +123,8 @@ class ShardedSparseScorer:
         self.defer_results = bool(defer_results)
         self._tbl = None          # lazy [D, 2, local_cap, K] device array
         self._tbl_dirty = np.zeros(self.items_cap, dtype=bool)
-        self._score_into_fns: Dict[int, object] = {}
-        self._score_window_fns: Dict[tuple, object] = {}
+        self._score_into_fns: Dict[tuple, object] = {}  # (R, pallas-routed)
+        self._score_window_fns: Dict[tuple, object] = {}  # (plan, routed)
         self._tbl_gather_fns: Dict[int, object] = {}
         # Fixed-shape scoring (same contract and env override as the
         # single-device sparse scorer — constant per-bucket rectangles,
@@ -130,6 +132,14 @@ class ShardedSparseScorer:
         self.fixed_shapes = resolve_fixed_shapes(fixed_shapes,
                                                  self.defer_results)
         self._plan_buckets = {}  # bucket -> high-water chunk count
+        # Fused-kernel routing, same contract as the single-device sparse
+        # scorer (ops/pallas_score.resolve_sparse_pallas_flag): the
+        # Pallas rectangle kernel runs PER SHARD inside the shard_map
+        # bodies (pallas_call is an ordinary per-device op there).
+        from ..ops.pallas_score import resolve_sparse_pallas_flag
+
+        self.use_pallas = resolve_sparse_pallas_flag(use_pallas)
+        self._pallas_interpret = jax.default_backend() != "tpu"
 
         from .distributed import put_global
 
@@ -203,23 +213,40 @@ class ShardedSparseScorer:
             self._move_fns[L] = fn
         return fn
 
-    def _score_fn(self, R: int):
-        fn = self._score_fns.get(R)
-        if fn is None:
-            top_k = self.top_k
+    def _rect_pallas(self, R: int) -> bool:
+        """Whether bucket width ``R`` routes through the fused kernel
+        (ops/pallas_score.rect_routed — the shared routing rule)."""
+        from ..ops.pallas_score import rect_routed
 
+        return rect_routed(self.use_pallas, R, self.top_k, self.items_cap)
+
+    def _rect_score(self, cnt, dst, row_sums, meta, observed, R: int):
+        """One rectangle on one shard: the fused kernel when routed,
+        else the XLA body — identical packed output either way."""
+        if self._rect_pallas(R):
+            from ..ops.pallas_score import pallas_score_rect
+
+            return pallas_score_rect(cnt, dst, row_sums, meta, observed,
+                                     top_k=self.top_k, R=R,
+                                     interpret=self._pallas_interpret)
+        return _score_rect(cnt, dst, row_sums, meta, observed,
+                           self.top_k, R)
+
+    def _score_fn(self, R: int):
+        key = (R, self._rect_pallas(R))
+        fn = self._score_fns.get(key)
+        if fn is None:
             def _score(cnt_loc, dst_loc, row_sums, meta_loc, observed):
-                out = _score_rect(cnt_loc[0], dst_loc[0], row_sums,
-                                  meta_loc[0], observed, top_k, R)
+                out = self._rect_score(cnt_loc[0], dst_loc[0], row_sums,
+                                       meta_loc[0], observed, R)
                 return out[None]
 
-            fn = jax.jit(shard_map(
-                _score, mesh=self.mesh,
-                in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None), P(),
-                          P(ITEM_AXIS), P()),
-                out_specs=P(ITEM_AXIS),
-            ))
-            self._score_fns[R] = fn
+            fn = jax.jit(shard_map_maybe_relaxed(
+                _score, self.mesh,
+                (P(ITEM_AXIS, None), P(ITEM_AXIS, None), P(),
+                 P(ITEM_AXIS), P()),
+                P(ITEM_AXIS), relaxed=key[1]))
+            self._score_fns[key] = fn
         return fn
 
     @property
@@ -230,26 +257,25 @@ class ShardedSparseScorer:
     def _score_into_fn(self, R: int):
         """Scoring dispatch that scatters straight into the sharded
         deferred-results table (rows are shard-local: global // D)."""
-        fn = self._score_into_fns.get(R)
+        key = (R, self._rect_pallas(R))
+        fn = self._score_into_fns.get(key)
         if fn is None:
-            top_k = self.top_k
             D = self.n_shards
 
             def _score_into(tbl_loc, cnt_loc, dst_loc, row_sums, meta_loc,
                             observed):
-                out = _score_rect(cnt_loc[0], dst_loc[0], row_sums,
-                                  meta_loc[0], observed, top_k, R)
+                out = self._rect_score(cnt_loc[0], dst_loc[0], row_sums,
+                                       meta_loc[0], observed, R)
                 rowids, lens = meta_loc[0][0], meta_loc[0][2]
                 local = jnp.where(lens > 0, rowids // D, _SENT)
                 return tbl_loc[0].at[:, local].set(out, mode="drop")[None]
 
-            fn = jax.jit(shard_map(
-                _score_into, mesh=self.mesh,
-                in_specs=(P(ITEM_AXIS), P(ITEM_AXIS, None),
-                          P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
-                out_specs=P(ITEM_AXIS),
-            ), donate_argnums=(0,))
-            self._score_into_fns[R] = fn
+            fn = jax.jit(shard_map_maybe_relaxed(
+                _score_into, self.mesh,
+                (P(ITEM_AXIS), P(ITEM_AXIS, None),
+                 P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
+                P(ITEM_AXIS), relaxed=key[1]), donate_argnums=(0,))
+            self._score_into_fns[key] = fn
         return fn
 
     def _score_window_into_fn(self, plan: tuple):
@@ -257,28 +283,29 @@ class ShardedSparseScorer:
         dispatch runs every plan rectangle on each shard (same static
         plan on all shards — the caller pads every shard's meta to the
         common per-bucket cap)."""
-        fn = self._score_window_fns.get(plan)
+        # Routing is a pure function of R except for the vocab bound,
+        # which can flip when items_cap grows past 2^24 — key on it.
+        key = (plan, self.use_pallas and self.items_cap <= 1 << 24)
+        fn = self._score_window_fns.get(key)
         if fn is None:
-            top_k = self.top_k
             D = self.n_shards
 
             def _f(tbl_loc, cnt_loc, dst_loc, row_sums, meta_loc, observed):
                 tbl = tbl_loc[0]
                 for R, S, off in plan:
                     meta = jax.lax.slice(meta_loc[0], (0, off), (3, off + S))
-                    out = _score_rect(cnt_loc[0], dst_loc[0], row_sums,
-                                      meta, observed, top_k, R)
+                    out = self._rect_score(cnt_loc[0], dst_loc[0], row_sums,
+                                           meta, observed, R)
                     local = jnp.where(meta[2] > 0, meta[0] // D, _SENT)
                     tbl = tbl.at[:, local].set(out, mode="drop")
                 return tbl[None]
 
-            fn = jax.jit(shard_map(
-                _f, mesh=self.mesh,
-                in_specs=(P(ITEM_AXIS), P(ITEM_AXIS, None),
-                          P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
-                out_specs=P(ITEM_AXIS),
-            ), donate_argnums=(0,))
-            self._score_window_fns[plan] = fn
+            fn = jax.jit(shard_map_maybe_relaxed(
+                _f, self.mesh,
+                (P(ITEM_AXIS), P(ITEM_AXIS, None),
+                 P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
+                P(ITEM_AXIS), relaxed=key[1]), donate_argnums=(0,))
+            self._score_window_fns[key] = fn
         return fn
 
     def _tbl_gather_fn(self, rp: int):

@@ -818,32 +818,20 @@ class SparseDeviceScorer:
         # program's static plan only ever grows, so compile count stays
         # bounded even when a bucket occasionally overflows s_block).
         self._plan_buckets = {}
-        # Fused-kernel routing for wide rectangles (--pallas). auto: OFF
-        # for now — slab counts are int32, where the measured dense A/B
-        # favored XLA ~5x (TPU_ROUND2.jsonl pallas-bench, v5e); the
-        # sparse-pallas tpu_round2 row re-decides this on chip (VERDICT
-        # r3 Next #2) and this default flips if the rectangle form
-        # cliffs like dense int16 did (247x). 'on' forces the kernel for
-        # every rectangle rect_supported() can carry; narrow buckets
-        # (R < 256) stay XLA either way — they don't tile the 128-lane
-        # VPU and are cheap for XLA.
-        if use_pallas not in ("auto", "on", "off"):
-            raise ValueError(
-                f"use_pallas must be auto|on|off, got {use_pallas!r}")
-        self.use_pallas = use_pallas == "on"
+        # Fused-kernel routing for wide rectangles (--pallas): see
+        # ops/pallas_score.resolve_sparse_pallas_flag (the measured
+        # rationale lives there, once, for both sparse scorers).
+        from ..ops.pallas_score import resolve_sparse_pallas_flag
+
+        self.use_pallas = resolve_sparse_pallas_flag(use_pallas)
         self._pallas_interpret = jax.default_backend() != "tpu"
 
     def _rect_pallas(self, R: int) -> bool:
-        """Whether bucket width ``R`` routes through the fused kernel.
+        """Whether bucket width ``R`` routes through the fused kernel
+        (ops/pallas_score.rect_routed — the shared routing rule)."""
+        from ..ops.pallas_score import rect_routed
 
-        The vocab bound mirrors the kernel's own guard (partner ids ride
-        as exact float32); a vocab growing past it simply reroutes new
-        plans to XLA instead of raising mid-stream.
-        """
-        from ..ops.pallas_score import rect_supported
-
-        return (self.use_pallas and rect_supported(R, self.top_k)
-                and self.items_cap <= 1 << 24)
+        return rect_routed(self.use_pallas, R, self.top_k, self.items_cap)
 
     # Back-compat introspection used by tests.
     @property
