@@ -175,6 +175,19 @@ def sparse_pallas(quick: bool) -> dict:
             jax.block_until_ready(fn())
         return (time.monotonic() - start) / n
 
+    def parity(a, b) -> dict:
+        """On-HARDWARE parity of two packed [2, S, K] results. CPU
+        interpret mode already pins this; re-checking compiled-on-chip
+        catches Mosaic miscompiles (a known class: carried-scratch/
+        bitcast issues appear only at real grid sizes — see
+        ops/pallas_score.py)."""
+        from ..ops.pallas_score import topk_parity
+
+        a, b = np.asarray(a), np.asarray(b)
+        ok, mism = topk_parity(a[0], a[1].view(np.int32),
+                               b[0], b[1].view(np.int32))
+        return {"scores_allclose": ok, "id_mismatches": mism}
+
     by_rect = {}
     for R in (256, 1024, 4096):
         S = fixed_block(R, budget, row_cap)
@@ -191,16 +204,23 @@ def sparse_pallas(quick: bool) -> dict:
         meta[1] = starts
         meta[2] = lens
         meta_j = jnp.asarray(meta)
+        xla_out = _score_slab(cnt, dst, row_sums, meta_j, observed,
+                              top_k=top_k, R=R)
         xla_s = timeit(lambda: _score_slab(
             cnt, dst, row_sums, meta_j, observed, top_k=top_k, R=R))
         try:
+            interp = jax.default_backend() != "tpu"
+            pl_out = _score_slab_pallas(cnt, dst, row_sums, meta_j,
+                                        observed, top_k=top_k, R=R,
+                                        interpret=interp)
             pl_s = timeit(lambda: _score_slab_pallas(
                 cnt, dst, row_sums, meta_j, observed, top_k=top_k, R=R,
-                interpret=jax.default_backend() != "tpu"))
+                interpret=interp))
             by_rect[f"R{R}xS{S}"] = {
                 "xla_ms": round(xla_s * 1e3, 2),
                 "pallas_ms": round(pl_s * 1e3, 2),
                 "pallas_speedup": round(xla_s / pl_s, 3),
+                "parity": parity(xla_out, pl_out),
             }
         except Exception as exc:
             by_rect[f"R{R}xS{S}"] = {
@@ -209,6 +229,60 @@ def sparse_pallas(quick: bool) -> dict:
             }
     return {"count_dtype": "int32", "vocab": num_items,
             "by_rect": by_rect}
+
+
+@guard("sharded-pallas-1chip")
+def sharded_pallas_1chip(quick: bool) -> dict:
+    """End-to-end validation of the kernel-inside-shard_map paths on ONE
+    real chip (a 1-device mesh): both sharded backends run --pallas on
+    vs off on the same stream and the results must match. Multi-chip
+    meshes aren't reachable over the tunnel; this proves
+    compile+execute+parity of the exact shard_map+pallas programs a pod
+    would run (the CPU tests only ever exercise them interpreted)."""
+    import numpy as np
+
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharded import ShardedScorer
+    from ..parallel.sharded_sparse import ShardedSparseScorer
+    from ..sampling.reservoir import PairDeltaBatch
+
+    rng = np.random.default_rng(3)
+    n, items = (20_000, 256) if quick else (60_000, 512)
+    src = rng.integers(0, items, n).astype(np.int64)
+    dst = rng.integers(0, items, n).astype(np.int64)
+    keep = src != dst
+    pairs = PairDeltaBatch(src[keep], dst[keep],
+                           np.ones(int(keep.sum()), dtype=np.int32))
+    mesh = make_mesh(1)
+
+    def compare(mk):
+        out = {}
+        for pl in ("on", "off"):
+            sc = mk(pl)
+            sc.process_window(0, pairs)
+            batches = [sc.flush(), sc.flush()]
+            out[pl] = {int(r): (v.copy(), i.copy())
+                       for b in batches
+                       for r, i, v in zip(b.rows, b.idx, b.vals)}
+        from ..ops.pallas_score import topk_parity
+
+        rows_match = set(out["on"]) == set(out["off"])
+        common = sorted(set(out["on"]) & set(out["off"]))
+        v_on = np.stack([out["on"][r][0] for r in common])
+        i_on = np.stack([out["on"][r][1] for r in common])
+        v_off = np.stack([out["off"][r][0] for r in common])
+        i_off = np.stack([out["off"][r][1] for r in common])
+        ok, id_mism = topk_parity(v_off, i_off, v_on, i_on)
+        return {"rows": len(out["off"]), "rows_match": rows_match,
+                "scores_allclose": ok, "id_mismatches": id_mism}
+
+    return {
+        "sharded_dense_int16": compare(lambda pl: ShardedScorer(
+            items, 10, mesh=mesh, count_dtype="int16", use_pallas=pl)),
+        "sharded_sparse": compare(lambda pl: ShardedSparseScorer(
+            10, mesh=mesh, defer_results=True, fixed_shapes=True,
+            use_pallas=pl)),
+    }
 
 
 @guard("pallas-bench")
@@ -293,6 +367,7 @@ def main() -> None:
         "config4-sparse": config4_sparse,
         "ml25m-sparse": ml25m_sparse,
         "sparse-pallas": sparse_pallas,
+        "sharded-pallas-1chip": sharded_pallas_1chip,
         "ml25m-full": ml25m_full,
         "config5-sparse": config5_sparse,
         "pallas-bench": pallas_bench,

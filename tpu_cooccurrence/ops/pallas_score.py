@@ -308,6 +308,26 @@ def rect_routed(enabled: bool, R: int, top_k: int, items_cap: int) -> bool:
     return enabled and rect_supported(R, top_k) and items_cap <= 1 << 24
 
 
+def topk_parity(vals_a, idx_a, vals_b, idx_b, rtol=1e-5, atol=1e-5):
+    """THE kernel-vs-XLA parity contract, shared by tests and the on-chip
+    bench checks: scores allclose, and every UNTIED position (score
+    unique within its row under the same tolerance) carries the same id.
+    Tied positions may legitimately order differently. Vectorized —
+    safe to run inside a scarce TPU grant window.
+
+    Returns ``(scores_allclose: bool, untied_id_mismatches: int)``.
+    """
+    import numpy as np
+
+    vals_a, vals_b = np.asarray(vals_a), np.asarray(vals_b)
+    idx_a, idx_b = np.asarray(idx_a), np.asarray(idx_b)
+    scores_ok = bool(np.allclose(vals_a, vals_b, rtol=rtol, atol=atol))
+    untied = np.isclose(vals_a[:, :, None], vals_a[:, None, :],
+                        rtol=rtol, atol=atol).sum(-1) == 1
+    mism = int(((idx_a != idx_b) & np.isfinite(vals_a) & untied).sum())
+    return scores_ok, mism
+
+
 def resolve_sparse_pallas_flag(use_pallas: str) -> bool:
     """Resolve an ``auto|on|off`` --pallas request for a SPARSE scorer.
 
