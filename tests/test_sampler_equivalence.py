@@ -126,3 +126,25 @@ def test_reservoir_retention_is_uniform():
     sigma = (p * (1 - p) / n_seeds) ** 0.5
     assert freq.min() > p - 5 * sigma, (freq.min(), p)
     assert freq.max() < p + 5 * sigma, (freq.max(), p)
+
+
+def test_checkpoint_hist_zeroed_after_empty_growth():
+    """hist grows with np.empty (host-floor optimization); the
+    persistence view must still be deterministic — every cell beyond a
+    row's hist_len reads zero in checkpoint_state."""
+    import numpy as np
+
+    from tpu_cooccurrence.sampling.reservoir import UserReservoirSampler
+
+    s = UserReservoirSampler(user_cut=5, seed=3, skip_cuts=False)
+    rng = np.random.default_rng(1)
+    for _w in range(3):
+        users = rng.integers(0, 5_000, 4_000).astype(np.int64)  # > 1024 rows
+        items = rng.integers(0, 100, 4_000).astype(np.int64)
+        s.fire(users, items, np.ones(4_000, dtype=bool))
+    assert s.hist.shape[0] > 1024, "growth never happened — test is inert"
+    st = s.checkpoint_state(5_000)
+    cols = np.arange(st["hist"].shape[1])[None, :]
+    dead = cols >= st["hist_len"][:, None]
+    assert (st["hist"][dead] == 0).all(), (
+        "uninitialized heap bytes leaked into the checkpoint")

@@ -268,6 +268,12 @@ def sharded_pallas_1chip(quick: bool) -> dict:
 
         rows_match = set(out["on"]) == set(out["off"])
         common = sorted(set(out["on"]) & set(out["off"]))
+        if not common:
+            # Disjoint/empty row sets ARE the parity failure this check
+            # exists to catch — report it, don't crash on np.stack([]).
+            return {"rows": len(out["off"]), "rows_on": len(out["on"]),
+                    "rows_match": rows_match, "scores_allclose": False,
+                    "id_mismatches": -1}
         v_on = np.stack([out["on"][r][0] for r in common])
         i_on = np.stack([out["on"][r][1] for r in common])
         v_off = np.stack([out["off"][r][0] for r in common])

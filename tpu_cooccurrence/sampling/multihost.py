@@ -56,7 +56,9 @@ def scatter_part_state(part: UserReservoirSampler, p: int, P: int,
     # The vocab can be ahead of the sampler (unfired buffered windows);
     # size the part up before slicing.
     part._ensure_rows(n_local - 1)
-    hist[p::P, : part.hist.shape[1]] = part.hist[:n_local]
+    # clean_hist: zero the unspecified cells (np.empty growth) so merged
+    # checkpoints stay deterministic, like the serial sampler's.
+    hist[p::P, : part.hist.shape[1]] = part.clean_hist(n_local)
     hist_len[p::P] = part.hist_len[:n_local]
     total[p::P] = part.total[:n_local]
     draws[p::P] = part.draws[:n_local]

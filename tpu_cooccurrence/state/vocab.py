@@ -74,10 +74,17 @@ class IdMap:
         miss = dense1 == 0
         if miss.any():
             miss_ids = ids[miss]
-            # First-appearance order over the (small) new-id subset only.
-            uniq, first = np.unique(miss_ids, return_index=True)
-            order = np.argsort(first, kind="stable")
-            new_ext = uniq[order]
+            # First-appearance dedup WITHOUT sorting (np.unique sorts —
+            # measured as the mapping's dominant cost on vocab-heavy
+            # streams): scatter descending markers over the reversed
+            # array (last write wins => the first occurrence's marker
+            # survives), then keep exactly the positions whose marker
+            # reads back as their own. The temp markers only touch miss
+            # slots, every one of which is finalized just below.
+            n = len(miss_ids)
+            table[miss_ids[::-1]] = np.arange(n, 0, -1, dtype=np.int64)
+            is_first = table[miss_ids] == np.arange(1, n + 1)
+            new_ext = miss_ids[is_first]  # in first-appearance order
             base = len(self._rev)
             table[new_ext] = base + 1 + np.arange(len(new_ext),
                                                   dtype=np.int64)
