@@ -20,14 +20,23 @@ ENV = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
 
 
 def _write(path, items, ts0, n=400, seed=1, mtime_ns=None):
+    """Write under a hidden temp name, set mtime, then rename into the
+    watched directory: the CLI's monitor polls concurrently, and a file
+    observed mid-write (or before the utime backdate) would advance the
+    monitor's mtime marker past the final mtime and lose the file.
+    Hidden names (leading '.') are excluded from listing."""
     rng = np.random.default_rng(seed)
     ts = ts0 + np.cumsum(rng.integers(0, 3, n))
-    with open(path, "w") as f:
+    path = str(path)
+    tmp = os.path.join(os.path.dirname(path),
+                       "." + os.path.basename(path) + ".tmp")
+    with open(tmp, "w") as f:
         for u, i, t in zip(rng.integers(0, 30, n),
                            rng.choice(items, n), ts):
             f.write(f"{u},{i},{t}\n")
     if mtime_ns is not None:
-        os.utime(path, ns=(mtime_ns, mtime_ns))
+        os.utime(tmp, ns=(mtime_ns, mtime_ns))
+    os.rename(tmp, path)
     return int(ts[-1])
 
 

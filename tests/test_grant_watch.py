@@ -98,12 +98,45 @@ def test_watch_stage_timeout_then_grant_lost(monkeypatch, tmp_path):
     assert done[0]["sessions"] == 1
 
 
+def test_offline_stage_runs_after_grant_loss(monkeypatch, tmp_path):
+    """Stages marked needs_grant=False (the summary rewrite) still run
+    after a mid-capture grant death — the partial capture's fresh JSONL
+    rows must reach the summary artifact."""
+    flag = tmp_path / "grant-up"
+    flag.write_text("1")
+    monkeypatch.setattr(
+        grant_watch, "PROBE_CODE",
+        f"import os; print('GRANT-tpu' if os.path.exists({str(flag)!r}) "
+        f"else 'GRANT-cpu')")
+    die_cmd = [sys.executable, "-c",
+               f"import os, sys; os.remove({str(flag)!r}); sys.exit(1)"]
+    skipped = tmp_path / "skipped-chip-stage"
+    chip_cmd = [sys.executable, "-c",
+                f"open({str(skipped)!r}, 'w').close()"]
+    offline = tmp_path / "offline-ran"
+    offline_cmd = [sys.executable, "-c",
+                   f"open({str(offline)!r}, 'w').close()"]
+    log = str(tmp_path / "watch.jsonl")
+    grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
+        stages=[("die", die_cmd, 60.0),
+                ("chip", chip_cmd, 60.0),          # needs grant: skipped
+                ("offline", offline_cmd, 60.0, False)])
+    assert not skipped.exists(), "chip stage must be skipped after loss"
+    assert offline.exists(), "offline stage must run after grant loss"
+    events = [e["event"] for e in _read_log(log)]
+    assert "grant-lost" in events
+
+
 def test_default_stages_shape():
     stages = grant_watch.default_stages()
-    names = [n for n, _argv, _t in stages]
-    assert names == ["tpu_round2", "bench.py"]
-    for _n, argv, deadline in stages:
-        assert argv[0] == sys.executable
-        assert deadline > 0
+    names = [s[0] for s in stages]
+    assert names == ["tpu_round2", "bench.py", "summarize"]
+    for s in stages:
+        assert s[1][0] == sys.executable
+        assert s[2] > 0
+    # Only the offline summary rewrite survives a grant loss.
+    assert [s[3] if len(s) > 3 else True for s in stages] == [
+        True, True, False]
     quick = grant_watch.default_stages(quick=True)
     assert "--quick" in quick[0][1]

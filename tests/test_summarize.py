@@ -1,0 +1,67 @@
+"""Machine-generated on-chip summary + the guard name-shadowing fix."""
+
+import json
+
+from tpu_cooccurrence.bench import summarize, tpu_round2
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_latest_by_name_maps_historic_config4_rows():
+    rows = [
+        {"name": "zipfian-1M-items", "ok": True, "backend": "hybrid",
+         "pairs_per_sec": 32098.6},
+        {"name": "zipfian-1M-items", "ok": True, "backend": "sparse",
+         "pairs_per_sec": 71862.0},
+        {"name": "config4-sparse", "ok": False, "error": "dead"},
+        {"name": "ml25m-full", "ok": True, "seconds": 181.5},
+    ]
+    latest = summarize.latest_by_name(rows)
+    assert latest["config4-sparse"]["pairs_per_sec"] == 71862.0
+    assert latest["config4-hybrid"]["pairs_per_sec"] == 32098.6
+    assert latest["ml25m-full"]["seconds"] == 181.5
+
+
+def test_render_targets_and_regeneration(tmp_path, monkeypatch):
+    r2 = tmp_path / "rounds.jsonl"
+    hist = tmp_path / "hist.jsonl"
+    _write_jsonl(r2, [
+        {"name": "config4-sparse", "ok": True, "pairs_per_sec": 500_000,
+         "ts": "2026-08-01 00:00:00"},
+        {"name": "ml25m-sparse", "ok": True, "seconds": 42.0,
+         "ts": "2026-08-01 00:10:00"},
+        {"name": "tunnel-probe", "ok": True, "sync_ms_per_dispatch": 3.5,
+         "enqueue_ms_per_dispatch": 0.2, "upload_1024kb_ms": 9.0,
+         "ts": "2026-08-01 00:01:00"},
+    ])
+    _write_jsonl(hist, [
+        {"ts": "2026-08-01 00:20:00", "pairs_per_sec": 3_000_000,
+         "vs_baseline": 25.9, "backend": "tpu"},
+    ])
+    monkeypatch.setattr(tpu_round2, "OUT", str(r2))
+    monkeypatch.setattr(summarize, "ROUND2_PATH", str(r2))
+    monkeypatch.setattr(summarize, "HISTORY_PATH", str(hist))
+    text = summarize.render()
+    assert "25.9x host oracle" in text and text.count("**MET**") >= 3
+    assert "500,000 pairs/s" in text
+    assert "42.0 s single-chip** (**MET**)" in text
+    assert "3.5 ms" in text
+
+
+def test_guard_preserves_pass_name(tmp_path, monkeypatch):
+    out = tmp_path / "out.jsonl"
+    monkeypatch.setattr(tpu_round2, "OUT", str(out))
+
+    @tpu_round2.guard("my-pass")
+    def fake(quick):
+        return {"name": "inner-bench-result", "value": 7}
+
+    fake(False)
+    row = json.loads(out.read_text().strip())
+    assert row["name"] == "my-pass"
+    assert row["config"] == "inner-bench-result"
+    assert row["value"] == 7 and row["ok"] is True

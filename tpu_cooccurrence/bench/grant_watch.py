@@ -100,8 +100,10 @@ def probe_once(timeout_s: float = 240.0) -> bool:
     return backend is not None and backend != "cpu"
 
 
-def default_stages(quick: bool = False) -> List[Tuple[str, List[str], float]]:
-    """(name, argv, deadline_s) capture stages, scarce-first.
+def default_stages(quick: bool = False) -> List[tuple]:
+    """(name, argv, deadline_s[, needs_grant]) capture stages,
+    scarce-first. ``needs_grant=False`` stages (offline artifact
+    rewrites) still run after a mid-capture grant loss.
 
     ``tpu_round2`` internally orders: tunnel-probe (projection
     constants), config4-sparse + ml25m-sparse (the two north stars),
@@ -123,6 +125,12 @@ def default_stages(quick: bool = False) -> List[Tuple[str, List[str], float]]:
         ("tpu_round2", round2, 900.0 if quick else 5400.0),
         ("bench.py", [sys.executable, os.path.join(REPO, "bench.py")],
          bench_budget),
+        # Regenerate the machine-written summary so a capture session
+        # leaves current-truth numbers in one readable artifact — even a
+        # PARTIAL session (tpu_round2 appends per measurement, so a
+        # grant dying mid-pass still left fresh rows to summarize).
+        ("summarize", [sys.executable, "-m",
+                       "tpu_cooccurrence.bench.summarize"], 120.0, False),
     ]
 
 
@@ -195,17 +203,22 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
         if granted:
             log_event({"event": "grant", "cycle": cycle}, log_path)
             all_ok = True
-            for name, argv, deadline in (stages if stages is not None
-                                         else default_stages(quick)):
+            lost = False
+            for stage in (stages if stages is not None
+                          else default_stages(quick)):
+                name, argv, deadline = stage[:3]
+                needs_grant = stage[3] if len(stage) > 3 else True
+                if lost and needs_grant:
+                    continue  # don't burn chip stages on a dead tunnel
                 ok = run_stage(name, argv, deadline, log_path)
-                if not ok:
-                    # Stage failed or timed out — re-probe before burning
-                    # the remaining stages on a dead tunnel.
-                    if not probe_once(probe_timeout_s):
-                        log_event({"event": "grant-lost", "cycle": cycle},
-                                  log_path)
-                        all_ok = False
-                        break
+                if not ok and needs_grant and not probe_once(
+                        probe_timeout_s):
+                    # Stage failed AND the tunnel is gone: skip the
+                    # remaining chip stages; offline stages (e.g. the
+                    # summary rewrite) still run on the partial capture.
+                    log_event({"event": "grant-lost", "cycle": cycle},
+                              log_path)
+                    lost = True
                 all_ok = all_ok and ok
             sessions += 1
             if all_ok:

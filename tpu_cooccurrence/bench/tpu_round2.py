@@ -45,7 +45,13 @@ def guard(name: str):
         def run(*a, **k):
             start = time.monotonic()
             try:
-                res = fn(*a, **k)
+                res = dict(fn(*a, **k))
+                # The measurement NAME is the pass's identity; an inner
+                # BenchResult's own "name" must not shadow it (it did
+                # through round 3 — config4 rows landed as
+                # "zipfian-1M-items"; summarize.py accepts both).
+                if "name" in res:
+                    res["config"] = res.pop("name")
                 emit({"name": name, "ok": True,
                       "wall_s": round(time.monotonic() - start, 1), **res})
             except Exception as exc:  # record and continue the pass
