@@ -72,3 +72,27 @@ def test_restore_large_ids_lands_in_sorted_mode():
     assert m._table is None
     np.testing.assert_array_equal(
         m.map_batch(np.array([3, IdMap._TABLE_CAP + 9])), [1, 0])
+
+
+def test_table_dedup_matches_sorted_first_appearance():
+    """The sort-free reversed-scatter dedup in _map_table must assign
+    dense ids in exact first-appearance order — differentially checked
+    against a naive scan over many random duplicate-heavy batches."""
+    import numpy as np
+
+    from tpu_cooccurrence.state.vocab import IdMap
+
+    rng = np.random.default_rng(0xDED)
+    for _trial in range(30):
+        v = IdMap()
+        naive = {}
+        for _batch in range(rng.integers(1, 5)):
+            ids = rng.integers(0, 200, rng.integers(1, 400))
+            got = v.map_batch(ids)
+            for ext in ids.tolist():
+                naive.setdefault(ext, len(naive))
+            expect = np.asarray([naive[e] for e in ids.tolist()])
+            np.testing.assert_array_equal(got, expect)
+        # Reverse mapping agrees.
+        for ext, dense in naive.items():
+            assert v.to_external(dense) == ext
