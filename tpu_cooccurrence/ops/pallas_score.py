@@ -284,8 +284,15 @@ def _rect_topk_kernel(k11_ref, dsf_ref, rsj_ref, rsi_ref, obs_ref,
 
 
 def rect_tile(R: int) -> int:
-    """Column-tile width for a rectangle of width ``R`` (lane-aligned)."""
-    return min(512, R)
+    """Column-tile width for a rectangle of width ``R`` (lane-aligned).
+
+    Wide tiles amortize the sequential top-K merge: the on-chip dense
+    sweep measured 2048 → 179 ms vs 512 → 300 ms at [8192, 61440] int16
+    (TPU_ROUND2.jsonl pallas-bench), and the int32 rectangle blocks are
+    8 sublanes, so a [8, 2048] i32 tile is ~64 KB — far under VMEM. The
+    sparse-pallas bench row re-times each rectangle width on chip.
+    """
+    return min(2048, R)
 
 
 def rect_supported(R: int, top_k: int) -> bool:
