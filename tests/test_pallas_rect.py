@@ -252,3 +252,35 @@ def test_sparse_pallas_auto_defaults_off_on_cpu():
     sc = SparseDeviceScorer(10, use_pallas="auto")
     assert sc.use_pallas is False
     assert not sc._rect_pallas(1024)
+
+
+def test_sharded_dense_pallas_checkpoint_cross_padding(tmp_path):
+    """A checkpoint written WITHOUT pallas (vocab padded to n_shards
+    only) restores into a pallas-enabled scorer (vocab padded to a
+    kernel-tile multiple) and vice versa — both directions continue to
+    identical results."""
+    from tpu_cooccurrence.parallel.sharded import ShardedScorer
+
+    class SmallTile(ShardedScorer):
+        PALLAS_TILE = 128
+
+    pairs1 = _dense_stream(seed=21, n=8_000, items=250)
+    pairs2 = _dense_stream(seed=22, n=8_000, items=250)
+
+    def run(pl_first, pl_second):
+        a = SmallTile(250, 10, num_shards=8, count_dtype="int16",
+                      use_pallas=pl_first)
+        a.process_window(0, pairs1)
+        a.flush()
+        st = a.checkpoint_state()
+        b = SmallTile(250, 10, num_shards=8, count_dtype="int16",
+                      use_pallas=pl_second)
+        b.restore_state(st)
+        b.process_window(10, pairs2)
+        batch = b.flush()
+        return {int(r): (v.copy(), i.copy())
+                for r, i, v in zip(batch.rows, batch.idx, batch.vals)}
+
+    ref = run("off", "off")
+    for combo in (("off", "on"), ("on", "off"), ("on", "on")):
+        _assert_topk_match(run(*combo), ref)
