@@ -306,3 +306,29 @@ def test_device_deferred_auto_capacity_growth():
     b.finish()
     assert b.scorer.num_items > 1024  # growth actually happened
     assert_latest_close(a.latest, b.latest)
+
+
+def test_vocab_smaller_than_top_k():
+    """A vocabulary smaller than K must not crash the dense backends
+    (lax.top_k rejects k > axis size; the reference's heap simply holds
+    fewer entries). Found by the extended randomized sweep."""
+    rng = np.random.default_rng(0x26)
+    n = 600
+    users = rng.integers(0, 20, n).astype(np.int64)
+    items = rng.integers(0, 5, n).astype(np.int64)
+    ts = np.cumsum(rng.integers(0, 3, n)).astype(np.int64)
+    kw = dict(window_size=20, seed=9, item_cut=8, user_cut=4, top_k=10)
+    oracle = run_production(Config(backend=Backend.ORACLE,
+                                   development_mode=True, **kw),
+                            users, items, ts)
+    ref = {i: oracle.latest[i] for i in oracle.latest}
+    for backend, extra in (("device", {"num_items": 5}),
+                           ("sharded", {"num_items": 5, "num_shards": 4}),
+                           ("sparse", {})):
+        job = run_production(Config(backend=Backend(backend),
+                                    development_mode=True,
+                                    **dict(kw, **extra)),
+                             users, items, ts)
+        assert job.counters.as_dict() == oracle.counters.as_dict(), backend
+        assert_latest_close(ref, {i: job.latest[i] for i in job.latest},
+                            rtol=2e-4, atol=2e-4)

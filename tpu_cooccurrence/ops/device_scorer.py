@@ -194,6 +194,22 @@ def _grow_dense(C, row_sums, n: int):
     return newC, new_rs
 
 
+def topk_padded(scores, top_k: int):
+    """``lax.top_k`` tolerating vocabularies SMALLER than K: the missing
+    lanes pad with (-inf, 0), which every consumer already filters (the
+    reference's heap simply holds fewer entries in this regime)."""
+    k_eff = min(top_k, scores.shape[-1])
+    vals, idx = jax.lax.top_k(scores, k_eff)
+    if k_eff < top_k:
+        pad = top_k - k_eff
+        vals = jnp.concatenate(
+            [vals, jnp.full(vals.shape[:-1] + (pad,), -jnp.inf,
+                            vals.dtype)], axis=-1)
+        idx = jnp.concatenate(
+            [idx, jnp.zeros(idx.shape[:-1] + (pad,), idx.dtype)], axis=-1)
+    return vals, idx
+
+
 @functools.partial(jax.jit, static_argnames=("top_k", "packed"))
 def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
     counts = C[rows]  # [S, I] int32
@@ -206,7 +222,7 @@ def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
     k22 = observed + k11 - k12 - k21
     scores = llr_stable(k11, k12, k21, k22)
     scores = jnp.where(counts != 0, scores, -jnp.inf)
-    vals, idx = jax.lax.top_k(scores, top_k)
+    vals, idx = topk_padded(scores, top_k)
     if packed:
         # One fused [2, S, K] float32 result => a single device->host fetch.
         return jnp.stack([vals, jax.lax.bitcast_convert_type(idx, jnp.float32)])

@@ -45,7 +45,7 @@ from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              narrow_deltas_int32)
 from ..ops.llr import llr_stable
 from ..ops.device_scorer import (pad_pow2, resolve_pallas_flag,
-                                 score_row_budget)
+                                 score_row_budget, topk_padded)
 from ..sampling.reservoir import PairDeltaBatch
 from .mesh import (ITEM_AXIS, make_mesh, pad_to_multiple,
                    shard_map_maybe_relaxed)
@@ -169,7 +169,8 @@ class ShardedScorer:
             k22 = observed + k11 - k12 - k21
             scores = llr_stable(k11, k12, k21, k22)
             scores = jnp.where(counts != 0, scores, -jnp.inf)
-            vals, idx = jax.lax.top_k(scores, top_k)
+            # topk_padded: a vocab smaller than K pads with -inf/0.
+            vals, idx = topk_padded(scores, top_k)
             # Pack per shard into [1, 2, S, K] f32 => one fetchable buffer.
             return jnp.stack(
                 [vals, jax.lax.bitcast_convert_type(idx, jnp.float32)])[None]
