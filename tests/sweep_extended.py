@@ -1,0 +1,91 @@
+"""Out-of-suite extended randomized sweep (run manually after major
+changes — docs/ARCHITECTURE.md testing strategy):
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tests/sweep_extended.py [--trials 30] [--seed-base 0xA11CE]
+
+Samples the config space (tumbling/sliding, cuts on/off, random top-k
+including > vocab, random streams) and checks a wide backend-variant
+matrix against the float64 oracle through the in-suite protocol
+(identical counters; scores to tolerance; gap-gated exact ids). Round 4
+provenance: seed family 0xA11CE caught the vocab-smaller-than-top-K
+dense crash (fixed + pinned in tests/test_pipeline.py); families
+0xA11CE and 0xB0B then ran 240 runs clean.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trials", type=int, default=30)
+    ap.add_argument("--seed-base", type=lambda s: int(s, 0),
+                    default=0xA11CE)
+    args = ap.parse_args()
+
+    from tpu_cooccurrence.config import Backend, Config
+    from test_pipeline import assert_latest_close, run_production
+
+    fails = 0
+    for trial in range(args.trials):
+        rng = np.random.default_rng(args.seed_base + trial)
+        n = int(rng.integers(200, 2500))
+        n_users = int(rng.integers(2, 50))
+        n_items = int(rng.integers(4, 200))
+        users = rng.integers(0, n_users, n).astype(np.int64)
+        items = rng.integers(0, n_items, n).astype(np.int64)
+        ts = np.cumsum(rng.integers(0, 4, n)).astype(np.int64)
+        kw = dict(window_size=int(rng.integers(3, 60)),
+                  seed=int(rng.integers(0, 2**31)),
+                  item_cut=int(rng.integers(1, 12)),
+                  user_cut=int(rng.integers(1, 8)),
+                  top_k=int(rng.integers(1, 14)),
+                  skip_cuts=bool(rng.integers(0, 2)))
+        slide = None
+        if trial % 4 == 0:
+            base = int(rng.integers(2, 10))
+            kw["window_size"] = base * int(rng.integers(2, 5))
+            slide = base
+        oracle = run_production(
+            Config(backend=Backend.ORACLE, window_slide=slide,
+                   development_mode=True, **kw), users, items, ts)
+        ref = {i: oracle.latest[i] for i in oracle.latest}
+        variants = [
+            ("device", {"num_items": n_items}),
+            ("device", {"num_items": n_items, "count_dtype": "int16"}),
+            ("sparse", {}),
+            ("sparse", {"num_shards": 8}),
+            ("sparse", {"pallas": "on"}),
+            ("sharded", {"num_items": n_items, "num_shards": 8}),
+            ("sharded", {"num_shards": 4}),  # derive-from-data
+        ]
+        for backend, extra in variants:
+            cfg = Config(backend=Backend(backend), window_slide=slide,
+                         development_mode=True, **dict(kw, **extra))
+            try:
+                job = run_production(cfg, users, items, ts)
+                assert job.counters.as_dict() == oracle.counters.as_dict()
+                assert_latest_close(
+                    ref, {i: job.latest[i] for i in job.latest},
+                    rtol=2e-4, atol=2e-4)
+            except Exception as exc:  # record all, fail at end
+                fails += 1
+                print(f"TRIAL {trial} {backend} {extra}: {exc!r}"[:300],
+                      flush=True)
+        if trial % 10 == 9:
+            print(f"trial {trial + 1}/{args.trials} done", flush=True)
+    print("FAILURES:", fails)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
