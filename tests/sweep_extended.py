@@ -25,11 +25,64 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np  # noqa: E402
 
 
+def _checkpoint_trial(trial, rng, kw, slide, users, items, ts,
+                      assert_latest_close, Backend, Config):
+    """Randomized mid-stream checkpoint/restore equivalence: restore at
+    a random split point and finish — results must match an
+    uninterrupted run for every backend."""
+    import tempfile
+
+    import numpy as np
+
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    split = int(len(users) * float(rng.uniform(0.3, 0.7)))
+    fails = 0
+    for backend, extra in (("oracle", {}), ("sparse", {}),
+                           ("device", {}),
+                           ("sparse", {"num_shards": 4})):
+        with tempfile.TemporaryDirectory() as ck:
+            cfg = Config(backend=Backend(backend), window_slide=slide,
+                         development_mode=True, checkpoint_dir=ck,
+                         **dict(kw, **extra))
+            try:
+                ref = CooccurrenceJob(Config(
+                    backend=Backend(backend), window_slide=slide,
+                    development_mode=True, **dict(kw, **extra)))
+                ref.add_batch(users, items, ts)
+                ref.finish()
+                a = CooccurrenceJob(cfg)
+                a.add_batch(users[:split], items[:split], ts[:split])
+                a.checkpoint()
+                b = CooccurrenceJob(cfg)
+                b.restore()
+                b.add_batch(users[split:], items[split:], ts[split:])
+                b.finish()
+                assert (ref.counters.as_dict() == b.counters.as_dict()
+                        ), "counters diverge"
+                r = {i: ref.latest[i] for i in ref.latest}
+                g = {i: b.latest[i] for i in b.latest}
+                assert set(r) == set(g), "item sets diverge"
+                for item in r:
+                    np.testing.assert_allclose(
+                        np.array([v for _, v in g[item]]),
+                        np.array([v for _, v in r[item]]),
+                        rtol=1e-6, atol=1e-6)
+            except Exception as exc:
+                fails += 1
+                print(f"CKPT TRIAL {trial} {backend} {extra} "
+                      f"split={split}: {exc!r}"[:300], flush=True)
+    return fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trials", type=int, default=30)
     ap.add_argument("--seed-base", type=lambda s: int(s, 0),
                     default=0xA11CE)
+    ap.add_argument("--checkpoint", action="store_true",
+                    help="mid-stream checkpoint/restore equivalence "
+                         "instead of the backend matrix")
     args = ap.parse_args()
 
     from tpu_cooccurrence.config import Backend, Config
@@ -55,6 +108,13 @@ def main() -> int:
             base = int(rng.integers(2, 10))
             kw["window_size"] = base * int(rng.integers(2, 5))
             slide = base
+        if args.checkpoint:
+            fails += _checkpoint_trial(trial, rng, kw, slide, users,
+                                       items, ts, assert_latest_close,
+                                       Backend, Config)
+            if trial % 10 == 9:
+                print(f"trial {trial + 1}/{args.trials} done", flush=True)
+            continue
         oracle = run_production(
             Config(backend=Backend.ORACLE, window_slide=slide,
                    development_mode=True, **kw), users, items, ts)
