@@ -73,3 +73,18 @@ def test_projection_carries_error_bars(tmp_path, monkeypatch):
     np.testing.assert_allclose(low, round(host + dev / 8, 2), atol=0.011)
     np.testing.assert_allclose(
         high, round(host + dev / 8 + w * 8.0e-3, 2), atol=0.011)
+
+
+def test_sparse_host_floor_mocked_mode(monkeypatch):
+    """--host-only --backend sparse runs the REAL sparse scorer with
+    device dispatches stubbed (reproducible sparse host floor), and the
+    patches are restored afterwards."""
+    import tpu_cooccurrence.state.sparse_scorer as ss
+
+    monkeypatch.delenv("MOVIELENS_25M", raising=False)  # stand-in stream
+    orig = ss._apply_update
+    out = ml25m.run_full(20_000, host_only=True,
+                         backend=ml25m.Backend.SPARSE)
+    assert out["backend"] == "sparse-device-mocked"
+    assert out["windows"] > 0 and out["pairs"] > 0
+    assert ss._apply_update is orig, "device stubs leaked"
