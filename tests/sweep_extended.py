@@ -75,6 +75,84 @@ def _checkpoint_trial(trial, rng, kw, slide, users, items, ts,
     return fails
 
 
+def _multihost_trial(trial, rng, kw, slide, users, items, ts, tmpdir):
+    """One randomized 2-process multi-controller run vs the in-process
+    8-shard reference: merged disjoint row partitions must reproduce
+    the single-process results exactly."""
+    import json
+    import socket
+    import subprocess
+
+    import numpy as np
+
+    from tpu_cooccurrence.config import Backend, Config
+    from test_pipeline import run_production
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "multihost_worker.py")
+    backend = ["sharded", "sparse"][trial % 2]
+    partition = bool(rng.integers(0, 2))
+    n_items_cap = int(items.max()) + 1
+    stream = os.path.join(tmpdir, f"s{trial}.npz")
+    np.savez(stream, users=users, items=items, ts=ts)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs, outs = [], []
+    for pid in range(2):
+        spec = dict(kw, stream=stream, coordinator=coordinator,
+                    num_processes=2, process_id=pid, phase="full",
+                    backend=backend, num_shards=8, num_items=n_items_cap,
+                    partition_sampling=partition, window_slide=slide)
+        spec_p = os.path.join(tmpdir, f"spec{trial}-{pid}.json")
+        out_p = os.path.join(tmpdir, f"out{trial}-{pid}.json")
+        with open(spec_p, "w") as f:
+            json.dump(spec, f)
+        outs.append(out_p)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, spec_p, out_p], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = []
+    for p, out_p in zip(procs, outs):
+        stdout, stderr = p.communicate(timeout=300)
+        if p.returncode != 0:
+            print(f"MH TRIAL {trial} {backend} ps={partition}: worker "
+                  f"rc={p.returncode}: {stderr[-300:]}", flush=True)
+            return 1
+        with open(out_p) as f:
+            results.append(json.load(f))
+    merged = {}
+    for res in results:
+        for item, top in res["latest"].items():
+            if int(item) in merged:
+                print(f"MH TRIAL {trial}: row {item} from two processes",
+                      flush=True)
+                return 1
+            merged[int(item)] = [(int(j), s) for j, s in top]
+    ref = run_production(
+        Config(**kw, backend=Backend(backend), num_shards=8,
+               num_items=n_items_cap, window_slide=slide),
+        users, items, ts)
+    ok = set(merged) == set(ref.latest)
+    if ok:
+        for item in merged:
+            a = np.array([v for _, v in merged[item]])
+            b = np.array([v for _, v in ref.latest[item]])
+            if len(a) != len(b) or not np.allclose(a, b, rtol=1e-6,
+                                                   atol=1e-6):
+                ok = False
+                break
+    if not ok:
+        print(f"MH TRIAL {trial} {backend} ps={partition}: results "
+              f"diverge from single-process reference", flush=True)
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trials", type=int, default=30)
@@ -83,6 +161,9 @@ def main() -> int:
     ap.add_argument("--checkpoint", action="store_true",
                     help="mid-stream checkpoint/restore equivalence "
                          "instead of the backend matrix")
+    ap.add_argument("--multihost", action="store_true",
+                    help="randomized 2-process multi-controller runs vs "
+                         "the in-process reference")
     args = ap.parse_args()
 
     from tpu_cooccurrence.config import Backend, Config
@@ -113,6 +194,19 @@ def main() -> int:
                                        items, ts, assert_latest_close,
                                        Backend, Config)
             if trial % 10 == 9:
+                print(f"trial {trial + 1}/{args.trials} done", flush=True)
+            continue
+        if args.multihost:
+            import tempfile
+
+            # The worker spec carries neither of these; drop them from
+            # the reference config too so both sides run identically.
+            kw.pop("skip_cuts", None)
+            kw.pop("top_k", None)
+            with tempfile.TemporaryDirectory() as td:
+                fails += _multihost_trial(trial, rng, kw, slide,
+                                          users, items, ts, td)
+            if trial % 5 == 4:
                 print(f"trial {trial + 1}/{args.trials} done", flush=True)
             continue
         oracle = run_production(
