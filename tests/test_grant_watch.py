@@ -85,9 +85,12 @@ def test_watch_stage_timeout_then_grant_lost(monkeypatch, tmp_path):
     after_cmd = [sys.executable, "-c",
                  f"open({str(never)!r}, 'w').close()"]
     log = str(tmp_path / "watch.jsonl")
+    # The hang stage's deadline must comfortably exceed interpreter
+    # startup (measured >2.5 s under load) so os.remove runs before the
+    # SIGKILL — the hang comes from the sleep, not slow startup.
     captures = grant_watch.watch(
         interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
-        stages=[("hang", hang_cmd, 2.0), ("after", after_cmd, 60.0)])
+        stages=[("hang", hang_cmd, 15.0), ("after", after_cmd, 60.0)])
     assert captures == 0  # incomplete sessions don't count as captures
     assert not never.exists(), "stages after grant-loss must be skipped"
     events = [e["event"] for e in _read_log(log)]
@@ -140,3 +143,40 @@ def test_default_stages_shape():
         True, True, False]
     quick = grant_watch.default_stages(quick=True)
     assert "--quick" in quick[0][1]
+
+
+def test_status_summarizes_log(tmp_path):
+    log = tmp_path / "w.jsonl"
+    rows = [
+        {"ts": "t0", "event": "watch-start"},
+        {"ts": "t1", "event": "no-grant", "cycle": 1},
+        {"ts": "t2", "event": "grant", "cycle": 5},
+        {"ts": "t3", "event": "capture-done", "complete": False,
+         "cycle": 5},
+        {"ts": "t4", "event": "grant", "cycle": 9},
+        {"ts": "t5", "event": "capture-done", "complete": True,
+         "cycle": 9},
+        {"ts": "t6", "event": "no-grant", "cycle": 13},
+    ]
+    with open(log, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    s = grant_watch.status(str(log))
+    assert s["first_ts"] == "t0" and s["last_ts"] == "t6"
+    assert s["cycles_probed"] == 13
+    assert s["grants"] == 2
+    assert s["captures_complete"] == 1
+    assert s["last_capture_ts"] == "t5"
+    missing = grant_watch.status(str(tmp_path / "none.jsonl"))
+    assert missing["exists"] is False
+    # Cycles accumulate across restarted watch runs: a clean first run
+    # of 12 (from its watch-end total — heartbeats undercount) plus an
+    # in-progress second run at cycle 3.
+    with open(log, "w") as f:
+        for r in ({"ts": "a", "event": "watch-start"},
+                  {"ts": "b", "event": "no-grant", "cycle": 1},
+                  {"ts": "c", "event": "watch-end", "cycles": 12},
+                  {"ts": "d", "event": "watch-start"},
+                  {"ts": "e", "event": "no-grant", "cycle": 3}):
+            f.write(json.dumps(r) + "\n")
+    assert grant_watch.status(str(log))["cycles_probed"] == 15

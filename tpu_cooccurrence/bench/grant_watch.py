@@ -245,6 +245,50 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
     return captures
 
 
+def status(log_path: str = LOG_PATH) -> dict:
+    """Summarize a watch log: probe cycles, grants, capture sessions."""
+    out = {"log": log_path, "exists": os.path.exists(log_path),
+           "first_ts": None, "last_ts": None, "last_event": None,
+           "cycles_probed": 0, "grants": 0, "captures_complete": 0,
+           "last_capture_ts": None}
+    if not out["exists"]:
+        return out
+    # Cycles accumulate ACROSS watch runs (each run restarts at cycle 1):
+    # a run's count is its watch-end total when present (dead-tunnel
+    # cycles are heartbeat-sampled, so per-event maxima undercount), else
+    # the largest cycle any of its events carried.
+    total_cycles = 0
+    run_max = 0
+    with open(log_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            out["first_ts"] = out["first_ts"] or e.get("ts")
+            out["last_ts"] = e.get("ts")
+            out["last_event"] = e.get("event")
+            ev = e.get("event")
+            if ev == "watch-start":
+                total_cycles += run_max
+                run_max = 0
+            elif ev == "watch-end":
+                run_max = max(run_max, e.get("cycles", 0))
+            elif "cycle" in e:
+                run_max = max(run_max, e.get("cycle", 0))
+            if ev == "grant":
+                out["grants"] += 1
+            if ev == "capture-done":
+                if e.get("complete"):
+                    out["captures_complete"] += 1
+                out["last_capture_ts"] = e.get("ts")
+    out["cycles_probed"] = total_cycles + run_max
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interval", type=float, default=300.0,
@@ -260,7 +304,12 @@ def main() -> None:
                     help="single probe cycle (= --max-cycles 1)")
     ap.add_argument("--quick", action="store_true",
                     help="run tpu_round2 --quick (tunnel sanity shapes)")
+    ap.add_argument("--status", action="store_true",
+                    help="summarize GRANT_WATCH.jsonl and exit (no probe)")
     args = ap.parse_args()
+    if args.status:
+        print(json.dumps(status()))
+        return
     watch(interval_s=args.interval, probe_timeout_s=args.probe_timeout,
           max_cycles=1 if args.once else args.max_cycles,
           max_captures=args.max_captures, quick=args.quick)
