@@ -13,6 +13,16 @@ use, so doing this in conftest (before any test touches jax) is safe.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# JAX_PLATFORMS=cpu alone is NOT enough to keep jax off the network:
+# the sitecustomize-registered accelerator plugin still contacts its
+# pool at import, and a half-dead tunnel (TCP accepts, never answers)
+# then hangs the interpreter indefinitely — reproduced 2026-07-31,
+# where a supervised soak-test child inherited JAX_PLATFORMS=cpu but
+# not this guard and hung the whole suite for an hour. Clearing the
+# pool address list here makes every test AND every subprocess a test
+# spawns (supervisor children, multihost workers, CLI runs) immune to
+# tunnel state.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

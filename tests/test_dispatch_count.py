@@ -21,9 +21,10 @@ from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
 class DispatchCounter:
     """Counting shims around the sparse scorer's jitted entry points."""
 
-    TRACKED = ("_apply_update", "_apply_moves_update", "_score_slab",
-               "_score_into_table", "_score_window_into_table", "_grow",
-               "_compact_gather")
+    TRACKED = ("_apply_update", "_apply_moves_update",
+               "_apply_update_chunked", "_apply_moves_update_chunked",
+               "_score_slab", "_score_into_table",
+               "_score_window_into_table", "_grow", "_compact_gather")
 
     def __init__(self, monkeypatch):
         self.counts = {name: 0 for name in self.TRACKED}
@@ -45,7 +46,10 @@ class DispatchCounter:
 
     @property
     def updates(self):
-        return self.counts["_apply_update"] + self.counts["_apply_moves_update"]
+        return (self.counts["_apply_update"]
+                + self.counts["_apply_moves_update"]
+                + self.counts["_apply_update_chunked"]
+                + self.counts["_apply_moves_update_chunked"])
 
     @property
     def window_scores(self):

@@ -101,6 +101,47 @@ def test_watch_stage_timeout_then_grant_lost(monkeypatch, tmp_path):
     assert done[0]["sessions"] == 1
 
 
+def test_failed_measurement_with_live_grant_still_completes(
+        monkeypatch, tmp_path):
+    """A stage that exits nonzero while the grant survives (tpu_round2
+    recording a failed measurement) is logged but does not void the
+    session — one deterministically-failing measurement must not make a
+    --max-captures watcher re-burn every future grant re-running the
+    whole list. Timeouts/grant loss still do (previous tests)."""
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    fail_cmd = [sys.executable, "-c", "import sys; sys.exit(1)"]
+    after = tmp_path / "after-ran"
+    after_cmd = [sys.executable, "-c",
+                 f"open({str(after)!r}, 'w').close()"]
+    log = str(tmp_path / "watch.jsonl")
+    captures = grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_captures=1, log_path=log,
+        stages=[("tpu_round2:bad-measurement", fail_cmd, 60.0),
+                ("next", after_cmd, 60.0)])
+    assert captures == 1
+    assert after.exists(), "later stages must still run (grant is up)"
+    done = [e for e in _read_log(log) if e["event"] == "capture-done"]
+    assert done[0]["complete"] is True
+    assert done[0]["failed_stages"] == ["tpu_round2:bad-measurement"]
+    assert "grant-lost" not in [e["event"] for e in _read_log(log)]
+
+
+def test_failed_artifact_stage_voids_completeness(monkeypatch, tmp_path):
+    """A failed NON-measurement stage (bench.py, summarize) means the
+    session's deliverable is missing: complete must be False even with
+    the grant up, so --max-captures keeps watching for a usable one."""
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    fail_cmd = [sys.executable, "-c", "import sys; sys.exit(1)"]
+    log = str(tmp_path / "watch.jsonl")
+    captures = grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
+        stages=[("bench.py", fail_cmd, 60.0)])
+    assert captures == 0
+    done = [e for e in _read_log(log) if e["event"] == "capture-done"]
+    assert done[0]["complete"] is False
+    assert done[0]["failed_stages"] == ["bench.py"]
+
+
 def test_offline_stage_runs_after_grant_loss(monkeypatch, tmp_path):
     """Stages marked needs_grant=False (the summary rewrite) still run
     after a mid-capture grant death — the partial capture's fresh JSONL
@@ -134,15 +175,32 @@ def test_offline_stage_runs_after_grant_loss(monkeypatch, tmp_path):
 def test_default_stages_shape():
     stages = grant_watch.default_stages()
     names = [s[0] for s in stages]
-    assert names == ["tpu_round2", "bench.py", "summarize"]
+    # Per-measurement stages (own deadline each: a hanging measurement
+    # costs one deadline, not the rest of a monolithic pass), headline
+    # numbers first, then the official bench artifact and the offline
+    # summary rewrite.
+    assert names[0] == "tpu_round2:tunnel-probe"
+    assert names[1] == "tpu_round2:config4-headline"
+    assert "tpu_round2:ml25m-sparse" in names
+    assert "tpu_round2:ml25m-full" in names
+    assert "tpu_round2:sparse-pallas" in names
+    assert names[-2:] == ["bench.py", "summarize"]
     for s in stages:
         assert s[1][0] == sys.executable
         assert s[2] > 0
+    for s in stages:
+        if s[0].startswith("tpu_round2:"):
+            only = s[1][s[1].index("--only") + 1]
+            assert s[0] == f"tpu_round2:{only}"
     # Only the offline summary rewrite survives a grant loss.
-    assert [s[3] if len(s) > 3 else True for s in stages] == [
-        True, True, False]
+    assert [s[3] if len(s) > 3 else True for s in stages] == (
+        [True] * (len(stages) - 1) + [False])
     quick = grant_watch.default_stages(quick=True)
-    assert "--quick" in quick[0][1]
+    assert all("--quick" in s[1] for s in quick
+               if s[0].startswith("tpu_round2:"))
+    # Quick deadlines are tighter than full ones, stage by stage.
+    for full_s, quick_s in zip(stages, quick):
+        assert quick_s[2] <= full_s[2]
 
 
 def test_status_summarizes_log(tmp_path):

@@ -52,6 +52,38 @@ def test_render_targets_and_regeneration(tmp_path, monkeypatch):
     assert "3.5 ms" in text
 
 
+def test_render_config4_headline_and_upload_ab(tmp_path, monkeypatch):
+    """A short grant landing only the headline rows still reaches the
+    summary; the upload A/B renders a verdict only on comparable rows
+    (same event count) and flags mixed provenance instead."""
+    r2 = tmp_path / "rounds.jsonl"
+    rows = [
+        {"name": "config4-headline", "ok": True, "pairs_per_sec": 480_000,
+         "events": 1_000_000, "mode": "L16/fixed",
+         "ts": "2026-08-01 00:00:00"},
+        {"name": "config4-chunked", "ok": True, "pairs_per_sec": 700_000,
+         "events": 1_000_000, "mode": "L16/fixed/chunks4",
+         "ts": "2026-08-01 00:05:00"},
+    ]
+    _write_jsonl(r2, rows)
+    monkeypatch.setattr(tpu_round2, "OUT", str(r2))
+    monkeypatch.setattr(summarize, "ROUND2_PATH", str(r2))
+    monkeypatch.setattr(summarize, "HISTORY_PATH",
+                        str(tmp_path / "none.jsonl"))
+    text = summarize.render()
+    assert "700,000 pairs/s** (config4-chunked" in text
+    assert "**MET**" in text            # 700k >= 458k target
+    assert "chunked upload WINS" in text
+    # Mixed provenance: a --quick chunked row must not decide the flip.
+    rows[1] = dict(rows[1], events=200_000)
+    _write_jsonl(r2, rows)
+    text = summarize.render()
+    assert "INCOMPARABLE" in text
+    assert "WINS" not in text
+    # Full-size rows outrank a faster quick row for the target line.
+    assert "480,000 pairs/s** (config4-headline" in text
+
+
 def test_guard_preserves_pass_name(tmp_path, monkeypatch):
     out = tmp_path / "out.jsonl"
     monkeypatch.setattr(tpu_round2, "OUT", str(out))

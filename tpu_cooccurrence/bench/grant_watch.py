@@ -105,16 +105,27 @@ def default_stages(quick: bool = False) -> List[tuple]:
     scarce-first. ``needs_grant=False`` stages (offline artifact
     rewrites) still run after a mid-capture grant loss.
 
-    ``tpu_round2`` internally orders: tunnel-probe (projection
-    constants), config4-sparse + ml25m-sparse (the two north stars),
-    then the long tails — so even if its deadline cuts the pass short,
-    the JSONL already holds the headline numbers. ``bench.py`` is the
-    driver's official artifact; it appends to ``bench_history.jsonl``
-    on-chip so a later cpu-fallback round can cite the capture.
+    Each ``tpu_round2`` measurement is its own stage (``--only NAME``)
+    with its own deadline: the 2026-07-31 grant session showed that a
+    measurement that HANGS on a mid-capture grant death (rather than
+    raising) burns the whole remaining stage budget — per-measurement
+    stages cap that at one measurement's deadline, and the watch
+    loop's re-probe between failed stages skips the rest of the chip
+    work the moment the tunnel is actually gone. Headline-first order:
+    one number per north star before anything long. ``bench.py`` is
+    the driver's official artifact; it appends to
+    ``bench_history.jsonl`` on-chip so a later cpu-fallback round can
+    cite the capture.
     """
-    round2 = [sys.executable, "-m", "tpu_cooccurrence.bench.tpu_round2"]
-    if quick:
-        round2.append("--quick")
+    def round2(only: str, deadline_s: float,
+               quick_deadline_s: float) -> tuple:
+        argv = [sys.executable, "-m", "tpu_cooccurrence.bench.tpu_round2",
+                "--only", only]
+        if quick:
+            argv.append("--quick")
+        return (f"tpu_round2:{only}", argv,
+                quick_deadline_s if quick else deadline_s)
+
     # bench.py enforces its own internal deadlines (probe 240s + accel
     # child + cpu-fallback child, env-tunable); the stage deadline is a
     # strict backstop ABOVE that budget so the watcher never kills a
@@ -122,7 +133,21 @@ def default_stages(quick: bool = False) -> List[tuple]:
     bench_budget = (240.0 + BENCH_ACCEL_DEADLINE_S + BENCH_CPU_DEADLINE_S
                     + 360.0)
     return [
-        ("tpu_round2", round2, 900.0 if quick else 5400.0),
+        # Deadlines: prior on-chip walls (ml25m-full 190s, pallas-bench
+        # 596s, TPU_ROUND2.jsonl) + first-contact compiles at tunnel
+        # speed, with generous slack — they are hang backstops, not
+        # performance expectations.
+        round2("tunnel-probe", 600.0, 300.0),
+        round2("config4-headline", 1200.0, 600.0),
+        round2("config4-chunked", 1200.0, 600.0),
+        round2("ml25m-sparse", 1800.0, 600.0),
+        round2("sparse-pallas", 1200.0, 600.0),
+        round2("ml25m-full", 1800.0, 600.0),
+        round2("sharded-pallas-1chip", 1200.0, 600.0),
+        round2("config4-sparse", 2400.0, 900.0),
+        round2("config5-sparse", 1200.0, 600.0),
+        round2("pallas-bench", 1800.0, 600.0),
+        round2("configs", 3600.0, 900.0),
         ("bench.py", [sys.executable, os.path.join(REPO, "bench.py")],
          bench_budget),
         # Regenerate the machine-written summary so a capture session
@@ -135,8 +160,14 @@ def default_stages(quick: bool = False) -> List[tuple]:
 
 
 def run_stage(name: str, argv: Sequence[str], deadline_s: float,
-              log_path: str = LOG_PATH) -> bool:
+              log_path: str = LOG_PATH) -> str:
     """Run one capture stage under a hard deadline; never raises.
+
+    Returns a status string: ``"ok"`` (exit 0), ``"failed"`` (ran to
+    completion with a nonzero exit — e.g. tpu_round2 recording a failed
+    measurement), ``"timeout"`` (deadline kill), ``"error"`` (could not
+    spawn). The caller treats failed differently from timed-out: a
+    failure is a recorded result, a timeout is a truncated session.
 
     The stage runs in its own process group and a timeout kills the
     WHOLE group — stages like bench.py spawn measurement grandchildren
@@ -154,7 +185,7 @@ def run_stage(name: str, argv: Sequence[str], deadline_s: float,
     except OSError as exc:
         log_event({"event": "stage-error", "stage": name, "ok": False,
                    "error": repr(exc)}, log_path)
-        return False
+        return "error"
     try:
         out, err = proc.communicate(timeout=deadline_s)
     except subprocess.TimeoutExpired:
@@ -165,7 +196,7 @@ def run_stage(name: str, argv: Sequence[str], deadline_s: float,
         proc.communicate()
         log_event({"event": "stage-timeout", "stage": name, "ok": False,
                    "wall_s": round(time.monotonic() - start, 1)}, log_path)
-        return False
+        return "timeout"
     ok = proc.returncode == 0
     log_event({"event": "stage-end", "stage": name, "ok": ok,
                "rc": proc.returncode,
@@ -173,7 +204,7 @@ def run_stage(name: str, argv: Sequence[str], deadline_s: float,
                "stdout_tail": (out or "")[-2000:],
                **({} if ok else {"stderr_tail": (err or "")[-2000:]})},
               log_path)
-    return ok
+    return "ok" if ok else "failed"
 
 
 def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
@@ -182,10 +213,19 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
           log_path: str = LOG_PATH,
           stages: Optional[List[Tuple[str, List[str], float]]] = None,
           heartbeat_every: int = 12) -> int:
-    """The watch loop. Returns the number of COMPLETE capture sessions
-    (every stage ran and exited 0 — a grant that dies mid-capture does
-    not count, so ``max_captures=1`` keeps watching until one usable
-    capture exists).
+    """The watch loop. Returns the number of COMPLETE capture sessions.
+
+    Complete = every stage RAN to completion under its deadline and the
+    grant survived the whole session. A ``tpu_round2`` measurement
+    stage that exits nonzero with the grant still up is logged — its
+    failure IS a recorded result in TPU_ROUND2.jsonl — but does NOT
+    void the session: otherwise one deterministically-failing
+    measurement would make an unattended ``max_captures`` watcher
+    re-burn every future grant re-running the full stage list forever.
+    Timeouts, spawn errors, mid-capture grant loss, and failures of the
+    artifact stages (bench.py, summarize — their nonzero exit means the
+    session's deliverable is missing) DO void it, so ``max_captures=1``
+    keeps watching until one usable capture exists.
 
     ``max_cycles``/``max_captures`` bound the loop for tests and for
     drivers that only need one capture; the operator default (both
@@ -202,16 +242,29 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
         granted = probe_once(probe_timeout_s)
         if granted:
             log_event({"event": "grant", "cycle": cycle}, log_path)
-            all_ok = True
+            truncated = False
             lost = False
+            failed_stages = []
             for stage in (stages if stages is not None
                           else default_stages(quick)):
                 name, argv, deadline = stage[:3]
                 needs_grant = stage[3] if len(stage) > 3 else True
                 if lost and needs_grant:
                     continue  # don't burn chip stages on a dead tunnel
-                ok = run_stage(name, argv, deadline, log_path)
-                if not ok and needs_grant and not probe_once(
+                status = run_stage(name, argv, deadline, log_path)
+                if status != "ok":
+                    failed_stages.append(name)
+                if status in ("timeout", "error"):
+                    truncated = True  # hung or unrunnable: not a result
+                elif status == "failed" and not name.startswith(
+                        "tpu_round2"):
+                    # Only tpu_round2 measurement stages may fail
+                    # without voiding the session (their failure IS a
+                    # recorded result in TPU_ROUND2.jsonl). A failed
+                    # bench.py or summarize means the session's
+                    # deliverable is missing.
+                    truncated = True
+                if status != "ok" and needs_grant and not probe_once(
                         probe_timeout_s):
                     # Stage failed AND the tunnel is gone: skip the
                     # remaining chip stages; offline stages (e.g. the
@@ -219,13 +272,15 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
                     log_event({"event": "grant-lost", "cycle": cycle},
                               log_path)
                     lost = True
-                all_ok = all_ok and ok
             sessions += 1
-            if all_ok:
+            complete = not truncated and not lost
+            if complete:
                 captures += 1
             log_event({"event": "capture-done", "cycle": cycle,
-                       "complete": all_ok, "sessions": sessions,
-                       "captures": captures}, log_path)
+                       "complete": complete, "sessions": sessions,
+                       "captures": captures,
+                       **({"failed_stages": failed_stages}
+                          if failed_stages else {})}, log_path)
             if max_captures is not None and captures >= max_captures:
                 break
         elif cycle % heartbeat_every == 1 or heartbeat_every <= 1:

@@ -96,20 +96,61 @@ def render() -> str:
     else:
         lines += ["", "- no on-chip capture recorded yet"]
 
-    # Config 4.
+    # Config 4. The headline-first capture order means a short grant
+    # may land config4-headline (one L16/fixed run) without the sweep;
+    # evaluate the target on the best successful row of any form.
     lines += ["", "## Config 4 — 1M-item Zipfian (sparse backend)"]
-    c4 = rounds.get("config4-sparse")
-    if c4:
-        pps = c4.get("pairs_per_sec", 0)
+    c4_rows = [(name, rounds[name]) for name in
+               ("config4-headline", "config4-chunked", "config4-sparse")
+               if name in rounds]
+    if c4_rows:
+        # Full-size rows outrank --quick ones regardless of pairs/s —
+        # the target is only meaningful at the full 1M-event stream.
+        best_name, best = max(
+            c4_rows, key=lambda nr: (nr[1].get("events", 0),
+                                     nr[1].get("pairs_per_sec", 0)))
+        pps = best.get("pairs_per_sec", 0)
         ok = pps >= CONFIG4_TARGET_PAIRS_PER_SEC
+        mode = best.get("mode")
         lines += [
             "",
-            f"- **{pps:,.0f} pairs/s** ({c4.get('ts', '?')}) — target "
+            f"- **{pps:,.0f} pairs/s** ({best_name}"
+            + (f", {mode}" if mode else "")
+            + (f", {best['events']:,} events"
+               if best.get("events") is not None else "")
+            + f", {best.get('ts', '?')}) — target "
             f">= {CONFIG4_TARGET_PAIRS_PER_SEC:,} (20x host): "
             f"{'**MET**' if ok else '**NOT MET**'}",
         ]
-        if "pairs_per_sec_by_mode" in c4:
-            lines.append(f"- by mode: {c4['pairs_per_sec_by_mode']}")
+        sweep = rounds.get("config4-sparse")
+        if sweep and "pairs_per_sec_by_mode" in sweep:
+            lines.append(
+                f"- sweep by mode ({sweep.get('ts', '?')}): "
+                f"{sweep['pairs_per_sec_by_mode']}")
+        head, chunk = (rounds.get("config4-headline"),
+                       rounds.get("config4-chunked"))
+        if head and chunk:
+            h, c = (head.get("pairs_per_sec", 0),
+                    chunk.get("pairs_per_sec", 0))
+            he, ce = head.get("events"), chunk.get("events")
+            fmt = (lambda v: f"{v:,}" if isinstance(v, int) else str(v))
+            if he != ce:
+                # Mixed provenance (e.g. one --quick row): a hardware
+                # default must not flip on incomparable runs.
+                lines.append(
+                    f"- upload A/B: INCOMPARABLE — monolithic ran "
+                    f"{fmt(he)} events ({head.get('ts', '?')}), chunked "
+                    f"{fmt(ce)} events ({chunk.get('ts', '?')}); re-run "
+                    f"both at full size before deciding")
+            else:
+                winner = (
+                    "chunked upload WINS — flip "
+                    "state/sparse_scorer._upload_chunks' TPU default"
+                    if c > h * 1.05 else
+                    "monolithic upload holds (keep default)")
+                lines.append(
+                    f"- upload A/B ({fmt(he)} events): monolithic "
+                    f"{h:,.0f} vs 4-chunk {c:,.0f} pairs/s — {winner}")
     else:
         lines += ["", "- no successful capture yet"]
 
@@ -164,9 +205,16 @@ def render() -> str:
                   f"- sync dispatch RTT "
                   f"{probe.get('sync_ms_per_dispatch')} ms, enqueue "
                   f"{probe.get('enqueue_ms_per_dispatch')} ms, upload "
+                  f"256KB {probe.get('upload_256kb_ms')} ms / "
                   f"1MB {probe.get('upload_1024kb_ms')} ms "
                   f"({probe.get('ts', '?')}) — feeds the v5e-8 "
                   f"projection's upper bound (bench/ml25m.py)"]
+        if probe.get("upload_4x256kb_ms") is not None:
+            lines.append(
+                f"- chunked-upload A/B: 1MB monolithic "
+                f"{probe.get('upload_1024kb_ms')} ms vs 4x256KB "
+                f"{probe.get('upload_4x256kb_ms')} ms (see "
+                f"TPU_COOC_UPLOAD_CHUNKS)")
     return "\n".join(lines) + "\n"
 
 

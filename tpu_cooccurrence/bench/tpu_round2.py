@@ -5,14 +5,19 @@ TPU-gated measurements in one sitting and appends JSON lines to
 ``TPU_ROUND2.jsonl`` at the repo root (one object per measurement, with
 failures recorded rather than aborting the pass):
 
-1. config4-sparse   — the 1M-item Zipfian north star on the sparse
-                      backend (target: >=458k pairs/s = 20x the measured
-                      22.9k host-oracle baseline, BASELINE.md).
-2. ml25m-full       — the full 25M-event dense int16 device run +
-                      v5e-8 projection (bench/ml25m.py).
-3. pallas-bench     — --pallas on vs off on the int16 max-vocab shape
-                      (the kernel's earn-or-delete case, VERDICT item 8).
+1. config4-headline — the 1M-item Zipfian north star in ONE number
+                      (single L16/fixed run; target: >=458k pairs/s =
+                      20x the measured 22.9k host-oracle baseline,
+                      BASELINE.md). config4-sparse is the 4-mode sweep.
+2. ml25m-sparse / ml25m-full — the two config-3 carrier candidates,
+                      25M events + v5e-8 projection (bench/ml25m.py).
+3. sparse-pallas / sharded-pallas-1chip / pallas-bench — kernel-vs-XLA
+                      A/Bs with on-hardware parity checks.
 4. configs          — the five BASELINE.md benchmark configs.
+
+Each measurement can run alone via ``--only NAME`` — grant_watch runs
+them as separate deadline'd stages so a hang costs one measurement,
+not the pass.
 
 (config4-hybrid was the round-1 carrier comparison row; the hybrid
 backend lost it 2.2x on-chip and was retired round 3.)
@@ -24,8 +29,10 @@ Usage (on a TPU-attached interpreter — no JAX_PLATFORMS override):
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
+import sys
 import time
 import traceback
 
@@ -54,9 +61,11 @@ def guard(name: str):
                     res["config"] = res.pop("name")
                 emit({"name": name, "ok": True,
                       "wall_s": round(time.monotonic() - start, 1), **res})
+                return True
             except Exception as exc:  # record and continue the pass
                 emit({"name": name, "ok": False, "error": repr(exc),
                       "trace": traceback.format_exc()[-1500:]})
+                return False
         return run
     return deco
 
@@ -104,9 +113,8 @@ def config4_sparse(quick: bool) -> dict:
     # second run of each.
     by_mode = {}
     best = None
-    prior = {k: os.environ.get(k) for k in
-             ("TPU_COOC_SCORE_LADDER", "TPU_COOC_FIXED_SCORE")}
-    try:
+    with _env_overrides(TPU_COOC_SCORE_LADDER="4",
+                        TPU_COOC_FIXED_SCORE="1"):
         for ladder, fixed in (("4", "1"), ("16", "1"), ("64", "1"),
                               ("16", "0")):
             os.environ["TPU_COOC_SCORE_LADDER"] = ladder
@@ -117,17 +125,72 @@ def config4_sparse(quick: bool) -> dict:
             by_mode[key] = round(r.pairs_per_sec, 1)
             if best is None or r.pairs_per_sec > best.pairs_per_sec:
                 best = r
+    d = best.as_dict()
+    d["pairs_per_sec_by_mode"] = by_mode
+    d["vs_host_baseline_22.9k"] = round(best.pairs_per_sec / 22_900, 2)
+    return d
+
+
+@contextlib.contextmanager
+def _env_overrides(**overrides: str):
+    """Set env vars for the duration, restoring the operator's values
+    (shared by the config4 passes; the remaining passes read the
+    ambient settings on purpose)."""
+    prior = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        yield
     finally:
-        # Restore the operator's settings for the remaining passes.
         for k, v in prior.items():
             if v is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-    d = best.as_dict()
-    d["pairs_per_sec_by_mode"] = by_mode
-    d["vs_host_baseline_22.9k"] = round(best.pairs_per_sec / 22_900, 2)
+
+
+def _config4_single(quick: bool, mode_label: str, **extra_env: str) -> dict:
+    """One warmup + one measured run of config 4 in L16/fixed mode.
+
+    Pins every knob the A/B rows vary — including UPLOAD_CHUNKS, so an
+    ambient operator setting can't contaminate the monolithic arm of
+    the upload comparison."""
+    from .configs import config4_zipfian_1m
+
+    n = 200_000 if quick else 1_000_000
+    env = dict(TPU_COOC_SCORE_LADDER="16", TPU_COOC_FIXED_SCORE="1",
+               TPU_COOC_UPLOAD_CHUNKS="1")
+    env.update(extra_env)
+    with _env_overrides(**env):
+        config4_zipfian_1m(n_events=n)  # warmup: populate jit caches
+        r = config4_zipfian_1m(n_events=n)
+    d = r.as_dict()
+    d["mode"] = mode_label
+    d["vs_host_baseline_22.9k"] = round(r.pairs_per_sec / 22_900, 2)
     return d
+
+
+@guard("config4-headline")
+def config4_headline(quick: bool) -> dict:
+    """North star #1 in ONE number, fast: a single run of the
+    best-known mode (L16/fixed — the TPU default) instead of the 4-mode
+    sweep, so a short grant session still settles the headline before
+    anything long runs. The 2026-07-31 grant lived ~18 minutes and the
+    sweep (8 full 1M-event runs + tunnel-speed compiles) consumed all
+    of it without emitting; this row exists so that can't recur. The
+    full sweep remains as config4-sparse."""
+    return _config4_single(quick, "L16/fixed")
+
+
+@guard("config4-chunked")
+def config4_chunked(quick: bool) -> dict:
+    """config4-headline with the update upload split into 4 transfers
+    (TPU_COOC_UPLOAD_CHUNKS=4): the 2026-07-31 tunnel probe measured a
+    per-transfer cost cliff between 256 KB and 1 MB, and config-4's
+    ~0.8 MB/window update sits above it. Compare against the
+    config4-headline row — if this wins on-chip, flip the scorer's
+    default for TPU (state/sparse_scorer._upload_chunks)."""
+    return _config4_single(quick, "L16/fixed/chunks4",
+                           TPU_COOC_UPLOAD_CHUNKS="4")
 
 
 @guard("ml25m-full")
@@ -370,17 +433,21 @@ def main() -> None:
     ap.add_argument("--only", default=None,
                     help="comma-separated subset of measurement names")
     args = ap.parse_args()
-    # Scarce-first order: the probe (projection constants) and the two
-    # north stars run before the long tails, so a short grant still
-    # settles the headline questions; sparse-pallas right after decides
-    # the config-4 carrier kernel in the same sitting.
+    # Scarce-first order: the probe (projection constants) and ONE
+    # number per north star run before anything long (config4-headline
+    # is a single-mode run; the 4-mode sweep is config4-sparse, after
+    # the carrier rows), so a short grant still settles the headline
+    # questions; sparse-pallas decides the config-4 carrier kernel in
+    # the same sitting.
     passes = {
         "tunnel-probe": tunnel_probe_pass,
-        "config4-sparse": config4_sparse,
+        "config4-headline": config4_headline,
+        "config4-chunked": config4_chunked,
         "ml25m-sparse": ml25m_sparse,
         "sparse-pallas": sparse_pallas,
-        "sharded-pallas-1chip": sharded_pallas_1chip,
         "ml25m-full": ml25m_full,
+        "sharded-pallas-1chip": sharded_pallas_1chip,
+        "config4-sparse": config4_sparse,
         "config5-sparse": config5_sparse,
         "pallas-bench": pallas_bench,
         "configs": all_configs,
@@ -391,14 +458,31 @@ def main() -> None:
         if unknown:
             ap.error(f"unknown measurement(s) {sorted(unknown)}; "
                      f"choose from {sorted(passes)}")
+    # Persistent compile cache: grant time is scarce and tunnel-speed
+    # compiles dominated the 2026-07-31 session. The scorers enable it
+    # lazily at init, but measurements that die before a scorer exists
+    # (or pure-probe passes) would compile uncached — enable it up
+    # front. xla_cache handles host fingerprinting and opt-out.
+    from ..xla_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     import jax
 
-    emit({"name": "env", "ok": True,
-          "devices": [str(d) for d in jax.devices()],
-          "backend": jax.default_backend(), "quick": args.quick})
+    # One env row per capture session, not one per --only subprocess:
+    # grant_watch runs each measurement as its own stage and the
+    # tracked JSONL would otherwise gain ~11 identical rows a session.
+    if only is None or "tunnel-probe" in only:
+        emit({"name": "env", "ok": True,
+              "devices": [str(d) for d in jax.devices()],
+              "backend": jax.default_backend(), "quick": args.quick})
+    all_ok = True
     for name, fn in passes.items():
         if only is None or name in only:
-            fn(args.quick)
+            all_ok = bool(fn(args.quick)) and all_ok
+    # Per-measurement stage runs (grant_watch) key their re-probe logic
+    # off the exit code; a failed measurement must not exit 0.
+    if not all_ok:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

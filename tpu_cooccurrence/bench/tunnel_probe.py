@@ -56,14 +56,18 @@ def probe() -> dict:
     out["sync_ms_per_dispatch"] = round(
         (time.monotonic() - start) / n * 1e3, 3)
 
-    # 3: upload of a window-sized packed update buffer (256 KB, 1 MB).
-    for kb in (256, 1024):
+    # 3: upload of a window-sized packed update buffer. The ladder
+    # brackets the cliff the 2026-07-31 capture found between 256 KB
+    # (0.3 ms, ~850 MB/s) and 1 MB (11.6 ms, ~86 MB/s) — if it is a
+    # per-transfer threshold, the sparse scorer's ~0.8 MB/window update
+    # can ride under it by splitting (see 3b and
+    # TPU_COOC_UPLOAD_CHUNKS in state/sparse_scorer.py).
+    @jax.jit
+    def consume(b):
+        return b.sum()
+
+    for kb in (128, 256, 384, 512, 768, 1024, 2048):
         buf = np.zeros((2, kb * 128), dtype=np.int32)  # kb KiB total
-
-        @jax.jit
-        def consume(b):
-            return b.sum()
-
         consume(jnp.asarray(buf)).block_until_ready()
         reps = 10
         start = time.monotonic()
@@ -71,6 +75,21 @@ def probe() -> dict:
             consume(jnp.asarray(buf)).block_until_ready()
         out[f"upload_{kb}kb_ms"] = round(
             (time.monotonic() - start) / reps * 1e3, 2)
+
+    # 3b: the same 1 MB as 4 separate 256 KB arguments of ONE jitted
+    # call (4 transfers, 1 dispatch) vs the monolithic upload above.
+    @jax.jit
+    def consume4(a, b, c, d):
+        return a.sum() + b.sum() + c.sum() + d.sum()
+
+    bufs = [np.zeros((2, 256 * 128), dtype=np.int32) for _ in range(4)]
+    consume4(*map(jnp.asarray, bufs)).block_until_ready()
+    reps = 10
+    start = time.monotonic()
+    for _ in range(reps):
+        consume4(*map(jnp.asarray, bufs)).block_until_ready()
+    out["upload_4x256kb_ms"] = round(
+        (time.monotonic() - start) / reps * 1e3, 2)
 
     # 4: blocking fetch of a packed [2, 4096, 10] f32 result (~320 KB).
     res = jnp.ones((2, 4096, 10), jnp.float32)

@@ -115,6 +115,46 @@ _apply_update = functools.partial(jax.jit, donate_argnums=(0, 1, 2))(
     _update_body)
 
 
+def _upload_chunks() -> int:
+    """How many pieces to split the per-window update upload into.
+
+    The tunneled chip's host->device transfer cost is non-linear in
+    size (measured 2026-07-31 on-chip: 256 KB = 0.3 ms ~ 850 MB/s,
+    1 MB = 11.6 ms ~ 86 MB/s — a per-transfer threshold in between);
+    K separate smaller arguments of one jitted call may ride under the
+    cliff. Default 1 (monolithic) until an on-chip A/B (tpu_round2
+    ``config4-chunked`` vs ``config4-headline``, and tunnel_probe 3b)
+    proves the split wins on real hardware."""
+    try:
+        return max(1, int(os.environ.get("TPU_COOC_UPLOAD_CHUNKS", "1")))
+    except ValueError:
+        return 1
+
+
+def _split_upd(upd: np.ndarray, k: int) -> Optional[Tuple[np.ndarray, ...]]:
+    """``upd`` as k contiguous column-range pieces, or None when
+    splitting is off / not worthwhile (tiny windows) / uneven."""
+    if k <= 1 or upd.shape[1] % k or upd.shape[1] // k < 1024:
+        return None
+    return tuple(np.ascontiguousarray(p) for p in np.split(upd, k, axis=1))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _apply_update_chunked(cnt, dst, row_sums, upd_parts, bounds):
+    """_apply_update with the update buffer arriving as K separate
+    transfers; the concatenate is device-side and fuses away."""
+    return _update_body(cnt, dst, row_sums,
+                        jnp.concatenate(upd_parts, axis=1), bounds)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("L",))
+def _apply_moves_update_chunked(cnt, dst, row_sums, mv, upd_parts, bounds,
+                                L: int):
+    cnt, dst = _moves_body(cnt, dst, mv, L)
+    return _update_body(cnt, dst, row_sums,
+                        jnp.concatenate(upd_parts, axis=1), bounds)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("L",))
 def _apply_moves_update(cnt, dst, row_sums, mv, upd, bounds, L: int):
     """Row relocations + the window update in ONE dispatch.
@@ -933,15 +973,34 @@ class SparseDeviceScorer:
         upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
         bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
 
+        parts = _split_upd(upd, _upload_chunks())
+        if parts is not None:
+            # Ledger mirrors the actual transfer pattern: one event per
+            # chunk plus the small metadata buffers (same byte total as
+            # the monolithic event).
+            for p in parts:
+                LEDGER.up("update-chunk", p)
         if plan.mv is not None:
-            LEDGER.up("update", upd, bounds, plan.mv)
-            self.cnt, self.dst, self.row_sums = _apply_moves_update(
-                self.cnt, self.dst, self.row_sums, plan.mv, upd, bounds,
-                L=plan.mv_len)
+            if parts is not None:
+                LEDGER.up("update-meta", bounds, plan.mv)
+                self.cnt, self.dst, self.row_sums = (
+                    _apply_moves_update_chunked(
+                        self.cnt, self.dst, self.row_sums, plan.mv,
+                        parts, bounds, L=plan.mv_len))
+            else:
+                LEDGER.up("update", upd, bounds, plan.mv)
+                self.cnt, self.dst, self.row_sums = _apply_moves_update(
+                    self.cnt, self.dst, self.row_sums, plan.mv, upd,
+                    bounds, L=plan.mv_len)
         else:
-            LEDGER.up("update", upd, bounds)
-            self.cnt, self.dst, self.row_sums = _apply_update(
-                self.cnt, self.dst, self.row_sums, upd, bounds)
+            if parts is not None:
+                LEDGER.up("update-meta", bounds)
+                self.cnt, self.dst, self.row_sums = _apply_update_chunked(
+                    self.cnt, self.dst, self.row_sums, parts, bounds)
+            else:
+                LEDGER.up("update", upd, bounds)
+                self.cnt, self.dst, self.row_sums = _apply_update(
+                    self.cnt, self.dst, self.row_sums, upd, bounds)
 
         if self.development_mode:
             self._check_row_sums(rows)
