@@ -126,6 +126,39 @@ def test_failed_measurement_with_live_grant_still_completes(
     assert "grant-lost" not in [e["event"] for e in _read_log(log)]
 
 
+def test_headline_group_failure_voids_completeness(monkeypatch, tmp_path):
+    """If every ran member of a REQUIRED_STAGE_GROUPS headline group
+    fails (the 2026-07-31 transient-UNAVAILABLE class hitting all
+    config-4 forms), the session is not a usable capture — a
+    --max-captures watcher must keep watching. A succeeding ALTERNATIVE
+    member of the group keeps the session complete."""
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    fail_cmd = [sys.executable, "-c", "import sys; sys.exit(1)"]
+    ok_cmd = [sys.executable, "-c", "print('ok')"]
+    log = str(tmp_path / "watch.jsonl")
+    captures = grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
+        stages=[("tpu_round2:config4-headline", fail_cmd, 60.0),
+                ("tpu_round2:config4-chunked", fail_cmd, 60.0)])
+    assert captures == 0
+    done = [e for e in _read_log(log) if e["event"] == "capture-done"]
+    assert done[0]["complete"] is False
+    assert done[0]["missing_headline_groups"] == [[
+        "tpu_round2:config4-headline", "tpu_round2:config4-chunked",
+        "tpu_round2:config4-sparse"]]
+    # The sweep form succeeding satisfies the group (OR semantics: a
+    # deterministically-failing variant can't wedge the watcher).
+    log2 = str(tmp_path / "watch2.jsonl")
+    captures = grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log2,
+        stages=[("tpu_round2:config4-headline", fail_cmd, 60.0),
+                ("tpu_round2:config4-sparse", ok_cmd, 60.0)])
+    assert captures == 1
+    done = [e for e in _read_log(log2) if e["event"] == "capture-done"]
+    assert done[0]["complete"] is True
+    assert "missing_headline_groups" not in done[0]
+
+
 def test_failed_artifact_stage_voids_completeness(monkeypatch, tmp_path):
     """A failed NON-measurement stage (bench.py, summarize) means the
     session's deliverable is missing: complete must be False even with

@@ -142,6 +142,42 @@ def test_device_backend_matches_oracle_job(overrides):
             assert o_items == p_items
 
 
+def test_device_backend_chunked_upload_matches(monkeypatch):
+    """TPU_COOC_UPLOAD_CHUNKS=K splits the dense packed COO upload into
+    K transfers of one dispatch (the tunnel-cliff lever, shared with
+    the sparse backend); results, counters, and the ledger's transfer
+    pattern all track the monolithic path."""
+    import tpu_cooccurrence.ops.device_scorer as ds
+    from tpu_cooccurrence.observability import LEDGER
+
+    kw = dict(window_size=10, seed=0xBEEF, development_mode=True,
+              backend=Backend.DEVICE, num_items=32)
+    users, items, ts = random_stream(2)
+    a = run_production(Config(**kw), users, items, ts)
+
+    calls = {"chunked": 0}
+    for name in ("_update_coo_chunked", "_update_coo_u16_chunked"):
+        orig = getattr(ds, name)
+
+        def counting(*args, _orig=orig, **kwargs):
+            calls["chunked"] += 1
+            return _orig(*args, **kwargs)
+
+        monkeypatch.setattr(ds, name, counting)
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNKS", "4")
+    LEDGER.reset()
+    b = run_production(Config(**kw), users, items, ts)
+    assert calls["chunked"] > 0, "chunked path must actually engage"
+    assert set(a.latest) == set(b.latest)
+    for item in a.latest:
+        np.testing.assert_allclose(
+            [s for _, s in b.latest[item]],
+            [s for _, s in a.latest[item]], rtol=1e-6, atol=1e-6)
+    assert a.counters.as_dict() == b.counters.as_dict()
+    up = LEDGER.labels("h2d")
+    assert "coo-chunk" in up and "coo" not in up
+
+
 def test_device_backend_counters_match_oracle_backend():
     cfg_o = Config(window_size=10, seed=3, item_cut=4, user_cut=3,
                    backend=Backend.ORACLE)

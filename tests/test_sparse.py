@@ -280,8 +280,13 @@ def test_sparse_chunked_upload_matches(monkeypatch):
             == 4 * up_labels.count("update-meta"))
 
 
-def test_split_upd_edges():
-    """Splitting declines tiny windows, uneven lengths, and k<=1."""
+def test_split_upd_edges(caplog):
+    """Splitting declines tiny windows, uneven lengths, and k<=1 — and
+    a requested-but-declined split warns once (an operator A/B-testing
+    on grant time must not silently measure the monolithic path)."""
+    import logging
+
+    import tpu_cooccurrence.ops.device_scorer as ds
     from tpu_cooccurrence.state.sparse_scorer import _split_upd
 
     upd = np.zeros((2, 4096), dtype=np.int32)
@@ -289,8 +294,15 @@ def test_split_upd_edges():
     assert len(parts) == 4 and all(p.shape == (2, 1024) for p in parts)
     assert all(p.flags["C_CONTIGUOUS"] for p in parts)
     assert _split_upd(upd, 1) is None
-    assert _split_upd(upd, 8) is None          # 512-element chunks: too small
-    assert _split_upd(np.zeros((2, 4098), np.int32), 4) is None  # uneven
+    ds._split_declined_warned = False
+    with caplog.at_level(logging.WARNING, logger="tpu_cooccurrence"):
+        assert _split_upd(upd, 8) is None      # 512-element chunks: too small
+        assert _split_upd(np.zeros((2, 4098), np.int32), 4) is None  # uneven
+    warnings = [r for r in caplog.records
+                if "TPU_COOC_UPLOAD_CHUNKS" in r.message]
+    assert len(warnings) == 1, "declined split must warn exactly once"
+    assert _split_upd(upd, 1) is None          # k<=1 never warns
+    ds._split_declined_warned = False
 
 
 def test_sparse_deferred_matches_pipelined():

@@ -207,6 +207,21 @@ def run_stage(name: str, argv: Sequence[str], deadline_s: float,
     return "ok" if ok else "failed"
 
 
+#: Usable-capture contract: groups of alternative headline stages. If a
+#: session RAN any stage of a group, at least one member must succeed
+#: for the session to count as a complete capture — otherwise a
+#: transient on-chip failure of every north-star measurement (the
+#: recorded 2026-07-31 'UNAVAILABLE' class) would satisfy
+#: ``--max-captures 1`` with zero usable numbers. Groups are ORs so a
+#: deterministically-failing variant can't wedge the watcher as long as
+#: any alternative form of the number lands.
+REQUIRED_STAGE_GROUPS = (
+    ("tpu_round2:config4-headline", "tpu_round2:config4-chunked",
+     "tpu_round2:config4-sparse"),
+    ("tpu_round2:ml25m-sparse", "tpu_round2:ml25m-full"),
+)
+
+
 def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
           max_cycles: Optional[int] = None, quick: bool = False,
           max_captures: Optional[int] = None,
@@ -224,8 +239,11 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
     re-burn every future grant re-running the full stage list forever.
     Timeouts, spawn errors, mid-capture grant loss, and failures of the
     artifact stages (bench.py, summarize — their nonzero exit means the
-    session's deliverable is missing) DO void it, so ``max_captures=1``
-    keeps watching until one usable capture exists.
+    session's deliverable is missing) DO void it, as does a
+    ``REQUIRED_STAGE_GROUPS`` headline group whose every ran member
+    failed (a transient failure of all north-star forms must not
+    satisfy ``max_captures``), so ``max_captures=1`` keeps watching
+    until one usable capture exists.
 
     ``max_cycles``/``max_captures`` bound the loop for tests and for
     drivers that only need one capture; the operator default (both
@@ -244,16 +262,15 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
             log_event({"event": "grant", "cycle": cycle}, log_path)
             truncated = False
             lost = False
-            failed_stages = []
+            statuses = {}
             for stage in (stages if stages is not None
                           else default_stages(quick)):
                 name, argv, deadline = stage[:3]
                 needs_grant = stage[3] if len(stage) > 3 else True
                 if lost and needs_grant:
                     continue  # don't burn chip stages on a dead tunnel
-                status = run_stage(name, argv, deadline, log_path)
-                if status != "ok":
-                    failed_stages.append(name)
+                status = statuses[name] = run_stage(name, argv, deadline,
+                                                    log_path)
                 if status in ("timeout", "error"):
                     truncated = True  # hung or unrunnable: not a result
                 elif status == "failed" and not name.startswith(
@@ -273,14 +290,25 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
                               log_path)
                     lost = True
             sessions += 1
-            complete = not truncated and not lost
+            # Headline contract: a group that ran but produced no
+            # success (e.g. a transient UNAVAILABLE on every config-4
+            # form) leaves the session unusable — keep watching.
+            missing_groups = [
+                g for g in REQUIRED_STAGE_GROUPS
+                if any(n in statuses for n in g)
+                and not any(statuses.get(n) == "ok" for n in g)]
+            failed_stages = [n for n, s in statuses.items() if s != "ok"]
+            complete = not truncated and not lost and not missing_groups
             if complete:
                 captures += 1
             log_event({"event": "capture-done", "cycle": cycle,
                        "complete": complete, "sessions": sessions,
                        "captures": captures,
                        **({"failed_stages": failed_stages}
-                          if failed_stages else {})}, log_path)
+                          if failed_stages else {}),
+                       **({"missing_headline_groups":
+                           [list(g) for g in missing_groups]}
+                          if missing_groups else {})}, log_path)
             if max_captures is not None and captures >= max_captures:
                 break
         elif cycle % heartbeat_every == 1 or heartbeat_every <= 1:

@@ -34,6 +34,8 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import logging
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -179,6 +181,63 @@ def _update_coo_u16(C, row_sums, coo, num_items: int):
     also falls back to the int32 block when a window's aggregated cell
     delta leaves int16 range.
     """
+    src = coo[0].astype(jnp.int32)
+    dst = coo[1].astype(jnp.int32)
+    delta = coo[2].astype(jnp.int16).astype(jnp.int32)  # sign-extend
+    return _apply_coo(C, row_sums, src, dst, delta, num_items)
+
+
+def upload_chunks() -> int:
+    """How many pieces to split per-window packed uploads into.
+
+    The tunneled chip's host->device transfer cost is non-linear in
+    size (measured 2026-07-31 on-chip: 256 KB = 0.3 ms ~ 850 MB/s,
+    1 MB = 11.6 ms ~ 86 MB/s — a per-transfer threshold in between);
+    K separate smaller arguments of one jitted call may ride under the
+    cliff. Default 1 (monolithic) until the on-chip A/Bs (tpu_round2
+    ``config4-chunked``, tunnel_probe 3b) prove the split wins on real
+    hardware. Shared by the sparse update and dense COO paths."""
+    try:
+        return max(1, int(os.environ.get("TPU_COOC_UPLOAD_CHUNKS", "1")))
+    except ValueError:
+        return 1
+
+
+_split_declined_warned = False
+
+
+def split_upload(arr: np.ndarray, k: int) -> Optional[Tuple]:
+    """``arr`` ([rows, N]) as k contiguous column-range pieces, or None
+    when splitting is off / not worthwhile (tiny windows) / uneven.
+
+    A requested-but-declined split warns once: an operator A/B-testing
+    chunking on scarce grant time must not silently measure the
+    monolithic path (padded widths are pow2/pow4, so e.g. K=3 never
+    divides and would never engage)."""
+    if k <= 1 or arr.shape[1] % k or arr.shape[1] // k < 1024:
+        global _split_declined_warned
+        if k > 1 and not _split_declined_warned:
+            _split_declined_warned = True
+            logging.getLogger("tpu_cooccurrence").warning(
+                "TPU_COOC_UPLOAD_CHUNKS=%d requested but a width-%d "
+                "upload cannot split evenly into >=1024-column chunks; "
+                "monolithic upload used for such windows (use a power "
+                "of two that divides the padded width)", k, arr.shape[1])
+        return None
+    return tuple(np.ascontiguousarray(p) for p in np.split(arr, k, axis=1))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+def _update_coo_chunked(C, row_sums, coo_parts, num_items: int):
+    """_update_coo with the block arriving as K separate transfers;
+    the concatenate is device-side and fuses away."""
+    coo = jnp.concatenate(coo_parts, axis=1)
+    return _apply_coo(C, row_sums, coo[0], coo[1], coo[2], num_items)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+def _update_coo_u16_chunked(C, row_sums, coo_parts, num_items: int):
+    coo = jnp.concatenate(coo_parts, axis=1)
     src = coo[0].astype(jnp.int32)
     dst = coo[1].astype(jnp.int32)
     delta = coo[2].astype(jnp.int16).astype(jnp.int32)  # sign-extend
@@ -457,9 +516,19 @@ class DeviceScorer:
                 update = _update_coo
             coo[0, :n] = src[lo: lo + n]
             coo[1, :n] = dst[lo: lo + n]
-            LEDGER.up("coo", coo)
-            self.C, self.row_sums = update(
-                self.C, self.row_sums, coo, num_items=self.num_items)
+            parts = split_upload(coo, upload_chunks())
+            if parts is not None:
+                for p in parts:
+                    LEDGER.up("coo-chunk", p)
+                update_chunked = (_update_coo_u16_chunked if use_u16
+                                  else _update_coo_chunked)
+                self.C, self.row_sums = update_chunked(
+                    self.C, self.row_sums, parts,
+                    num_items=self.num_items)
+            else:
+                LEDGER.up("coo", coo)
+                self.C, self.row_sums = update(
+                    self.C, self.row_sums, coo, num_items=self.num_items)
 
         window_sum = int(pairs.delta.sum())
         self.observed += window_sum

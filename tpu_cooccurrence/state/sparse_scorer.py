@@ -61,7 +61,8 @@ from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..observability import LEDGER
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              merge_sorted_insert, narrow_deltas_int32)
-from ..ops.device_scorer import DeferredResultsTable, pad_pow2, pad_pow4
+from ..ops.device_scorer import (DeferredResultsTable, pad_pow2, pad_pow4,
+                                 split_upload, upload_chunks)
 from ..ops.llr import llr_stable
 from ..sampling.reservoir import PairDeltaBatch, _ragged_arange
 from .results import TopKBatch
@@ -115,28 +116,10 @@ _apply_update = functools.partial(jax.jit, donate_argnums=(0, 1, 2))(
     _update_body)
 
 
-def _upload_chunks() -> int:
-    """How many pieces to split the per-window update upload into.
-
-    The tunneled chip's host->device transfer cost is non-linear in
-    size (measured 2026-07-31 on-chip: 256 KB = 0.3 ms ~ 850 MB/s,
-    1 MB = 11.6 ms ~ 86 MB/s — a per-transfer threshold in between);
-    K separate smaller arguments of one jitted call may ride under the
-    cliff. Default 1 (monolithic) until an on-chip A/B (tpu_round2
-    ``config4-chunked`` vs ``config4-headline``, and tunnel_probe 3b)
-    proves the split wins on real hardware."""
-    try:
-        return max(1, int(os.environ.get("TPU_COOC_UPLOAD_CHUNKS", "1")))
-    except ValueError:
-        return 1
-
-
-def _split_upd(upd: np.ndarray, k: int) -> Optional[Tuple[np.ndarray, ...]]:
-    """``upd`` as k contiguous column-range pieces, or None when
-    splitting is off / not worthwhile (tiny windows) / uneven."""
-    if k <= 1 or upd.shape[1] % k or upd.shape[1] // k < 1024:
-        return None
-    return tuple(np.ascontiguousarray(p) for p in np.split(upd, k, axis=1))
+# Shared with the dense COO path; see the rationale (tunnel transfer
+# cliff, measured 2026-07-31) at their definitions in ops/device_scorer.
+_upload_chunks = upload_chunks
+_split_upd = split_upload
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
