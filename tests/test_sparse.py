@@ -280,29 +280,74 @@ def test_sparse_chunked_upload_matches(monkeypatch):
             == 4 * up_labels.count("update-meta"))
 
 
-def test_split_upd_edges(caplog):
+def test_split_upload_edges(caplog):
     """Splitting declines tiny windows, uneven lengths, and k<=1 — and
     a requested-but-declined split warns once (an operator A/B-testing
     on grant time must not silently measure the monolithic path)."""
     import logging
 
     import tpu_cooccurrence.ops.device_scorer as ds
-    from tpu_cooccurrence.state.sparse_scorer import _split_upd
+    from tpu_cooccurrence.ops.device_scorer import split_upload
 
     upd = np.zeros((2, 4096), dtype=np.int32)
-    parts = _split_upd(upd, 4)
+    parts = split_upload(upd, 4)
     assert len(parts) == 4 and all(p.shape == (2, 1024) for p in parts)
     assert all(p.flags["C_CONTIGUOUS"] for p in parts)
-    assert _split_upd(upd, 1) is None
+    assert split_upload(upd, 1) is None
     ds._split_declined_warned = False
     with caplog.at_level(logging.WARNING, logger="tpu_cooccurrence"):
-        assert _split_upd(upd, 8) is None      # 512-element chunks: too small
-        assert _split_upd(np.zeros((2, 4098), np.int32), 4) is None  # uneven
+        assert split_upload(upd, 8) is None    # 512-element chunks: too small
+        assert split_upload(np.zeros((2, 4098), np.int32), 4) is None  # uneven
     warnings = [r for r in caplog.records
                 if "TPU_COOC_UPLOAD_CHUNKS" in r.message]
     assert len(warnings) == 1, "declined split must warn exactly once"
-    assert _split_upd(upd, 1) is None          # k<=1 never warns
+    assert split_upload(upd, 1) is None        # k<=1 never warns
     ds._split_declined_warned = False
+
+
+def test_split_upload_auto_adapts_k(monkeypatch):
+    """TPU_COOC_UPLOAD_CHUNK_KB picks the smallest pow2 K that brings
+    each piece under the byte target (window sizes are data-dependent,
+    so fixed K leaves big windows above the transfer cliff); explicit
+    TPU_COOC_UPLOAD_CHUNKS wins when both are set."""
+    from tpu_cooccurrence.ops.device_scorer import split_upload_auto
+
+    monkeypatch.delenv("TPU_COOC_UPLOAD_CHUNKS", raising=False)
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNK_KB", "256")
+    mb1 = np.zeros((2, 131072), dtype=np.int32)       # 1 MiB
+    parts = split_upload_auto(mb1)
+    assert len(parts) == 4                             # 4 x 256 KiB
+    assert all(p.nbytes == 256 * 1024 for p in parts)
+    mb4 = np.zeros((2, 524288), dtype=np.int32)        # 4 MiB -> 16 pieces
+    assert len(split_upload_auto(mb4)) == 16
+    small = np.zeros((2, 4096), dtype=np.int32)        # 32 KiB: monolithic
+    assert split_upload_auto(small) is None
+    # Chunk floor still applies: never below 1024 columns per piece.
+    assert all(p.shape[1] >= 1024 for p in split_upload_auto(mb4))
+    # Explicit K overrides the byte target.
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNKS", "2")
+    assert len(split_upload_auto(mb1)) == 2
+    # A SET K=1 pins the MONOLITHIC arm even against an ambient
+    # CHUNK_KB — the A/B's baseline must not silently chunk.
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNKS", "1")
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNK_KB", "256")
+    assert split_upload_auto(mb1) is None
+    # Both off: monolithic.
+    monkeypatch.delenv("TPU_COOC_UPLOAD_CHUNKS")
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNK_KB", "0")
+    assert split_upload_auto(mb1) is None
+
+
+def test_sparse_adaptive_chunked_matches(monkeypatch):
+    """End-to-end parity under the adaptive byte-target policy."""
+    users, items, ts = random_stream(13, n=1500, n_items=90)
+    kw = dict(window_size=15, seed=21, item_cut=6, user_cut=4,
+              backend=Backend.SPARSE, development_mode=True)
+    a = run_production(Config(**kw), users, items, ts)
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNK_KB", "16")  # tiny: forces K
+    b = run_production(Config(**kw), users, items, ts)
+    assert_latest_close(a.latest, b.latest)
+    assert a.counters.as_dict() == b.counters.as_dict()
 
 
 def test_sparse_deferred_matches_pipelined():

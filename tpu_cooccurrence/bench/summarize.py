@@ -144,8 +144,9 @@ def render() -> str:
                     f"both at full size before deciding")
             else:
                 winner = (
-                    "chunked upload WINS — flip "
-                    "state/sparse_scorer._upload_chunks' TPU default"
+                    "chunked upload WINS — default "
+                    "TPU_COOC_UPLOAD_CHUNK_KB=256 on TPU "
+                    "(ops/device_scorer.upload_chunk_kb)"
                     if c > h * 1.05 else
                     "monolithic upload holds (keep default)")
                 lines.append(

@@ -158,7 +158,7 @@ def _config4_single(quick: bool, mode_label: str, **extra_env: str) -> dict:
 
     n = 200_000 if quick else 1_000_000
     env = dict(TPU_COOC_SCORE_LADDER="16", TPU_COOC_FIXED_SCORE="1",
-               TPU_COOC_UPLOAD_CHUNKS="1")
+               TPU_COOC_UPLOAD_CHUNKS="1", TPU_COOC_UPLOAD_CHUNK_KB="0")
     env.update(extra_env)
     with _env_overrides(**env):
         config4_zipfian_1m(n_events=n)  # warmup: populate jit caches
@@ -187,8 +187,10 @@ def config4_chunked(quick: bool) -> dict:
     (TPU_COOC_UPLOAD_CHUNKS=4): the 2026-07-31 tunnel probe measured a
     per-transfer cost cliff between 256 KB and 1 MB, and config-4's
     ~0.8 MB/window update sits above it. Compare against the
-    config4-headline row — if this wins on-chip, flip the scorer's
-    default for TPU (state/sparse_scorer._upload_chunks)."""
+    config4-headline row — if this wins on-chip, default
+    TPU_COOC_UPLOAD_CHUNK_KB=256 on TPU (the adaptive policy,
+    ops/device_scorer.upload_chunk_kb — fixed K leaves outsized
+    windows above the cliff)."""
     return _config4_single(quick, "L16/fixed/chunks4",
                            TPU_COOC_UPLOAD_CHUNKS="4")
 

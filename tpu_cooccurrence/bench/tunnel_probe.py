@@ -59,9 +59,9 @@ def probe() -> dict:
     # 3: upload of a window-sized packed update buffer. The ladder
     # brackets the cliff the 2026-07-31 capture found between 256 KB
     # (0.3 ms, ~850 MB/s) and 1 MB (11.6 ms, ~86 MB/s) — if it is a
-    # per-transfer threshold, the sparse scorer's ~0.8 MB/window update
-    # can ride under it by splitting (see 3b and
-    # TPU_COOC_UPLOAD_CHUNKS in state/sparse_scorer.py).
+    # per-transfer threshold, the scorers' ~0.8 MB/window uploads can
+    # ride under it by splitting (see 3b and TPU_COOC_UPLOAD_CHUNKS /
+    # TPU_COOC_UPLOAD_CHUNK_KB in ops/device_scorer.py).
     @jax.jit
     def consume(b):
         return b.sum()

@@ -227,6 +227,41 @@ def split_upload(arr: np.ndarray, k: int) -> Optional[Tuple]:
     return tuple(np.ascontiguousarray(p) for p in np.split(arr, k, axis=1))
 
 
+def upload_chunk_kb() -> float:
+    """Byte target per upload piece (0 = off). The adaptive form of the
+    chunk policy: where TPU_COOC_UPLOAD_CHUNKS fixes K for every
+    window, TPU_COOC_UPLOAD_CHUNK_KB picks the smallest power-of-two K
+    per upload that brings each piece under the target — window sizes
+    are data-dependent (pow2/pow4 ladders), so a fixed K leaves big
+    windows above the measured per-transfer cliff (e.g. 3 MB / 4 =
+    750 KB pieces). This is the shape the TPU default takes if the
+    on-chip A/B proves chunking."""
+    try:
+        return float(os.environ.get("TPU_COOC_UPLOAD_CHUNK_KB", "0"))
+    except ValueError:
+        return 0.0
+
+
+def split_upload_auto(arr: np.ndarray) -> Optional[Tuple]:
+    """Pieces for this upload per the env policy, or None (monolithic).
+
+    A SET TPU_COOC_UPLOAD_CHUNKS wins outright — including =1, which
+    pins the monolithic arm of an A/B against an ambient CHUNK_KB (the
+    same silent-contamination hazard _config4_single pins against).
+    Otherwise TPU_COOC_UPLOAD_CHUNK_KB adapts K to the buffer size."""
+    if os.environ.get("TPU_COOC_UPLOAD_CHUNKS"):
+        return split_upload(arr, upload_chunks())
+    kb = upload_chunk_kb()
+    if kb <= 0:
+        return None
+    cols = arr.shape[1]
+    k = 1
+    while (arr.nbytes / k > kb * 1024 and cols % (2 * k) == 0
+           and cols // (2 * k) >= 1024):
+        k *= 2
+    return split_upload(arr, k) if k > 1 else None
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
 def _update_coo_chunked(C, row_sums, coo_parts, num_items: int):
     """_update_coo with the block arriving as K separate transfers;
@@ -516,7 +551,7 @@ class DeviceScorer:
                 update = _update_coo
             coo[0, :n] = src[lo: lo + n]
             coo[1, :n] = dst[lo: lo + n]
-            parts = split_upload(coo, upload_chunks())
+            parts = split_upload_auto(coo)
             if parts is not None:
                 for p in parts:
                     LEDGER.up("coo-chunk", p)

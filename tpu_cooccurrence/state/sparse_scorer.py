@@ -62,7 +62,7 @@ from ..observability import LEDGER
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              merge_sorted_insert, narrow_deltas_int32)
 from ..ops.device_scorer import (DeferredResultsTable, pad_pow2, pad_pow4,
-                                 split_upload, upload_chunks)
+                                 split_upload_auto)
 from ..ops.llr import llr_stable
 from ..sampling.reservoir import PairDeltaBatch, _ragged_arange
 from .results import TopKBatch
@@ -114,12 +114,6 @@ def _update_body(cnt, dst, row_sums, upd, bounds):
 
 _apply_update = functools.partial(jax.jit, donate_argnums=(0, 1, 2))(
     _update_body)
-
-
-# Shared with the dense COO path; see the rationale (tunnel transfer
-# cliff, measured 2026-07-31) at their definitions in ops/device_scorer.
-_upload_chunks = upload_chunks
-_split_upd = split_upload
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -956,7 +950,7 @@ class SparseDeviceScorer:
         upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
         bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
 
-        parts = _split_upd(upd, _upload_chunks())
+        parts = split_upload_auto(upd)
         if parts is not None:
             # Ledger mirrors the actual transfer pattern: one event per
             # chunk plus the small metadata buffers (same byte total as
