@@ -126,6 +126,28 @@ def test_failed_measurement_with_live_grant_still_completes(
     assert "grant-lost" not in [e["event"] for e in _read_log(log)]
 
 
+def test_recapture_cooldown_pauses_chip_stages(monkeypatch, tmp_path):
+    """After a COMPLETE capture the watcher must not hammer a
+    still-live grant with back-to-back duplicate passes: chip stages
+    pause for the cooldown (cycles tick, no probe/capture), while
+    cooldown=0 recaptures immediately."""
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    ok_cmd = [sys.executable, "-c", "print('ok')"]
+    log = str(tmp_path / "watch.jsonl")
+    grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=3, log_path=log,
+        stages=[("stub", ok_cmd, 60.0)], recapture_cooldown_s=3600.0)
+    events = [e["event"] for e in _read_log(log)]
+    assert events.count("grant") == 1, "cooldown must suppress recapture"
+    assert events.count("capture-done") == 1
+    log2 = str(tmp_path / "watch2.jsonl")
+    grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=2, log_path=log2,
+        stages=[("stub", ok_cmd, 60.0)], recapture_cooldown_s=0.0)
+    events = [e["event"] for e in _read_log(log2)]
+    assert events.count("capture-done") == 2, "cooldown=0 recaptures"
+
+
 def test_headline_group_failure_voids_completeness(monkeypatch, tmp_path):
     """If every ran member of a REQUIRED_STAGE_GROUPS headline group
     fails (the 2026-07-31 transient-UNAVAILABLE class hitting all
@@ -254,7 +276,7 @@ def test_status_summarizes_log(tmp_path):
             f.write(json.dumps(r) + "\n")
     s = grant_watch.status(str(log))
     assert s["first_ts"] == "t0" and s["last_ts"] == "t6"
-    assert s["cycles_probed"] == 13
+    assert s["cycles"] == 13
     assert s["grants"] == 2
     assert s["captures_complete"] == 1
     assert s["last_capture_ts"] == "t5"
@@ -270,4 +292,8 @@ def test_status_summarizes_log(tmp_path):
                   {"ts": "d", "event": "watch-start"},
                   {"ts": "e", "event": "no-grant", "cycle": 3}):
             f.write(json.dumps(r) + "\n")
-    assert grant_watch.status(str(log))["cycles_probed"] == 15
+    s2 = grant_watch.status(str(log))
+    assert s2["cycles"] == 15
+    # probes_run sums watch-end probes (falling back to cycles for
+    # pre-cooldown rows without the field); in-flight runs trail.
+    assert s2["probes_run"] == 12

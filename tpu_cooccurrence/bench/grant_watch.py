@@ -227,7 +227,8 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
           max_captures: Optional[int] = None,
           log_path: str = LOG_PATH,
           stages: Optional[List[Tuple[str, List[str], float]]] = None,
-          heartbeat_every: int = 12) -> int:
+          heartbeat_every: int = 12,
+          recapture_cooldown_s: float = 3600.0) -> int:
     """The watch loop. Returns the number of COMPLETE capture sessions.
 
     Complete = every stage RAN to completion under its deadline and the
@@ -248,16 +249,29 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
     ``max_cycles``/``max_captures`` bound the loop for tests and for
     drivers that only need one capture; the operator default (both
     None) loops until killed.
+
+    ``recapture_cooldown_s``: after a COMPLETE capture, chip stages
+    pause this long even if the grant stays up — a multi-hour grant
+    must not be hammered with back-to-back duplicate 1-2 h capture
+    passes on a shared chip. Incomplete sessions retry immediately
+    (headline-first order makes the retry cheap).
     """
     captures = 0
     sessions = 0
     cycle = 0
+    probes = 0
+    last_complete = None
     log_event({"event": "watch-start", "interval_s": interval_s,
                "quick": quick}, log_path)
     while True:
         cycle += 1
         cycle_start = time.monotonic()
-        granted = probe_once(probe_timeout_s)
+        cooling = (last_complete is not None
+                   and time.monotonic() - last_complete
+                   < recapture_cooldown_s)
+        if not cooling:
+            probes += 1
+        granted = False if cooling else probe_once(probe_timeout_s)
         if granted:
             log_event({"event": "grant", "cycle": cycle}, log_path)
             truncated = False
@@ -301,6 +315,7 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
             complete = not truncated and not lost and not missing_groups
             if complete:
                 captures += 1
+                last_complete = time.monotonic()
             log_event({"event": "capture-done", "cycle": cycle,
                        "complete": complete, "sessions": sessions,
                        "captures": captures,
@@ -314,8 +329,11 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
         elif cycle % heartbeat_every == 1 or heartbeat_every <= 1:
             # Dead-tunnel cycles log a periodic heartbeat, not every
             # probe: the JSONL is a tracked artifact and a day of
-            # 5-minute probes would be pure churn.
-            log_event({"event": "no-grant", "cycle": cycle}, log_path)
+            # 5-minute probes would be pure churn. During the
+            # post-capture cooldown no probe ran, so the grant state is
+            # unknown — log that, not a spurious no-grant.
+            log_event({"event": "cooldown" if cooling else "no-grant",
+                       "cycle": cycle}, log_path)
         if max_cycles is not None and cycle >= max_cycles:
             break
         # Probe cadence, not sleep cadence: a 4-minute dead-probe hang
@@ -323,17 +341,22 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
         remaining = interval_s - (time.monotonic() - cycle_start)
         if remaining > 0:
             time.sleep(remaining)
-    log_event({"event": "watch-end", "cycles": cycle,
+    log_event({"event": "watch-end", "cycles": cycle, "probes": probes,
                "sessions": sessions, "captures": captures}, log_path)
     return captures
 
 
 def status(log_path: str = LOG_PATH) -> dict:
-    """Summarize a watch log: probe cycles, grants, capture sessions."""
+    """Summarize a watch log: loop cycles, probes, grants, captures.
+
+    ``cycles`` counts loop iterations (including post-capture cooldown
+    cycles in which no probe ran); ``probes_run`` counts actual tunnel
+    probes, summed from watch-end rows (runs still in flight have not
+    written one, so it can trail ``cycles``)."""
     out = {"log": log_path, "exists": os.path.exists(log_path),
            "first_ts": None, "last_ts": None, "last_event": None,
-           "cycles_probed": 0, "grants": 0, "captures_complete": 0,
-           "last_capture_ts": None}
+           "cycles": 0, "probes_run": 0, "grants": 0,
+           "captures_complete": 0, "last_capture_ts": None}
     if not out["exists"]:
         return out
     # Cycles accumulate ACROSS watch runs (each run restarts at cycle 1):
@@ -360,6 +383,7 @@ def status(log_path: str = LOG_PATH) -> dict:
                 run_max = 0
             elif ev == "watch-end":
                 run_max = max(run_max, e.get("cycles", 0))
+                out["probes_run"] += e.get("probes", e.get("cycles", 0))
             elif "cycle" in e:
                 run_max = max(run_max, e.get("cycle", 0))
             if ev == "grant":
@@ -368,7 +392,7 @@ def status(log_path: str = LOG_PATH) -> dict:
                 if e.get("complete"):
                     out["captures_complete"] += 1
                 out["last_capture_ts"] = e.get("ts")
-    out["cycles_probed"] = total_cycles + run_max
+    out["cycles"] = total_cycles + run_max
     return out
 
 
@@ -389,13 +413,17 @@ def main() -> None:
                     help="run tpu_round2 --quick (tunnel sanity shapes)")
     ap.add_argument("--status", action="store_true",
                     help="summarize GRANT_WATCH.jsonl and exit (no probe)")
+    ap.add_argument("--recapture-cooldown", type=float, default=3600.0,
+                    help="seconds to pause chip stages after a COMPLETE "
+                         "capture while the grant stays up (default 3600)")
     args = ap.parse_args()
     if args.status:
         print(json.dumps(status()))
         return
     watch(interval_s=args.interval, probe_timeout_s=args.probe_timeout,
           max_cycles=1 if args.once else args.max_cycles,
-          max_captures=args.max_captures, quick=args.quick)
+          max_captures=args.max_captures, quick=args.quick,
+          recapture_cooldown_s=args.recapture_cooldown)
 
 
 if __name__ == "__main__":
