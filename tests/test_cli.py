@@ -1,7 +1,6 @@
 """CLI driver: end-to-end runs and crash recovery (in-process main)."""
 
 import numpy as np
-import pytest
 
 from tpu_cooccurrence import cli
 

@@ -10,8 +10,6 @@ import json
 import os
 import sys
 
-import pytest
-
 from tpu_cooccurrence.bench import grant_watch
 
 

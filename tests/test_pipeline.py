@@ -290,7 +290,6 @@ def test_device_deferred_matches_pipelined():
     """Dense-backend deferred-results mode (job default without
     --emit-updates) matches the per-window pipeline's final state, for
     both count dtypes and the pallas-on path."""
-    import jax.numpy as jnp
 
     from tpu_cooccurrence.job import CooccurrenceJob
     from tpu_cooccurrence.ops.device_scorer import DeviceScorer

@@ -42,8 +42,6 @@ import contextlib
 import json
 import time
 
-import numpy as np
-
 from ..config import Backend, Config
 from ..job import CooccurrenceJob
 from ..metrics import OBSERVED_COOCCURRENCES

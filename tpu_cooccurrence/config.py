@@ -14,7 +14,6 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import enum
-import sys
 import time
 from typing import Optional, Sequence
 

@@ -8,7 +8,7 @@ generator (1M items, alpha=1.1) — see SURVEY.md §6.
 from __future__ import annotations
 
 import os
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Tuple
 
 import numpy as np
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Iterable, Optional
 
 import numpy as np
 
@@ -30,7 +30,7 @@ from .metrics import (
 )
 from .io.parse import InteractionBatch
 from .sampling.item_cut import ItemInteractionCut
-from .sampling.reservoir import PairDeltaBatch, UserReservoirSampler
+from .sampling.reservoir import UserReservoirSampler
 from .sampling.sliding import SlidingBasketSampler
 from .observability import StepTimer, WindowStats, clock
 from .state.rescorer import HostRescorer, WindowTopK

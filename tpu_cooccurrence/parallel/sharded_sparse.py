@@ -35,7 +35,6 @@ process's chips own; restore requires the writing run's process layout.
 
 from __future__ import annotations
 
-import functools
 import os
 from typing import Dict, List, Optional, Tuple
 

@@ -20,7 +20,6 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from typing import Optional
 
 import numpy as np
 
