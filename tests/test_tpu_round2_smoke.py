@@ -1,0 +1,149 @@
+"""CPU smoke tests for the on-chip measurement machinery.
+
+The tpu_round2 passes only ever execute on a scarce TPU grant; an
+import error, renamed helper, or signature drift inside one would
+otherwise surface for the first time MID-GRANT and burn the session
+(the 2026-07-31 capture lost config4 to exactly this failure class,
+though that one was a transient backend error). These tests run the
+cheap machinery end to end on CPU — subprocess, exit codes, JSONL rows,
+env pinning — without the heavy measurement bodies.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_tunnel_probe_stage_end_to_end(tmp_path):
+    """The cheapest real pass runs as grant_watch would run it: own
+    subprocess, --only selection, exit 0, rows appended to the
+    overridden artifact (env + measurement), never the tracked file."""
+    out = tmp_path / "rounds.jsonl"
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_cooccurrence.bench.tpu_round2",
+         "--quick", "--only", "tunnel-probe"],
+        env=dict(ENV, TPU_ROUND2_OUT=str(out)),
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1500:]
+    rows = _read_jsonl(out)
+    names = [x["name"] for x in rows]
+    assert names == ["env", "tunnel-probe"]
+    probe = rows[1]
+    assert probe["ok"] is True
+    for key in ("sync_ms_per_dispatch", "enqueue_ms_per_dispatch",
+                "upload_256kb_ms", "upload_1024kb_ms",
+                "upload_4x256kb_ms", "fetch_320kb_ms"):
+        assert key in probe, key
+
+
+def test_env_row_only_with_tunnel_probe(tmp_path):
+    """Per-measurement stages must not spam one env row each into the
+    artifact: only the tunnel-probe stage (or a full run) writes it."""
+    out = tmp_path / "rounds.jsonl"
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_cooccurrence.bench.tpu_round2",
+         "--quick", "--only", "config4-headline"],
+        env=dict(ENV, TPU_ROUND2_OUT=str(out),
+                 TPU_COOC_SMOKE_EVENTS="2000"),
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1500:]
+    names = [x["name"] for x in _read_jsonl(out)]
+    assert names == ["config4-headline"]
+
+
+def test_smoke_events_ignored_off_cpu(monkeypatch):
+    """A stale TPU_COOC_SMOKE_EVENTS export must not shrink a grant
+    capture: the knob only applies on the cpu backend."""
+    import jax
+
+    from tpu_cooccurrence.bench import tpu_round2
+
+    monkeypatch.setenv("TPU_COOC_SMOKE_EVENTS", "2000")
+    assert tpu_round2._config4_events(quick=False) == 2_000  # cpu: honored
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert tpu_round2._config4_events(quick=False) == 1_000_000
+    assert tpu_round2._config4_events(quick=True) == 200_000
+
+
+def test_grant_watch_strips_smoke_env(monkeypatch, tmp_path):
+    """grant_watch stages never inherit the smoke/redirect knobs —
+    capture purity is owned by the watcher."""
+    from tpu_cooccurrence.bench import grant_watch
+
+    monkeypatch.setenv("TPU_COOC_SMOKE_EVENTS", "2000")
+    monkeypatch.setenv("TPU_ROUND2_OUT", "/tmp/nope.jsonl")
+    probe = tmp_path / "env.json"
+    cmd = [sys.executable, "-c",
+           "import json, os, sys; json.dump("
+           "{k: os.environ.get(k) for k in ('TPU_COOC_SMOKE_EVENTS',"
+           " 'TPU_ROUND2_OUT', 'PATH')}, open(sys.argv[1], 'w'))",
+           str(probe)]
+    assert grant_watch.run_stage(
+        "envprobe", cmd, 60.0, str(tmp_path / "w.jsonl")) == "ok"
+    env = json.loads(probe.read_text())
+    assert env["TPU_COOC_SMOKE_EVENTS"] is None
+    assert env["TPU_ROUND2_OUT"] is None
+    assert env["PATH"], "the rest of the environment must pass through"
+
+
+def test_config4_passes_pin_their_env(tmp_path, monkeypatch):
+    """config4-headline/-chunked must pin every A/B knob (ladder, fixed
+    shapes, BOTH chunk knobs) against ambient operator settings, and
+    restore them afterwards — contaminated arms decide hardware
+    defaults on garbage."""
+    from tpu_cooccurrence.bench import tpu_round2
+    from tpu_cooccurrence.bench import configs
+
+    monkeypatch.setattr(tpu_round2, "OUT", str(tmp_path / "o.jsonl"))
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNKS", "4")       # ambient
+    monkeypatch.setenv("TPU_COOC_UPLOAD_CHUNK_KB", "256")   # ambient
+    monkeypatch.setenv("TPU_COOC_SCORE_LADDER", "64")       # ambient
+    seen = []
+
+    class FakeResult:
+        pairs_per_sec = 123_456.0
+
+        def as_dict(self):
+            return {"name": "zipfian-1M-items", "pairs_per_sec": 123456.0,
+                    "events": 1}
+
+    def fake_config4(n_events):
+        seen.append({k: os.environ.get(k) for k in
+                     ("TPU_COOC_SCORE_LADDER", "TPU_COOC_FIXED_SCORE",
+                      "TPU_COOC_UPLOAD_CHUNKS",
+                      "TPU_COOC_UPLOAD_CHUNK_KB")})
+        return FakeResult()
+
+    monkeypatch.setattr(configs, "config4_zipfian_1m", fake_config4)
+    assert tpu_round2.config4_headline(True) is True   # guard returns ok
+    assert tpu_round2.config4_chunked(True) is True
+    # Two runs (warmup + measure) per pass.
+    assert len(seen) == 4
+    for env in seen[:2]:   # headline: the monolithic arm
+        assert env["TPU_COOC_UPLOAD_CHUNKS"] == "1"
+        assert env["TPU_COOC_UPLOAD_CHUNK_KB"] == "0"
+        assert env["TPU_COOC_SCORE_LADDER"] == "16"
+        assert env["TPU_COOC_FIXED_SCORE"] == "1"
+    for env in seen[2:]:   # chunked arm
+        assert env["TPU_COOC_UPLOAD_CHUNKS"] == "4"
+        assert env["TPU_COOC_SCORE_LADDER"] == "16"
+    # Operator settings restored.
+    assert os.environ["TPU_COOC_UPLOAD_CHUNKS"] == "4"
+    assert os.environ["TPU_COOC_UPLOAD_CHUNK_KB"] == "256"
+    assert os.environ["TPU_COOC_SCORE_LADDER"] == "64"
+    rows = _read_jsonl(tmp_path / "o.jsonl")
+    assert [r["name"] for r in rows] == ["config4-headline",
+                                        "config4-chunked"]
+    assert all(r["ok"] for r in rows)
+    # The measurement name owns the row; the inner BenchResult's name
+    # lands under "config".
+    assert rows[0]["config"] == "zipfian-1M-items"

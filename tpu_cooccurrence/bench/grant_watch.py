@@ -177,8 +177,13 @@ def run_stage(name: str, argv: Sequence[str], deadline_s: float,
     log_event({"event": "stage-start", "stage": name,
                "deadline_s": deadline_s}, log_path)
     start = time.monotonic()
+    # Capture purity: stale CPU-smoke-test exports must not shrink or
+    # redirect a scarce grant capture (TPU_COOC_SMOKE_EVENTS=2000 left
+    # over from test iteration would make every config4 row garbage).
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("TPU_COOC_SMOKE_EVENTS", "TPU_ROUND2_OUT")}
     try:
-        proc = subprocess.Popen(list(argv), cwd=REPO,
+        proc = subprocess.Popen(list(argv), cwd=REPO, env=env,
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True,
                                 start_new_session=True)

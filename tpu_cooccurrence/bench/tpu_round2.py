@@ -36,8 +36,12 @@ import sys
 import time
 import traceback
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "TPU_ROUND2.jsonl")
+#: TPU_ROUND2_OUT overrides the artifact path — for CPU smoke tests of
+#: the measurement machinery (which must not bitrot between grants, nor
+#: pollute the tracked JSONL with CPU rows).
+OUT = os.environ.get("TPU_ROUND2_OUT") or os.path.join(
+    os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "TPU_ROUND2.jsonl")
 
 
 def emit(obj: dict) -> None:
@@ -103,7 +107,7 @@ def config5_sparse(quick: bool) -> dict:
 def config4_sparse(quick: bool) -> dict:
     from .configs import config4_zipfian_1m
 
-    n = 200_000 if quick else 1_000_000
+    n = _config4_events(quick)
     # Two-axis sweep: score ladder x fixed-shape scoring. With fixed
     # shapes ON (the TPU default) every bucket pads to its constant
     # rectangle, so the ladder only decides the bucket set; the
@@ -148,6 +152,26 @@ def _env_overrides(**overrides: str):
                 os.environ[k] = v
 
 
+def _config4_events(quick: bool) -> int:
+    """Event count for the config-4 passes. TPU_COOC_SMOKE_EVENTS
+    shrinks it for CPU smoke tests of the measurement machinery (which
+    must not bitrot between grants). On an accelerator backend the
+    knob is IGNORED with a warning: a stale export must not shrink a
+    scarce grant capture into garbage rows (grant_watch additionally
+    strips it from stage env). Every row records its ``events``
+    regardless."""
+    smoke = os.environ.get("TPU_COOC_SMOKE_EVENTS")
+    if smoke:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return max(1_000, int(smoke))
+        print(f"tpu_round2: ignoring TPU_COOC_SMOKE_EVENTS={smoke} on "
+              f"backend {jax.default_backend()!r} — smoke sizes would "
+              "corrupt a grant capture", file=sys.stderr)
+    return 200_000 if quick else 1_000_000
+
+
 def _config4_single(quick: bool, mode_label: str, **extra_env: str) -> dict:
     """One warmup + one measured run of config 4 in L16/fixed mode.
 
@@ -156,7 +180,7 @@ def _config4_single(quick: bool, mode_label: str, **extra_env: str) -> dict:
     the upload comparison."""
     from .configs import config4_zipfian_1m
 
-    n = 200_000 if quick else 1_000_000
+    n = _config4_events(quick)
     env = dict(TPU_COOC_SCORE_LADDER="16", TPU_COOC_FIXED_SCORE="1",
                TPU_COOC_UPLOAD_CHUNKS="1", TPU_COOC_UPLOAD_CHUNK_KB="0")
     env.update(extra_env)
