@@ -25,9 +25,9 @@ def test_sliding_assignment_scalar():
 
 def test_sliding_assignment_vectorized_matches_scalar():
     w = SlidingWindows(12, 4)
-    ts = np.arange(0, 40)
+    ts = np.arange(-40, 40)   # spans pre-epoch: floors must agree too
     batch = w.assign(ts)
-    assert batch.shape == (40, 3)
+    assert batch.shape == (80, 3)
     for pos, t in enumerate(ts.tolist()):
         assert sorted(batch[pos].tolist()) == sorted(w.assign_scalar(t))
 
@@ -35,6 +35,24 @@ def test_sliding_assignment_vectorized_matches_scalar():
 def test_sliding_requires_divisible():
     with pytest.raises(ValueError):
         SlidingWindows(10, 3)
+
+
+def test_negative_timestamps_assign_floored():
+    """Event time is a raw long in the reference (pre-epoch timestamps
+    are legal CSV input); window starts must floor toward -inf, not
+    truncate toward zero — Python/numpy // both floor, matching
+    Flink's TimeWindow.getWindowStartWithOffset."""
+    w = TumblingWindows(10)
+    np.testing.assert_array_equal(
+        w.assign(np.array([-1, -10, -11, -25, 0])),
+        [-10, -10, -20, -30, 0])
+    assert w.assign_scalar(-1) == [-10]
+    assert w.max_timestamp(-10) == -1
+    s = SlidingWindows(10, 5)
+    # ts=-3 is inside [-5,5) and [-10,0). (The batch-vs-scalar sweep
+    # over negatives lives in
+    # test_sliding_assignment_vectorized_matches_scalar.)
+    assert sorted(s.assign_scalar(-3)) == [-10, -5]
 
 
 def test_engine_fires_in_order_and_drops_late():
