@@ -10,6 +10,8 @@ import json
 import os
 import sys
 
+import pytest
+
 from tpu_cooccurrence.bench import grant_watch
 
 
@@ -122,6 +124,23 @@ def test_failed_measurement_with_live_grant_still_completes(
     assert done[0]["complete"] is True
     assert done[0]["failed_stages"] == ["tpu_round2:bad-measurement"]
     assert "grant-lost" not in [e["event"] for e in _read_log(log)]
+
+
+def test_second_watcher_refuses_to_start(monkeypatch, tmp_path):
+    """Two watchers would race duplicate captures on the scarce chip;
+    the second instance must fail fast while the lock is held."""
+    import fcntl
+
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-cpu')")
+    log = str(tmp_path / "w.jsonl")
+    holder = open(log + ".lock", "w")
+    fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    with pytest.raises(SystemExit, match="another grant_watch"):
+        grant_watch.watch(interval_s=0, probe_timeout_s=60,
+                          max_cycles=1, log_path=log, stages=[])
+    holder.close()   # released: now it can start
+    assert grant_watch.watch(interval_s=0, probe_timeout_s=60,
+                             max_cycles=1, log_path=log, stages=[]) == 0
 
 
 def test_recapture_cooldown_pauses_chip_stages(monkeypatch, tmp_path):

@@ -38,6 +38,7 @@ at FlinkCooccurrences.java:173-181 (Duration + accumulator dump).
 from __future__ import annotations
 
 import argparse
+import fcntl
 import json
 import os
 import re
@@ -282,6 +283,34 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
     passes on a shared chip. Incomplete sessions retry immediately
     (headline-first order makes the retry cheap).
     """
+    # Single-watcher lock: two watchers would race duplicate capture
+    # sessions on the scarce chip. Held for the watch's lifetime and
+    # released in the finally below; a second instance fails fast.
+    # Mode "a": a failed second start must not truncate the holder's
+    # recorded PID (an operator reads it to find who holds the lock).
+    lock_path = log_path + ".lock"
+    lock_file = open(lock_path, "a")
+    try:
+        fcntl.flock(lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        lock_file.close()
+        raise SystemExit(
+            f"another grant_watch holds {lock_path}; refusing to start "
+            "a second watcher (duplicate captures would race the chip)")
+    lock_file.truncate(0)
+    lock_file.write(f"{os.getpid()}\n")
+    lock_file.flush()
+    try:
+        return _watch_locked(
+            interval_s, probe_timeout_s, max_cycles, quick, max_captures,
+            log_path, stages, heartbeat_every, recapture_cooldown_s)
+    finally:
+        lock_file.close()  # releases the flock
+
+
+def _watch_locked(interval_s, probe_timeout_s, max_cycles, quick,
+                  max_captures, log_path, stages, heartbeat_every,
+                  recapture_cooldown_s) -> int:
     captures = 0
     sessions = 0
     cycle = 0
