@@ -126,6 +126,147 @@ def test_failed_measurement_with_live_grant_still_completes(
     assert "grant-lost" not in [e["event"] for e in _read_log(log)]
 
 
+def test_transient_failure_retried_once_then_captured(
+        monkeypatch, tmp_path):
+    """VERDICT r4 Next #2: a stage failing with a transient error
+    signature (the 2026-07-31 `UNAVAILABLE` class) while the liveness
+    probe stays green is retried with backoff; fail-once-then-succeed
+    means one retry and a completed capture."""
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    attempts = tmp_path / "attempts"
+    flaky_cmd = [sys.executable, "-c", (
+        f"import os, sys\n"
+        f"p = {str(attempts)!r}\n"
+        f"n = len(open(p).read()) if os.path.exists(p) else 0\n"
+        f"open(p, 'a').write('x')\n"
+        f"if n == 0:\n"
+        f"    sys.stderr.write('UNAVAILABLE: TPU backend setup/compile "
+        f"error\\n'); sys.exit(1)\n"
+        f"print('captured')")]
+    log = str(tmp_path / "watch.jsonl")
+    captures = grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_captures=1, log_path=log,
+        stages=[("tpu_round2:flaky", flaky_cmd, 60.0)],
+        stage_retries=2, retry_backoff_s=0.0)
+    assert captures == 1
+    assert attempts.read_text() == "xx", "exactly one retry"
+    events = [e["event"] for e in _read_log(log)]
+    assert events.count("stage-retry") == 1
+    retry = [e for e in _read_log(log) if e["event"] == "stage-retry"][0]
+    assert retry["stage"] == "tpu_round2:flaky"
+    assert retry["attempt"] == 1
+    done = [e for e in _read_log(log) if e["event"] == "capture-done"][0]
+    assert done["complete"] is True
+    assert "failed_stages" not in done, "retried-to-success is a success"
+
+
+def test_transient_failure_always_failing_moves_on(monkeypatch, tmp_path):
+    """Fail-always exhausts the bounded retries and moves on — the
+    retry loop must not wedge a session on one broken measurement."""
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    attempts = tmp_path / "attempts"
+    fail_cmd = [sys.executable, "-c", (
+        f"import sys; open({str(attempts)!r}, 'a').write('x'); "
+        f"sys.stderr.write('UNAVAILABLE: transient\\n'); sys.exit(1)")]
+    after = tmp_path / "after-ran"
+    after_cmd = [sys.executable, "-c",
+                 f"open({str(after)!r}, 'w').close()"]
+    log = str(tmp_path / "watch.jsonl")
+    captures = grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
+        stages=[("tpu_round2:always-bad", fail_cmd, 60.0),
+                ("next", after_cmd, 60.0)],
+        stage_retries=2, retry_backoff_s=0.0)
+    assert attempts.read_text() == "xxx", "initial run + 2 retries"
+    assert after.exists(), "later stages still run after giving up"
+    assert captures == 1, ("exhausted-retry measurement failure is a "
+                           "recorded result, not a voided session")
+    done = [e for e in _read_log(log) if e["event"] == "capture-done"][0]
+    assert done["failed_stages"] == ["tpu_round2:always-bad"]
+
+
+def test_deterministic_failure_not_retried(monkeypatch, tmp_path):
+    """A nonzero exit WITHOUT a transient marker (assertion, shape bug)
+    must not burn grant time on retries that cannot succeed."""
+    monkeypatch.setattr(grant_watch, "PROBE_CODE", "print('GRANT-tpu')")
+    attempts = tmp_path / "attempts"
+    fail_cmd = [sys.executable, "-c", (
+        f"import sys; open({str(attempts)!r}, 'a').write('x'); "
+        f"sys.stderr.write('AssertionError: rows diverged\\n'); "
+        f"sys.exit(1)")]
+    log = str(tmp_path / "watch.jsonl")
+    grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
+        stages=[("tpu_round2:det-bad", fail_cmd, 60.0)],
+        stage_retries=2, retry_backoff_s=0.0)
+    assert attempts.read_text() == "x", "no retries"
+    assert "stage-retry" not in [e["event"] for e in _read_log(log)]
+
+
+def test_transient_failure_with_dead_tunnel_not_retried(
+        monkeypatch, tmp_path):
+    """Retry is gated on a green liveness probe: a transient failure
+    whose re-probe shows the grant gone skips the retry (and the
+    session records grant-lost as before)."""
+    flag = tmp_path / "grant-up"
+    flag.write_text("1")
+    monkeypatch.setattr(
+        grant_watch, "PROBE_CODE",
+        f"import os; print('GRANT-tpu' if os.path.exists({str(flag)!r}) "
+        f"else 'GRANT-cpu')")
+    attempts = tmp_path / "attempts"
+    die_cmd = [sys.executable, "-c", (
+        f"import os, sys; open({str(attempts)!r}, 'a').write('x'); "
+        f"os.remove({str(flag)!r}); "
+        f"sys.stderr.write('UNAVAILABLE: tunnel died\\n'); sys.exit(1)")]
+    log = str(tmp_path / "watch.jsonl")
+    grant_watch.watch(
+        interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
+        stages=[("tpu_round2:died", die_cmd, 60.0)],
+        stage_retries=2, retry_backoff_s=0.0)
+    assert attempts.read_text() == "x", "no retry on a dead tunnel"
+    events = [e["event"] for e in _read_log(log)]
+    assert "stage-retry" not in events
+    assert "grant-lost" in events
+
+
+def test_is_transient_failure_markers():
+    assert grant_watch.is_transient_failure(
+        "jaxlib...: UNAVAILABLE: TPU backend setup/compile error")
+    assert grant_watch.is_transient_failure("DEADLINE_EXCEEDED: rpc")
+    assert grant_watch.is_transient_failure("Socket closed")
+    assert not grant_watch.is_transient_failure("AssertionError: boom")
+    assert not grant_watch.is_transient_failure("")
+    assert not grant_watch.is_transient_failure(None)
+
+
+def test_capture_env_scrubs_measurement_knobs(monkeypatch, tmp_path):
+    """ADVICE r4: stale operator exports of the upload-chunk and
+    score-mode knobs must not reach capture stages — they would
+    silently re-pin what the unpinned passes measure."""
+    for k in ("TPU_COOC_SMOKE_EVENTS", "TPU_ROUND2_OUT",
+              "TPU_COOC_UPLOAD_CHUNKS", "TPU_COOC_UPLOAD_CHUNK_KB",
+              "TPU_COOC_SCORE_LADDER", "TPU_COOC_FIXED_SCORE"):
+        monkeypatch.setenv(k, "stale")
+    monkeypatch.setenv("TPU_COOC_HARMLESS", "kept")
+    seen = tmp_path / "env.json"
+    dump_cmd = [sys.executable, "-c", (
+        "import json, os; "
+        f"json.dump({{k: v for k, v in os.environ.items() "
+        f"if k.startswith('TPU_')}}, open({str(seen)!r}, 'w'))")]
+    status, _err = grant_watch.run_stage(
+        "dump-env", dump_cmd, 60.0, str(tmp_path / "w.jsonl"))
+    assert status == "ok"
+    env = json.loads(seen.read_text())
+    assert "TPU_COOC_SMOKE_EVENTS" not in env
+    assert "TPU_ROUND2_OUT" not in env
+    assert "TPU_COOC_UPLOAD_CHUNKS" not in env
+    assert "TPU_COOC_UPLOAD_CHUNK_KB" not in env
+    assert "TPU_COOC_SCORE_LADDER" not in env
+    assert "TPU_COOC_FIXED_SCORE" not in env
+    assert env.get("TPU_COOC_HARMLESS") == "kept"
+
+
 def test_second_watcher_refuses_to_start(monkeypatch, tmp_path):
     """Two watchers would race duplicate captures on the scarce chip;
     the second instance must fail fast while the lock is held."""

@@ -39,6 +39,11 @@ def test_tunnel_probe_stage_end_to_end(tmp_path):
     assert names == ["env", "tunnel-probe"]
     probe = rows[1]
     assert probe["ok"] is True
+    # ADVICE r4: every measurement row carries its own platform tag so a
+    # row from a session whose tunnel-probe stage was skipped can still
+    # be told apart from an accidental CPU run. Distinct key from the
+    # job-backend "backend" field some measurements also record.
+    assert probe["jax_platform"] == "cpu"
     for key in ("sync_ms_per_dispatch", "enqueue_ms_per_dispatch",
                 "upload_256kb_ms", "upload_1024kb_ms",
                 "upload_4x256kb_ms", "fetch_320kb_ms"):
@@ -87,8 +92,9 @@ def test_grant_watch_strips_smoke_env(monkeypatch, tmp_path):
            "{k: os.environ.get(k) for k in ('TPU_COOC_SMOKE_EVENTS',"
            " 'TPU_ROUND2_OUT', 'PATH')}, open(sys.argv[1], 'w'))",
            str(probe)]
-    assert grant_watch.run_stage(
-        "envprobe", cmd, 60.0, str(tmp_path / "w.jsonl")) == "ok"
+    status, _err = grant_watch.run_stage(
+        "envprobe", cmd, 60.0, str(tmp_path / "w.jsonl"))
+    assert status == "ok"
     env = json.loads(probe.read_text())
     assert env["TPU_COOC_SMOKE_EVENTS"] is None
     assert env["TPU_ROUND2_OUT"] is None
@@ -110,7 +116,8 @@ def test_stage_priority_and_load_provenance(tmp_path):
            "f'{os.nice(0)} {os.getpgrp() == os.getpid()}')",
            str(out)]
     log = tmp_path / "w.jsonl"
-    assert grant_watch.run_stage("nice-probe", cmd, 60.0, str(log)) == "ok"
+    status, _err = grant_watch.run_stage("nice-probe", cmd, 60.0, str(log))
+    assert status == "ok"
     niceness, own_group = out.read_text().split()
     assert own_group == "True", "stage must lead its own process group"
     # Root uid alone does not imply renice permission (CAP_SYS_NICE);
@@ -148,7 +155,7 @@ def test_config4_passes_pin_their_env(tmp_path, monkeypatch):
 
         def as_dict(self):
             return {"name": "zipfian-1M-items", "pairs_per_sec": 123456.0,
-                    "events": 1}
+                    "events": 1, "backend": "sparse"}
 
     def fake_config4(n_events):
         seen.append({k: os.environ.get(k) for k in
@@ -181,3 +188,10 @@ def test_config4_passes_pin_their_env(tmp_path, monkeypatch):
     # The measurement name owns the row; the inner BenchResult's name
     # lands under "config".
     assert rows[0]["config"] == "zipfian-1M-items"
+    # The JOB backend field (summarize.py keys on it) must survive the
+    # platform tag — distinct keys, neither shadowing the other.
+    import jax
+
+    jax.devices()  # platform tag reads the cached backend
+    assert rows[0]["backend"] == "sparse"
+    assert tpu_round2._backend_tag() == {"jax_platform": "cpu"}

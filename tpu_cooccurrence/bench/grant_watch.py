@@ -174,15 +174,35 @@ def _boost_stage_priority(pid: int) -> None:
         pass  # not privileged (needs CAP_SYS_NICE): normal priority
 
 
+#: Error-text markers that identify a TRANSIENT on-chip failure — the
+#: recorded 2026-07-31 class (`UNAVAILABLE: TPU backend setup/compile
+#: error` arriving while the tunnel probe stayed green) plus its
+#: grpc-status siblings. Deliberately narrow: a deterministic failure
+#: (shape bug, assertion) must not be retried on scarce grant time.
+TRANSIENT_ERROR_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "Socket closed",
+    "Connection reset", "failed to connect",
+)
+
+
+def is_transient_failure(stderr_tail: str) -> bool:
+    """True iff a failed stage's stderr looks like a transient
+    tunnel/backend error worth retrying while the probe is green."""
+    return any(m in (stderr_tail or "") for m in TRANSIENT_ERROR_MARKERS)
+
+
 def run_stage(name: str, argv: Sequence[str], deadline_s: float,
-              log_path: str = LOG_PATH) -> str:
+              log_path: str = LOG_PATH) -> Tuple[str, str]:
     """Run one capture stage under a hard deadline; never raises.
 
-    Returns a status string: ``"ok"`` (exit 0), ``"failed"`` (ran to
-    completion with a nonzero exit — e.g. tpu_round2 recording a failed
-    measurement), ``"timeout"`` (deadline kill), ``"error"`` (could not
-    spawn). The caller treats failed differently from timed-out: a
-    failure is a recorded result, a timeout is a truncated session.
+    Returns ``(status, stderr_tail)``. Status is ``"ok"`` (exit 0),
+    ``"failed"`` (ran to completion with a nonzero exit — e.g.
+    tpu_round2 recording a failed measurement), ``"timeout"`` (deadline
+    kill), ``"error"`` (could not spawn). The caller treats failed
+    differently from timed-out: a failure is a recorded result, a
+    timeout is a truncated session. The stderr tail lets the caller
+    classify a failure as transient (``is_transient_failure``) for the
+    bounded retry path.
 
     The stage runs in its own process group and a timeout kills the
     WHOLE group — stages like bench.py spawn measurement grandchildren
@@ -198,11 +218,19 @@ def run_stage(name: str, argv: Sequence[str], deadline_s: float,
     log_event({"event": "stage-start", "stage": name,
                "deadline_s": deadline_s, "load1": load1}, log_path)
     start = time.monotonic()
-    # Capture purity: stale CPU-smoke-test exports must not shrink or
-    # redirect a scarce grant capture (TPU_COOC_SMOKE_EVENTS=2000 left
-    # over from test iteration would make every config4 row garbage).
+    # Capture purity: stale operator exports must not shrink, redirect,
+    # or silently re-pin a scarce grant capture (TPU_COOC_SMOKE_EVENTS
+    # =2000 left over from test iteration would make every config4 row
+    # garbage; a leftover TPU_COOC_UPLOAD_CHUNK_KB would change what the
+    # unpinned passes measure while summarize compares them against the
+    # pinned A/B arms). The A/B passes re-pin their own arms explicitly,
+    # so stripping the knobs here is always safe.
     env = {k: v for k, v in os.environ.items()
-           if k not in ("TPU_COOC_SMOKE_EVENTS", "TPU_ROUND2_OUT")}
+           if k not in ("TPU_COOC_SMOKE_EVENTS", "TPU_ROUND2_OUT",
+                        "TPU_COOC_UPLOAD_CHUNKS",
+                        "TPU_COOC_UPLOAD_CHUNK_KB",
+                        "TPU_COOC_SCORE_LADDER",
+                        "TPU_COOC_FIXED_SCORE")}
     try:
         proc = subprocess.Popen(list(argv), cwd=REPO, env=env,
                                 stdout=subprocess.PIPE,
@@ -211,7 +239,7 @@ def run_stage(name: str, argv: Sequence[str], deadline_s: float,
     except OSError as exc:
         log_event({"event": "stage-error", "stage": name, "ok": False,
                    "error": repr(exc)}, log_path)
-        return "error"
+        return "error", repr(exc)
     _boost_stage_priority(proc.pid)
     try:
         out, err = proc.communicate(timeout=deadline_s)
@@ -223,15 +251,16 @@ def run_stage(name: str, argv: Sequence[str], deadline_s: float,
         proc.communicate()
         log_event({"event": "stage-timeout", "stage": name, "ok": False,
                    "wall_s": round(time.monotonic() - start, 1)}, log_path)
-        return "timeout"
+        return "timeout", ""
     ok = proc.returncode == 0
+    err_tail = (err or "")[-2000:]
     log_event({"event": "stage-end", "stage": name, "ok": ok,
                "rc": proc.returncode,
                "wall_s": round(time.monotonic() - start, 1),
                "stdout_tail": (out or "")[-2000:],
-               **({} if ok else {"stderr_tail": (err or "")[-2000:]})},
+               **({} if ok else {"stderr_tail": err_tail})},
               log_path)
-    return "ok" if ok else "failed"
+    return ("ok" if ok else "failed"), err_tail
 
 
 #: Usable-capture contract: groups of alternative headline stages. If a
@@ -255,7 +284,10 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
           log_path: str = LOG_PATH,
           stages: Optional[List[Tuple[str, List[str], float]]] = None,
           heartbeat_every: int = 12,
-          recapture_cooldown_s: float = 3600.0) -> int:
+          recapture_cooldown_s: float = 3600.0,
+          stage_retries: int = 2,
+          retry_backoff_s: float = 20.0,
+          liveness_timeout_s: float = 60.0) -> int:
     """The watch loop. Returns the number of COMPLETE capture sessions.
 
     Complete = every stage RAN to completion under its deadline and the
@@ -282,6 +314,27 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
     must not be hammered with back-to-back duplicate 1-2 h capture
     passes on a shared chip. Incomplete sessions retry immediately
     (headline-first order makes the retry cheap).
+
+    ``stage_retries``/``retry_backoff_s``: a chip stage that FAILS with
+    a transient error signature (``is_transient_failure`` — the
+    2026-07-31 `UNAVAILABLE` class) while an immediate liveness probe
+    still sees the grant is retried up to ``stage_retries`` times with
+    linear backoff, instead of being recorded as the session's only
+    attempt. Deterministic failures (no transient marker) and timeouts
+    are never retried — grant minutes are the scarce resource.
+
+    ``liveness_timeout_s``: deadline for the cheap BETWEEN-stage probes
+    (post-failure re-probe, retry gating). Deliberately much shorter
+    than ``probe_timeout_s``: the 240 s default exists for a cold
+    grant's first contact, but mid-session a healthy tunnel usually
+    answers in seconds — a session with many deterministically-failing
+    stages must not burn ~40 min of grant time on inter-stage probes
+    alone. Because each probe is a fresh interpreter whose handshake
+    CAN legitimately outlast the short deadline (busy shared chip,
+    tunnel-speed first compile), a failed quick probe is never enough
+    to void a session: the grant-lost decision re-confirms with the
+    full ``probe_timeout_s`` before skipping the remaining chip stages.
+    A failed quick probe merely skips an optional retry.
     """
     # Single-watcher lock: two watchers would race duplicate capture
     # sessions on the scarce chip. Held for the watch's lifetime and
@@ -303,14 +356,16 @@ def watch(interval_s: float = 300.0, probe_timeout_s: float = 240.0,
     try:
         return _watch_locked(
             interval_s, probe_timeout_s, max_cycles, quick, max_captures,
-            log_path, stages, heartbeat_every, recapture_cooldown_s)
+            log_path, stages, heartbeat_every, recapture_cooldown_s,
+            stage_retries, retry_backoff_s, liveness_timeout_s)
     finally:
         lock_file.close()  # releases the flock
 
 
 def _watch_locked(interval_s, probe_timeout_s, max_cycles, quick,
                   max_captures, log_path, stages, heartbeat_every,
-                  recapture_cooldown_s) -> int:
+                  recapture_cooldown_s, stage_retries, retry_backoff_s,
+                  liveness_timeout_s) -> int:
     captures = 0
     sessions = 0
     cycle = 0
@@ -338,8 +393,35 @@ def _watch_locked(interval_s, probe_timeout_s, max_cycles, quick,
                 needs_grant = stage[3] if len(stage) > 3 else True
                 if lost and needs_grant:
                     continue  # don't burn chip stages on a dead tunnel
-                status = statuses[name] = run_stage(name, argv, deadline,
-                                                    log_path)
+                status, err_tail = run_stage(name, argv, deadline,
+                                             log_path)
+                # Bounded retry of TRANSIENT chip failures while the
+                # grant is demonstrably still up: the 2026-07-31 session
+                # lost its only config-4 attempt to one `UNAVAILABLE`
+                # compile error that a single retry would have cleared
+                # (the probe was green seconds later). Deterministic
+                # failures and timeouts are not retried. quick_probe
+                # carries the most recent liveness result forward so the
+                # grant-lost check below doesn't immediately re-hang on
+                # a tunnel a gate probe just found dead.
+                attempt = 0
+                quick_probe = None  # None = no probe since last run
+                while (status == "failed" and needs_grant
+                       and attempt < stage_retries
+                       and is_transient_failure(err_tail)):
+                    quick_probe = probe_once(liveness_timeout_s)
+                    if not quick_probe:
+                        break  # not demonstrably up: skip the retry
+                    attempt += 1
+                    backoff = retry_backoff_s * attempt
+                    log_event({"event": "stage-retry", "stage": name,
+                               "attempt": attempt,
+                               "backoff_s": backoff}, log_path)
+                    time.sleep(backoff)
+                    status, err_tail = run_stage(name, argv, deadline,
+                                                 log_path)
+                    quick_probe = None  # stale after another stage run
+                statuses[name] = status
                 if status in ("timeout", "error"):
                     truncated = True  # hung or unrunnable: not a result
                 elif status == "failed" and not name.startswith(
@@ -350,14 +432,27 @@ def _watch_locked(interval_s, probe_timeout_s, max_cycles, quick,
                     # bench.py or summarize means the session's
                     # deliverable is missing.
                     truncated = True
-                if status != "ok" and needs_grant and not probe_once(
-                        probe_timeout_s):
-                    # Stage failed AND the tunnel is gone: skip the
-                    # remaining chip stages; offline stages (e.g. the
-                    # summary rewrite) still run on the partial capture.
-                    log_event({"event": "grant-lost", "cycle": cycle},
-                              log_path)
-                    lost = True
+                if status != "ok" and needs_grant:
+                    # Grant-lost check, two-tier: reuse the retry gate's
+                    # probe when fresh, else a quick probe; a negative
+                    # is re-confirmed with the full cold-contact timeout
+                    # before voiding — a fresh probe interpreter's
+                    # handshake can outlast the quick deadline on a
+                    # perfectly healthy grant, and wrongly skipping the
+                    # remaining chip stages costs the whole session.
+                    alive = quick_probe
+                    if alive is None:
+                        alive = probe_once(liveness_timeout_s)
+                    if not alive:
+                        alive = probe_once(probe_timeout_s)
+                    if not alive:
+                        # Stage failed AND the tunnel is gone: skip the
+                        # remaining chip stages; offline stages (the
+                        # summary rewrite) still run on the partial
+                        # capture.
+                        log_event({"event": "grant-lost",
+                                   "cycle": cycle}, log_path)
+                        lost = True
             sessions += 1
             # Headline contract: a group that ran but produced no
             # success (e.g. a transient UNAVAILABLE on every config-4
@@ -471,6 +566,16 @@ def main() -> None:
     ap.add_argument("--recapture-cooldown", type=float, default=3600.0,
                     help="seconds to pause chip stages after a COMPLETE "
                          "capture while the grant stays up (default 3600)")
+    ap.add_argument("--stage-retries", type=int, default=2,
+                    help="max retries of a transiently-failed chip stage "
+                         "while the liveness probe stays green (default 2)")
+    ap.add_argument("--retry-backoff", type=float, default=20.0,
+                    help="linear backoff base between stage retries, "
+                         "seconds (default 20)")
+    ap.add_argument("--liveness-timeout", type=float, default=60.0,
+                    help="deadline for cheap between-stage liveness "
+                         "probes (default 60; the full --probe-timeout "
+                         "covers only cold first contact)")
     args = ap.parse_args()
     if args.status:
         print(json.dumps(status()))
@@ -478,7 +583,10 @@ def main() -> None:
     watch(interval_s=args.interval, probe_timeout_s=args.probe_timeout,
           max_cycles=1 if args.once else args.max_cycles,
           max_captures=args.max_captures, quick=args.quick,
-          recapture_cooldown_s=args.recapture_cooldown)
+          recapture_cooldown_s=args.recapture_cooldown,
+          stage_retries=args.stage_retries,
+          retry_backoff_s=args.retry_backoff,
+          liveness_timeout_s=args.liveness_timeout)
 
 
 if __name__ == "__main__":

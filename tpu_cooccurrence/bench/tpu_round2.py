@@ -51,6 +51,29 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def _backend_tag() -> dict:
+    """Per-row platform provenance: grant_watch runs each measurement as
+    its own `--only` subprocess, so the one-per-session env row may not
+    exist in the same process (or at all, if tunnel-probe was skipped) —
+    without this tag a row can't be told apart from an accidental CPU
+    run. The key is ``jax_platform``, NOT ``backend``: several
+    measurement dicts already carry a ``backend`` field meaning the
+    *job* backend ("sparse", "device-int16", ...) which summarize.py
+    keys on — the platform tag must neither be shadowed by it nor
+    shadow it. Reads only jax's CACHED default backend: triggering a
+    first backend init here (e.g. in the error path of a measurement
+    that died before any dispatch, on a now-dead tunnel) could hang
+    past the stage deadline and convert a recorded failure into a
+    voided session. Uninitialized ⇒ no tag, honestly."""
+    try:
+        from jax._src import xla_bridge
+
+        backend = xla_bridge._default_backend  # cached; None if uninit
+        return {} if backend is None else {"jax_platform": backend.platform}
+    except Exception:  # pragma: no cover - private-API drift
+        return {}
+
+
 def guard(name: str):
     def deco(fn):
         def run(*a, **k):
@@ -63,11 +86,12 @@ def guard(name: str):
                 # "zipfian-1M-items"; summarize.py accepts both).
                 if "name" in res:
                     res["config"] = res.pop("name")
-                emit({"name": name, "ok": True,
+                emit({"name": name, "ok": True, **_backend_tag(),
                       "wall_s": round(time.monotonic() - start, 1), **res})
                 return True
             except Exception as exc:  # record and continue the pass
-                emit({"name": name, "ok": False, "error": repr(exc),
+                emit({"name": name, "ok": False, **_backend_tag(),
+                      "error": repr(exc),
                       "trace": traceback.format_exc()[-1500:]})
                 return False
         return run
