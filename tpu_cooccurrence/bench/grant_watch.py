@@ -409,15 +409,21 @@ def _watch_locked(interval_s, probe_timeout_s, max_cycles, quick,
                 while (status == "failed" and needs_grant
                        and attempt < stage_retries
                        and is_transient_failure(err_tail)):
+                    attempt += 1
+                    backoff = retry_backoff_s * attempt
+                    # Backoff FIRST, probe second: a probe taken before
+                    # the sleep is backoff-seconds stale by launch time,
+                    # and a retry launched onto a tunnel that died
+                    # during the sleep hangs to the stage deadline —
+                    # converting a recorded failure into a voided
+                    # session, strictly worse than not retrying.
+                    time.sleep(backoff)
                     quick_probe = probe_once(liveness_timeout_s)
                     if not quick_probe:
                         break  # not demonstrably up: skip the retry
-                    attempt += 1
-                    backoff = retry_backoff_s * attempt
                     log_event({"event": "stage-retry", "stage": name,
                                "attempt": attempt,
                                "backoff_s": backoff}, log_path)
-                    time.sleep(backoff)
                     status, err_tail = run_stage(name, argv, deadline,
                                                  log_path)
                     quick_probe = None  # stale after another stage run
