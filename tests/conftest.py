@@ -32,3 +32,29 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Fast lane by default (VERDICT r4 Next #8): the soak / sweep /
+    multihost / pallas-rect surfaces are minutes each, pushing the
+    default suite past CI-feedback territory. They are deselected
+    unless the round gate opts back in (``TPU_COOC_FULL_SUITE=1``) or
+    the operator's own selection must win: an explicit ``-m``/``-k``
+    expression, or a selection consisting ENTIRELY of slow tests
+    (``pytest tests/test_multihost.py`` means run exactly those — while
+    the driver's ``pytest tests/`` still gets the fast lane because the
+    collection is mixed)."""
+    if os.environ.get("TPU_COOC_FULL_SUITE", "").lower() in (
+            "1", "true", "yes"):
+        return
+    if config.getoption("-m") or config.getoption("-k"):
+        return
+    kept = [i for i in items if "slow" not in i.keywords]
+    if not kept:
+        return  # everything named is slow: the operator asked for it
+    deselected = [i for i in items if "slow" in i.keywords]
+    if deselected:
+        config.hook.pytest_deselected(items=deselected)
+        items[:] = kept

@@ -88,22 +88,23 @@ def test_bench_env_path_selection(monkeypatch):
     from tpu_cooccurrence.bench import configs
 
     monkeypatch.setenv("MOVIELENS_100K", UDATA)
-    users, items, ts, standin = configs._movielens_100k()
-    assert standin is False and len(users) == 30
+    users, items, ts, model = configs._movielens_100k()
+    assert model is None and len(users) == 30
 
     monkeypatch.setenv("MOVIELENS_25M", RATINGS)
-    users, items, ts, standin = configs._movielens_25m(limit=20)
-    assert standin is False and len(users) == 20
+    users, items, ts, model = configs._movielens_25m(limit=20)
+    assert model is None and len(users) == 20
 
     monkeypatch.setenv("INSTACART_ORDERS", ORDERS)
     monkeypatch.setenv("INSTACART_ORDER_PRODUCTS", ORDER_PRODUCTS)
-    users, items, ts, standin = configs._instacart()
-    assert standin is False and len(users) == 26
+    users, items, ts, model = configs._instacart()
+    assert model is None and len(users) == 26
 
-    # Missing path -> stand-in, clearly labeled.
+    # Missing path -> stand-in, labeled with the generator model (the
+    # helper that picks the generator owns the provenance label).
     monkeypatch.setenv("MOVIELENS_100K", "/nonexistent/u.data")
-    *_ignore, standin = configs._movielens_100k()
-    assert standin is True
+    *_ignore, model = configs._movielens_100k()
+    assert model == "calibrated-v1"
 
 
 def test_bench_config_runs_real_fixture(monkeypatch):

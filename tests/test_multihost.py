@@ -27,6 +27,10 @@ from tpu_cooccurrence.config import Backend, Config
 
 from test_pipeline import random_stream, run_production
 
+# Two-process coordinated runs: minutes of wall-clock. Slow lane
+# (deselected by default; TPU_COOC_FULL_SUITE=1 selects it back in).
+pytestmark = pytest.mark.slow
+
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
 STREAM_KW = dict(window_size=10, seed=0x51AB, item_cut=6, user_cut=4,

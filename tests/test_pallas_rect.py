@@ -19,6 +19,10 @@ from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
 from tpu_cooccurrence.state.sparse_scorer import (SparseDeviceScorer,
                                                   _score_rect)
 
+# Interpret-mode Pallas across meshes: minutes of wall-clock. Slow lane
+# (deselected by default; TPU_COOC_FULL_SUITE=1 selects it back in).
+pytestmark = pytest.mark.slow
+
 
 def _random_slab(rng, n_rows, num_items, R, zero_frac=0.1,
                  count_hi=50):

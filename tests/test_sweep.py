@@ -16,6 +16,10 @@ from tpu_cooccurrence.config import Backend, Config
 
 from test_pipeline import assert_latest_close, run_production
 
+# Randomized sweep: minutes of wall-clock. Slow lane (deselected by
+# default; TPU_COOC_FULL_SUITE=1 or -m selects it back in).
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.parametrize("trial", range(6))
 def test_randomized_backend_equivalence(trial):

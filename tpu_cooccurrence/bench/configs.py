@@ -24,7 +24,6 @@ import os
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
-import numpy as np
 
 from ..config import Backend, Config
 from ..io import synthetic
@@ -48,6 +47,11 @@ class BenchResult:
     pairs: int
     seconds: float
     synthetic_standin: bool
+    #: Which synthetic model produced the stand-in stream (None for real
+    #: files): "zipf" (legacy shape-matched Zipf) or "calibrated-v1"
+    #: (marginals fitted to the dataset's published spectra — see
+    #: docs/calibrated_standins.md).
+    standin_model: Optional[str] = None
 
     @property
     def pairs_per_sec(self) -> float:
@@ -62,11 +66,16 @@ class BenchResult:
             "seconds": round(self.seconds, 3),
             "pairs_per_sec": round(self.pairs_per_sec, 1),
             "synthetic_standin": self.synthetic_standin,
+            **({"standin_model": self.standin_model}
+               if self.standin_model else {}),
         }
 
 
 def _run(name: str, cfg: Config, users, items, ts,
-         synthetic_standin: bool) -> BenchResult:
+         standin_model) -> BenchResult:
+    """``standin_model``: None = real input; a string names the
+    synthetic model (legacy bool accepted: True = unlabeled stand-in,
+    the pre-calibration rows' meaning)."""
     job = CooccurrenceJob(cfg)
     start = time.monotonic()
     job.add_batch(users, items, ts)
@@ -74,7 +83,9 @@ def _run(name: str, cfg: Config, users, items, ts,
     seconds = time.monotonic() - start
     return BenchResult(name, cfg.backend.value, len(users),
                        job.counters.get(OBSERVED_COOCCURRENCES), seconds,
-                       synthetic_standin)
+                       bool(standin_model),
+                       standin_model if isinstance(standin_model, str)
+                       else None)
 
 
 def config1_tiny_text(backend: Backend = Backend.DEVICE) -> BenchResult:
@@ -87,22 +98,23 @@ def config1_tiny_text(backend: Backend = Backend.DEVICE) -> BenchResult:
 
 
 def _movielens_100k() -> Tuple:
+    """(users, items, ts, standin_model): model is None for real files —
+    the helper that picks the generator owns the provenance label."""
     path = os.environ.get("MOVIELENS_100K", "")
     if path and os.path.exists(path):
         (users, items, ts), = synthetic.movielens_interactions(path)
-        return users, items, ts, False
-    # Stand-in: 100K events, 943 users, 1682 items, zipf-ish popularity.
-    users, items, ts = synthetic.zipfian_interactions(
-        100_000, n_items=1682, n_users=943, alpha=1.05, seed=100,
-        events_per_ms=5)
-    return users, items, ts, True
+        return users, items, ts, None
+    # Stand-in calibrated to the published ML-100K marginals (943
+    # users x 1,682 movies, top-3 movie counts, >=20 ratings/user).
+    users, items, ts = synthetic.ml100k_calibrated()
+    return users, items, ts, "calibrated-v1"
 
 
 def config2_ml100k(backend: Backend = Backend.DEVICE) -> BenchResult:
-    users, items, ts, standin = _movielens_100k()
+    users, items, ts, model = _movielens_100k()
     cfg = Config(window_size=4000, seed=2, item_cut=500, user_cut=500,
                  backend=backend, num_items=int(items.max()) + 1)
-    return _run("ml-100k-tumbling", cfg, users, items, ts, standin)
+    return _run("ml-100k-tumbling", cfg, users, items, ts, model)
 
 
 def _movielens_25m(limit: Optional[int]) -> Tuple:
@@ -111,12 +123,14 @@ def _movielens_25m(limit: Optional[int]) -> Tuple:
         (users, items, ts), = synthetic.movielens_interactions(path)
         if limit:
             users, items, ts = users[:limit], items[:limit], ts[:limit]
-        return users, items, ts, False
+        return users, items, ts, None
     n = limit or 2_000_000
-    users, items, ts = synthetic.zipfian_interactions(
-        n, n_items=62_000, n_users=162_000, alpha=1.05, seed=25,
-        events_per_ms=50)
-    return users, items, ts, True
+    # Stand-in calibrated to the published ML-25M marginals (162,541
+    # users x 59,047 movies, near-tied top movies at ~81.5k ratings,
+    # >=20 ratings/user) — a plain Zipf alpha misses the real head by
+    # construction (docs/calibrated_standins.md has the deltas).
+    users, items, ts = synthetic.ml25m_calibrated(n)
+    return users, items, ts, "calibrated-v1"
 
 
 def _dense_cfg_extras(backend: Backend, items) -> Dict:
@@ -134,11 +148,11 @@ def config3_ml25m_sliding(backend: Backend = Backend.DEVICE,
     """62k-item vocab: a dense int32 C (15.4 GB) misses one chip's HBM, but
     reference-style int16 counts (7.7 GB) fit — so the dense device backend
     carries this config instead of the host-matrix hybrid."""
-    users, items, ts, standin = _movielens_25m(limit)
+    users, items, ts, model = _movielens_25m(limit)
     cfg = Config(window_size=4000, window_slide=1000, seed=3,
                  item_cut=500, user_cut=500, backend=backend,
                  **_dense_cfg_extras(backend, items))
-    return _run("ml-25m-sliding", cfg, users, items, ts, standin)
+    return _run("ml-25m-sliding", cfg, users, items, ts, model)
 
 
 def config4_zipfian_1m(backend: Backend = Backend.SPARSE,
@@ -160,30 +174,24 @@ def _instacart() -> Tuple:
     if orders and os.path.exists(orders) and os.path.exists(order_products):
         (users, items, ts), = synthetic.instacart_interactions(
             orders, order_products)
-        return users, items, ts, False
-    # Stand-in: basket-shaped stream — ~8 items per (user, ts) basket.
-    # (Scale via BENCH_BASKETS; persistent histories make the pair volume
-    # grow quadratically in per-user interactions.)
-    rng = np.random.default_rng(55)
+        return users, items, ts, None
+    # Stand-in calibrated to the published Instacart marginals (user
+    # order counts 4..100 mean 16.6, basket sizes mean ~10 median 8,
+    # Banana-headed product spectrum). Scale via BENCH_BASKETS;
+    # persistent histories make the pair volume grow quadratically in
+    # per-user interactions.
     n_baskets = int(os.environ.get("BENCH_BASKETS", 20_000))
-    sizes = rng.poisson(8, n_baskets).clip(1, 40)
-    users = np.repeat(rng.integers(0, 5_000, n_baskets), sizes)
-    ts = np.repeat(np.arange(n_baskets, dtype=np.int64) * 10, sizes)
-    n = int(sizes.sum())
-    ranks = np.arange(1, 50_000, dtype=np.float64)
-    w = ranks ** -1.05
-    cdf = np.cumsum(w) / w.sum()
-    items = np.searchsorted(cdf, rng.random(n)).astype(np.int64)
-    return users, items, ts, True
+    users, items, ts = synthetic.instacart_calibrated(n_baskets)
+    return users, items, ts, "calibrated-v1"
 
 
 def config5_instacart(backend: Backend = Backend.DEVICE) -> BenchResult:
     """~50k-item vocab: int16 counts (5 GB dense C) keep this on the dense
     device backend (17x the hybrid's throughput here)."""
-    users, items, ts, standin = _instacart()
+    users, items, ts, model = _instacart()
     cfg = Config(window_size=1000, seed=5, item_cut=500, user_cut=500,
                  backend=backend, **_dense_cfg_extras(backend, items))
-    return _run("instacart-incremental", cfg, users, items, ts, standin)
+    return _run("instacart-incremental", cfg, users, items, ts, model)
 
 
 ALL_CONFIGS: List[Tuple[str, Callable[[], BenchResult]]] = [

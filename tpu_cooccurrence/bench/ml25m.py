@@ -154,7 +154,7 @@ def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000,
     leave most of each dense row empty) at the price of host index work,
     so the chip decides which carries config 3 (bench/tpu_round2.py
     measures both)."""
-    users, items, ts, standin = _movielens_25m(limit=n_events)
+    users, items, ts, standin_model = _movielens_25m(limit=n_events)
     n = len(users)
     dense = backend == Backend.DEVICE
     cfg = Config(window_size=4000, window_slide=1000, seed=3,
@@ -194,7 +194,8 @@ def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000,
         "pairs_per_sec": round(pairs / max(seconds, 1e-9), 1),
         "host_sample_seconds": round(host_s, 2),
         "device_score_seconds": round(device_s, 2),
-        "synthetic_standin": standin,
+        "synthetic_standin": standin_model is not None,
+        **({"standin_model": standin_model} if standin_model else {}),
     }
     if not host_only:
         psum_hi_s, psum_src = measured_psum_latency()
