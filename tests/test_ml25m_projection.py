@@ -45,6 +45,45 @@ def test_psum_reads_latest_probe_capture(tmp_path, monkeypatch):
     assert "measured" in src and "2026-02-02" in src
 
 
+def test_sharded_overhead_absent_before_capture(tmp_path, monkeypatch):
+    monkeypatch.setattr(tpu_round2, "OUT", str(tmp_path / "none.jsonl"))
+    s, src = ml25m.measured_sharded_overhead()
+    assert s is None and "no sharded-pallas-1chip" in src
+
+
+def test_projection_point_uses_measured_overhead(tmp_path, monkeypatch):
+    """VERDICT r4 Next #7: once a sharded-pallas-1chip capture exists,
+    the projection's per-window collective term is the measured
+    shard_map+psum overhead — zero assumed constants — and the source
+    strings say which measurement each constant came from."""
+    out_file = tmp_path / "rounds.jsonl"
+    with open(out_file, "w") as f:
+        f.write(json.dumps({"name": "tunnel-probe", "ok": True,
+                            "sync_ms_per_dispatch": 8.0,
+                            "ts": "2026-03-03 00:00:00"}) + "\n")
+        f.write(json.dumps({"name": "sharded-pallas-1chip", "ok": True,
+                            "sharded_overhead_ms_per_window": 1.25,
+                            "ts": "2026-03-04 00:00:00"}) + "\n")
+    monkeypatch.setattr(tpu_round2, "OUT", str(out_file))
+    monkeypatch.delenv("MOVIELENS_25M", raising=False)
+    out = ml25m.run_full(20_000, host_only=False)
+    assert out["psum_latency_s"] == 1.25e-3
+    assert "measured 1-chip shard_map+psum" in out["psum_latency_source"]
+    assert "2026-03-04" in out["psum_latency_source"]
+    assert "assumed" not in out["psum_latency_source"]
+    assert "assumed" not in out["psum_latency_upper_source"]
+    host = out["host_sample_seconds"]
+    dev = out["device_score_seconds"]
+    w = out["windows"]
+    np.testing.assert_allclose(
+        out["v5e8_projected_seconds"],
+        round(host + dev / 8 + w * 1.25e-3, 2), atol=0.011)
+    # Upper bound: max(measured sync RTT, 2x point) per window.
+    np.testing.assert_allclose(
+        out["v5e8_projected_range"][1],
+        round(host + dev / 8 + w * 8.0e-3, 2), atol=0.011)
+
+
 def test_projection_carries_error_bars(tmp_path, monkeypatch):
     """run_full's projection reports point, range, and both constants'
     provenance; a measured tunnel RTT bounds the range from above but

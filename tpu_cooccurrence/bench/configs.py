@@ -72,10 +72,9 @@ class BenchResult:
 
 
 def _run(name: str, cfg: Config, users, items, ts,
-         standin_model) -> BenchResult:
-    """``standin_model``: None = real input; a string names the
-    synthetic model (legacy bool accepted: True = unlabeled stand-in,
-    the pre-calibration rows' meaning)."""
+         standin_model: Optional[str]) -> BenchResult:
+    """``standin_model``: None = real (or non-stand-in) input; a string
+    names the synthetic model that stands in for a real dataset."""
     job = CooccurrenceJob(cfg)
     start = time.monotonic()
     job.add_batch(users, items, ts)
@@ -83,9 +82,7 @@ def _run(name: str, cfg: Config, users, items, ts,
     seconds = time.monotonic() - start
     return BenchResult(name, cfg.backend.value, len(users),
                        job.counters.get(OBSERVED_COOCCURRENCES), seconds,
-                       bool(standin_model),
-                       standin_model if isinstance(standin_model, str)
-                       else None)
+                       standin_model is not None, standin_model)
 
 
 def config1_tiny_text(backend: Backend = Backend.DEVICE) -> BenchResult:
@@ -94,7 +91,7 @@ def config1_tiny_text(backend: Backend = Backend.DEVICE) -> BenchResult:
     n_items = int(items.max()) + 1
     cfg = Config(window_size=1_000_000, skip_cuts=True, seed=1,
                  backend=backend, num_items=n_items)
-    return _run("tiny-text-batch", cfg, users, items, ts, False)
+    return _run("tiny-text-batch", cfg, users, items, ts, None)
 
 
 def _movielens_100k() -> Tuple:
@@ -145,9 +142,10 @@ def _dense_cfg_extras(backend: Backend, items) -> Dict:
 
 def config3_ml25m_sliding(backend: Backend = Backend.DEVICE,
                           limit: Optional[int] = 500_000) -> BenchResult:
-    """62k-item vocab: a dense int32 C (15.4 GB) misses one chip's HBM, but
-    reference-style int16 counts (7.7 GB) fit — so the dense device backend
-    carries this config instead of the host-matrix hybrid."""
+    """59k-item vocab (the calibrated stand-in carries ML-25M's real
+    59,047 movies): a dense int32 C (13.9 GB) misses one chip's HBM,
+    but reference-style int16 counts (7.0 GB) fit — so the dense device
+    backend carries this config instead of the host-matrix hybrid."""
     users, items, ts, model = _movielens_25m(limit)
     cfg = Config(window_size=4000, window_slide=1000, seed=3,
                  item_cut=500, user_cut=500, backend=backend,
@@ -165,7 +163,7 @@ def config4_zipfian_1m(backend: Backend = Backend.SPARSE,
         events_per_ms=200)
     cfg = Config(window_size=100, seed=4, item_cut=500, user_cut=500,
                  backend=backend)
-    return _run("zipfian-1M-items", cfg, users, items, ts, False)
+    return _run("zipfian-1M-items", cfg, users, items, ts, None)
 
 
 def _instacart() -> Tuple:

@@ -5,12 +5,12 @@ BASELINE.json's second target: "full MovieLens-25M item-item matrix in
 extrapolating from the 500k-event stand-in slice (VERDICT round 1, weak
 item 3):
 
-* the FULL 25M-event, 62k-item, 162k-user shape (real ratings.csv when
+* the FULL 25M-event, 59k-item, 162.5k-user shape (real ratings.csv when
   ``MOVIELENS_25M`` points at it; otherwise the shape-matched Zipfian
   stand-in — labeled), streamed through the production job in bounded
   chunks, sliding windows + top-k (benchmark config 3's setup);
 * the backend that carries that vocabulary on one chip: dense device,
-  reference-style int16 counts (7.7 GB HBM at 62k items);
+  reference-style int16 counts (7.0 GB HBM at 59,047 items);
 * a stated, formula-explicit projection to v5e-8 from the single-chip
   measurement: the sharded backend splits every device stage (scatter
   update, gather+LLR+top-K) across 8 item-sharded chips with one psum
@@ -19,7 +19,7 @@ item 3):
   ``projected = host_seconds + device_seconds / 8 + windows * psum_lat``.
   Host and device seconds are separated by the job's per-window step
   timer. The psum term's point estimate is the stated on-pod allowance
-  (PSUM_LATENCY_DEFAULT_S — ICI all-reduce of the [62k] row-sum vector
+  (PSUM_LATENCY_DEFAULT_S — ICI all-reduce of the [59k] row-sum vector
   is sub-millisecond on v5e); the reported ``[low, high]`` range uses
   zero exposed latency as the floor and the tunnel probe's MEASURED
   synchronized-dispatch RTT as the ceiling. The measured RTT includes
@@ -49,7 +49,7 @@ from ..state.results import TopKBatch
 from .configs import _movielens_25m
 
 # Fallback per-window ICI all-reduce latency for the v5e-8 projection
-# when no measured dispatch RTT exists yet: one psum of an int32 [62k]
+# when no measured dispatch RTT exists yet: one psum of an int32 [59k]
 # row-sum vector (~250 KB) per fired window. v5e ICI moves that in tens
 # of microseconds; 200 us is a deliberately fat allowance for launch +
 # sync skew. measured_psum_latency() replaces this with the tunnel
@@ -59,14 +59,8 @@ from .configs import _movielens_25m
 PSUM_LATENCY_DEFAULT_S = 200e-6
 
 
-def measured_psum_latency():
-    """(latency_s, source): the latest measured synchronized-dispatch RTT
-    from the tunnel probe (TPU_ROUND2.jsonl), else the stated default.
-
-    A per-window psum costs one synchronized collective launch; the
-    probe's ``sync_ms_per_dispatch`` (tiny kernel, block after each) is
-    the measured stand-in for that launch+sync cost on this hardware.
-    """
+def _latest_row(name: str, required_key: str):
+    """Latest ok TPU_ROUND2.jsonl row of ``name`` carrying the key."""
     from .tpu_round2 import OUT
 
     latest = None
@@ -80,16 +74,45 @@ def measured_psum_latency():
                     obj = json.loads(line)
                 except ValueError:
                     continue
-                if (obj.get("name") == "tunnel-probe" and obj.get("ok")
-                        and "sync_ms_per_dispatch" in obj):
+                if (obj.get("name") == name and obj.get("ok")
+                        and required_key in obj):
                     latest = obj
     except OSError:
         pass
+    return latest
+
+
+def measured_psum_latency():
+    """(latency_s, source): the latest measured synchronized-dispatch RTT
+    from the tunnel probe (TPU_ROUND2.jsonl), else the stated default.
+
+    A per-window psum costs one synchronized collective launch; the
+    probe's ``sync_ms_per_dispatch`` (tiny kernel, block after each) is
+    the measured stand-in for that launch+sync cost on this hardware.
+    """
+    latest = _latest_row("tunnel-probe", "sync_ms_per_dispatch")
     if latest is not None:
         return (latest["sync_ms_per_dispatch"] / 1e3,
                 "measured sync dispatch RTT, tunnel transport included "
                 f"({latest.get('ts', '?')})")
     return PSUM_LATENCY_DEFAULT_S, "assumed default (no probe capture yet)"
+
+
+def measured_sharded_overhead():
+    """(seconds_per_window, source) for the projection's point estimate
+    (VERDICT r4, Next #7): the sharded-pallas-1chip stage times the SAME
+    windows through the unsharded sparse scorer and a 1-device-mesh
+    sharded one on the real chip; the difference is the measured
+    shard_map+psum wrapper cost per window at the config-3 row-sum
+    scale. Present => the projection cites zero assumed constants.
+    Returns (None, reason) before any capture."""
+    latest = _latest_row("sharded-pallas-1chip",
+                         "sharded_overhead_ms_per_window")
+    if latest is not None:
+        return (latest["sharded_overhead_ms_per_window"] / 1e3,
+                "measured 1-chip shard_map+psum overhead per window "
+                f"({latest.get('ts', '?')})")
+    return None, "no sharded-pallas-1chip capture yet"
 
 N_EVENTS_FULL = 25_000_000
 
@@ -150,7 +173,7 @@ def sparse_device_mocked():
 def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000,
              backend: Backend = Backend.DEVICE) -> dict:
     """``backend``: DEVICE is the dense int16 carrier; SPARSE scores only
-    nonzero cells (~60x fewer at this shape — 54M pairs over a 62k vocab
+    nonzero cells (~60x fewer at this shape — 54M pairs over a 59k vocab
     leave most of each dense row empty) at the price of host index work,
     so the chip decides which carries config 3 (bench/tpu_round2.py
     measures both)."""
@@ -199,12 +222,20 @@ def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000,
     }
     if not host_only:
         psum_hi_s, psum_src = measured_psum_latency()
-        # Point estimate: the stated on-pod launch+sync allowance. The
-        # measured RTT includes tunnel transport a locally-attached pod
-        # never pays, so it serves as the explicit UPPER bound instead
-        # of inflating the point estimate; the lower bound is
-        # collectives fully overlapped with compute.
-        psum_s = PSUM_LATENCY_DEFAULT_S
+        overhead_s, overhead_src = measured_sharded_overhead()
+        # Point estimate: the measured 1-chip shard_map+psum wrapper
+        # cost per window when a capture exists (VERDICT r4 Next #7 —
+        # zero assumed constants), else the stated on-pod allowance.
+        # The probe's sync RTT includes tunnel transport a locally-
+        # attached pod never pays, so it serves as the explicit UPPER
+        # bound instead of inflating the point estimate; the lower
+        # bound is collectives fully overlapped with compute.
+        if overhead_s is not None:
+            psum_s = overhead_s
+            point_src = overhead_src
+        else:
+            psum_s = PSUM_LATENCY_DEFAULT_S
+            point_src = "assumed on-pod allowance (point estimate)"
         projected = host_s + device_s / 8 + windows * psum_s
         proj_low = host_s + device_s / 8
         proj_high = (host_s + device_s / 8
@@ -213,8 +244,7 @@ def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000,
         out["v5e8_projected_range"] = [round(proj_low, 2),
                                        round(proj_high, 2)]
         out["psum_latency_s"] = psum_s
-        out["psum_latency_source"] = ("assumed on-pod allowance "
-                                      "(point estimate)")
+        out["psum_latency_source"] = point_src
         out["psum_latency_upper_s"] = psum_hi_s
         out["psum_latency_upper_source"] = psum_src
         out["v5e8_projection"] = (

@@ -401,12 +401,52 @@ def sharded_pallas_1chip(quick: bool) -> dict:
         return {"rows": len(out["off"]), "rows_match": rows_match,
                 "scores_allclose": ok, "id_mismatches": id_mism}
 
+    # VERDICT r4 Next #7: the shard_map+psum wrapper's per-window cost,
+    # measured on the one real device at the config-3 row-sum scale —
+    # the same windows through the unsharded sparse scorer and a
+    # 1-device-mesh sharded one; the difference is the wrapper term
+    # (shard_map launch + the per-window row-sum psum a pod pays) the
+    # v5e-8 projection previously covered with an assumed allowance.
+    from ..state.sparse_scorer import SparseDeviceScorer
+
+    vocab = 59_047  # config 3's calibrated ML-25M vocabulary
+    n_w = 3 if quick else 6
+    per_w = 10_000 if quick else 30_000
+    r2 = np.random.default_rng(7)
+    windows = []
+    for w in range(n_w + 1):
+        s = r2.integers(0, vocab, per_w).astype(np.int64)
+        d = r2.integers(0, vocab, per_w).astype(np.int64)
+        k = s != d
+        windows.append((w, PairDeltaBatch(
+            s[k], d[k], np.ones(int(k.sum()), dtype=np.int32))))
+
+    def step_time(sc):
+        sc.process_window(*windows[0])  # compile + first-touch growth
+        sc.flush()
+        start = time.monotonic()
+        for w, p in windows[1:]:
+            sc.process_window(w, p)
+        sc.flush()  # deferred results: the fetch closes the timing
+        return (time.monotonic() - start) / n_w
+
+    t_plain = step_time(SparseDeviceScorer(10, defer_results=True,
+                                           fixed_shapes=True))
+    t_sharded = step_time(ShardedSparseScorer(10, mesh=mesh,
+                                              defer_results=True,
+                                              fixed_shapes=True))
     return {
         "sharded_dense_int16": compare(lambda pl: ShardedScorer(
             items, 10, mesh=mesh, count_dtype="int16", use_pallas=pl)),
         "sharded_sparse": compare(lambda pl: ShardedSparseScorer(
             10, mesh=mesh, defer_results=True, fixed_shapes=True,
             use_pallas=pl)),
+        "step_ms_per_window_unsharded": round(t_plain * 1e3, 2),
+        "step_ms_per_window_sharded_1dev": round(t_sharded * 1e3, 2),
+        "sharded_overhead_ms_per_window": round(
+            max(0.0, t_sharded - t_plain) * 1e3, 3),
+        "overhead_vocab": vocab,
+        "overhead_pairs_per_window": per_w,
     }
 
 

@@ -174,6 +174,7 @@ def ml100k_calibrated(n_events: int = ML100K_EVENTS, seed: int = 100,
 #: Bananas 394,930 / Organic Strawberries 275,577; basket mean ~10.1,
 #: median ~8.
 INSTACART_CALIBRATION = dict(
+    n_orders=3_421_083,
     n_products=49_688, item_s=0.7845, item_q=0.836,
     orders_mu=2.3026, orders_sigma=0.9079, orders_lo=4.0, orders_hi=100.0,
     basket_mu=2.0794, basket_sigma=0.6822, basket_lo=1.0, basket_hi=145.0,
@@ -190,8 +191,9 @@ def instacart_calibrated(n_baskets: int, seed: int = 55,
     c = INSTACART_CALIBRATION
     rng = np.random.default_rng(seed)
     # Scale the user population with the basket budget so orders/user
-    # keeps its real mean (16.6) at any size; full size = all users.
-    n_users = max(1, min(c["n_users"], int(round(n_baskets / 16.6))))
+    # keeps its real mean at any size; full size = exactly all users.
+    n_users = max(1, min(c["n_users"], int(round(
+        n_baskets * c["n_users"] / c["n_orders"]))))
     orders = truncated_lognormal_activity(
         n_users, c["orders_mu"], c["orders_sigma"],
         c["orders_lo"], c["orders_hi"], rng)
