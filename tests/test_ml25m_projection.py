@@ -51,6 +51,27 @@ def test_sharded_overhead_absent_before_capture(tmp_path, monkeypatch):
     assert s is None and "no sharded-pallas-1chip" in src
 
 
+def test_projection_constants_reject_cpu_tagged_rows(tmp_path,
+                                                     monkeypatch):
+    """A CPU smoke row (jax_platform=cpu) in the tracked JSONL must not
+    become a projection constant — same onchip_row predicate as the
+    summary (shared altitude, not per-reader filters)."""
+    out = tmp_path / "rounds.jsonl"
+    with open(out, "w") as f:
+        f.write(json.dumps({"name": "tunnel-probe", "ok": True,
+                            "jax_platform": "cpu",
+                            "sync_ms_per_dispatch": 99.0}) + "\n")
+        f.write(json.dumps({"name": "sharded-pallas-1chip", "ok": True,
+                            "jax_platform": "cpu",
+                            "sharded_overhead_ms_per_window": 13.6})
+                + "\n")
+    monkeypatch.setattr(tpu_round2, "OUT", str(out))
+    lat, src = ml25m.measured_psum_latency()
+    assert lat == ml25m.PSUM_LATENCY_DEFAULT_S and "assumed" in src
+    s, src2 = ml25m.measured_sharded_overhead()
+    assert s is None
+
+
 def test_projection_point_uses_measured_overhead(tmp_path, monkeypatch):
     """VERDICT r4 Next #7: once a sharded-pallas-1chip capture exists,
     the projection's per-window collective term is the measured

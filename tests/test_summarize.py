@@ -26,6 +26,43 @@ def test_latest_by_name_maps_historic_config4_rows():
     assert latest["ml25m-full"]["seconds"] == 181.5
 
 
+def test_latest_by_name_rejects_non_tpu_platform_rows():
+    """An ok row tagged jax_platform=cpu (smoke run whose OUT override
+    was lost) must never become the latest on-chip number; untagged
+    historic rows and tpu-tagged rows pass."""
+    rows = [
+        {"name": "config4-headline", "ok": True, "pairs_per_sec": 1.0,
+         "jax_platform": "tpu"},
+        {"name": "config4-headline", "ok": True, "pairs_per_sec": 9e9,
+         "jax_platform": "cpu"},
+        {"name": "ml25m-full", "ok": True, "seconds": 181.5},  # historic
+    ]
+    latest = summarize.latest_by_name(rows)
+    assert latest["config4-headline"]["pairs_per_sec"] == 1.0
+    assert latest["ml25m-full"]["seconds"] == 181.5
+
+
+def test_render_sharded_overhead_line(tmp_path, monkeypatch):
+    r2 = tmp_path / "rounds.jsonl"
+    _write_jsonl(r2, [
+        {"name": "sharded-pallas-1chip", "ok": True,
+         "jax_platform": "tpu", "ts": "2026-08-01 00:05:00",
+         "sharded_dense_int16": {"scores_allclose": True},
+         "sharded_sparse": {"scores_allclose": True},
+         "step_ms_per_window_unsharded": 10.0,
+         "step_ms_per_window_sharded_1dev": 11.2,
+         "sharded_overhead_ms_per_window": 1.2,
+         "overhead_vocab": 59_047},
+    ])
+    monkeypatch.setattr(summarize, "ROUND2_PATH", str(r2))
+    monkeypatch.setattr(summarize, "HISTORY_PATH",
+                        str(tmp_path / "none.jsonl"))
+    text = summarize.render()
+    assert "1.2 ms/window" in text
+    assert "59047-item row sums" in text
+    assert "measured point estimate" in text
+
+
 def test_render_targets_and_regeneration(tmp_path, monkeypatch):
     r2 = tmp_path / "rounds.jsonl"
     hist = tmp_path / "hist.jsonl"

@@ -60,8 +60,10 @@ PSUM_LATENCY_DEFAULT_S = 200e-6
 
 
 def _latest_row(name: str, required_key: str):
-    """Latest ok TPU_ROUND2.jsonl row of ``name`` carrying the key."""
-    from .tpu_round2 import OUT
+    """Latest usable TPU_ROUND2.jsonl row of ``name`` carrying the key
+    (``onchip_row``: ok and not tagged with a non-TPU platform — a CPU
+    smoke row must not become a projection constant)."""
+    from .tpu_round2 import OUT, onchip_row
 
     latest = None
     try:
@@ -74,7 +76,7 @@ def _latest_row(name: str, required_key: str):
                     obj = json.loads(line)
                 except ValueError:
                     continue
-                if (obj.get("name") == name and obj.get("ok")
+                if (obj.get("name") == name and onchip_row(obj)
                         and required_key in obj):
                     latest = obj
     except OSError:

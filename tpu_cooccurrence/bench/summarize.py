@@ -54,9 +54,15 @@ def latest_by_name(rows):
     it shadow the pass name): map the known historic spellings back to
     their measurement identity, keyed by backend where ambiguous.
     """
+    from .tpu_round2 import onchip_row
+
     out = {}
     for r in rows:
-        if not r.get("ok"):
+        # onchip_row: ok AND not tagged with a non-TPU platform (a CPU
+        # smoke run whose TPU_ROUND2_OUT override was lost must not
+        # become "the latest on-chip number"); shared with ml25m.py's
+        # projection-constant readers.
+        if not onchip_row(r):
             continue
         name = r.get("name")
         if name == "zipfian-1M-items":  # historic config4 rows
@@ -199,6 +205,15 @@ def render() -> str:
                      f"({sh.get('ts', '?')}): "
                      f"dense {sh.get('sharded_dense_int16')}, "
                      f"sparse {sh.get('sharded_sparse')}")
+        if sh.get("sharded_overhead_ms_per_window") is not None:
+            lines.append(
+                f"- shard_map+psum wrapper overhead (1-chip, "
+                f"{sh.get('overhead_vocab')}-item row sums): "
+                f"{sh.get('sharded_overhead_ms_per_window')} ms/window "
+                f"(unsharded {sh.get('step_ms_per_window_unsharded')} ms "
+                f"vs sharded {sh.get('step_ms_per_window_sharded_1dev')} "
+                f"ms) — the v5e-8 projection's measured point estimate "
+                f"(bench/ml25m.measured_sharded_overhead)")
 
     probe = rounds.get("tunnel-probe")
     if probe:

@@ -51,6 +51,19 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def onchip_row(r: dict) -> bool:
+    """Shared predicate for TPU_ROUND2.jsonl readers (summarize.py,
+    ml25m.py): an ok row is usable as an on-chip number unless its
+    platform tag says otherwise. A CPU smoke run whose TPU_ROUND2_OUT
+    override was lost must poison neither the summary nor the
+    projection constants. Historic rows predate the tag and pass
+    untagged — their capture sessions were TPU-only."""
+    if not r.get("ok"):
+        return False
+    platform = r.get("jax_platform")
+    return platform is None or platform == "tpu"
+
+
 def _backend_tag() -> dict:
     """Per-row platform provenance: grant_watch runs each measurement as
     its own `--only` subprocess, so the one-per-session env row may not
