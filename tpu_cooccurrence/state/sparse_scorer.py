@@ -685,8 +685,15 @@ class HashSlabIndex(SlabIndex):
                 # streams, so the re-probe is masked to the moved rows'
                 # cells, not the whole window.
                 ex_pos = np.flatnonzero(~new_sel)
-                stale = ex_pos[np.isin(d_key[ex_pos] >> 32,
-                                       self._moved_rows.astype(np.int64))]
+                # Membership via a dense row mask, not np.isin: isin
+                # sorts both sides (O(n log n) per window) and this
+                # line sits on the per-window hot path. Every existing
+                # cell's row was registered through ensure_rows at
+                # first insertion, so row ids index row_start-sized
+                # arrays by the class invariant.
+                mask = np.zeros(len(self.row_start), dtype=bool)
+                mask[self._moved_rows] = True
+                stale = ex_pos[mask[d_key[ex_pos] >> 32]]
                 if len(stale):
                     ex_keys = np.ascontiguousarray(d_key[stale])
                     ex_slots = np.empty(len(ex_keys), dtype=np.int32)
