@@ -138,6 +138,10 @@ def _bind_prototypes(lib, i64p, i32p) -> None:
     lib.slab_hash_update.restype = ctypes.c_int64
     lib.slab_hash_update.argtypes = [
         i64p, i32p, ctypes.c_int64, i64p, i32p, ctypes.c_int64]
+    lib.slab_shift_rows.restype = ctypes.c_int64
+    lib.slab_shift_rows.argtypes = [
+        i64p, i32p, ctypes.c_int64, i64p, i32p, i32p, i32p,
+        ctypes.c_int64]
     lib.grouped_rank_dense.restype = None
     lib.grouped_rank_dense.argtypes = [i64p, ctypes.c_int64, i32p, i32p]
 

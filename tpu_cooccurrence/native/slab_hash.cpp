@@ -88,6 +88,40 @@ int64_t slab_hash_insert(int64_t* tkeys, int32_t* tvals, int64_t mask,
   return exhausted;
 }
 
+// One-pass row relocation for DISJOINT moves (every new region lies
+// beyond the old heap end — the _allocate growth case): for each moved
+// row, copy its reverse-map keys old->new and re-point the table's
+// slot values, without materializing the ragged index/gather arrays
+// the NumPy path builds per window. NOT safe for compaction's
+// overlapping re-lay — the caller keeps the gather-first path there.
+int64_t slab_shift_rows(int64_t* tkeys, int32_t* tvals, int64_t mask,
+                        int64_t* slot_key, const int32_t* old_starts,
+                        const int32_t* new_starts, const int32_t* lens,
+                        int64_t n_rows) {
+  int64_t exhausted = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const int64_t os = old_starts[r];
+    const int64_t ns = new_starts[r];
+    const int64_t len = lens[r];
+    for (int64_t j = 0; j < len; ++j) {
+      const int64_t key = slot_key[os + j];
+      slot_key[ns + j] = key;
+      uint64_t h = mix((uint64_t)key) & (uint64_t)mask;
+      int64_t left = mask + 1;
+      while (left > 0 && tkeys[h] != key) {
+        h = (h + 1) & (uint64_t)mask;
+        --left;
+      }
+      if (left == 0) {
+        ++exhausted;  // key absent: promised-present contract violated
+        continue;
+      }
+      tvals[h] = (int32_t)(ns + j);
+    }
+  }
+  return exhausted;
+}
+
 // Overwrite the slot of keys known to be present (row relocations and
 // compaction re-laying).
 int64_t slab_hash_update(int64_t* tkeys, int32_t* tvals, int64_t mask,

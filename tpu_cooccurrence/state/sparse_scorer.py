@@ -454,9 +454,16 @@ class SlabIndex:
         return AllocPlan(mv, mv_len, slots, ~exists)
 
     def _shift_moved(self, rows: np.ndarray, old_starts: np.ndarray,
-                     lens: np.ndarray, new_starts: np.ndarray) -> None:
+                     lens: np.ndarray, new_starts: np.ndarray,
+                     disjoint: bool = False) -> None:
         """Re-point the index at relocated rows' new slots (their g_key
-        segment is contiguous in the sorted layout)."""
+        segment is contiguous in the sorted layout).
+
+        ``disjoint``: every new region lies beyond the old heap end
+        (the _allocate growth case, never compaction's overlapping
+        re-lay) — a hint subclasses use to pick an in-place fast path;
+        this sorted implementation edits only g_slot values and needs
+        no distinction."""
         seg_lo = np.searchsorted(self.g_key, rows.astype(np.int64) << 32)
         idx = np.repeat(seg_lo, lens) + _ragged_arange(lens)
         self.g_slot[idx] += np.repeat(new_starts - old_starts, lens)
@@ -488,8 +495,10 @@ class SlabIndex:
             self.garbage += int(self.row_cap[grow_rows].sum())
             moved = old_len > 0
             if moved.any():
+                # Growth offsets start at the old heap_end: disjoint.
                 self._shift_moved(grow_rows[moved], old_start[moved],
-                                  old_len[moved], offs[moved])
+                                  old_len[moved], offs[moved],
+                                  disjoint=True)
                 mv_count = int(moved.sum())
                 mv_len = int(pad_pow4(int(old_len[moved].max()), minimum=8))
                 mv_pad = pad_pow4(mv_count, minimum=8)
@@ -706,15 +715,32 @@ class HashSlabIndex(SlabIndex):
         return AllocPlan(mv, mv_len, slots, new_sel.copy())
 
     def _shift_moved(self, rows: np.ndarray, old_starts: np.ndarray,
-                     lens: np.ndarray, new_starts: np.ndarray) -> None:
+                     lens: np.ndarray, new_starts: np.ndarray,
+                     disjoint: bool = False) -> None:
         # The reverse map recovers the moved cells' keys (the sorted
         # index found them by key-segment instead).
         self._moved_rows = rows  # apply() re-probes only these rows' cells
+        self._ensure_slot_key(self.heap_end)
+        if disjoint:
+            # Growth relocations (every window on Zipfian streams): one
+            # C pass copies each row's reverse-map keys and re-points
+            # the table, skipping the ragged index/gather temporaries
+            # below. Only valid when no new region overlaps an old one
+            # — guaranteed by _allocate (offsets start at heap_end).
+            self._check_probe(self._lib.slab_shift_rows(
+                self._p64(self._tkeys), self._p32(self._tvals),
+                self._cap - 1, self._p64(self.slot_key),
+                self._p32(np.ascontiguousarray(old_starts,
+                                               dtype=np.int32)),
+                self._p32(np.ascontiguousarray(new_starts,
+                                               dtype=np.int32)),
+                self._p32(np.ascontiguousarray(lens, dtype=np.int32)),
+                len(lens)))
+            return
         old_idx = np.repeat(old_starts, lens) + _ragged_arange(lens)
         keys = np.ascontiguousarray(self.slot_key[old_idx])
         new_idx = (np.repeat(new_starts, lens)
                    + _ragged_arange(lens)).astype(np.int32)
-        self._ensure_slot_key(self.heap_end)
         self.slot_key[new_idx] = keys
         self._check_probe(self._lib.slab_hash_update(
             self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
