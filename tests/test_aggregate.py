@@ -40,3 +40,71 @@ def test_distinct_sorted():
         [0, 2, 5, 9])
     np.testing.assert_array_equal(
         distinct_sorted(np.array([3], dtype=np.int32)), [3])
+
+
+def test_native_fold_matches_numpy_unique():
+    """The native sort-and-fold must be bit-identical to the np.unique
+    path (same sorted keys, same int64 sums) on adversarial inputs:
+    heavy duplication, cancellations to zero, singleton tails."""
+    from tpu_cooccurrence.native import coo_aggregate
+
+    rng = np.random.default_rng(11)
+    for n in (1, 2, 7, 1000, 50_000):
+        src = rng.integers(0, 50, n).astype(np.int64)
+        dst = rng.integers(0, 40, n).astype(np.int64)
+        delta = rng.choice(np.array([-1, 1], dtype=np.int64), n)
+        key = (src << 32) | dst
+        uniq_ref, inverse = np.unique(key, return_inverse=True)
+        agg_ref = np.bincount(inverse, weights=delta,
+                              minlength=len(uniq_ref)).astype(np.int64)
+        folded = coo_aggregate(key, delta)
+        if folded is None:  # no native lib on this box: numpy path only
+            return
+        uniq, agg = folded
+        np.testing.assert_array_equal(uniq, uniq_ref)
+        np.testing.assert_array_equal(agg, agg_ref)
+        # Inputs must be untouched (callers reuse them).
+        assert (key == ((src << 32) | dst)).all()
+    s2, d2, a2, k2 = aggregate_window_coo(src, dst, delta,
+                                          return_key=True)
+    np.testing.assert_array_equal(k2, uniq_ref)
+    np.testing.assert_array_equal(a2, agg_ref)
+    assert s2.dtype == np.int32 and d2.dtype == np.int32
+
+
+def test_integrated_native_branch_matches(monkeypatch):
+    """Drive aggregate_window_coo's NATIVE branch (normally gated at
+    2M deltas) by lowering the threshold: results must match the numpy
+    branch exactly, and caller arrays must survive (only the internal
+    packed-key local is clobbered)."""
+    from tpu_cooccurrence.native import coo_aggregate, get_lib
+    from tpu_cooccurrence.ops import aggregate as agg_mod
+
+    if get_lib() is None:
+        return  # numpy-only box: nothing to compare
+    rng = np.random.default_rng(5)
+    n = 30_000
+    src = rng.integers(0, 300, n).astype(np.int64)
+    dst = rng.integers(0, 200, n).astype(np.int64)
+    delta = rng.choice(np.array([-1, 1], dtype=np.int64), n)
+    ref = aggregate_window_coo(src, dst, delta, return_key=True)
+    monkeypatch.setattr(agg_mod, "NATIVE_FOLD_MIN", 1)
+    src_c, dst_c, delta_c = src.copy(), dst.copy(), delta.copy()
+    got = agg_mod.aggregate_window_coo(src, dst, delta, return_key=True)
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+    np.testing.assert_array_equal(src, src_c)
+    np.testing.assert_array_equal(dst, dst_c)
+    np.testing.assert_array_equal(delta, delta_c)
+
+
+def test_native_fold_length_mismatch_raises():
+    from tpu_cooccurrence.native import coo_aggregate, get_lib
+
+    if get_lib() is None:
+        return
+    import pytest
+
+    with pytest.raises(ValueError, match="delta length"):
+        coo_aggregate(np.zeros(4, dtype=np.int64),
+                      np.zeros(3, dtype=np.int64))

@@ -22,7 +22,8 @@ _HERE = os.path.dirname(__file__)
 _SRCS = [os.path.join(_HERE, "reservoir_expand.cpp"),
          os.path.join(_HERE, "sliding_expand.cpp"),
          os.path.join(_HERE, "slab_hash.cpp"),
-         os.path.join(_HERE, "grouped_rank.cpp")]
+         os.path.join(_HERE, "grouped_rank.cpp"),
+         os.path.join(_HERE, "coo_aggregate.cpp")]
 _LIB = os.path.join(_HERE, "libreservoir_expand.so")
 
 _lib: Optional[ctypes.CDLL] = None
@@ -144,6 +145,8 @@ def _bind_prototypes(lib, i64p, i32p) -> None:
         ctypes.c_int64]
     lib.grouped_rank_dense.restype = None
     lib.grouped_rank_dense.argtypes = [i64p, ctypes.c_int64, i32p, i32p]
+    lib.coo_aggregate.restype = ctypes.c_int64
+    lib.coo_aggregate.argtypes = [i64p, i64p, ctypes.c_int64]
 
 
 def _ptr64(a: np.ndarray):
@@ -156,6 +159,40 @@ def _ptr32(a: np.ndarray):
 
 def _ptr8(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def coo_aggregate(key: np.ndarray, delta: np.ndarray,
+                  clobber_key: bool = False):
+    """Native fold of duplicate packed cell keys; returns
+    ``(unique_sorted_keys, int64 summed deltas)`` or None (no lib).
+
+    The C routine folds in place; this wrapper hands it the caller's
+    buffer only when that is safe — ``clobber_key=True`` says the key
+    array is throwaway (the hot path hands a freshly-packed local, and
+    an 8B*n defensive memcpy is exactly the cost class the native fold
+    exists to remove); deltas are only reused when the dtype conversion
+    already produced a fresh array. Callers see their inputs unchanged
+    unless they opted in.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(key)
+    if len(delta) != n:
+        # The numpy path's bincount(weights=...) raised on this; the C
+        # loop would read past the buffer instead.
+        raise ValueError(
+            f"coo_aggregate: delta length {len(delta)} != key length {n}")
+    if n == 0:
+        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    keys = np.ascontiguousarray(key, dtype=np.int64)
+    if keys is key and not clobber_key:
+        keys = keys.copy()
+    deltas = np.ascontiguousarray(delta, dtype=np.int64)
+    if deltas is delta:
+        deltas = deltas.copy()
+    m = int(lib.coo_aggregate(_ptr64(keys), _ptr64(deltas), n))
+    return keys[:m], deltas[:m]
 
 
 def expand_appends(hist: np.ndarray, users: np.ndarray, items: np.ndarray,

@@ -1,0 +1,54 @@
+// Per-window COO cell aggregation: fold duplicate packed (src, dst)
+// keys and sum their deltas, returning the unique cells sorted by key.
+//
+// Why native: the NumPy path (ops/aggregate.aggregate_window_coo) is
+// np.unique — an indirect argsort over every raw pair delta plus a
+// bincount over the inverse, ~40% of the dense carrier's host floor at
+// the calibrated ML-25M workload (435M pair deltas across 503
+// windows). One std::sort over (key, delta) records followed by an
+// in-place fold is both cache-friendlier (16-byte records, no
+// permutation gather) and sorts each record once.
+//
+// In-place contract: the caller passes COPIES of the packed key array
+// and an int64 delta array; both are overwritten, the fold's results
+// occupying the first `return value` entries sorted ascending by key.
+// Exactness matches the NumPy path: deltas are small ints, int64
+// summation is exact (the NumPy path's float64 bincount is exact below
+// 2^53 the same way).
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+struct Cell {
+  int64_t key;
+  int64_t delta;
+};
+}  // namespace
+
+extern "C" {
+
+int64_t coo_aggregate(int64_t* keys, int64_t* deltas, int64_t n) {
+  if (n <= 0) return 0;
+  std::vector<Cell> cells;
+  cells.reserve((size_t)n);
+  for (int64_t i = 0; i < n; ++i) cells.push_back({keys[i], deltas[i]});
+  std::sort(cells.begin(), cells.end(),
+            [](const Cell& a, const Cell& b) { return a.key < b.key; });
+  int64_t m = 0;
+  keys[0] = cells[0].key;
+  deltas[0] = cells[0].delta;
+  for (int64_t i = 1; i < n; ++i) {
+    if (cells[i].key == keys[m]) {
+      deltas[m] += cells[i].delta;
+    } else {
+      ++m;
+      keys[m] = cells[i].key;
+      deltas[m] = cells[i].delta;
+    }
+  }
+  return m + 1;
+}
+
+}  // extern "C"

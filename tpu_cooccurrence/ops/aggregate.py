@@ -12,6 +12,11 @@ from typing import Tuple
 
 import numpy as np
 
+#: Window-delta count above which the native sort-and-fold carries the
+#: per-window cell aggregation (module-level so tests can lower it to
+#: drive the integrated native branch; measured break-even ~1M).
+NATIVE_FOLD_MIN = 2_000_000
+
 
 def aggregate_window_coo(src: np.ndarray, dst: np.ndarray,
                          delta: np.ndarray, return_key: bool = False):
@@ -27,9 +32,23 @@ def aggregate_window_coo(src: np.ndarray, dst: np.ndarray,
     (and rescores rows for) net-zero cells.
     """
     key = (src.astype(np.int64) << 32) | dst.astype(np.int64)
-    uniq_key, inverse = np.unique(key, return_inverse=True)
-    agg = np.bincount(inverse, weights=delta,
-                      minlength=len(uniq_key)).astype(np.int64)
+    folded = None
+    if len(key) >= NATIVE_FOLD_MIN:
+        # Native sort-and-fold: one std::sort over 16-byte (key, delta)
+        # records vs np.unique's indirect argsort + inverse bincount.
+        # Measured 1.65x at 5-10M deltas but break-even at ~1M (numpy's
+        # int64 argsort is competitive there), so only giant windows
+        # route native. `key` is a throwaway local: the fold may
+        # clobber it instead of paying a defensive copy.
+        from ..native import coo_aggregate
+
+        folded = coo_aggregate(key, delta, clobber_key=True)
+    if folded is not None:
+        uniq_key, agg = folded
+    else:
+        uniq_key, inverse = np.unique(key, return_inverse=True)
+        agg = np.bincount(inverse, weights=delta,
+                          minlength=len(uniq_key)).astype(np.int64)
     out = ((uniq_key >> 32).astype(np.int32),
            (uniq_key & 0xFFFFFFFF).astype(np.int32),
            agg)
