@@ -14,8 +14,10 @@ import numpy as np
 
 #: Window-delta count above which the native sort-and-fold carries the
 #: per-window cell aggregation (module-level so tests can lower it to
-#: drive the integrated native branch; measured break-even ~1M).
-NATIVE_FOLD_MIN = 2_000_000
+#: drive the integrated native branch). Measured break-even sits where
+#: the working set outgrows L3 (~4M 16-byte records on this box; numpy's
+#: int64 argsort wins below it, the single-pass fold 1.65x above).
+NATIVE_FOLD_MIN = 4_000_000
 
 
 def aggregate_window_coo(src: np.ndarray, dst: np.ndarray,
