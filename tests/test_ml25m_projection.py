@@ -135,6 +135,24 @@ def test_projection_carries_error_bars(tmp_path, monkeypatch):
         high, round(host + dev / 8 + w * 8.0e-3, 2), atol=0.011)
 
 
+def test_partitioned_projection_labeled(tmp_path, monkeypatch):
+    """The secondary host-partitioned projection must be present,
+    follow host/8 + device/8 + windows*psum, and carry the
+    assumed-linear-scaling label (it is arithmetic, not measurement)."""
+    monkeypatch.setattr(tpu_round2, "OUT", str(tmp_path / "none.jsonl"))
+    monkeypatch.delenv("MOVIELENS_25M", raising=False)
+    out = ml25m.run_full(20_000, host_only=False)
+    host = out["host_sample_seconds"]
+    dev = out["device_score_seconds"]
+    w = out["windows"]
+    np.testing.assert_allclose(
+        out["v5e8_partitioned_projected_seconds"],
+        round(host / 8 + dev / 8 + w * out["psum_latency_s"], 2),
+        atol=0.011)
+    assert "assumed" in out["v5e8_partitioned_note"]
+    assert "--partition-sampling" in out["v5e8_partitioned_note"]
+
+
 def test_sparse_host_floor_mocked_mode(monkeypatch):
     """--host-only --backend sparse runs the REAL sparse scorer with
     device dispatches stubbed (reproducible sparse host floor), and the

@@ -256,6 +256,21 @@ def run_full(n_events: int, host_only: bool, chunk: int = 2_000_000,
             f"[upper: {psum_hi_s*1e6:.0f}us]")
         out["under_60s_single_chip"] = seconds < 60
         out["under_60s_v5e8_projected"] = projected < 60
+        # Secondary projection: at the calibrated workload the HOST term
+        # binds (round 5: 52 s dense floor vs device/8), and the
+        # framework's --partition-sampling splits exactly that term
+        # across the pod host's worker processes (u % P partitioning;
+        # correctness pinned by tests/test_multihost.py and the
+        # randomized multihost sweeps). Its LINEAR host scaling is
+        # arithmetic, not a measurement — this box has one core — so
+        # the row is labeled and kept separate from the primary
+        # projection, which assumes no host partitioning at all.
+        out["v5e8_partitioned_projected_seconds"] = round(
+            host_s / 8 + device_s / 8 + windows * psum_s, 2)
+        out["v5e8_partitioned_note"] = (
+            "host/8 + device/8 + windows*psum under --partition-sampling"
+            " (8 worker processes on the pod host); host scaling assumed"
+            " linear — unmeasurable on this 1-core box")
     return out
 
 
