@@ -180,6 +180,13 @@ def render() -> str:
                          + (f" {rng}" if rng else "")
                          + (" (**MET** projected)"
                             if proj < ML25M_TARGET_SECONDS else ""))
+        part_proj = m.get("v5e8_partitioned_projected_seconds")
+        if part_proj is not None:
+            parts.append(
+                f"; host-partitioned v5e-8 {part_proj} s"
+                + (" (**MET**, assumed-linear host split)"
+                   if part_proj < ML25M_TARGET_SECONDS else "")
+                + " [arithmetic: see v5e8_partitioned_note]")
         parts.append(f"— {m.get('ts', '?')}")
         lines.append(" ".join(str(p) for p in parts))
 
