@@ -422,6 +422,8 @@ def test_status_summarizes_log(tmp_path):
         {"ts": "t0", "event": "watch-start"},
         {"ts": "t1", "event": "no-grant", "cycle": 1},
         {"ts": "t2", "event": "grant", "cycle": 5},
+        {"ts": "t2b", "event": "stage-retry", "cycle": 5,
+         "stage": "tpu_round2:x", "attempt": 1},
         {"ts": "t3", "event": "capture-done", "complete": False,
          "cycle": 5},
         {"ts": "t4", "event": "grant", "cycle": 9},
@@ -436,6 +438,7 @@ def test_status_summarizes_log(tmp_path):
     assert s["first_ts"] == "t0" and s["last_ts"] == "t6"
     assert s["cycles"] == 13
     assert s["grants"] == 2
+    assert s["stage_retries"] == 1
     assert s["captures_complete"] == 1
     assert s["last_capture_ts"] == "t5"
     missing = grant_watch.status(str(tmp_path / "none.jsonl"))

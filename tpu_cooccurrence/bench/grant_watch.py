@@ -517,7 +517,8 @@ def status(log_path: str = LOG_PATH) -> dict:
     out = {"log": log_path, "exists": os.path.exists(log_path),
            "first_ts": None, "last_ts": None, "last_event": None,
            "cycles": 0, "probes_run": 0, "grants": 0,
-           "captures_complete": 0, "last_capture_ts": None}
+           "stage_retries": 0, "captures_complete": 0,
+           "last_capture_ts": None}
     if not out["exists"]:
         return out
     # Cycles accumulate ACROSS watch runs (each run restarts at cycle 1):
@@ -549,6 +550,8 @@ def status(log_path: str = LOG_PATH) -> dict:
                 run_max = max(run_max, e.get("cycle", 0))
             if ev == "grant":
                 out["grants"] += 1
+            if ev == "stage-retry":
+                out["stage_retries"] += 1
             if ev == "capture-done":
                 if e.get("complete"):
                     out["captures_complete"] += 1
