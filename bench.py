@@ -35,20 +35,25 @@ from tpu_cooccurrence.bench.grant_watch import (
     BENCH_CPU_DEADLINE_S as CPU_DEADLINE_S)
 
 
-def run(backend: str, users, items, ts, num_items: int, window_ms: int):
+def run(backend: str, users, items, ts, num_items: int, window_ms: int,
+        pipeline_depth: int = 0):
     from tpu_cooccurrence.config import Backend, Config
     from tpu_cooccurrence.job import CooccurrenceJob
     from tpu_cooccurrence.metrics import OBSERVED_COOCCURRENCES
 
     cfg = Config(window_size=window_ms, seed=0xC0FFEE, item_cut=500,
-                 user_cut=500, backend=Backend(backend), num_items=num_items)
+                 user_cut=500, backend=Backend(backend), num_items=num_items,
+                 pipeline_depth=pipeline_depth)
     job = CooccurrenceJob(cfg)
     start = time.monotonic()
     job.add_batch(users, items, ts)
     job.finish()
     elapsed = time.monotonic() - start
     pairs = job.counters.get(OBSERVED_COOCCURRENCES)
-    return pairs, elapsed
+    # Per-stage busy fractions (observability.StepTimer.occupancy): the
+    # pipeline-overlap diagnostic — a serial run's host+score sums to
+    # <= ~100%, an overlapped run exceeds it.
+    return pairs, elapsed, job.step_timer.occupancy(elapsed)
 
 
 # Shared execute-a-real-op probe (grant_watch imports no jax, so this
@@ -58,11 +63,18 @@ def run(backend: str, users, items, ts, num_items: int, window_ms: int):
 from tpu_cooccurrence.bench.grant_watch import probe_backend
 
 
-def _record_onchip(value: float, vs_baseline: float, backend: str) -> None:
-    """Append a successful on-chip measurement to the bench history."""
+def _record_onchip(value: float, vs_baseline: float, backend: str,
+                   pipeline_depth: int, occupancy: dict) -> None:
+    """Append a successful on-chip measurement to the bench history.
+
+    ``pipeline_depth`` and the per-stage occupancy ride along so the
+    overlap win (host-busy% + score-busy% > 100) is visible in the
+    trajectory, not just in a single run's stdout.
+    """
     entry = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
              "pairs_per_sec": value, "vs_baseline": vs_baseline,
-             "backend": backend}
+             "backend": backend, "pipeline_depth": pipeline_depth,
+             "occupancy": occupancy}
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -109,6 +121,7 @@ def measure() -> None:
 
     n_events = int(os.environ.get("BENCH_EVENTS", 400_000))
     n_items = int(os.environ.get("BENCH_ITEMS", 20_000))
+    pipeline_depth = int(os.environ.get("BENCH_PIPELINE_DEPTH", 0))
     users, items, ts = zipfian_interactions(
         n_events, n_items=n_items, n_users=5_000, alpha=1.1, seed=3,
         events_per_ms=200)
@@ -116,17 +129,20 @@ def measure() -> None:
     # Untimed warmup on the full stream: populates the jit caches for every
     # pad bucket the measured run will hit, so the metric is steady-state
     # throughput rather than one-time XLA compile latency.
-    run("device", users, items, ts, num_items=n_items, window_ms=100)
+    run("device", users, items, ts, num_items=n_items, window_ms=100,
+        pipeline_depth=pipeline_depth)
 
     # Median of three measured runs: the benched chip can be reached over a
     # shared tunnel, where single-run wall-clock swings by 2x under
-    # contention.
+    # contention. The occupancy published is the median run's.
     samples = []
     for _ in range(3):
-        pairs, elapsed = run("device", users, items, ts,
-                             num_items=n_items, window_ms=100)
-        samples.append(pairs / max(elapsed, 1e-9))
-    pairs_per_sec = sorted(samples)[1]
+        pairs, elapsed, occupancy = run("device", users, items, ts,
+                                        num_items=n_items, window_ms=100,
+                                        pipeline_depth=pipeline_depth)
+        samples.append((pairs / max(elapsed, 1e-9), occupancy))
+    samples.sort(key=lambda s: s[0])
+    pairs_per_sec, occupancy = samples[1]
 
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.
@@ -135,8 +151,8 @@ def measure() -> None:
         with open(baseline_path) as f:
             baseline = json.load(f)["pairs_per_sec"]
     else:
-        b_pairs, b_elapsed = run("oracle", users, items, ts,
-                                 num_items=n_items, window_ms=100)
+        b_pairs, b_elapsed, _ = run("oracle", users, items, ts,
+                                    num_items=n_items, window_ms=100)
         baseline = b_pairs / max(b_elapsed, 1e-9)
         with open(baseline_path, "w") as f:
             json.dump({"pairs_per_sec": baseline}, f)
@@ -149,6 +165,8 @@ def measure() -> None:
         "value": round(pairs_per_sec, 1),
         "unit": "pairs/s",
         "vs_baseline": round(pairs_per_sec / max(baseline, 1e-9), 3),
+        "pipeline_depth": pipeline_depth,
+        "occupancy": occupancy,
     }
     if backend == "cpu":
         out["platform"] = ("cpu-fallback"
@@ -165,7 +183,8 @@ def measure() -> None:
                 "stale": True,
             }
     else:
-        _record_onchip(out["value"], out["vs_baseline"], backend)
+        _record_onchip(out["value"], out["vs_baseline"], backend,
+                       pipeline_depth, occupancy)
     print(json.dumps(out))
 
 
@@ -197,7 +216,25 @@ def _run_child(env: dict, deadline_s: float):
 
 
 def main() -> None:
-    if "--measure" in sys.argv[1:]:
+    # --pipeline-depth N (default 0 = serial): the execution-mode knob
+    # under measurement; flows to the measurement children via env so the
+    # parent stays argv-compatible with the driver's bare invocation.
+    argv = sys.argv[1:]
+    if "--pipeline-depth" in argv:
+        i = argv.index("--pipeline-depth")
+        try:
+            depth = int(argv[i + 1])
+        except (IndexError, ValueError):
+            sys.stderr.write("bench: --pipeline-depth needs an integer\n")
+            return 2
+        if depth not in (0, 1, 2):
+            # Fail here, not minutes later as an opaque all-children-
+            # failed artifact after the backend probe has run.
+            sys.stderr.write(
+                f"bench: --pipeline-depth must be 0, 1 or 2, got {depth}\n")
+            return 2
+        os.environ["BENCH_PIPELINE_DEPTH"] = str(depth)
+    if "--measure" in argv:
         return measure()
 
     # Parent: never imports jax; all chip contact is in deadline'd

@@ -1,6 +1,7 @@
 """Shared per-window COO aggregation (ops/aggregate.py)."""
 
 import numpy as np
+import pytest
 
 from tpu_cooccurrence.ops.aggregate import aggregate_window_coo, distinct_sorted
 
@@ -108,3 +109,56 @@ def test_native_fold_length_mismatch_raises():
     with pytest.raises(ValueError, match="delta length"):
         coo_aggregate(np.zeros(4, dtype=np.int64),
                       np.zeros(3, dtype=np.int64))
+
+def test_native_fold_rejects_non_integer_delta():
+    """The native int64 fold would silently truncate fractional deltas
+    where the float64 NumPy fallback sums them exactly — non-integer
+    input must raise instead of folding differently by code path."""
+    from tpu_cooccurrence.native import coo_aggregate, get_lib
+
+    if get_lib() is None:
+        pytest.skip("native fold unavailable; dtype guard unexercised")
+
+    with pytest.raises(TypeError, match="integer"):
+        coo_aggregate(np.zeros(3, dtype=np.int64),
+                      np.asarray([0.5, 1.0, 2.0]))
+
+
+def test_return_key_does_not_pin_full_buffer(monkeypatch):
+    """return_key=True hands back an owning copy, not a prefix view of the
+    (potentially >= 4M-entry) packed-key work buffer.
+
+    The hazard lives in the NATIVE branch (the fold returns a prefix view
+    of its full sort buffer), so the threshold is lowered to force that
+    routing; the numpy fallback's np.unique output owns its memory either
+    way."""
+    from tpu_cooccurrence.native import get_lib
+    from tpu_cooccurrence.ops import aggregate
+
+    if get_lib() is None:
+        pytest.skip("native fold unavailable; fallback output always owns")
+    monkeypatch.setattr(aggregate, "NATIVE_FOLD_MIN", 1)
+    src = np.asarray([3, 1, 1, 3], dtype=np.int32)
+    dst = np.asarray([0, 2, 2, 0], dtype=np.int32)
+    delta = np.asarray([1, 1, 1, 1], dtype=np.int64)
+    _, _, agg, key = aggregate_window_coo(src, dst, delta, return_key=True)
+    assert key.base is None, "d_key must own its memory"
+    assert agg.base is None, "folded deltas must own their memory"
+    np.testing.assert_array_equal(
+        key, np.asarray([(1 << 32) | 2, (3 << 32) | 0], dtype=np.int64))
+    np.testing.assert_array_equal(agg, np.asarray([2, 2], dtype=np.int64))
+
+
+def test_aggregated_pairs_fold_matches_direct():
+    from tpu_cooccurrence.ops.aggregate import AggregatedPairs
+
+    src = np.asarray([5, 2, 5, 2, 7], dtype=np.int32)
+    dst = np.asarray([1, 3, 1, 3, 0], dtype=np.int32)
+    delta = np.asarray([1, -1, 2, 4, 1], dtype=np.int64)
+    agg = AggregatedPairs.fold(src, dst, delta)
+    s, d, v, k = aggregate_window_coo(src, dst, delta, return_key=True)
+    np.testing.assert_array_equal(agg.src, s)
+    np.testing.assert_array_equal(agg.dst, d)
+    np.testing.assert_array_equal(agg.delta, v)
+    np.testing.assert_array_equal(agg.key, k)
+    assert len(agg) == len(s)

@@ -1,0 +1,234 @@
+"""Pipelined execution (`--pipeline-depth`, pipeline.py) tests.
+
+The contract under test is EXACT parity: at any depth the pipelined job
+must emit bit-identical per-window top-K tables, final results, and
+counters to the serial path on the same seeded Zipfian stream — the
+overlap is a scheduling change, not a math change. Plus the lifecycle
+guarantees: ordered mid-stream shutdown (nothing dropped or
+double-applied), worker-failure latching (no deadlocked producer), and
+the checkpoint barrier.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config
+from tpu_cooccurrence.io.synthetic import zipfian_interactions
+from tpu_cooccurrence.job import CooccurrenceJob
+from tpu_cooccurrence.pipeline import PipelineDriver, PipelineError, StagedWindow
+from tpu_cooccurrence.state.results import materialize_dense
+
+
+def zipf_stream(n=12_000, n_items=400, n_users=150, seed=3):
+    return zipfian_interactions(n, n_items=n_items, n_users=n_users,
+                                alpha=1.1, seed=seed, events_per_ms=40)
+
+
+def run_job(backend, depth, users, items, ts, chunk=997, collect=False,
+            **cfg_kw):
+    cfg_kw.setdefault("item_cut", 50)
+    cfg_kw.setdefault("user_cut", 50)
+    cfg = Config(window_size=100, seed=7,
+                 backend=Backend(backend), pipeline_depth=depth, **cfg_kw)
+    job = CooccurrenceJob(cfg)
+    emitted = []
+    if collect:
+        # Per-window emission stream: in pipelined mode this fires on the
+        # scorer worker, in serial mode on the caller — the sequences
+        # must still be identical (FIFO scoring order).
+        job.on_update = lambda out: emitted.append(materialize_dense(out))
+    for lo in range(0, len(users), chunk):
+        job.add_batch(users[lo:lo + chunk], items[lo:lo + chunk],
+                      ts[lo:lo + chunk])
+    job.finish()
+    return job, emitted
+
+
+def assert_jobs_identical(a, b):
+    assert a.counters.as_dict() == b.counters.as_dict()
+    assert a.windows_fired == b.windows_fired
+    assert set(a.latest) == set(b.latest)
+    for item in a.latest:
+        assert a.latest[item] == b.latest[item], item
+
+
+# -- exact serial-vs-pipelined parity ----------------------------------
+
+
+@pytest.mark.parametrize("backend", ["oracle", "sparse", "device"])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_parity_final_state(backend, depth):
+    """Final top-K tables and every counter are bit-identical to serial."""
+    users, items, ts = zipf_stream()
+    serial, _ = run_job(backend, 0, users, items, ts)
+    piped, _ = run_job(backend, depth, users, items, ts)
+    assert_jobs_identical(serial, piped)
+
+
+@pytest.mark.parametrize("backend", ["oracle", "sparse"])
+def test_parity_every_window(backend):
+    """The per-window emission stream matches window for window.
+
+    --emit-updates keeps per-window results flowing (no deferred table),
+    so this pins the FIFO ordering guarantee: window N's table is
+    identical AND arrives before window N+1's, exactly as in serial.
+    """
+    users, items, ts = zipf_stream()
+    _, serial_windows = run_job(backend, 0, users, items, ts,
+                                collect=True, emit_updates=True)
+    _, piped_windows = run_job(backend, 2, users, items, ts,
+                               collect=True, emit_updates=True)
+    assert len(serial_windows) == len(piped_windows)
+    assert serial_windows == piped_windows
+
+
+def test_parity_sliding_windows():
+    """Sliding mode (stateless sampler, no feedback edge) pipelines too."""
+    users, items, ts = zipf_stream(n=8_000)
+    serial, _ = run_job("oracle", 0, users, items, ts, window_slide=50)
+    piped, _ = run_job("oracle", 2, users, items, ts, window_slide=50)
+    assert_jobs_identical(serial, piped)
+
+
+def test_parity_with_feedback_edge():
+    """Aggressive cuts produce rejections; the feedback decrement stays on
+    the sampling thread and must land before the NEXT window fires —
+    divergence here would show up as different sampled pair counts."""
+    users, items, ts = zipf_stream()
+    serial, _ = run_job("oracle", 0, users, items, ts, item_cut=8,
+                        user_cut=4)
+    piped, _ = run_job("oracle", 2, users, items, ts, item_cut=8,
+                       user_cut=4)
+    assert_jobs_identical(serial, piped)
+
+
+def test_parity_across_checkpoint_barrier(tmp_path):
+    """Periodic checkpoints barrier the pipeline; the snapshot point (and
+    everything after it) matches serial exactly."""
+    users, items, ts = zipf_stream(n=8_000)
+    serial, _ = run_job("sparse", 0, users, items, ts,
+                        checkpoint_dir=str(tmp_path / "s"),
+                        checkpoint_every_windows=2)
+    piped, _ = run_job("sparse", 2, users, items, ts,
+                       checkpoint_dir=str(tmp_path / "p"),
+                       checkpoint_every_windows=2)
+    assert_jobs_identical(serial, piped)
+    assert piped.pipeline is not None
+    assert piped.pipeline.windows_processed == piped.windows_fired
+
+
+# -- lifecycle: shutdown, drain, failure -------------------------------
+
+
+def test_mid_stream_close_drops_nothing():
+    """Killing the driver mid-stream processes everything already
+    submitted exactly once; resuming afterwards still ends bit-identical
+    to serial (nothing dropped, nothing double-applied)."""
+    users, items, ts = zipf_stream()
+    serial, _ = run_job("oracle", 0, users, items, ts)
+
+    cfg = Config(window_size=100, seed=7, item_cut=50, user_cut=50,
+                 backend=Backend.ORACLE, pipeline_depth=2)
+    job = CooccurrenceJob(cfg)
+    half = len(users) // 2
+    job.add_batch(users[:half], items[:half], ts[:half])
+    fired_at_close = job.windows_fired
+    job.pipeline.close()  # ordered: drains the queue, then joins
+    # Every submitted window was scored exactly once before the join.
+    assert job.pipeline.windows_processed == fired_at_close
+    assert len(job.step_timer.windows) == fired_at_close
+    # The driver restarts its worker on the next submit; the stream
+    # continues and the end state is still exact.
+    job.add_batch(users[half:], items[half:], ts[half:])
+    job.finish()
+    assert job.pipeline.windows_processed == job.windows_fired
+    assert_jobs_identical(serial, job)
+
+
+def test_worker_failure_latches_and_raises():
+    """A scorer failure on the worker re-raises on the caller thread as
+    PipelineError, and the producer can never deadlock against the dead
+    consumer (queued slots keep being recycled)."""
+
+    class ExplodingScorer:
+        accepts_aggregated = False
+
+        def process_window(self, ts, pairs):
+            raise RuntimeError("boom")
+
+    cfg = Config(window_size=100, seed=7, backend=Backend.ORACLE,
+                 pipeline_depth=1)
+    job = CooccurrenceJob(cfg, scorer=ExplodingScorer())
+    users, items, ts = zipf_stream(n=4_000)
+    with pytest.raises(PipelineError, match="boom"):
+        for lo in range(0, len(users), 499):
+            job.add_batch(users[lo:lo + 499], items[lo:lo + 499],
+                          ts[lo:lo + 499])
+        job.finish()
+    # The raise tears the worker down first: a caller that catches the
+    # error and discards the job must not leak a parked daemon thread
+    # (which would pin the job, scorer, and device buffers forever).
+    worker = job.pipeline._worker
+    assert worker is None or not worker.is_alive()
+
+
+def test_submit_order_is_fifo():
+    """Windows are scored in exactly the submitted order (the parity
+    guarantee's mechanical core), even at depth 2."""
+
+    class Recorder:
+        accepts_aggregated = False
+
+        def __init__(self):
+            self.seen = []
+
+        def process_window(self, ts, pairs):
+            self.seen.append(ts)
+            return []
+
+    cfg = Config(window_size=100, seed=7, backend=Backend.ORACLE,
+                 pipeline_depth=2)
+    rec = Recorder()
+    job = CooccurrenceJob(cfg, scorer=rec)
+    driver = job.pipeline
+    for w in range(7):
+        driver.submit(StagedWindow(ts=w, payload=None, events=0,
+                                   raw_pairs=0, sample_seconds=0.0))
+    driver.barrier()
+    assert rec.seen == list(range(7))
+    driver.close()
+
+
+def test_staging_ring_is_bounded():
+    """Backpressure: the ring never allocates beyond depth + 1 slots, and
+    every slot is recycled by the end of the run."""
+    users, items, ts = zipf_stream(n=8_000)
+    job, _ = run_job("sparse", 2, users, items, ts)
+    ring = job.pipeline.ring
+    assert ring._free.qsize() == 2 + 1  # queue positions + active side
+
+
+# -- configuration surface ---------------------------------------------
+
+
+def test_depth_validation():
+    # Config validates in __post_init__ — construction itself raises.
+    with pytest.raises(ValueError, match="pipeline-depth"):
+        Config(window_size=100, pipeline_depth=3)
+    with pytest.raises(ValueError, match="single-process"):
+        Config(window_size=100, pipeline_depth=1, coordinator="h:1234",
+               num_processes=2, process_id=0, backend=Backend.SHARDED,
+               num_shards=2, num_items=64)
+    with pytest.raises(ValueError):
+        PipelineDriver(job=None, depth=0)
+
+
+def test_occupancy_reports_both_stages():
+    """StepTimer.occupancy feeds the run log and bench JSON; both stage
+    fractions and the wall clock must be present and sane."""
+    users, items, ts = zipf_stream(n=6_000)
+    job, _ = run_job("oracle", 1, users, items, ts)
+    occ = job.step_timer.occupancy(1.0)
+    assert set(occ) == {"host_busy_pct", "score_busy_pct", "wall_seconds"}
+    assert occ["host_busy_pct"] > 0
+    assert occ["score_busy_pct"] > 0

@@ -48,6 +48,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          delay_s=config.restart_delay_ms / 1000.0)
 
     config.log_configuration(LOG)
+    if config.pipeline_depth > 0:
+        # Make the execution mode unmissable in the run log: with
+        # --emit-updates the result stream is produced by the pipeline's
+        # scorer worker (one step behind the device frontier), not the
+        # ingest thread — relevant when correlating stdout with stderr
+        # timing lines.
+        LOG.info("pipelined execution: depth=%d (host sampling overlaps "
+                 "device scoring; output is bit-identical to serial)",
+                 config.pipeline_depth)
 
     job = CooccurrenceJob(config)
     source = FileMonitorSource(

@@ -119,6 +119,11 @@ class Config:
     count_dtype: str = "int32"  # dense C cell dtype; int16 halves HBM
     # (reference-style short counts incl. its wraparound, doubles the
     # dense/sharded vocab ceiling)
+    pipeline_depth: int = 0  # pipelined execution: the caller thread
+    # samples window N+1 while a worker thread runs the scorer for
+    # window N (pipeline.py). 0 = serial (today's behavior); 1 =
+    # single-window overlap; 2 = double-buffered (absorbs stage jitter).
+    # Bit-identical output to serial at every depth (parity-tested).
     development_mode: bool = False  # invariant checks (FlinkCooccurrences.java:34)
     emit_updates: bool = False  # stream every window's updated top-K rows
     # to stdout as they materialize (the consumable form of the
@@ -171,6 +176,18 @@ class Config:
                 raise ValueError(
                     "--partition-sampling is a multi-host mode — it needs "
                     "--coordinator/--num-processes/--process-id")
+        if self.pipeline_depth not in (0, 1, 2):
+            raise ValueError(
+                f"--pipeline-depth must be 0, 1 or 2, got "
+                f"{self.pipeline_depth}")
+        if self.pipeline_depth > 0 and self.coordinator is not None:
+            # Multi-controller collectives must be issued in the same
+            # order on every process; a per-process scorer thread racing
+            # a sampling thread (which also collects under
+            # --partition-sampling) cannot guarantee that lockstep.
+            raise ValueError(
+                "--pipeline-depth > 0 is single-process only (multi-host "
+                "runs issue collectives from the job thread in lockstep)")
 
     @property
     def window_millis(self) -> int:
@@ -267,6 +284,13 @@ class Config:
                        help="Sparse-backend fixed-shape scoring (constant "
                             "per-bucket rectangles; auto = on for real "
                             "TPUs when results are deferred)")
+        p.add_argument("--pipeline-depth", type=int, choices=[0, 1, 2],
+                       default=0, dest="pipeline_depth",
+                       help="Overlap host sampling with device scoring: "
+                            "sample window N+1 while the scorer runs "
+                            "window N on a worker thread (0 = serial, "
+                            "2 = double-buffered; output is bit-identical "
+                            "at every depth)")
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")

@@ -134,6 +134,17 @@ class CooccurrenceJob:
         # mode has no feedback edge (per-window caps, no rejections).
         if not config.skip_cuts and not self.sliding:
             self.counters.add(FEEDBACK_QUEUES, 1)
+        # Pipelined execution (--pipeline-depth > 0): the caller thread
+        # keeps sampling window N+1 while a worker thread runs the scorer
+        # for window N (pipeline.py — the Flink-operator-overlap
+        # analogue). Depth 0 is the serial path, bit-identical by the
+        # parity tests. The feedback edge stays on the sampling thread,
+        # so its between-fires ordering is untouched.
+        self.pipeline = None
+        if config.pipeline_depth > 0:
+            from .pipeline import PipelineDriver
+
+            self.pipeline = PipelineDriver(self, config.pipeline_depth)
 
     def _parse_fixed_score(self):
         fixed = {"auto": None, "on": True,
@@ -186,12 +197,18 @@ class CooccurrenceJob:
             fixed = self._parse_fixed_score()
             if self.config.num_shards > 1:
                 from .parallel.distributed import maybe_multihost_mesh
+
+                # Join the multi-controller runtime BEFORE importing the
+                # scorer module: its jits probe the backend at import
+                # (ops/donation.py), and jax.distributed.initialize must
+                # precede any backend initialization.
+                mesh = maybe_multihost_mesh(self.config)
                 from .parallel.sharded_sparse import ShardedSparseScorer
 
                 return ShardedSparseScorer(
                     self.config.top_k, num_shards=self.config.num_shards,
                     counters=self.counters,
-                    mesh=maybe_multihost_mesh(self.config),
+                    mesh=mesh,
                     development_mode=self.config.development_mode,
                     score_ladder=self.config.score_ladder,
                     defer_results=not self.config.emit_updates,
@@ -218,6 +235,11 @@ class CooccurrenceJob:
                                       fixed_shapes=fixed,
                                       use_pallas=self.config.pallas)
         if backend == Backend.SHARDED:
+            from .parallel.distributed import maybe_multihost_mesh
+
+            # Multi-controller init before the scorer import — see the
+            # sharded-sparse branch above.
+            mesh = maybe_multihost_mesh(self.config)
             from .parallel.sharded import ShardedScorer
 
             num_items = self.config.num_items
@@ -225,12 +247,10 @@ class CooccurrenceJob:
             # starts small and doubles (resharding) on growth, like the
             # dense backend. Multi-host still needs an explicit capacity
             # (ShardedScorer raises: capacity must agree across processes).
-            from .parallel.distributed import maybe_multihost_mesh
-
             return ShardedScorer(num_items, self.config.top_k,
                                  num_shards=self.config.num_shards,
                                  counters=self.counters,
-                                 mesh=maybe_multihost_mesh(self.config),
+                                 mesh=mesh,
                                  count_dtype=self.config.count_dtype,
                                  use_pallas=self.config.pallas)
         raise ValueError(f"unknown backend {backend}")
@@ -257,7 +277,21 @@ class CooccurrenceJob:
 
     def finish(self) -> None:
         """End of stream — Watermark(MAX_VALUE) fires everything."""
-        self._drain(final=True)
+        try:
+            self._drain(final=True)
+        except BaseException:
+            if self.pipeline is not None:
+                # Join the worker so no daemon thread outlives the job,
+                # but keep the in-flight exception as THE failure — a
+                # close() here could replace it with the worker's own
+                # latched error and point the operator at the wrong one.
+                self.pipeline._shutdown_worker()
+            raise
+        if self.pipeline is not None:
+            # Ordered shutdown: the final drain already barriered, so the
+            # close is immediate; it also surfaces any latched worker
+            # error before the balance check below can mask it.
+            self.pipeline.close()
         if (self.config.development_mode
                 and not getattr(self.scorer, "process_suffix", "")
                 and not getattr(self.scorer, "defer_results", False)):
@@ -291,6 +325,11 @@ class CooccurrenceJob:
         LOG.info("Duration\t%d", duration_ms)
         LOG.info("Accumulator results: %s", self.counters)
         LOG.info("Step timing: %s", self.step_timer.summary())
+        # Per-stage busy fractions over the wall clock: a serial run sums
+        # to <= ~100%, an overlapped pipelined run exceeds it — the
+        # one-line visibility of the pipeline win (ROADMAP: host bubble).
+        LOG.info("Stage occupancy: %s",
+                 self.step_timer.occupancy(duration_ms / 1000.0))
         self.duration_ms = duration_ms
         return self.latest
 
@@ -315,26 +354,50 @@ class CooccurrenceJob:
                     if not self.config.skip_cuts and len(feedback_items):
                         self.item_cut.apply_feedback(
                             feedback_items, self.config.development_mode, self.counters)
-            # Score on the backend.
-            with clock() as score_clock:
-                window_out: WindowTopK = self.scorer.process_window(ts, pairs)
-            # Pipelined backends return the previous window's results;
-            # they expose the count actually dispatched for this window.
-            self.step_timer.record(WindowStats(
-                timestamp=ts, events=len(items), pairs=len(pairs),
-                rows_scored=getattr(self.scorer, "last_dispatched_rows",
-                                    len(window_out)),
-                sample_seconds=sample_clock.seconds,
-                score_seconds=score_clock.seconds))
-            self._absorb(window_out)
+                if self.pipeline is not None:
+                    # Pre-fold on the sampling thread for backends that
+                    # accept aggregated deltas — the scorer worker's turn
+                    # then starts at slot allocation / COO packing.
+                    payload, slot = self._stage(pairs)
+            if self.pipeline is not None:
+                from .pipeline import StagedWindow
+
+                self.pipeline.submit(StagedWindow(
+                    ts=ts, payload=payload, events=len(items),
+                    raw_pairs=len(pairs),
+                    sample_seconds=sample_clock.seconds, slot=slot))
+            else:
+                # Score on the backend.
+                with clock() as score_clock:
+                    window_out: WindowTopK = self.scorer.process_window(ts, pairs)
+                # Pipelined backends return the previous window's results;
+                # they expose the count actually dispatched for this window.
+                self.step_timer.record(WindowStats(
+                    timestamp=ts, events=len(items), pairs=len(pairs),
+                    rows_scored=getattr(self.scorer, "last_dispatched_rows",
+                                        len(window_out)),
+                    sample_seconds=sample_clock.seconds,
+                    score_seconds=score_clock.seconds))
+                self._absorb(window_out)
             if (self.config.checkpoint_dir
                     and self.config.checkpoint_every_windows > 0
                     and self.windows_fired % self.config.checkpoint_every_windows == 0):
+                # checkpoint() barriers the pipeline first, so the
+                # snapshot point is identical to the serial path's.
                 self.checkpoint(source=self.source)
         if final:
+            if self.pipeline is not None:
+                self.pipeline.barrier()
             # Backends with a result pipeline (device) hold the last window's
             # top-K in flight; drain it.
             self._absorb(self._flush_scorer())
+
+    def _stage(self, pairs):
+        """Producer-side staging: fold into a ring slot when the backend
+        accepts pre-aggregated deltas; raw pass-through otherwise."""
+        if len(pairs) and getattr(self.scorer, "accepts_aggregated", False):
+            return self.pipeline.ring.stage(pairs)
+        return pairs, None
 
     def _flush_scorer(self) -> WindowTopK:
         flush = getattr(self.scorer, "flush", None)
@@ -354,6 +417,11 @@ class CooccurrenceJob:
     def checkpoint(self, source=None) -> None:
         from .state import checkpoint as ckpt
 
+        if self.pipeline is not None:
+            # Feedback-edge/result ordering forces a sync here: every
+            # submitted window must be scored and absorbed before the
+            # snapshot, or the scorer state would lag the sampler's.
+            self.pipeline.barrier()
         # Results still in the scorer's fetch pipeline belong to already-
         # processed windows; land them in `latest` before snapshotting.
         self._absorb(self._flush_scorer())

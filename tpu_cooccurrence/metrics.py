@@ -21,36 +21,51 @@ every buffered element is eventually processed — holds structurally).
 
 from __future__ import annotations
 
+import threading
 from collections import defaultdict
 from typing import Dict
 
 
 class Counters:
-    """A flat named-counter registry (Flink accumulator analogue)."""
+    """A flat named-counter registry (Flink accumulator analogue).
+
+    Increments are locked: in pipelined execution (``pipeline.py``) the
+    sampling thread and the scorer worker update the same registry, and a
+    Python ``dict[k] += v`` is a read-modify-write the GIL does not make
+    atomic. The lock is per-window-scale traffic (a handful of adds per
+    fire), not per-event — uncontended cost is noise.
+    """
 
     def __init__(self) -> None:
         self._counters: Dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
 
     def add(self, name: str, delta: int = 1) -> None:
-        self._counters[name] += delta
+        with self._lock:
+            self._counters[name] += delta
 
     def get(self, name: str) -> int:
         return self._counters.get(name, 0)
 
     def as_dict(self) -> Dict[str, int]:
-        return dict(self._counters)
+        with self._lock:
+            return dict(self._counters)
 
     def merge(self, other: "Counters") -> None:
-        for name, value in other._counters.items():
-            self._counters[name] += value
+        with self._lock:
+            for name, value in other._counters.items():
+                self._counters[name] += value
 
     def replace_all(self, values: Dict[str, int]) -> None:
         """Overwrite all counters (checkpoint restore)."""
-        self._counters.clear()
-        self._counters.update(values)
+        with self._lock:
+            self._counters.clear()
+            self._counters.update(values)
 
     def __repr__(self) -> str:
-        inner = ", ".join(f"{k}={v}" for k, v in sorted(self._counters.items()))
+        with self._lock:
+            inner = ", ".join(
+                f"{k}={v}" for k, v in sorted(self._counters.items()))
         return f"{{{inner}}}"
 
 

@@ -183,6 +183,14 @@ def coo_aggregate(key: np.ndarray, delta: np.ndarray,
         # loop would read past the buffer instead.
         raise ValueError(
             f"coo_aggregate: delta length {len(delta)} != key length {n}")
+    if not np.issubdtype(np.asarray(delta).dtype, np.integer):
+        # The int64 conversion below would silently truncate fractional
+        # deltas, diverging from the float64 bincount fallback (which
+        # sums them exactly). No caller ships non-integer deltas today;
+        # a future one must not fold differently by buffer size.
+        raise TypeError(
+            f"coo_aggregate: delta dtype must be integer, got "
+            f"{np.asarray(delta).dtype} (the native fold sums int64)")
     if n == 0:
         return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
     keys = np.ascontiguousarray(key, dtype=np.int64)

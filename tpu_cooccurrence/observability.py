@@ -66,6 +66,24 @@ class StepTimer:
         to look when a run's step timing regresses."""
         return sorted(self.windows, key=lambda w: -w.seconds)[:n]
 
+    def occupancy(self, wall_seconds: float) -> Dict[str, float]:
+        """Per-stage busy fractions of a run's wall clock.
+
+        The pipeline-overlap diagnostic (pipeline.py): a serial run's
+        ``host_busy_pct + score_busy_pct`` sums to at most ~100 (plus
+        ingest overhead outside both stages); a pipelined run exceeds
+        100 exactly by the overlap won. ``score_busy_pct`` counts the
+        scorer stage's thread time (host index/pack work + dispatch +
+        result materialization), not raw device occupancy — on an async
+        backend the device can be busy past it.
+        """
+        w = max(wall_seconds, 1e-9)
+        return {
+            "host_busy_pct": round(100.0 * self.total_sample_seconds / w, 1),
+            "score_busy_pct": round(100.0 * self.total_score_seconds / w, 1),
+            "wall_seconds": round(wall_seconds, 4),
+        }
+
 
 @dataclasses.dataclass
 class TransferEvent:

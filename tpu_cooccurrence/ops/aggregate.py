@@ -8,6 +8,7 @@ which a TPU scatter would otherwise apply serially.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Tuple
 
 import numpy as np
@@ -47,6 +48,14 @@ def aggregate_window_coo(src: np.ndarray, dst: np.ndarray,
         folded = coo_aggregate(key, delta, clobber_key=True)
     if folded is not None:
         uniq_key, agg = folded
+        # The native fold returns PREFIX VIEWS of its full raw-size work
+        # buffers; a caller retaining the folded deltas or d_key (scorer
+        # index paths, AggregatedPairs, the pipeline's staging ring)
+        # would pin the whole >= 4M-entry allocation behind a
+        # few-hundred-K prefix. Copies are m-scale — cheap.
+        agg = agg.copy()
+        if return_key:
+            uniq_key = uniq_key.copy()
     else:
         uniq_key, inverse = np.unique(key, return_inverse=True)
         agg = np.bincount(inverse, weights=delta,
@@ -55,6 +64,34 @@ def aggregate_window_coo(src: np.ndarray, dst: np.ndarray,
            (uniq_key & 0xFFFFFFFF).astype(np.int32),
            agg)
     return out + (uniq_key,) if return_key else out
+
+
+@dataclasses.dataclass
+class AggregatedPairs:
+    """One window's pair deltas already folded by :func:`aggregate_window_coo`.
+
+    The pipelined execution mode (``pipeline.py``) runs the fold on its
+    host staging thread so the scorer's turn starts at slot allocation /
+    COO packing; scorers that set ``accepts_aggregated = True`` take this
+    in place of a raw ``PairDeltaBatch`` and skip their own fold. The
+    fields are exactly the ``return_key=True`` output (sorted by packed
+    key, one entry per distinct cell, int64 exact deltas), so a scorer
+    consuming them is bit-identical to one folding the raw batch itself.
+    """
+
+    src: np.ndarray    # [M] int32, sorted (primary key)
+    dst: np.ndarray    # [M] int32
+    delta: np.ndarray  # [M] int64 exact folded deltas
+    key: np.ndarray    # [M] int64 packed src << 32 | dst, sorted
+
+    def __len__(self) -> int:
+        return len(self.src)
+
+    @staticmethod
+    def fold(src, dst, delta) -> "AggregatedPairs":
+        s, d, v, k = aggregate_window_coo(
+            src, dst, delta.astype(np.int64), return_key=True)
+        return AggregatedPairs(s, d, v, k)
 
 
 def narrow_deltas_int32(agg: np.ndarray) -> np.ndarray:

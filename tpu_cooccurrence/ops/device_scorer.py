@@ -49,6 +49,7 @@ from ..sampling.reservoir import PairDeltaBatch
 from ..state.results import TopKBatch
 from .aggregate import (aggregate_window_coo, distinct_sorted,
                         narrow_deltas_int32)
+from .donation import donate_argnums
 from .llr import llr_stable
 
 
@@ -155,12 +156,12 @@ def _apply_coo(C, row_sums, src, dst, delta, num_items: int):
     return C, row_sums + rs_delta
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1), static_argnames=("num_items",))
 def _update(C, row_sums, src, dst, delta, num_items: int):
     return _apply_coo(C, row_sums, src, dst, delta, num_items)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1), static_argnames=("num_items",))
 def _update_coo(C, row_sums, coo, num_items: int):
     """Scatter-apply a packed ``[3, N]`` (src, dst, delta) COO block.
 
@@ -171,7 +172,7 @@ def _update_coo(C, row_sums, coo, num_items: int):
     return _apply_coo(C, row_sums, coo[0], coo[1], coo[2], num_items)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1), static_argnames=("num_items",))
 def _update_coo_u16(C, row_sums, coo, num_items: int):
     """Scatter-apply a packed ``[3, N]`` uint16 COO block (half the bytes).
 
@@ -262,7 +263,7 @@ def split_upload_auto(arr: np.ndarray) -> Optional[Tuple]:
     return split_upload(arr, k) if k > 1 else None
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1), static_argnames=("num_items",))
 def _update_coo_chunked(C, row_sums, coo_parts, num_items: int):
     """_update_coo with the block arriving as K separate transfers;
     the concatenate is device-side and fuses away."""
@@ -270,7 +271,7 @@ def _update_coo_chunked(C, row_sums, coo_parts, num_items: int):
     return _apply_coo(C, row_sums, coo[0], coo[1], coo[2], num_items)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("num_items",))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1), static_argnames=("num_items",))
 def _update_coo_u16_chunked(C, row_sums, coo_parts, num_items: int):
     coo = jnp.concatenate(coo_parts, axis=1)
     src = coo[0].astype(jnp.int32)
@@ -330,7 +331,7 @@ def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
 _SENT_ROW = np.int32(2**31 - 1)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0))
 def _scatter_packed(tbl, packed, scatter_rows):
     return tbl.at[:, scatter_rows].set(packed, mode="drop")
 

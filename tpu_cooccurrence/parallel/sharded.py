@@ -46,6 +46,7 @@ from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
 from ..ops.llr import llr_stable
 from ..ops.device_scorer import (pad_pow2, resolve_pallas_flag,
                                  score_row_budget, topk_padded)
+from ..ops.donation import donate_argnums
 from ..sampling.reservoir import PairDeltaBatch
 from .mesh import (ITEM_AXIS, make_mesh, pad_to_multiple,
                    shard_map_maybe_relaxed)
@@ -179,7 +180,7 @@ class ShardedScorer:
             _update, mesh=self.mesh,
             in_specs=(P(ITEM_AXIS, None), P(), P(ITEM_AXIS)),
             out_specs=(P(ITEM_AXIS, None), P()),
-        ), donate_argnums=(0, 1))
+        ), donate_argnums=donate_argnums(0, 1))
         self._score = jax.jit(shard_map_maybe_relaxed(
             _score, self.mesh,
             (P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),

@@ -52,6 +52,7 @@ from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              narrow_deltas_int32)
 from ..ops.device_scorer import pad_pow2, pad_pow4
+from ..ops.donation import donate_argnums
 from ..sampling.reservoir import PairDeltaBatch
 from ..state.results import TopKBatch
 from ..state.sparse_scorer import (_SENT, SlabIndex, _apply_cells,
@@ -177,7 +178,7 @@ class ShardedSparseScorer:
             in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None), P(),
                       P(ITEM_AXIS), P(ITEM_AXIS), P(ITEM_AXIS)),
             out_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None), P()),
-        ), donate_argnums=(0, 1, 2))
+        ), donate_argnums=donate_argnums(0, 1, 2))
 
         # Move/grow/compaction programs are built per static width on
         # demand and cached — a fresh jit wrapper per call would miss
@@ -208,7 +209,7 @@ class ShardedSparseScorer:
                 in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None),
                           P(ITEM_AXIS)),
                 out_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None)),
-            ), donate_argnums=(0, 1))
+            ), donate_argnums=donate_argnums(0, 1))
             self._move_fns[L] = fn
         return fn
 
@@ -273,7 +274,7 @@ class ShardedSparseScorer:
                 _score_into, self.mesh,
                 (P(ITEM_AXIS), P(ITEM_AXIS, None),
                  P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
-                P(ITEM_AXIS), relaxed=key[1]), donate_argnums=(0,))
+                P(ITEM_AXIS), relaxed=key[1]), donate_argnums=donate_argnums(0))
             self._score_into_fns[key] = fn
         return fn
 
@@ -303,7 +304,7 @@ class ShardedSparseScorer:
                 _f, self.mesh,
                 (P(ITEM_AXIS), P(ITEM_AXIS, None),
                  P(ITEM_AXIS, None), P(), P(ITEM_AXIS), P()),
-                P(ITEM_AXIS), relaxed=key[1]), donate_argnums=(0,))
+                P(ITEM_AXIS), relaxed=key[1]), donate_argnums=donate_argnums(0))
             self._score_window_fns[key] = fn
         return fn
 
@@ -367,7 +368,7 @@ class ShardedSparseScorer:
                 in_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None),
                           P(ITEM_AXIS)),
                 out_specs=(P(ITEM_AXIS, None), P(ITEM_AXIS, None)),
-            ), donate_argnums=(0, 1))
+            ), donate_argnums=donate_argnums(0, 1))
             self._compact_fns[g_pad] = fn
         return fn
 
@@ -402,7 +403,7 @@ class ShardedSparseScorer:
 
             self._tbl = jax.jit(shard_map(
                 _gt, mesh=self.mesh, in_specs=P(ITEM_AXIS),
-                out_specs=P(ITEM_AXIS)), donate_argnums=(0,))(old)
+                out_specs=P(ITEM_AXIS)), donate_argnums=donate_argnums(0))(old)
 
     def _ensure_heap(self, need_end: int) -> None:
         if need_end <= self.capacity:

@@ -1,0 +1,246 @@
+"""Pipelined window execution: overlap host sampling with device scoring.
+
+The serial job pays ``host_time + device_time`` per window: it samples a
+window on the caller thread, then runs the scorer's host work (fold, slot
+allocation, COO packing) and device dispatch before sampling the next one.
+The reference overlaps its operators across Flink task slots
+(``FlinkCooccurrences.java:89-167``); this module is the TPU build's
+equivalent — a bounded-depth producer/consumer pipeline:
+
+* the **caller thread** (producer) keeps running windowing + cuts + pair
+  generation for window ``N+1`` — including the per-cell fold when the
+  backend accepts pre-aggregated deltas (:class:`~.ops.aggregate.AggregatedPairs`) — and applies the feedback
+  edge (item-cut reject decrements) *before* firing the next window, so
+  the sampled stream is bit-identical to the serial path's;
+* the **scorer worker thread** (consumer) runs the backend's
+  ``process_window`` for window ``N`` — host-side index/packing plus the
+  already-jitted, donated-buffer device dispatch — and absorbs the
+  previous window's materialized top-K into ``LatestResults`` one step
+  behind the device frontier (the scorers' existing one-window result
+  pipeline / deferred table, unchanged).
+
+Nothing in the steady state forces ``block_until_ready``: the worker's
+dispatches return as soon as the transfer is enqueued, and synchronization
+happens only where results are consumed (``state/results.py``
+materialization) or a checkpoint fires (:meth:`PipelineDriver.barrier`).
+
+**Staging ring.** Staged windows ride a ring of ``depth + 1``
+pre-allocated, reusable host buffers (the packed fold output the worker
+hands to the scorer): one slot per queue position plus one for whichever
+side is actively packing or scoring. Reuse keeps the slot pages hot
+across windows and bounds staging memory: when every slot is in flight
+the producer blocks in ``stage`` until the worker recycles one — the
+memory-bound form of backpressure, one window ahead of the queue-bound
+form in ``submit``. A slot is recycled only after the
+worker's ``process_window`` for it returns — by then every staged byte
+has been copied into the scorer's own packed upload buffers, so the
+device never holds a reference into the ring (true page-pinning is not
+reachable from NumPy; warm, bounded, reused pages are the practical
+equivalent on this runtime).
+
+**Ordering and shutdown.** The queue is FIFO and the worker is single:
+windows are scored in exactly the serial order, and
+:meth:`PipelineDriver.close` processes everything already submitted
+before joining the thread — a mid-stream shutdown drops or double-applies
+nothing (``tests/test_pipeline_driver.py``). A worker failure is latched
+and re-raised on the caller thread at the next ``submit``/``barrier``/
+``close``; the worker keeps draining (and recycling) queued slots so the
+producer can never deadlock against a dead consumer.
+
+Parity argument (exact, not approximate): sampling state (item cut,
+reservoirs, RNG draws) lives entirely on the producer and is touched in
+the same order as the serial path; the scorer sees the identical
+``(ts, pairs)`` sequence through a FIFO; the fold the producer performs
+for ``accepts_aggregated`` backends is the same
+``aggregate_window_coo`` call the scorer would have made, byte for byte.
+``tests/test_pipeline_driver.py`` pins serial-vs-pipelined equality of
+top-K tables and counters on a seeded Zipfian stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .observability import WindowStats, clock
+from .ops.aggregate import AggregatedPairs
+
+#: Queue sentinel: process everything already enqueued, then exit.
+_SHUTDOWN = object()
+
+
+class PipelineError(RuntimeError):
+    """A scorer-worker failure, re-raised on the caller thread."""
+
+
+@dataclasses.dataclass
+class StagedWindow:
+    """One sampled window handed from the producer to the scorer worker."""
+
+    ts: int
+    payload: object          # PairDeltaBatch | AggregatedPairs
+    events: int              # window event count (observability)
+    raw_pairs: int           # pre-fold pair count (stats parity w/ serial)
+    sample_seconds: float    # producer-side stage time for this window
+    slot: Optional["_StagingSlot"] = None  # ring slot backing the payload
+
+
+class _StagingSlot:
+    """One ring slot: growable pinned-size buffers for a folded window."""
+
+    __slots__ = ("key", "delta", "src", "dst")
+
+    def __init__(self) -> None:
+        self.key = np.empty(0, np.int64)
+        self.delta = np.empty(0, np.int64)
+        self.src = np.empty(0, np.int32)
+        self.dst = np.empty(0, np.int32)
+
+    def pack(self, src, dst, delta, key) -> AggregatedPairs:
+        m = len(key)
+        if m > len(self.key):
+            cap = max(1 << 12, 1 << (m - 1).bit_length())
+            self.key = np.empty(cap, np.int64)
+            self.delta = np.empty(cap, np.int64)
+            self.src = np.empty(cap, np.int32)
+            self.dst = np.empty(cap, np.int32)
+        self.key[:m] = key
+        self.delta[:m] = delta
+        self.src[:m] = src
+        self.dst[:m] = dst
+        return AggregatedPairs(self.src[:m], self.dst[:m], self.delta[:m],
+                               self.key[:m])
+
+
+class StagingRing:
+    """Bounded pool of :class:`_StagingSlot`; ``stage`` blocks when every
+    slot is in flight (the memory-bound form of backpressure)."""
+
+    def __init__(self, depth: int) -> None:
+        self._free: "queue.Queue[_StagingSlot]" = queue.Queue()
+        # depth queue positions + 1 for the side actively packing/scoring:
+        # the producer can block here (memory-bound backpressure) but the
+        # worker's release always unblocks it — no deadlock.
+        for _ in range(depth + 1):
+            self._free.put(_StagingSlot())
+
+    def stage(self, pairs) -> "tuple[AggregatedPairs, _StagingSlot]":
+        """Fold one window's raw pair deltas and pack them into a slot."""
+        slot = self._free.get()
+        agg = AggregatedPairs.fold(pairs.src, pairs.dst, pairs.delta)
+        return slot.pack(agg.src, agg.dst, agg.delta, agg.key), slot
+
+    def release(self, slot: _StagingSlot) -> None:
+        self._free.put(slot)
+
+
+class PipelineDriver:
+    """Depth-bounded scorer pipeline owned by a :class:`~.job.CooccurrenceJob`.
+
+    ``depth`` bounds how many sampled-but-unscored windows may be in
+    flight (`queue` positions); the producer blocks on ``submit`` beyond
+    that — backpressure, not unbounded buffering. Depth 1 already
+    overlaps one window of sampling with one window of scoring; depth 2
+    additionally rides out jitter between the two stages' per-window
+    costs (the classic double buffer).
+    """
+
+    def __init__(self, job, depth: int) -> None:
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self.job = job
+        self.depth = depth
+        self.ring = StagingRing(depth)
+        self._queue: "queue.Queue[object]" = queue.Queue(maxsize=depth)
+        self._worker: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self.windows_processed = 0
+        self.scorer_busy_seconds = 0.0
+
+    # -- producer side ---------------------------------------------------
+
+    def submit(self, staged: StagedWindow) -> None:
+        """Enqueue one sampled window (blocks at ``depth`` in flight)."""
+        self._raise_if_failed()
+        self._ensure_worker()
+        self._queue.put(staged)
+
+    def barrier(self) -> None:
+        """Block until every submitted window is scored and absorbed.
+
+        The synchronization point checkpoints (and the end-of-stream
+        flush) require: after it, the scorer and ``LatestResults`` hold
+        exactly the serial path's state for the submitted prefix.
+        """
+        if self._worker is not None:
+            self._queue.join()
+        self._raise_if_failed()
+
+    def close(self) -> None:
+        """Ordered shutdown: drain everything submitted, then join."""
+        self._shutdown_worker()
+        self._raise_if_failed()
+
+    def _shutdown_worker(self) -> None:
+        """Drain the queue, stop the worker, join it. Idempotent."""
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.put(_SHUTDOWN)
+            self._worker.join()
+        self._worker = None
+
+    def _raise_if_failed(self) -> None:
+        if self._error is not None:
+            # Tear the worker down BEFORE surfacing the error: a caller
+            # that catches PipelineError and discards the job must not
+            # leak a parked daemon thread (pinning the job, the scorer
+            # and its device buffers). The worker keeps draining after a
+            # latched failure, so the shutdown sentinel is reached.
+            self._shutdown_worker()
+            raise PipelineError(
+                "pipeline scorer worker failed; the job cannot continue "
+                f"({type(self._error).__name__}: {self._error})"
+            ) from self._error
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, name="cooc-pipeline-scorer", daemon=True)
+            self._worker.start()
+
+    # -- worker side -----------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _SHUTDOWN:
+                self._queue.task_done()
+                return
+            try:
+                if self._error is None:
+                    self._process(item)
+            except BaseException as exc:  # latched; re-raised on caller
+                self._error = exc
+            finally:
+                # Recycle even on failure: the producer may be blocked in
+                # ring.stage() and must never deadlock on a dead worker.
+                if item.slot is not None:
+                    self.ring.release(item.slot)
+                self._queue.task_done()
+
+    def _process(self, item: StagedWindow) -> None:
+        job = self.job
+        with clock() as score_clock:
+            window_out = job.scorer.process_window(item.ts, item.payload)
+        self.scorer_busy_seconds += score_clock.seconds
+        job.step_timer.record(WindowStats(
+            timestamp=item.ts, events=item.events, pairs=item.raw_pairs,
+            rows_scored=getattr(job.scorer, "last_dispatched_rows",
+                                len(window_out)),
+            sample_seconds=item.sample_seconds,
+            score_seconds=score_clock.seconds))
+        job._absorb(window_out)
+        self.windows_processed += 1

@@ -27,6 +27,14 @@ WindowTopK = List[Tuple[int, List[Tuple[int, float]]]]
 
 
 class HostRescorer:
+    # Pipelined mode (pipeline.py) may hand this scorer pre-folded
+    # AggregatedPairs instead of a raw PairDeltaBatch. The math below only
+    # touches ``src``/``dst``/``delta`` and is invariant under the fold:
+    # per-item row sums, per-cell row updates, and the rescored-item set
+    # are identical whether deltas arrive raw or cell-aggregated, so the
+    # oracle stays an exact baseline for either execution mode.
+    accepts_aggregated = True
+
     def __init__(self, top_k: int, counters: Optional[Counters] = None,
                  development_mode: bool = False) -> None:
         self.top_k = top_k

@@ -16,6 +16,7 @@ materialization boundary (``IdMap.to_external_batch``).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Iterator, List, Mapping, Tuple
 
 import numpy as np
@@ -91,6 +92,13 @@ class LatestResults(Mapping):
     A dense pointer table maps each item to its most recent result row
     across all absorbed batches; superseded rows linger until
     :meth:`_compact` trims them (triggered when dead rows dominate).
+
+    Absorption and reads are lock-serialized: in pipelined execution
+    (``pipeline.py``) the scorer worker drains finished top-K tables into
+    this store one step behind the device frontier while the caller
+    thread may concurrently read (``--emit-updates`` consumers, progress
+    probes). The lock is per-window/per-read scale, far off the hot path;
+    serial mode pays only an uncontended acquire per window.
     """
 
     _COMPACT_MIN_ROWS = 1 << 20
@@ -101,6 +109,9 @@ class LatestResults(Mapping):
         self._ptr_batch = np.full(1024, -1, dtype=np.int64)
         self._ptr_row = np.zeros(1024, dtype=np.int64)
         self._total_rows = 0
+        # RLock: absorb paths call _compact (and _compact calls absorb/
+        # set_row) while already holding it.
+        self._lock = threading.RLock()
 
     # -- absorption (hot path) ------------------------------------------
 
@@ -120,30 +131,33 @@ class LatestResults(Mapping):
     def absorb_batch(self, batch: TopKBatch) -> None:
         if len(batch) == 0:
             return
-        bid = len(self._batches)
-        self._batches.append(batch)
-        rows = batch.rows.astype(np.int64)
-        self._ensure(int(rows.max()) + 1)
-        self._ptr_batch[rows] = bid
-        self._ptr_row[rows] = np.arange(len(rows), dtype=np.int64)
-        self._total_rows += len(rows)
-        if (self._total_rows >= self._COMPACT_MIN_ROWS
-                and self._total_rows > 2 * len(self)):
-            self._compact()
+        with self._lock:
+            bid = len(self._batches)
+            self._batches.append(batch)
+            rows = batch.rows.astype(np.int64)
+            self._ensure(int(rows.max()) + 1)
+            self._ptr_batch[rows] = bid
+            self._ptr_row[rows] = np.arange(len(rows), dtype=np.int64)
+            self._total_rows += len(rows)
+            if (self._total_rows >= self._COMPACT_MIN_ROWS
+                    and self._total_rows > 2 * len(self)):
+                self._compact()
 
     def set_row(self, dense_item: int, top: List[Tuple[int, float]]) -> None:
         """Single-row update from a host (list-producing) backend."""
-        if not self._batches or not isinstance(self._batches[-1], _ListBatch):
-            self._batches.append(_ListBatch())
-        bid = len(self._batches) - 1
-        row = self._batches[bid].append(top)
-        self._ensure(dense_item + 1)
-        self._ptr_batch[dense_item] = bid
-        self._ptr_row[dense_item] = row
-        self._total_rows += 1
-        if (self._total_rows >= self._COMPACT_MIN_ROWS
-                and self._total_rows > 2 * len(self)):
-            self._compact()
+        with self._lock:
+            if (not self._batches
+                    or not isinstance(self._batches[-1], _ListBatch)):
+                self._batches.append(_ListBatch())
+            bid = len(self._batches) - 1
+            row = self._batches[bid].append(top)
+            self._ensure(dense_item + 1)
+            self._ptr_batch[dense_item] = bid
+            self._ptr_row[dense_item] = row
+            self._total_rows += 1
+            if (self._total_rows >= self._COMPACT_MIN_ROWS
+                    and self._total_rows > 2 * len(self)):
+                self._compact()
 
     def _compact(self) -> None:
         """Drop superseded rows: rebuild live array rows into one batch."""
@@ -181,26 +195,30 @@ class LatestResults(Mapping):
         return np.nonzero(self._ptr_batch[:n] >= 0)[0]
 
     def __len__(self) -> int:
-        return int(len(self._live_dense()))
+        with self._lock:
+            return int(len(self._live_dense()))
 
     def __iter__(self) -> Iterator[int]:
-        live = self._live_dense()
-        if len(live) == 0:
-            return iter(())
-        return iter(self._vocab.to_external_batch(live).tolist())
+        with self._lock:
+            live = self._live_dense()
+            if len(live) == 0:
+                return iter(())
+            return iter(self._vocab.to_external_batch(live).tolist())
 
     def __contains__(self, ext_item) -> bool:
         dense = self._vocab.to_dense(ext_item)
-        return (dense is not None and dense < len(self._ptr_batch)
-                and self._ptr_batch[dense] >= 0)
+        with self._lock:
+            return (dense is not None and dense < len(self._ptr_batch)
+                    and self._ptr_batch[dense] >= 0)
 
     def __getitem__(self, ext_item) -> List[Tuple[int, float]]:
         dense = self._vocab.to_dense(ext_item)
-        if (dense is None or dense >= len(self._ptr_batch)
-                or self._ptr_batch[dense] < 0):
-            raise KeyError(ext_item)
-        b = self._batches[self._ptr_batch[dense]]
-        row = int(self._ptr_row[dense])
+        with self._lock:
+            if (dense is None or dense >= len(self._ptr_batch)
+                    or self._ptr_batch[dense] < 0):
+                raise KeyError(ext_item)
+            b = self._batches[self._ptr_batch[dense]]
+            row = int(self._ptr_row[dense])
         if isinstance(b, _ListBatch):
             top = b.rows[row]
             return [(self._vocab.to_external(j), s) for j, s in top]
@@ -214,6 +232,7 @@ class LatestResults(Mapping):
     # -- checkpoint helpers ---------------------------------------------
 
     def clear(self) -> None:
-        self._batches = []
-        self._ptr_batch[:] = -1
-        self._total_rows = 0
+        with self._lock:
+            self._batches = []
+            self._ptr_batch[:] = -1
+            self._total_rows = 0

@@ -59,10 +59,12 @@ import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..observability import LEDGER
-from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
-                             merge_sorted_insert, narrow_deltas_int32)
+from ..ops.aggregate import (AggregatedPairs, aggregate_window_coo,
+                             distinct_sorted, merge_sorted_insert,
+                             narrow_deltas_int32)
 from ..ops.device_scorer import (DeferredResultsTable, pad_pow2, pad_pow4,
                                  split_upload_auto)
+from ..ops.donation import donate_argnums
 from ..ops.llr import llr_stable
 from ..sampling.reservoir import PairDeltaBatch, _ragged_arange
 from .results import TopKBatch
@@ -112,11 +114,11 @@ def _update_body(cnt, dst, row_sums, upd, bounds):
     return cnt, dst, row_sums
 
 
-_apply_update = functools.partial(jax.jit, donate_argnums=(0, 1, 2))(
+_apply_update = functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1, 2))(
     _update_body)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1, 2))
 def _apply_update_chunked(cnt, dst, row_sums, upd_parts, bounds):
     """_apply_update with the update buffer arriving as K separate
     transfers; the concatenate is device-side and fuses away."""
@@ -124,7 +126,7 @@ def _apply_update_chunked(cnt, dst, row_sums, upd_parts, bounds):
                         jnp.concatenate(upd_parts, axis=1), bounds)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("L",))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1, 2), static_argnames=("L",))
 def _apply_moves_update_chunked(cnt, dst, row_sums, mv, upd_parts, bounds,
                                 L: int):
     cnt, dst = _moves_body(cnt, dst, mv, L)
@@ -132,7 +134,7 @@ def _apply_moves_update_chunked(cnt, dst, row_sums, mv, upd_parts, bounds,
                         jnp.concatenate(upd_parts, axis=1), bounds)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("L",))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1, 2), static_argnames=("L",))
 def _apply_moves_update(cnt, dst, row_sums, mv, upd, bounds, L: int):
     """Row relocations + the window update in ONE dispatch.
 
@@ -241,7 +243,7 @@ def _rect_into_table(tbl, cnt, dst, row_sums, meta, observed,
     return tbl.at[:, rowids].set(packed, mode="drop")
 
 
-@functools.partial(jax.jit, donate_argnums=(0,),
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0),
                    static_argnames=("top_k", "R", "pallas", "interpret"))
 def _score_into_table(tbl, cnt, dst, row_sums, meta, observed, *,
                       top_k: int, R: int, pallas: bool = False,
@@ -256,7 +258,7 @@ def _score_into_table(tbl, cnt, dst, row_sums, meta, observed, *,
                             top_k, R, pallas, interpret)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,),
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0),
                    static_argnames=("top_k", "plan", "interpret"))
 def _score_window_into_table(tbl, cnt, dst, row_sums, meta_all, observed, *,
                              top_k: int, plan, interpret: bool = False):
@@ -286,7 +288,7 @@ def _grow(arr, n: int):
     return jnp.zeros((n,), arr.dtype).at[: arr.shape[0]].set(arr)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("cap",))
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1), static_argnames=("cap",))
 def _compact_gather(cnt, dst, gmap, cap: int):
     """Rebuild the slab through a host-supplied gather map (compaction)."""
     return (jnp.zeros((cap,), cnt.dtype).at[: gmap.shape[0]].set(cnt[gmap]),
@@ -793,6 +795,12 @@ def make_slab_index(rows_capacity: int = 1 << 10) -> SlabIndex:
 class SparseDeviceScorer:
     """Single-device scorer over a :class:`SlabIndex`-managed HBM slab."""
 
+    # Pipelined mode (pipeline.py) may hand this scorer pre-folded
+    # AggregatedPairs — the producer thread runs the per-cell fold, and
+    # process_window starts at slot allocation. Bit-identical either way
+    # (the fold is the same aggregate_window_coo call).
+    accepts_aggregated = True
+
     # Per-score-chunk padded-cell budget. Padding is device compute only —
     # it never crosses the wire in this backend — so the budget is sized
     # for HBM transients ([S, R] gather + scores), not transfer, and the
@@ -943,10 +951,13 @@ class SparseDeviceScorer:
             LEDGER.up("compact-gather", gmap_pad)
             self.cnt, self.dst = _compact_gather(self.cnt, self.dst,
                                                  gmap_pad, cap=self.capacity)
-        delta64 = pairs.delta.astype(np.int64)
         self._ensure_items(int(max(pairs.src.max(), pairs.dst.max())))
-        src_d, _, d_val, d_key = aggregate_window_coo(
-            pairs.src, pairs.dst, delta64, return_key=True)
+        if isinstance(pairs, AggregatedPairs):
+            src_d, d_val, d_key = pairs.src, pairs.delta, pairs.key
+        else:
+            src_d, _, d_val, d_key = aggregate_window_coo(
+                pairs.src, pairs.dst, pairs.delta.astype(np.int64),
+                return_key=True)
         d_val32 = narrow_deltas_int32(d_val)
 
         # Row sums first (watermark ordering, reference
@@ -959,7 +970,9 @@ class SparseDeviceScorer:
         self.row_sums_host[rows] += rs_delta
         if self.row_sums_host[rows].max(initial=0) >= 2**31:
             raise ValueError("row sum exceeds int32 range")
-        window_sum = int(delta64.sum())
+        # Fold-invariant: the per-cell aggregated deltas sum to exactly the
+        # raw per-pair deltas (both int64), so either input form works.
+        window_sum = int(d_val.sum())
         self.observed += window_sum
         self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
 
