@@ -36,14 +36,18 @@ from tpu_cooccurrence.bench.grant_watch import (
 
 
 def run(backend: str, users, items, ts, num_items: int, window_ms: int,
-        pipeline_depth: int = 0):
+        pipeline_depth: int = 0, journal: str = None):
     from tpu_cooccurrence.config import Backend, Config
     from tpu_cooccurrence.job import CooccurrenceJob
     from tpu_cooccurrence.metrics import OBSERVED_COOCCURRENCES
+    from tpu_cooccurrence.observability.registry import REGISTRY
 
+    # Per-run metrics scope: the registry is process-global, so clear it
+    # here and the summaries below describe exactly this run's windows.
+    REGISTRY.reset()
     cfg = Config(window_size=window_ms, seed=0xC0FFEE, item_cut=500,
                  user_cut=500, backend=Backend(backend), num_items=num_items,
-                 pipeline_depth=pipeline_depth)
+                 pipeline_depth=pipeline_depth, journal=journal)
     job = CooccurrenceJob(cfg)
     start = time.monotonic()
     job.add_batch(users, items, ts)
@@ -52,8 +56,11 @@ def run(backend: str, users, items, ts, num_items: int, window_ms: int,
     pairs = job.counters.get(OBSERVED_COOCCURRENCES)
     # Per-stage busy fractions (observability.StepTimer.occupancy): the
     # pipeline-overlap diagnostic — a serial run's host+score sums to
-    # <= ~100%, an overlapped run exceeds it.
-    return pairs, elapsed, job.step_timer.occupancy(elapsed)
+    # <= ~100%, an overlapped run exceeds it. Latency: per-window
+    # p50/p95/p99 from the fixed-log-bucket histograms — BENCH_* carries
+    # tails, not just means (a 2x p99 regression is invisible in a mean).
+    return pairs, elapsed, job.step_timer.occupancy(elapsed), \
+        REGISTRY.summaries()
 
 
 # Shared execute-a-real-op probe (grant_watch imports no jax, so this
@@ -64,17 +71,22 @@ from tpu_cooccurrence.bench.grant_watch import probe_backend
 
 
 def _record_onchip(value: float, vs_baseline: float, backend: str,
-                   pipeline_depth: int, occupancy: dict) -> None:
+                   pipeline_depth: int, occupancy: dict,
+                   latency: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
     overlap win (host-busy% + score-busy% > 100) is visible in the
-    trajectory, not just in a single run's stdout.
+    trajectory, not just in a single run's stdout; ``latency`` carries
+    the per-window p50/p95/p99 summaries for the same reason — tail
+    regressions must be visible across PRs.
     """
     entry = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
              "pairs_per_sec": value, "vs_baseline": vs_baseline,
              "backend": backend, "pipeline_depth": pipeline_depth,
              "occupancy": occupancy}
+    if latency:
+        entry["latency"] = latency
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -122,6 +134,10 @@ def measure() -> None:
     n_events = int(os.environ.get("BENCH_EVENTS", 400_000))
     n_items = int(os.environ.get("BENCH_ITEMS", 20_000))
     pipeline_depth = int(os.environ.get("BENCH_PIPELINE_DEPTH", 0))
+    # Optional flight recorder for the measured runs (BENCH_JOURNAL=path):
+    # the three measured runs append to one JSONL, and its path rides the
+    # output line so the artifact is findable from the BENCH_* record.
+    journal = os.environ.get("BENCH_JOURNAL") or None
     users, items, ts = zipfian_interactions(
         n_events, n_items=n_items, n_users=5_000, alpha=1.1, seed=3,
         events_per_ms=200)
@@ -134,15 +150,15 @@ def measure() -> None:
 
     # Median of three measured runs: the benched chip can be reached over a
     # shared tunnel, where single-run wall-clock swings by 2x under
-    # contention. The occupancy published is the median run's.
+    # contention. The occupancy/latency published are the median run's.
     samples = []
     for _ in range(3):
-        pairs, elapsed, occupancy = run("device", users, items, ts,
-                                        num_items=n_items, window_ms=100,
-                                        pipeline_depth=pipeline_depth)
-        samples.append((pairs / max(elapsed, 1e-9), occupancy))
+        pairs, elapsed, occupancy, latency = run(
+            "device", users, items, ts, num_items=n_items, window_ms=100,
+            pipeline_depth=pipeline_depth, journal=journal)
+        samples.append((pairs / max(elapsed, 1e-9), occupancy, latency))
     samples.sort(key=lambda s: s[0])
-    pairs_per_sec, occupancy = samples[1]
+    pairs_per_sec, occupancy, latency = samples[1]
 
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.
@@ -151,8 +167,8 @@ def measure() -> None:
         with open(baseline_path) as f:
             baseline = json.load(f)["pairs_per_sec"]
     else:
-        b_pairs, b_elapsed, _ = run("oracle", users, items, ts,
-                                    num_items=n_items, window_ms=100)
+        b_pairs, b_elapsed, _, _ = run("oracle", users, items, ts,
+                                       num_items=n_items, window_ms=100)
         baseline = b_pairs / max(b_elapsed, 1e-9)
         with open(baseline_path, "w") as f:
             json.dump({"pairs_per_sec": baseline}, f)
@@ -167,7 +183,10 @@ def measure() -> None:
         "vs_baseline": round(pairs_per_sec / max(baseline, 1e-9), 3),
         "pipeline_depth": pipeline_depth,
         "occupancy": occupancy,
+        "latency": latency,
     }
+    if journal:
+        out["journal"] = journal
     if backend == "cpu":
         out["platform"] = ("cpu-fallback"
                            if os.environ.get("BENCH_CPU_FALLBACK") else "cpu")
@@ -184,7 +203,7 @@ def measure() -> None:
             }
     else:
         _record_onchip(out["value"], out["vs_baseline"], backend,
-                       pipeline_depth, occupancy)
+                       pipeline_depth, occupancy, latency)
     print(json.dumps(out))
 
 

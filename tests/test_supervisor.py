@@ -173,13 +173,103 @@ def test_supervise_text_sink_multibyte_across_chunks():
     assert sink.text == "a" + "\u20ac" * 100000 + "x\r\ny"
 
 
+def test_supervisor_quotes_dead_childs_journal_tail(tmp_path, caplog):
+    """A SIGKILLed child's journal survives (including a torn final
+    line) and the supervisor's restart log quotes its tail — the crashed
+    attempt's last fired windows are not lost with its discarded stdout."""
+    import logging
+
+    jpath = tmp_path / "j.jsonl"
+    marker = tmp_path / "crashed-once"
+    code = (
+        "import os, signal, sys\n"
+        "sys.path.insert(0, sys.argv[3])\n"
+        "from tpu_cooccurrence.observability.journal import RunJournal, VERSION\n"
+        "rec = dict(v=VERSION, seq=1, ts=100, events=5, pairs=3,\n"
+        "           rows_scored=2, sample_seconds=0.01, score_seconds=0.02,\n"
+        "           ring_depth=0, stall_seconds=0.0, wall_unix=1.0,\n"
+        "           counters={}, wire={})\n"
+        "j = RunJournal(sys.argv[1])\n"
+        "if not os.path.exists(sys.argv[2]):\n"
+        "    open(sys.argv[2], 'w').close()\n"
+        "    j.record(rec)\n"
+        "    j.record(dict(rec, seq=2, ts=200))\n"
+        "    j._f.write('{\"v\": 1, \"seq\": 3, \"ts\"')  # torn mid-write\n"
+        "    j._f.flush()\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        "j.record(dict(rec, seq=3, ts=300))\n"
+        "print('done')\n"
+    )
+    sink = _Sink()
+    with caplog.at_level(logging.WARNING, "tpu_cooccurrence.supervisor"):
+        rc = supervise([sys.executable, "-c", code, str(jpath), str(marker),
+                        REPO],
+                       attempts=1, delay_s=0, stdout=sink,
+                       journal_path=str(jpath))
+    assert rc == 0 and sink.text == "done\n"
+    quoted = [r.message for r in caplog.records if "journal" in r.message]
+    assert any("journal tail (2 record(s)" in m for m in quoted), quoted
+    # The dead attempt's LAST fired window (seq 2, not the torn seq-3
+    # line) is quoted verbatim.
+    assert any('"seq": 2' in m and '"ts": 200' in m for m in quoted), quoted
+    # The file itself carries both attempts: crash tail + clean rerun.
+    from tpu_cooccurrence.observability.journal import read_records
+
+    assert [r["seq"] for r in read_records(str(jpath))] == [1, 2, 3]
+
+
+def test_supervisor_journal_tail_missing_file_logs_and_continues(tmp_path,
+                                                                 caplog):
+    import logging
+
+    sink = _Sink()
+    with caplog.at_level(logging.WARNING, "tpu_cooccurrence.supervisor"):
+        rc = supervise([sys.executable, "-c", "import sys; sys.exit(3)"],
+                       attempts=0, delay_s=0, stdout=sink,
+                       journal_path=str(tmp_path / "never-written.jsonl"))
+    assert rc == 3
+    assert any("wrote no journal records" in r.message
+               for r in caplog.records)
+
+
+def test_supervisor_does_not_quote_stale_journal_as_dead_childs(tmp_path,
+                                                                caplog):
+    """A child that dies before its first window (startup crash) must not
+    have an earlier run's journal records quoted as its last act — even
+    when opening the journal grew the file by sealing a predecessor's
+    torn line (the 1-byte write that defeats a size-only guard)."""
+    import logging
+
+    jpath = tmp_path / "j.jsonl"
+    # Earlier run's record plus a torn final line (no trailing newline):
+    # the child's RunJournal open seals it with "\n" before crashing.
+    jpath.write_text('{"v": 1, "seq": 9, "ts": 900}\n{"v": 1, "seq": 10')
+    code = ("import sys\n"
+            "sys.path.insert(0, sys.argv[2])\n"
+            "from tpu_cooccurrence.observability.journal import RunJournal\n"
+            "RunJournal(sys.argv[1])\n"
+            "sys.exit(5)\n")
+    sink = _Sink()
+    with caplog.at_level(logging.WARNING, "tpu_cooccurrence.supervisor"):
+        rc = supervise([sys.executable, "-c", code, str(jpath), REPO],
+                       attempts=0, delay_s=0, stdout=sink,
+                       journal_path=str(jpath))
+    assert rc == 5
+    msgs = [r.message for r in caplog.records]
+    assert any("wrote no journal records" in m for m in msgs), msgs
+    assert not any('"seq": 9' in m for m in msgs), msgs
+
+
 @pytest.mark.slow
 def test_sigkill_under_supervisor_output_identical(tmp_path):
     """SIGKILL mid-run (right after the first periodic checkpoint lands);
     the supervisor restarts, the child restores, and total stdout is
-    byte-identical to an uninterrupted run — zero operator action."""
+    byte-identical to an uninterrupted run — zero operator action. The
+    run journal survives the kill: every record validates and the
+    supervisor quotes the dead attempt's tail."""
     f = tmp_path / "in.csv"
     write_stream(f, n=60_000)
+    jpath = tmp_path / "journal.jsonl"
     cli_args = ["-i", str(f), "-ws", "20", "-ic", "8", "-uc", "5",
                 "-s", "0xC0FFEE", "--backend", "oracle",
                 "--checkpoint-every-windows", "5"]
@@ -193,12 +283,27 @@ def test_sigkill_under_supervisor_output_identical(tmp_path):
     ck = tmp_path / "ck"
     worker = os.path.join(REPO, "tests", "supervised_crash_worker.py")
     cmd = [sys.executable, worker, str(ck), str(tmp_path / "crashed-once")]
-    cmd += cli_args + ["--checkpoint-dir", str(ck)]
+    cmd += cli_args + ["--checkpoint-dir", str(ck), "--journal", str(jpath)]
     sink = _Sink()
-    rc = supervise(cmd, attempts=2, delay_s=0, stdout=sink)
+    rc = supervise(cmd, attempts=2, delay_s=0, stdout=sink,
+                   journal_path=str(jpath))
     assert rc == 0
     assert (tmp_path / "crashed-once").exists(), "crash never injected"
     assert sink.text == clean.stdout
+    # Journal integrity across the kill + restore: every surviving line
+    # validates, and the stream replay is deterministic — any window
+    # ordinal journaled by both attempts carries identical logical fields.
+    from tpu_cooccurrence.observability.journal import (read_records,
+                                                        validate_record)
+
+    recs = list(read_records(str(jpath)))
+    assert recs, "journal never written"
+    by_seq = {}
+    for r in recs:
+        validate_record(r)
+        logical = (r["ts"], r["events"], r["pairs"])
+        assert by_seq.setdefault(r["seq"], logical) == logical
+    assert max(by_seq) == len(by_seq), "window ordinals must be gapless"
 
 
 def test_cli_restart_flag_healthy_run(tmp_path, capsys):

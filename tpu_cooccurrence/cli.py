@@ -44,8 +44,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         cmd = [sys.executable, "-m", "tpu_cooccurrence.cli"] + child_argv(raw)
         LOG.info("supervising job (up to %d restart(s), delay %d ms)",
                  config.restart_on_failure, config.restart_delay_ms)
+        # --journal flows through to the child (it writes the records);
+        # the supervisor only reads the tail for crash forensics.
         return supervise(cmd, config.restart_on_failure,
-                         delay_s=config.restart_delay_ms / 1000.0)
+                         delay_s=config.restart_delay_ms / 1000.0,
+                         journal_path=config.journal)
 
     config.log_configuration(LOG)
     if config.pipeline_depth > 0:
@@ -59,6 +62,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                  config.pipeline_depth)
 
     job = CooccurrenceJob(config)
+    metrics_server = None
+    if config.metrics_port is not None:
+        # Live scrape plane (observability/http.py): a long-running job is
+        # monitorable without attaching to stdout/stderr. Port 0 binds an
+        # ephemeral port; the bound port is in the startup log line.
+        from .observability import LEDGER
+        from .observability.http import MetricsServer
+        from .observability.registry import REGISTRY
+
+        metrics_server = MetricsServer(
+            REGISTRY, counters=job.counters, ledger=LEDGER,
+            port=config.metrics_port,
+            stale_after_s=config.healthz_stale_after_s).start()
     source = FileMonitorSource(
         config.input, job.counters,
         process_continuously=config.process_continuously)
@@ -127,6 +143,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not config.emit_updates:
         for item in sorted(job.latest):
             print(_render_row(item, job.latest[item]))
+    if metrics_server is not None:
+        # A clean shutdown, not a finally: on a crash the daemon thread
+        # dies with the process and the supervisor's journal-tail read
+        # covers the forensics.
+        metrics_server.stop()
     return 0
 
 

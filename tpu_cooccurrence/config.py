@@ -107,6 +107,16 @@ class Config:
     restart_delay_ms: int = 1000  # fixed delay between restart attempts
     # (the analogue of Flink's fixed-delay restart strategy)
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
+    journal: Optional[str] = None  # run-journal JSONL path: one flushed
+    # record per fired window (observability/journal.py flight recorder);
+    # a supervised crash leaves its tail intact and the supervisor quotes
+    # it in the restart log. None = off
+    metrics_port: Optional[int] = None  # live scrape endpoint
+    # (observability/http.py): /metrics Prometheus text + /healthz
+    # staleness probe on 127.0.0.1; 0 = ephemeral port (logged at
+    # startup); None = off
+    healthz_stale_after_s: float = 300.0  # /healthz turns 503 once no
+    # window has fired for this many wall seconds
     score_ladder: Optional[int] = None  # sparse score-bucket ladder base
     # (power of two >= 2); None = env TPU_COOC_SCORE_LADDER or 4. Coarser
     # = fewer dispatches, more padding — the high-latency-link lever.
@@ -176,6 +186,14 @@ class Config:
                 raise ValueError(
                     "--partition-sampling is a multi-host mode — it needs "
                     "--coordinator/--num-processes/--process-id")
+        if self.metrics_port is not None and not (
+                0 <= self.metrics_port <= 65535):
+            raise ValueError(
+                f"--metrics-port must be 0..65535, got {self.metrics_port}")
+        if self.healthz_stale_after_s <= 0:
+            raise ValueError(
+                f"--healthz-stale-after-s must be positive, got "
+                f"{self.healthz_stale_after_s}")
         if self.pipeline_depth not in (0, 1, 2):
             raise ValueError(
                 f"--pipeline-depth must be 0, 1 or 2, got "
@@ -264,6 +282,19 @@ class Config:
                             "multi-process ingest scale-out")
         p.add_argument("--profile-dir", default=None, dest="profile_dir",
                        help="Write a jax.profiler trace for TensorBoard")
+        p.add_argument("--journal", default=None, dest="journal",
+                       help="Append one JSONL record per fired window to "
+                            "this path (flight recorder; survives crashes "
+                            "and is quoted by the supervisor's restart log)")
+        p.add_argument("--metrics-port", type=int, default=None,
+                       dest="metrics_port",
+                       help="Serve Prometheus /metrics and /healthz on "
+                            "127.0.0.1:PORT (0 = ephemeral, logged at "
+                            "startup; omit to disable)")
+        p.add_argument("--healthz-stale-after-s", type=float, default=300.0,
+                       dest="healthz_stale_after_s",
+                       help="/healthz reports 503 once no window has fired "
+                            "for this many seconds (default: 300)")
         p.add_argument("--pallas", choices=["auto", "on", "off"],
                        default="auto",
                        help="Fused Pallas score/top-K kernel (auto: on for "

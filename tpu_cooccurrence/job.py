@@ -14,9 +14,10 @@ Duration and the accumulator dump mirror the reference's end-of-run logging
 
 from __future__ import annotations
 
+import json
 import logging
 import time
-from typing import Iterable, Optional
+from typing import Dict, Iterable, Optional
 
 import numpy as np
 
@@ -32,7 +33,8 @@ from .io.parse import InteractionBatch
 from .sampling.item_cut import ItemInteractionCut
 from .sampling.reservoir import UserReservoirSampler
 from .sampling.sliding import SlidingBasketSampler
-from .observability import StepTimer, WindowStats, clock
+from .observability import LEDGER, StepTimer, WindowStats, clock
+from .observability.registry import BYTES_BUCKETS, REGISTRY
 from .state.rescorer import HostRescorer, WindowTopK
 from .state.results import LatestResults, TopKBatch
 from .state.vocab import IdMap
@@ -125,6 +127,38 @@ class CooccurrenceJob:
         self.emissions = 0
         self.windows_fired = 0
         self.step_timer = StepTimer()
+        # Flight recorder (observability/journal.py): one flushed JSONL
+        # record per fired window. Per-window counter / wire deltas diff
+        # against these snapshots; both are read only by whichever thread
+        # records windows (the caller thread serially, the scorer worker
+        # pipelined), so no extra locking beyond the registries' own.
+        self.journal = None
+        if config.journal:
+            from .observability.journal import RunJournal
+
+            self.journal = RunJournal(config.journal)
+        self._prev_counters: Dict[str, int] = {}
+        self._prev_wire: Dict[str, int] = LEDGER.snapshot()
+        # Metrics plane (observability/registry.py): latency/byte
+        # distributions behind BENCH tail summaries and /metrics.
+        self._hist_sample = REGISTRY.histogram(
+            "cooc_window_sample_seconds",
+            help="host sampling stage seconds per fired window")
+        self._hist_score = REGISTRY.histogram(
+            "cooc_window_score_seconds",
+            help="scorer stage seconds per fired window")
+        self._hist_total = REGISTRY.histogram(
+            "cooc_window_total_seconds",
+            help="sample+score seconds per fired window")
+        self._hist_uplink = REGISTRY.histogram(
+            "cooc_window_uplink_bytes", BYTES_BUCKETS,
+            help="host->device bytes shipped per fired window")
+        self._gauge_windows = REGISTRY.gauge(
+            "cooc_windows_fired", help="fired-window ordinal")
+        self._gauge_last_window = REGISTRY.gauge(
+            "cooc_last_window_unix_seconds",
+            help="wall clock of the last fired window "
+                 "(healthz staleness input)")
         # Optional file source attached by the CLI so periodic checkpoints
         # snapshot the input offset too (crash recovery resumes mid-stream).
         self.source = None
@@ -314,6 +348,10 @@ class CooccurrenceJob:
                 raise AssertionError(
                     f"result pipeline out of balance: {rescored} rows "
                     f"dispatched but {self.emissions} materialized")
+        if self.journal is not None:
+            # Every window is recorded by now (the final drain barriered);
+            # close so the last line is durably on disk at process exit.
+            self.journal.close()
 
     def run(self, batches: Iterable[InteractionBatch]) -> "LatestResults":
         start = time.monotonic_ns()
@@ -330,6 +368,10 @@ class CooccurrenceJob:
         # one-line visibility of the pipeline win (ROADMAP: host bubble).
         LOG.info("Stage occupancy: %s",
                  self.step_timer.occupancy(duration_ms / 1000.0))
+        # Tail visibility in the summary itself (not just dev-mode lines):
+        # the slowest windows, JSON-shaped so log scrapers can parse them.
+        LOG.info("Slowest windows: %s",
+                 json.dumps(self.step_timer.slowest_as_dicts()))
         self.duration_ms = duration_ms
         return self.latest
 
@@ -358,26 +400,28 @@ class CooccurrenceJob:
                     # Pre-fold on the sampling thread for backends that
                     # accept aggregated deltas — the scorer worker's turn
                     # then starts at slot allocation / COO packing.
-                    payload, slot = self._stage(pairs)
+                    payload, slot, stall = self._stage(pairs)
             if self.pipeline is not None:
                 from .pipeline import StagedWindow
 
                 self.pipeline.submit(StagedWindow(
                     ts=ts, payload=payload, events=len(items),
                     raw_pairs=len(pairs),
-                    sample_seconds=sample_clock.seconds, slot=slot))
+                    sample_seconds=sample_clock.seconds, slot=slot,
+                    seq=self.windows_fired, stall_seconds=stall))
             else:
                 # Score on the backend.
                 with clock() as score_clock:
                     window_out: WindowTopK = self.scorer.process_window(ts, pairs)
                 # Pipelined backends return the previous window's results;
                 # they expose the count actually dispatched for this window.
-                self.step_timer.record(WindowStats(
+                self._record_window(WindowStats(
                     timestamp=ts, events=len(items), pairs=len(pairs),
                     rows_scored=getattr(self.scorer, "last_dispatched_rows",
                                         len(window_out)),
                     sample_seconds=sample_clock.seconds,
-                    score_seconds=score_clock.seconds))
+                    score_seconds=score_clock.seconds),
+                    seq=self.windows_fired)
                 self._absorb(window_out)
             if (self.config.checkpoint_dir
                     and self.config.checkpoint_every_windows > 0
@@ -394,10 +438,55 @@ class CooccurrenceJob:
 
     def _stage(self, pairs):
         """Producer-side staging: fold into a ring slot when the backend
-        accepts pre-aggregated deltas; raw pass-through otherwise."""
+        accepts pre-aggregated deltas; raw pass-through otherwise.
+        Returns ``(payload, slot, stall_seconds)`` — the stall is the
+        producer's wait for a free ring slot (memory-bound backpressure),
+        surfaced per window in the journal."""
         if len(pairs) and getattr(self.scorer, "accepts_aggregated", False):
-            return self.pipeline.ring.stage(pairs)
-        return pairs, None
+            payload, slot = self.pipeline.ring.stage(pairs)
+            return payload, slot, self.pipeline.ring.last_stall_seconds
+        return pairs, None, 0.0
+
+    def _record_window(self, stats: WindowStats, seq: int,
+                       ring_depth: int = 0,
+                       stall_seconds: float = 0.0) -> None:
+        """One fired window's observability fan-out: step timer ring,
+        latency/byte histograms, liveness gauges, and (when attached)
+        one flushed journal record.
+
+        Runs on whichever thread scores windows — the caller thread
+        serially, the scorer worker pipelined — so the delta snapshots it
+        keeps are single-threaded per mode. Checkpoint uplinks happen on
+        the sampling thread between fires; their bytes attribute to the
+        next window's wire delta (totals stay exact).
+        """
+        self.step_timer.record(stats)
+        wire = LEDGER.snapshot()
+        wire_delta = {k: wire[k] - self._prev_wire.get(k, 0) for k in wire}
+        self._prev_wire = wire
+        self._prev_counters, counter_delta = self.counters.snapshot_and_diff(
+            self._prev_counters)
+        self._hist_sample.observe(stats.sample_seconds)
+        self._hist_score.observe(stats.score_seconds)
+        self._hist_total.observe(stats.seconds)
+        self._hist_uplink.observe(wire_delta["h2d_bytes"])
+        self._gauge_windows.set(seq)
+        self._gauge_last_window.set(time.time())
+        if self.journal is not None:
+            from .observability.journal import VERSION
+
+            self.journal.record({
+                "v": VERSION, "seq": seq, "ts": stats.timestamp,
+                "events": stats.events, "pairs": stats.pairs,
+                "rows_scored": stats.rows_scored,
+                "sample_seconds": round(stats.sample_seconds, 6),
+                "score_seconds": round(stats.score_seconds, 6),
+                "ring_depth": ring_depth,
+                "stall_seconds": round(stall_seconds, 6),
+                "wall_unix": round(time.time(), 3),
+                "counters": counter_delta,
+                "wire": wire_delta,
+            })
 
     def _flush_scorer(self) -> WindowTopK:
         flush = getattr(self.scorer, "flush", None)
@@ -431,3 +520,9 @@ class CooccurrenceJob:
         from .state import checkpoint as ckpt
 
         ckpt.restore(self, self.config.checkpoint_dir, source=source)
+        # Re-baseline the journal's deltas: the restored counter totals
+        # predate this attempt, and the restore itself ships state up
+        # (e.g. the sparse slab's restore upload) — neither may be
+        # reported as the first post-restore window's own delta.
+        self._prev_counters = self.counters.as_dict()
+        self._prev_wire = LEDGER.snapshot()

@@ -52,9 +52,28 @@ class Counters:
             return dict(self._counters)
 
     def merge(self, other: "Counters") -> None:
+        # Snapshot `other` under ITS lock first (as_dict), then fold under
+        # ours — never hold both at once, so two registries merging toward
+        # each other cannot deadlock, and `other` mid-add can't be seen
+        # half-applied.
+        snap = other.as_dict()
         with self._lock:
-            for name, value in other._counters.items():
+            for name, value in snap.items():
                 self._counters[name] += value
+
+    def snapshot_and_diff(self, prev: Dict[str, int]
+                          ) -> "tuple[Dict[str, int], Dict[str, int]]":
+        """One locked snapshot plus its delta against ``prev`` (a previous
+        snapshot). The journal's per-window counter deltas: taking the
+        snapshot and computing the diff from the same locked view means a
+        concurrent ``add`` lands entirely in this window's delta or
+        entirely in the next — never split or double-counted."""
+        with self._lock:
+            snap = dict(self._counters)
+        diff = {name: value - prev.get(name, 0)
+                for name, value in snap.items()
+                if value != prev.get(name, 0)}
+        return snap, diff
 
     def replace_all(self, values: Dict[str, int]) -> None:
         """Overwrite all counters (checkpoint restore)."""
@@ -83,3 +102,18 @@ RESCORER_BUFFERED_ITEM_ROWS = "ItemRowRescorerBufferedItemRows"  # dev-mode
 RESCORER_BUFFERED_ROW_SUM_UPDATES = "ItemRowRescorerBufferedRowSumUpdates"  # dev-mode
 ROW_SUM_PROCESS_WINDOW = "RowSumProcessWindowRowSum"
 SPLIT_READER_NUM_SPLITS = "SplitReaderNumSplits"
+
+#: The reference's always-on accumulator set (the non-dev-mode names in
+#: the module docstring). The /metrics exposition emits every one of
+#: these even at zero, so a scraper sees a stable series set from the
+#: first scrape — absent-until-first-increment would read as a broken
+#: series to alerting rules.
+CANONICAL_COUNTERS = (
+    ITEM_LATE_ELEMENTS,
+    USER_LATE_ELEMENTS,
+    OBSERVED_COOCCURRENCES,
+    FEEDBACK_QUEUES,
+    RESCORED_ITEMS,
+    ROW_SUM_PROCESS_WINDOW,
+    SPLIT_READER_NUM_SPLITS,
+)

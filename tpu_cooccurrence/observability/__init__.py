@@ -6,6 +6,16 @@ provides the rest. The TPU build's upgrade: per-window step timing with
 stage breakdown (sampling vs scoring), retained as a ring buffer and
 summarizable, plus optional XLA profiler traces (``jax.profiler``) for
 TensorBoard.
+
+This package is the observability plane (the standalone replacement for
+the Flink UI the reference leans on):
+
+* this module — step timing, stage occupancy, the transfer ledger;
+* :mod:`.journal` — append-only JSONL flight recorder, one record per
+  fired window, crash-survivable;
+* :mod:`.registry` — typed gauges and fixed-log-bucket histograms with
+  p50/p95/p99 summaries and Prometheus text exposition;
+* :mod:`.http` — the live scrape endpoint (``/metrics``, ``/healthz``).
 """
 
 from __future__ import annotations
@@ -13,6 +23,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import threading
 import time
 from typing import Deque, Dict, Iterator, Optional
 
@@ -29,6 +40,18 @@ class WindowStats:
     @property
     def seconds(self) -> float:
         return self.sample_seconds + self.score_seconds
+
+    def as_dict(self) -> Dict[str, object]:
+        """JSON-serializable form (journal records, summary logs)."""
+        return {
+            "timestamp": self.timestamp,
+            "events": self.events,
+            "pairs": self.pairs,
+            "rows_scored": self.rows_scored,
+            "sample_seconds": round(self.sample_seconds, 6),
+            "score_seconds": round(self.score_seconds, 6),
+            "seconds": round(self.seconds, 6),
+        }
 
 
 class StepTimer:
@@ -65,6 +88,10 @@ class StepTimer:
         """The n slowest recent windows (ring-buffer scope) — the first place
         to look when a run's step timing regresses."""
         return sorted(self.windows, key=lambda w: -w.seconds)[:n]
+
+    def slowest_as_dicts(self, n: int = 3) -> list:
+        """JSON-serializable slowest-``n`` (end-of-run summary log)."""
+        return [w.as_dict() for w in self.slowest(n)]
 
     def occupancy(self, wall_seconds: float) -> Dict[str, float]:
         """Per-stage busy fractions of a run's wall clock.
@@ -109,40 +136,59 @@ class TransferLedger:
     crosses at every keyBy/broadcast (FlinkCooccurrences.java:89-167).
     One module-level instance (:data:`LEDGER`); events are a bounded
     ring so unbounded streams can't grow host memory.
+
+    Totals are locked (same discipline as ``metrics.Counters``): in
+    pipelined execution the sampling thread (checkpoint uplinks) and the
+    scorer worker (window dispatches) both record, and the ``+=`` on the
+    byte totals is a read-modify-write the GIL does not make atomic.
+    ``snapshot()`` returns a consistent (bytes, calls) view taken under
+    the same lock — the journal's per-window deltas are exact, never a
+    torn read between a bytes and a calls update.
     """
 
     def __init__(self, keep_events: int = 4096) -> None:
         self.events: Deque[TransferEvent] = collections.deque(
             maxlen=keep_events)
+        self._lock = threading.Lock()
         self.reset()
 
     def reset(self) -> None:
-        self.h2d_bytes = 0
-        self.d2h_bytes = 0
-        self.h2d_calls = 0
-        self.d2h_calls = 0
-        self.events.clear()
+        with self._lock:
+            self.h2d_bytes = 0
+            self.d2h_bytes = 0
+            self.h2d_calls = 0
+            self.d2h_calls = 0
+            self.events.clear()
 
     def up(self, label: str, *arrays) -> None:
         """Record one host->device upload (all buffers of one dispatch)."""
         n = sum(int(a.nbytes) for a in arrays)
-        self.h2d_bytes += n
-        self.h2d_calls += 1
-        self.events.append(TransferEvent("h2d", label, n))
+        with self._lock:
+            self.h2d_bytes += n
+            self.h2d_calls += 1
+            self.events.append(TransferEvent("h2d", label, n))
 
     def down(self, label: str, *arrays) -> None:
         """Record one device->host fetch."""
         n = sum(int(a.nbytes) for a in arrays)
-        self.d2h_bytes += n
-        self.d2h_calls += 1
-        self.events.append(TransferEvent("d2h", label, n))
+        with self._lock:
+            self.d2h_bytes += n
+            self.d2h_calls += 1
+            self.events.append(TransferEvent("d2h", label, n))
 
     def labels(self, direction: str) -> list:
-        return [e.label for e in self.events if e.direction == direction]
+        with self._lock:
+            return [e.label for e in self.events if e.direction == direction]
+
+    def snapshot(self) -> Dict[str, int]:
+        """Consistent totals: every (bytes, calls) pair reflects the same
+        set of recorded transfers (no torn mid-``up()`` reads)."""
+        with self._lock:
+            return {"h2d_bytes": self.h2d_bytes, "h2d_calls": self.h2d_calls,
+                    "d2h_bytes": self.d2h_bytes, "d2h_calls": self.d2h_calls}
 
     def summary(self) -> Dict[str, int]:
-        return {"h2d_bytes": self.h2d_bytes, "h2d_calls": self.h2d_calls,
-                "d2h_bytes": self.d2h_bytes, "d2h_calls": self.d2h_calls}
+        return self.snapshot()
 
 
 #: Process-wide ledger the scorers record into.

@@ -1,0 +1,124 @@
+"""Live scrape endpoint: ``/metrics`` (Prometheus text) + ``/healthz``.
+
+The reference outsources live monitoring to the Flink UI; this
+standalone build serves its own, from a stdlib ``http.server`` thread —
+zero dependencies, safe to run inside the job process because every
+handler only *reads* locked registries (no handler can touch job state).
+
+``/metrics`` returns Prometheus text-format 0.0.4: every reference-named
+counter (``metrics.Counters``), the TransferLedger wire totals, and all
+registry gauges/histograms.
+
+``/healthz`` returns JSON liveness derived from the last fired window's
+wall-clock age: 200 while the job is making window progress (or still
+inside the staleness grace period since start — a cold job that has not
+fired yet is "starting", not dead), 503 once the age exceeds the
+threshold. A long tail of empty input under ``--process-continuously``
+is indistinguishable from a hang by design — staleness means "no window
+fired", whatever the cause, which is exactly what an operator pages on.
+
+Port 0 binds an ephemeral port (CI) — the bound port is in ``.port``
+and the startup log line.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+from .registry import MetricsRegistry
+
+LOG = logging.getLogger("tpu_cooccurrence.metrics_http")
+
+#: Gauge (set by the job per window) the health check reads.
+LAST_WINDOW_GAUGE = "cooc_last_window_unix_seconds"
+
+
+class MetricsServer:
+    """Background scrape server over a registry + counters + ledger."""
+
+    def __init__(self, registry: MetricsRegistry, counters=None, ledger=None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 stale_after_s: float = 300.0) -> None:
+        self.registry = registry
+        self.counters = counters
+        self.ledger = ledger
+        self.stale_after_s = stale_after_s
+        self._started_unix = time.time()
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = outer.registry.render_prometheus(
+                        outer.counters, outer.ledger).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    code = 200
+                elif self.path.split("?", 1)[0] == "/healthz":
+                    payload, healthy = outer.health()
+                    body = (json.dumps(payload, sort_keys=True)
+                            + "\n").encode()
+                    ctype = "application/json"
+                    code = 200 if healthy else 503
+                else:
+                    body = b"not found\n"
+                    ctype = "text/plain"
+                    code = 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args) -> None:
+                LOG.debug("scrape: " + fmt, *args)
+
+        # ThreadingHTTPServer: a stuck scraper must not block the next
+        # scrape (handlers are read-only, so concurrency is safe).
+        self._server = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def health(self) -> "tuple[dict, bool]":
+        """(payload, healthy): last-window age vs the staleness threshold."""
+        now = time.time()
+        last = self.registry.gauge(LAST_WINDOW_GAUGE).get()
+        windows = int(self.registry.gauge("cooc_windows_fired").get())
+        if last > 0:
+            age = now - last
+            status = "ok" if age <= self.stale_after_s else "stale"
+        else:
+            # No window yet: grace-period from server start, then stale.
+            age = now - self._started_unix
+            status = "starting" if age <= self.stale_after_s else "stale"
+        payload = {"status": status,
+                   "windows_fired": windows,
+                   "last_window_age_seconds": round(age, 3),
+                   "stale_after_seconds": self.stale_after_s}
+        return payload, status != "stale"
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="cooc-metrics-http",
+            daemon=True)
+        self._thread.start()
+        LOG.info("serving /metrics and /healthz on http://%s:%d",
+                 self._server.server_address[0], self.port)
+        return self
+
+    def stop(self) -> None:
+        # shutdown() waits on serve_forever's loop; skip it when start()
+        # was never called (it would block forever on the unset event).
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join()
+            self._thread = None
+        self._server.server_close()

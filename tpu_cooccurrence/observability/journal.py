@@ -1,0 +1,182 @@
+"""Run journal: append-only JSONL flight recorder, one record per window.
+
+The reference's only run artifact is the end-of-job accumulator dump
+(``FlinkCooccurrences.java:173-181``); a crashed Flink job leaves its
+state to the JobManager. This standalone build's supervisor
+(``supervisor.py``) discards a crashed attempt's spooled stdout by
+design (exactly-once output), which previously meant a crash discarded
+*every* in-flight signal. The journal is the flight recorder that
+survives: each fired window appends one self-contained JSON line,
+flushed immediately, so after a SIGKILL the file's tail is the last
+fired window and the supervisor can quote it in the restart log.
+
+Record schema (:data:`SCHEMA`): logical fields (``seq``, ``ts``,
+``events``, ``pairs``, ``rows_scored``, counter deltas) are identical
+between serial and pipelined execution (pinned by
+``tests/test_observability.py``); timing/occupancy fields
+(``*_seconds``, ``ring_depth``, ``wall_unix``) are run-specific.
+Counter deltas in pipelined mode are attributed to the window the
+scorer worker just finished — sampling-side counters for the window the
+producer is concurrently sampling may land one record later, so the
+parity contract covers logical fields only.
+
+Readers (:func:`read_records`, :func:`tail`) tolerate a truncated final
+line — the expected shape of a file whose writer was SIGKILLed mid-
+``write`` — and skip it rather than failing the whole read.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Dict, Iterator, List, Optional
+
+#: Journal format version (bump on breaking schema changes).
+VERSION = 1
+
+#: Field name -> (required, type). ``counters`` / ``wire`` hold per-window
+#: deltas (not totals); empty deltas are omitted from ``counters``.
+SCHEMA = {
+    "v": (True, int),            # format version
+    "seq": (True, int),          # 1-based fired-window ordinal (resumes
+                                 # from the restored count after a restart)
+    "ts": (True, int),           # window timestamp (stream time, ms)
+    "events": (True, int),       # events in the fired window
+    "pairs": (True, int),        # raw (pre-fold) pair deltas sampled
+    "rows_scored": (True, int),  # rows dispatched to the scorer
+    "sample_seconds": (True, float),
+    "score_seconds": (True, float),
+    "ring_depth": (True, int),   # staged windows in flight at dequeue
+                                 # (0 on the serial path)
+    "stall_seconds": (True, float),  # producer wait for a staging slot
+    "wall_unix": (True, float),  # host wall clock at record time
+    "counters": (True, dict),    # counter name -> delta since last record
+    "wire": (True, dict),        # TransferLedger delta: h2d/d2h bytes+calls
+}
+
+
+def validate_record(rec: dict) -> None:
+    """Raise ``ValueError`` unless ``rec`` matches :data:`SCHEMA`."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"journal record is not an object: {rec!r}")
+    for field, (required, typ) in SCHEMA.items():
+        if field not in rec:
+            if required:
+                raise ValueError(f"journal record missing {field!r}: {rec}")
+            continue
+        v = rec[field]
+        if typ is float:
+            ok = isinstance(v, (int, float)) and not isinstance(v, bool)
+        else:
+            ok = isinstance(v, typ) and not isinstance(v, bool)
+        if not ok:
+            raise ValueError(
+                f"journal field {field!r} has type {type(v).__name__}, "
+                f"expected {typ.__name__}: {rec}")
+    unknown = set(rec) - set(SCHEMA)
+    if unknown:
+        raise ValueError(f"journal record has unknown fields {unknown}: {rec}")
+    if rec["v"] != VERSION:
+        raise ValueError(f"journal version {rec['v']} != {VERSION}")
+
+
+class RunJournal:
+    """Append-only writer. One line per :meth:`record`, flushed to the OS
+    immediately — the crash-survivability contract. Opened in append mode
+    so a supervised restart continues the same file (``seq`` resumes from
+    the restored window count, so the ordinal stream stays monotone)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        # A crashed predecessor may have died mid-write, leaving an
+        # unterminated partial line; seal it with a newline so this
+        # attempt's first record starts a fresh line instead of gluing
+        # itself onto the torn one (readers skip the torn line either way).
+        torn = False
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b"\n"
+        except OSError:
+            pass  # missing or empty file
+        self._f: Optional[io.TextIOBase] = open(  # noqa: SIM115 - long-lived
+            path, "a", encoding="utf-8")
+        if torn:
+            self._f.write("\n")
+            self._f.flush()
+
+    def record(self, rec: dict) -> None:
+        if self._f is None:
+            raise ValueError("journal is closed")
+        # One write syscall per record + explicit flush: a SIGKILL can
+        # truncate at most the line being written, never reorder lines.
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "RunJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_records(path: str) -> Iterator[dict]:
+    """Parse a journal, skipping unparseable lines (a crash-torn final
+    line is the expected case; the writer never produces one mid-file)."""
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue
+
+
+def tail(path: str, n: int = 5, read_back_bytes: int = 1 << 16,
+         start_offset: int = 0) -> List[dict]:
+    """Last ``n`` parseable records after ``start_offset``, ``[]`` when
+    the file is missing or holds none — the supervisor's crash-forensics
+    read.
+
+    Reads only the final ``read_back_bytes`` of the eligible range: a
+    long-running journal grows without rotation, and the restart path
+    must not parse weeks of records to quote five. ``start_offset``
+    scopes the read to one attempt's records (the caller passes the file
+    size captured at spawn; that is always a line boundary, or the start
+    of a torn line the writer seals). The first line of the chunk is
+    dropped when the seek landed mid-record.
+    """
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            start = max(start_offset, size - read_back_bytes)
+            f.seek(start)
+            chunk = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return []
+    lines = chunk.splitlines()
+    if start > start_offset and lines:
+        lines = lines[1:]  # partial first line from the mid-record seek
+    out: List[dict] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+        if len(out) > n:
+            out.pop(0)
+    return out

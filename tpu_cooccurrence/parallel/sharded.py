@@ -40,6 +40,7 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from ..observability.registry import REGISTRY, log_buckets
 from ..state.results import TopKBatch
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              narrow_deltas_int32)
@@ -50,6 +51,32 @@ from ..ops.donation import donate_argnums
 from ..sampling.reservoir import PairDeltaBatch
 from .mesh import (ITEM_AXIS, make_mesh, pad_to_multiple,
                    shard_map_maybe_relaxed)
+
+
+#: Row-count ladder for the dispatch-size histogram: 1 .. 2^24 rows.
+ROWS_BUCKETS = log_buckets(1.0, 2.0 ** 24)
+
+
+def _record_shard_metrics(n_rows: int, per_shard_counts) -> None:
+    """Per-dispatch distribution metrics shared by both sharded backends.
+
+    ``cooc_scorer_dispatch_rows`` is the per-window scored-row
+    distribution (the padded-rectangle driver); the imbalance gauge is
+    max/mean owned rows across shards — 1.0 is a perfectly balanced
+    dispatch, and a sustained high value means one chip's rows gate every
+    window (the sharded analogue of a straggler subtask).
+    """
+    REGISTRY.histogram(
+        "cooc_scorer_dispatch_rows", ROWS_BUCKETS,
+        help="distinct rows dispatched for scoring per window").observe(
+            max(n_rows, 1))
+    counts = np.asarray(per_shard_counts, dtype=np.float64)
+    mean = counts.mean()
+    if mean > 0:
+        REGISTRY.gauge(
+            "cooc_shard_row_imbalance",
+            help="max/mean owned scored rows across shards "
+                 "(1.0 = balanced)").set(float(counts.max() / mean))
 
 
 class ShardedScorer:
@@ -271,6 +298,7 @@ class ShardedScorer:
         row_owners = (rows // self.rows_per_shard).astype(np.int64)
         rows_b, row_counts = self._partition_by_owner(
             rows, row_owners, 64, shard_first_row)
+        _record_shard_metrics(len(rows), row_counts)
 
         # Chunk the padded per-shard row dimension to the HBM budget (both
         # are powers of two, so every chunk is shape-stable).

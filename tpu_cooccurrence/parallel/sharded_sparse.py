@@ -61,6 +61,7 @@ from ..state.sparse_scorer import (_SENT, SlabIndex, _apply_cells,
                                    make_slab_index, resolve_fixed_shapes,
                                    score_buckets)
 from .mesh import ITEM_AXIS, make_mesh, shard_map_maybe_relaxed
+from .sharded import _record_shard_metrics
 
 
 class ShardedSparseScorer:
@@ -497,8 +498,9 @@ class ShardedSparseScorer:
             upd[d, 1, b0:b1] = dv
             bounds[d] = (b0, b1)
         row_owner = (rows % D).astype(np.int64)
-        rp = pad_pow4(int(np.bincount(row_owner, minlength=D).max())
-                      if len(rows) else 1, minimum=256)
+        owner_counts = np.bincount(row_owner, minlength=D)
+        rp = pad_pow4(int(owner_counts.max()) if len(rows) else 1,
+                      minimum=256)
         rs_part = np.full((D, 2, rp), _SENT, dtype=np.int32)
         rs_part[:, 1, :] = 0
         for d in range(D):
@@ -517,6 +519,7 @@ class ShardedSparseScorer:
 
         self.counters.add(RESCORED_ITEMS, len(rows))
         self.last_dispatched_rows = len(rows)
+        _record_shard_metrics(len(rows), owner_counts)
         chunks = self._dispatch_scoring(rows, row_owner)
         prev, self._pending = self._pending, chunks
         return (self._materialize(prev) if prev is not None

@@ -67,6 +67,7 @@ from typing import Optional
 import numpy as np
 
 from .observability import WindowStats, clock
+from .observability.registry import REGISTRY
 from .ops.aggregate import AggregatedPairs
 
 #: Queue sentinel: process everything already enqueued, then exit.
@@ -87,6 +88,8 @@ class StagedWindow:
     raw_pairs: int           # pre-fold pair count (stats parity w/ serial)
     sample_seconds: float    # producer-side stage time for this window
     slot: Optional["_StagingSlot"] = None  # ring slot backing the payload
+    seq: int = 0             # fired-window ordinal (journal record id)
+    stall_seconds: float = 0.0  # producer wait for a free ring slot
 
 
 class _StagingSlot:
@@ -127,10 +130,16 @@ class StagingRing:
         # worker's release always unblocks it — no deadlock.
         for _ in range(depth + 1):
             self._free.put(_StagingSlot())
+        # Producer-side stall acquiring the last slot (single producer,
+        # so a plain attribute is race-free); ~0 while the scorer keeps
+        # up, the full scorer-lag once the ring is the bottleneck.
+        self.last_stall_seconds = 0.0
 
     def stage(self, pairs) -> "tuple[AggregatedPairs, _StagingSlot]":
         """Fold one window's raw pair deltas and pack them into a slot."""
-        slot = self._free.get()
+        with clock() as wait:
+            slot = self._free.get()
+        self.last_stall_seconds = wait.seconds
         agg = AggregatedPairs.fold(pairs.src, pairs.dst, pairs.delta)
         return slot.pack(agg.src, agg.dst, agg.delta, agg.key), slot
 
@@ -160,6 +169,15 @@ class PipelineDriver:
         self._error: Optional[BaseException] = None
         self.windows_processed = 0
         self.scorer_busy_seconds = 0.0
+        # Cumulative producer block time in submit (queue-bound
+        # backpressure; the ring-bound form is StagingRing stall).
+        self.queue_wait_seconds = 0.0
+        self._hist_queue_wait = REGISTRY.histogram(
+            "cooc_pipeline_queue_wait_seconds",
+            help="producer block time submitting a window (backpressure)")
+        self._gauge_ring_depth = REGISTRY.gauge(
+            "cooc_pipeline_ring_depth",
+            help="staged windows in flight after the last submit")
 
     # -- producer side ---------------------------------------------------
 
@@ -167,7 +185,11 @@ class PipelineDriver:
         """Enqueue one sampled window (blocks at ``depth`` in flight)."""
         self._raise_if_failed()
         self._ensure_worker()
-        self._queue.put(staged)
+        with clock() as wait:
+            self._queue.put(staged)
+        self.queue_wait_seconds += wait.seconds
+        self._hist_queue_wait.observe(wait.seconds)
+        self._gauge_ring_depth.set(self._queue.qsize())
 
     def barrier(self) -> None:
         """Block until every submitted window is scored and absorbed.
@@ -233,14 +255,19 @@ class PipelineDriver:
 
     def _process(self, item: StagedWindow) -> None:
         job = self.job
+        # Windows still queued behind this one — the journal's per-window
+        # ring-depth (how far the producer ran ahead of the scorer).
+        ring_depth = self._queue.qsize()
         with clock() as score_clock:
             window_out = job.scorer.process_window(item.ts, item.payload)
         self.scorer_busy_seconds += score_clock.seconds
-        job.step_timer.record(WindowStats(
+        job._record_window(WindowStats(
             timestamp=item.ts, events=item.events, pairs=item.raw_pairs,
             rows_scored=getattr(job.scorer, "last_dispatched_rows",
                                 len(window_out)),
             sample_seconds=item.sample_seconds,
-            score_seconds=score_clock.seconds))
+            score_seconds=score_clock.seconds),
+            seq=item.seq, ring_depth=ring_depth,
+            stall_seconds=item.stall_seconds)
         job._absorb(window_out)
         self.windows_processed += 1

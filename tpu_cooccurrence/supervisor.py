@@ -24,6 +24,7 @@ operator-facing logs.
 from __future__ import annotations
 
 import io
+import json
 import logging
 import os
 import shutil
@@ -58,8 +59,47 @@ def child_argv(argv: Sequence[str]) -> List[str]:
     return out
 
 
+def _journal_size(journal_path: Optional[str]) -> int:
+    if not journal_path:
+        return 0
+    try:
+        return os.path.getsize(journal_path)
+    except OSError:
+        return 0
+
+
+def _quote_journal_tail(journal_path: str, size_before: int,
+                        n: int = 5) -> None:
+    """Surface the dead child's last fired windows in the restart log.
+
+    The spooled stdout is discarded by design (exactly-once output), but
+    the run journal (``observability/journal.py``) survives the crash —
+    its tail is the flight-recorder readout: what the child was doing
+    when it died, without any Flink-UI equivalent to consult.
+
+    ``size_before`` is the journal size when this attempt was spawned:
+    only records written past it are quoted, so an attempt that died
+    before recording anything (startup crash, bad restore) — or one that
+    wrote fewer than ``n`` records — can never have an earlier attempt's
+    (or an earlier run's) windows quoted as its own last act.
+    """
+    from .observability.journal import tail
+
+    records = tail(journal_path, n=n, start_offset=size_before)
+    if not records:
+        LOG.warning("dead child wrote no journal records this attempt "
+                    "(%s); it died before its first window fired",
+                    journal_path)
+        return
+    LOG.warning("dead child's journal tail (%d record(s) from %s):",
+                len(records), journal_path)
+    for rec in records:
+        LOG.warning("  journal: %s", json.dumps(rec, sort_keys=True))
+
+
 def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
-              stdout=None, timeout_s: Optional[float] = None) -> int:
+              stdout=None, timeout_s: Optional[float] = None,
+              journal_path: Optional[str] = None) -> int:
     """Run ``cmd`` to successful completion, restarting up to ``attempts``
     times on abnormal exit. Returns the final exit code (0 on success,
     the last failure's code once attempts are exhausted).
@@ -70,10 +110,19 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
     Each attempt spools to an anonymous temp file (deleted on close
     regardless of outcome), so supervisor memory stays O(1) in the
     child's output size.
+
+    ``journal_path`` (the child's ``--journal`` file, when configured):
+    on every abnormal exit the last few journal records are quoted into
+    the restart log — the crashed attempt's final fired windows, which
+    would otherwise vanish with its discarded stdout.
     """
     sink = stdout if stdout is not None else sys.stdout
     restarts = 0
     while True:
+        # Journal size at spawn: the crash-forensics quote below must only
+        # fire for records THIS attempt wrote (append mode keeps earlier
+        # attempts' records in the same file).
+        journal_size_before = _journal_size(journal_path)
         # One anonymous spool per attempt: auto-deleted on close, so a
         # failed attempt's partial output vanishes without cleanup code.
         with tempfile.TemporaryFile() as spool:
@@ -108,6 +157,8 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
                     LOG.info("job completed after %d restart(s)", restarts)
                 return 0
         restarts += 1
+        if journal_path:
+            _quote_journal_tail(journal_path, journal_size_before)
         if restarts > attempts:
             LOG.error("job failed with rc=%d; restart attempts exhausted "
                       "(%d)", rc, attempts)
