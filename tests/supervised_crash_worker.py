@@ -22,8 +22,12 @@ def main() -> int:
             pass
 
         def watch() -> None:
-            state = os.path.join(ck, "state.npz")
-            while not os.path.exists(state):
+            # Any committed snapshot (generation-numbered state.<g>.npz,
+            # or the legacy un-numbered state.npz).
+            import glob
+
+            pat = os.path.join(ck, "state*.npz")
+            while not glob.glob(pat):
                 time.sleep(0.05)
             os.kill(os.getpid(), signal.SIGKILL)
 

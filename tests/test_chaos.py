@@ -1,0 +1,182 @@
+"""Chaos soak: the exactly-once-output contract under injected faults.
+
+The capstone of the robustness plane (ISSUE 3): run the *real* CLI
+under the *real* supervisor with crashes injected at distinct hot-path
+sites — window fire, scorer dispatch, checkpoint post-write-pre-rename
+(a torn commit), journal append — and assert the total stdout is
+bit-identical to an uninterrupted run. Every recovery layer is in the
+loop: supervisor respawn, checkpoint-generation fallback past the torn
+snapshot, journal torn-tail sealing, and (separately) the hang
+watchdog killing a stalled child.
+
+The quick variant is tier-1; the multi-site soak across pipeline
+depths 0 and 2 is ``slow`` (full-suite / round-gate lane).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_cooccurrence.supervisor import supervise
+
+from test_cli import write_stream
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+
+
+class _Sink:
+    def __init__(self):
+        self.text = ""
+
+    def write(self, s):
+        self.text += s
+
+
+def _clean_run(tmp_path, base_args):
+    """The uninterrupted reference run (its own checkpoint dir)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cooccurrence.cli"] + base_args
+        + ["--checkpoint-dir", str(tmp_path / "ck-clean")],
+        capture_output=True, text=True, env=ENV, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    return proc.stdout
+
+
+def _supervised_run(tmp_path, base_args, fault_specs, attempts,
+                    watchdog_stale_after_s=None):
+    """Drive supervise() in-process over real CLI children with the
+    fault plan armed (exactly-once across restarts via the marker dir)."""
+    ck = tmp_path / "ck"
+    jpath = tmp_path / "journal.jsonl"
+    cmd = [sys.executable, "-m", "tpu_cooccurrence.cli"] + base_args
+    cmd += ["--checkpoint-dir", str(ck), "--journal", str(jpath),
+            "--fault-state-dir", str(tmp_path / "fault-state")]
+    for spec in fault_specs:
+        cmd += ["--inject-fault", spec]
+    sink = _Sink()
+    rc = supervise(cmd, attempts=attempts, delay_s=0, stdout=sink,
+                   journal_path=str(jpath), crash_loop_threshold=0,
+                   watchdog_stale_after_s=watchdog_stale_after_s,
+                   checkpoint_dir=str(ck))
+    return rc, sink.text
+
+
+def _assert_all_fired(tmp_path, n):
+    fired = sorted(os.listdir(tmp_path / "fault-state"))
+    assert len(fired) == n, (
+        f"expected {n} injected faults to have fired, got {fired}")
+
+
+def test_chaos_quick_crash_parity(tmp_path):
+    """Tier-1 variant: three distinct crash sites — a window-loop crash,
+    a torn checkpoint commit (post-write-pre-rename), and a crash at
+    journal append — at pipeline depth 0; stdout must be bit-identical
+    to the uninterrupted run, with zero operator action."""
+    f = tmp_path / "in.csv"
+    write_stream(f, n=600)
+    base = ["-i", str(f), "-ws", "40", "-ic", "8", "-uc", "5",
+            "-s", "0xC0FFEE", "--backend", "oracle",
+            "--checkpoint-every-windows", "3"]
+    clean = _clean_run(tmp_path, base)
+    assert clean, "reference run produced no output"
+
+    rc, out = _supervised_run(
+        tmp_path, base,
+        ["window_fire:4:crash",
+         "checkpoint_post_write:6:torn_write",
+         "journal_append:9:crash"],
+        attempts=4)
+    assert rc == 0
+    assert out == clean
+    _assert_all_fired(tmp_path, 3)
+    # The torn checkpoint commit really was quarantined on fallback.
+    corrupt = [p for p in os.listdir(tmp_path / "ck")
+               if p.endswith(".corrupt")]
+    assert corrupt, "torn snapshot should have been quarantined"
+
+
+def test_chaos_watchdog_hang_recovery_parity(tmp_path):
+    """A child stalled by delay_ms injection past the watchdog
+    threshold is killed, restarted, and the run completes with exact
+    output parity — a hang costs one attempt, not the whole run."""
+    f = tmp_path / "in.csv"
+    write_stream(f, n=600)
+    base = ["-i", str(f), "-ws", "40", "-ic", "8", "-uc", "5",
+            "-s", "0xBEEF", "--backend", "oracle",
+            "--checkpoint-every-windows", "3"]
+    clean = _clean_run(tmp_path, base)
+
+    rc, out = _supervised_run(
+        tmp_path, base, ["window_fire:5:delay_ms:600000"],
+        attempts=2, watchdog_stale_after_s=2.0)
+    assert rc == 0
+    assert out == clean
+    _assert_all_fired(tmp_path, 1)
+
+
+def test_chaos_exception_kind_recovers_too(tmp_path):
+    """The exception kind (clean unwind, not SIGKILL) exits nonzero
+    through normal error handling and the supervised run still
+    converges to bit-identical output."""
+    f = tmp_path / "in.csv"
+    write_stream(f, n=400)
+    base = ["-i", str(f), "-ws", "50", "-ic", "8", "-uc", "5",
+            "-s", "0xFEED", "--backend", "oracle",
+            "--checkpoint-every-windows", "2"]
+    clean = _clean_run(tmp_path, base)
+    rc, out = _supervised_run(
+        tmp_path, base, ["scorer_dispatch:3:exception"], attempts=2)
+    assert rc == 0
+    assert out == clean
+    _assert_all_fired(tmp_path, 1)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [0, 2])
+def test_chaos_soak_multi_site_parity(tmp_path, depth):
+    """The full soak: crashes at four distinct sites (source read,
+    window fire, torn checkpoint commit, journal append) plus a worker-
+    thread crash at scorer dispatch, across pipeline depths 0 and 2 —
+    total stdout bit-identical to the uninterrupted run at the same
+    depth."""
+    f = tmp_path / "in.csv"
+    write_stream(f, n=4000)
+    base = ["-i", str(f), "-ws", "150", "-ic", "8", "-uc", "5",
+            "-s", "0xC0FFEE", "--backend", "oracle",
+            "--pipeline-depth", str(depth),
+            "--checkpoint-every-windows", "3",
+            "--checkpoint-retain", "4"]
+    clean = _clean_run(tmp_path, base)
+    faults = [
+        "source_read:crash",                    # before any progress
+        "window_fire:5:crash",
+        "scorer_dispatch:9:crash",              # worker thread at depth 2
+        "checkpoint_post_write:12:torn_write",  # corrupt committed latest
+        "journal_append:15:crash",
+    ]
+    rc, out = _supervised_run(tmp_path, base, faults, attempts=7)
+    assert rc == 0
+    assert out == clean
+    _assert_all_fired(tmp_path, len(faults))
+    corrupt = [p for p in os.listdir(tmp_path / "ck")
+               if p.endswith(".corrupt")]
+    assert corrupt, "torn snapshot should have been quarantined"
+
+    # Journal integrity across five kills: every surviving record
+    # validates, ordinals are gapless, and any window journaled by
+    # multiple attempts carries identical logical fields (the replay-
+    # determinism contract).
+    from tpu_cooccurrence.observability.journal import (read_records,
+                                                        validate_record)
+
+    recs = list(read_records(str(tmp_path / "journal.jsonl")))
+    assert recs, "journal never written"
+    by_seq = {}
+    for r in recs:
+        validate_record(r)
+        logical = (r["ts"], r["events"], r["pairs"])
+        assert by_seq.setdefault(r["seq"], logical) == logical
+    assert max(by_seq) == len(by_seq), "window ordinals must be gapless"

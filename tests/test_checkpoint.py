@@ -4,6 +4,7 @@ Closes the reference's fault-tolerance gap (SURVEY §5): rescorer state,
 reservoirs, window buffers, and the source offset all survive."""
 
 import numpy as np
+import pytest
 
 from tpu_cooccurrence.config import Backend, Config
 from tpu_cooccurrence.io.source import FileMonitorSource
@@ -109,7 +110,10 @@ def test_periodic_checkpointing(tmp_path):
     job = CooccurrenceJob(cfg)
     job.add_batch(users, items, ts)
     job.finish()
-    assert (tmp_path / "ckpt" / "state.npz").exists()
+    gens = sorted((tmp_path / "ckpt").glob("state.*.npz"))
+    assert gens, "no generation-numbered checkpoint landed"
+    assert (tmp_path / "ckpt" / "LATEST").read_text().strip() == \
+        max(gens, key=lambda p: int(p.name.split(".")[1])).name
     assert (tmp_path / "ckpt" / "meta.json").exists()
 
 
@@ -229,3 +233,224 @@ def test_deferred_resume_keeps_real_emission_count(tmp_path):
     c = CooccurrenceJob(Config(**kw, emit_updates=True))  # per-window
     c.restore()
     assert c.emissions == rescored
+
+
+# -- generations, integrity, quarantine (robustness PR) ----------------
+
+
+def test_generations_number_retain_and_latest(tmp_path):
+    """Each save commits a new state.<gen>.npz, LATEST tracks the
+    newest, and retention keeps only --checkpoint-retain generations."""
+    users, items, ts = random_stream(30, n=400)
+    cfg = make_cfg(tmp_path, checkpoint_retain=2)
+    job = CooccurrenceJob(cfg)
+    step = len(users) // 4
+    for i in range(4):
+        job.add_batch(users[i * step:(i + 1) * step],
+                      items[i * step:(i + 1) * step],
+                      ts[i * step:(i + 1) * step])
+        job.checkpoint()
+    ck = tmp_path / "ckpt"
+    gens = sorted(int(p.name.split(".")[1]) for p in ck.glob("state.*.npz"))
+    assert gens == [3, 4], f"retention should keep newest 2, got {gens}"
+    assert (ck / "LATEST").read_text().strip() == "state.4.npz"
+
+    b = CooccurrenceJob(make_cfg(tmp_path, checkpoint_retain=2))
+    b.restore()
+    assert b.windows_fired == job.windows_fired
+
+
+def test_exists_with_generation_files(tmp_path):
+    """exists() sees generation-numbered files, the legacy un-numbered
+    file, and nothing when only foreign/quarantined files remain."""
+    from tpu_cooccurrence.state import checkpoint as ckpt
+
+    users, items, ts = random_stream(31, n=200)
+    job = CooccurrenceJob(make_cfg(tmp_path))
+    ck = tmp_path / "ckpt"
+    assert not ckpt.exists(job, str(ck))
+    job.add_batch(users, items, ts)
+    job.checkpoint()
+    assert ckpt.exists(job, str(ck))
+    # Generation file renamed away (e.g. quarantined): nothing restorable.
+    for p in ck.glob("state.*.npz"):
+        p.rename(str(p) + ".corrupt")
+    assert not ckpt.exists(job, str(ck))
+    # Legacy un-numbered file alone counts (gen 0 compatibility).
+    (ck / "state.npz").write_bytes(b"whatever")
+    assert ckpt.exists(job, str(ck))
+
+
+def test_corrupt_latest_falls_back_a_generation(tmp_path, caplog):
+    """Truncating the newest generation must not crash-loop restore: it
+    falls back to the previous generation, quarantines the bad file as
+    *.corrupt, and counts it on the quarantine gauge."""
+    import logging
+
+    from tpu_cooccurrence.observability.registry import REGISTRY
+    from tpu_cooccurrence.state.checkpoint import QUARANTINE_GAUGE
+
+    users, items, ts = random_stream(32, n=400)
+    job = CooccurrenceJob(make_cfg(tmp_path))
+    half = 200
+    job.add_batch(users[:half], items[:half], ts[:half])
+    job.checkpoint()
+    fired_at_gen1 = job.windows_fired
+    job.add_batch(users[half:], items[half:], ts[half:])
+    job.checkpoint()
+    ck = tmp_path / "ckpt"
+    latest = max(ck.glob("state.*.npz"),
+                 key=lambda p: int(p.name.split(".")[1]))
+    # Tear the newest snapshot as a mid-write power loss would.
+    with open(latest, "r+b") as f:
+        f.truncate(latest.stat().st_size // 2)
+
+    before = REGISTRY.gauge(QUARANTINE_GAUGE).get()
+    b = CooccurrenceJob(make_cfg(tmp_path))
+    with caplog.at_level(logging.ERROR, "tpu_cooccurrence.checkpoint"):
+        b.restore()
+    assert b.windows_fired == fired_at_gen1  # the older generation
+    assert (ck / (latest.name + ".corrupt")).exists()
+    assert not latest.exists()
+    assert REGISTRY.gauge(QUARANTINE_GAUGE).get() == before + 1
+    assert any("quarantined" in r.message for r in caplog.records)
+
+
+def test_digest_mismatch_detected_without_truncation(tmp_path):
+    """A bit-flip that keeps the zip container readable still fails the
+    sha256 verification (np.load alone would restore silently)."""
+    import numpy as np
+
+    from tpu_cooccurrence.state.checkpoint import (
+        CheckpointCorrupt, _load_verified, compute_digest)
+
+    good = {"a": np.arange(10), "b": np.ones(3)}
+    path = tmp_path / "state.1.npz"
+    arrays = dict(good)
+    arrays["digest_sha256"] = np.frombuffer(
+        compute_digest(good).encode(), dtype=np.uint8)
+    np.savez(path, **arrays)
+    assert _load_verified(str(path))  # intact file verifies
+
+    tampered = dict(good)
+    tampered["a"] = np.arange(10) + 1  # the bit-flip
+    tampered["digest_sha256"] = arrays["digest_sha256"]
+    np.savez(path, **tampered)
+    with pytest.raises(CheckpointCorrupt, match="digest mismatch"):
+        _load_verified(str(path))
+
+
+def test_all_generations_corrupt_raises(tmp_path):
+    from tpu_cooccurrence.state.checkpoint import CheckpointCorrupt
+
+    users, items, ts = random_stream(33, n=200)
+    job = CooccurrenceJob(make_cfg(tmp_path))
+    job.add_batch(users, items, ts)
+    job.checkpoint()
+    ck = tmp_path / "ckpt"
+    for p in ck.glob("state.*.npz"):
+        with open(p, "r+b") as f:
+            f.truncate(16)
+    b = CooccurrenceJob(make_cfg(tmp_path))
+    with pytest.raises(CheckpointCorrupt, match="no checkpoint generation"):
+        b.restore()
+
+
+def test_step_back_retires_newest_generation(tmp_path):
+    from tpu_cooccurrence.state.checkpoint import step_back
+
+    users, items, ts = random_stream(34, n=300)
+    job = CooccurrenceJob(make_cfg(tmp_path))
+    half = 150
+    job.add_batch(users[:half], items[:half], ts[:half])
+    job.checkpoint()
+    fired_gen1 = job.windows_fired
+    job.add_batch(users[half:], items[half:], ts[half:])
+    job.checkpoint()
+    ck = tmp_path / "ckpt"
+
+    assert step_back(str(ck)) == 2
+    assert (ck / "state.2.npz.rolledback").exists()
+    b = CooccurrenceJob(make_cfg(tmp_path))
+    b.restore()
+    assert b.windows_fired == fired_gen1
+    # Only one generation left: nothing to step back to.
+    assert step_back(str(ck)) is None
+
+
+def test_save_sweeps_orphaned_tmps(tmp_path):
+    """A crash between mkstemp and os.replace leaves a *.tmp behind;
+    the next save deletes it once it is old enough to be provably dead,
+    and leaves fresh ones (a live concurrent writer's) alone."""
+    import os as _os
+    import time as _time
+
+    users, items, ts = random_stream(35, n=200)
+    job = CooccurrenceJob(make_cfg(tmp_path))
+    job.add_batch(users[:100], items[:100], ts[:100])
+    job.checkpoint()
+    ck = tmp_path / "ckpt"
+    stale = ck / "deadbeef.tmp"
+    stale.write_bytes(b"orphan")
+    old = _time.time() - 3600
+    _os.utime(stale, (old, old))
+    fresh = ck / "cafef00d.tmp"
+    fresh.write_bytes(b"live writer")
+    job.add_batch(users[100:], items[100:], ts[100:])
+    job.checkpoint()
+    assert not stale.exists(), "aged orphan tmp must be swept"
+    assert fresh.exists(), "fresh tmp may belong to a live writer"
+
+
+def test_restore_missing_checkpoint_message(tmp_path):
+    job = CooccurrenceJob(make_cfg(tmp_path))
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        job.restore()
+
+
+def test_restore_legacy_without_meta_json_message(tmp_path):
+    """A pre-atomic-commit npz (no embedded meta_json) is a format
+    error, not corruption: explicit message, no quarantine."""
+    import numpy as np
+
+    users, items, ts = random_stream(36, n=100)
+    job = CooccurrenceJob(make_cfg(tmp_path))
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    np.savez(ck / "state.npz", item_vocab=np.arange(3))
+    with pytest.raises(ValueError, match="no embedded\\s+meta_json"):
+        job.restore()
+    assert (ck / "state.npz").exists(), "format errors must not quarantine"
+
+
+def test_config_mismatch_not_quarantined(tmp_path):
+    """An operator restoring with the wrong flags gets the mismatch
+    message; the (perfectly good) checkpoint stays in place."""
+    users, items, ts = random_stream(37, n=150)
+    a = CooccurrenceJob(make_cfg(tmp_path))
+    a.add_batch(users, items, ts)
+    a.checkpoint()
+    bad = CooccurrenceJob(make_cfg(tmp_path, item_cut=99))
+    with pytest.raises(ValueError, match="config mismatch for item_cut"):
+        bad.restore()
+    ck = tmp_path / "ckpt"
+    assert list(ck.glob("state.*.npz")), "mismatch must not quarantine"
+    assert not list(ck.glob("*.corrupt"))
+
+
+def test_legacy_unnumbered_checkpoint_still_restores(tmp_path):
+    """A state.npz written by the pre-generation format restores as
+    generation 0 (rolling-upgrade compatibility)."""
+    import os as _os
+
+    users, items, ts = random_stream(38, n=300)
+    a = CooccurrenceJob(make_cfg(tmp_path))
+    a.add_batch(users, items, ts)
+    a.checkpoint()
+    ck = tmp_path / "ckpt"
+    gen1 = ck / "state.1.npz"
+    _os.replace(gen1, ck / "state.npz")  # demote to the legacy name
+    (ck / "LATEST").unlink()
+    b = CooccurrenceJob(make_cfg(tmp_path))
+    b.restore()
+    assert b.windows_fired == a.windows_fired

@@ -1,0 +1,220 @@
+"""Fault-injection plane: spec grammar, exactly-once semantics, kinds,
+and the static consistency of site names across the repo."""
+
+import os
+import re
+import time
+
+import pytest
+
+from tpu_cooccurrence.robustness import faults
+from tpu_cooccurrence.robustness.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    KINDS,
+    SITES,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- spec grammar ------------------------------------------------------
+
+
+def test_parse_defaults():
+    s = FaultSpec.parse("window_fire", 0)
+    assert (s.site, s.window_seq, s.kind, s.arg) == (
+        "window_fire", None, "crash", None)
+
+
+def test_parse_full():
+    s = FaultSpec.parse("scorer_dispatch:7:delay_ms:1500", 3)
+    assert (s.site, s.window_seq, s.kind, s.arg, s.index) == (
+        "scorer_dispatch", 7, "delay_ms", 1500, 3)
+
+
+def test_parse_kind_without_seq():
+    s = FaultSpec.parse("journal_append:torn_write", 0)
+    assert (s.site, s.window_seq, s.kind) == (
+        "journal_append", None, "torn_write")
+
+
+@pytest.mark.parametrize("bad, match", [
+    ("no_such_site", "unknown fault site"),
+    ("window_fire:3:no_such_kind", "unknown fault kind"),
+    ("window_fire:0", "window_seq must be >= 1"),
+    ("window_fire:3:delay_ms", "needs an argument"),
+    ("window_fire:3:crash:42", "takes no argument"),
+    ("window_fire:3:delay_ms:oops", "one integer argument"),
+    ("window_fire:3:delay_ms:-50", "non-negative"),
+])
+def test_parse_rejects(bad, match):
+    with pytest.raises(ValueError, match=match):
+        FaultSpec.parse(bad, 0)
+
+
+def test_config_validates_specs_at_parse_time():
+    from tpu_cooccurrence.config import Config
+
+    with pytest.raises(ValueError, match="unknown fault site"):
+        Config(input="x", window_size=10, seed=1,
+               inject_fault=["bogus_site:crash"])
+
+
+# -- firing semantics --------------------------------------------------
+
+
+def test_exception_kind_fires_once_at_seq():
+    plan = FaultPlan.parse(["window_fire:3:exception"])
+    plan.fire("window_fire", seq=1)
+    plan.fire("window_fire", seq=2)
+    plan.fire("scorer_dispatch", seq=3)  # wrong site: no trigger
+    with pytest.raises(InjectedFault, match="window_fire"):
+        plan.fire("window_fire", seq=3)
+    plan.fire("window_fire", seq=4)  # spent: never re-fires
+
+
+def test_seq_trigger_is_at_least_not_exact():
+    """A spec armed for seq 3 must still fire if the site is first hit
+    at seq 5 (e.g. the checkpoint cadence skipped the exact ordinal)."""
+    plan = FaultPlan.parse(["checkpoint_pre_write:3:exception"])
+    with pytest.raises(InjectedFault):
+        plan.fire("checkpoint_pre_write", seq=5)
+
+
+def test_delay_kind_sleeps(monkeypatch):
+    naps = []
+    monkeypatch.setattr(time, "sleep", naps.append)
+    plan = FaultPlan.parse(["source_read:delay_ms:2500"])
+    plan.fire("source_read", seq=1)
+    assert naps == [2.5]
+
+
+def test_crash_kind_calls_die(monkeypatch):
+    deaths = []
+    monkeypatch.setattr(faults, "_die", lambda: deaths.append(True))
+    plan = FaultPlan.parse(["window_fire"])
+    plan.fire("window_fire", seq=1)
+    assert deaths == [True]
+
+
+def test_torn_write_truncates_and_renames(tmp_path, monkeypatch):
+    monkeypatch.setattr(faults, "_die", lambda: None)
+    staged = tmp_path / "snap.tmp"
+    staged.write_bytes(b"x" * 1000)
+    final = tmp_path / "state.1.npz"
+    plan = FaultPlan.parse(["checkpoint_post_write:torn_write"])
+    plan.fire("checkpoint_post_write", seq=1, path=str(staged),
+              rename_to=str(final))
+    assert not staged.exists()
+    assert final.stat().st_size == 500  # torn half committed in place
+
+
+def test_torn_write_append_shape(tmp_path, monkeypatch):
+    monkeypatch.setattr(faults, "_die", lambda: None)
+    j = tmp_path / "j.jsonl"
+    j.write_text('{"seq": 1}\n')
+    plan = FaultPlan.parse(["journal_append:torn_write"])
+    plan.fire("journal_append", seq=2, path=str(j))
+    text = j.read_text()
+    assert text.startswith('{"seq": 1}\n')  # history intact
+    assert not text.endswith("\n")  # torn, newline-less tail
+
+
+def test_state_dir_persists_fired_across_rearm(tmp_path, monkeypatch):
+    deaths = []
+    monkeypatch.setattr(faults, "_die", lambda: deaths.append(True))
+    sd = str(tmp_path / "fault-state")
+    plan = FaultPlan.parse(["window_fire:2"], state_dir=sd)
+    plan.fire("window_fire", seq=2)
+    assert deaths == [True]
+    # A "restarted" process re-arms the same specs: the marker written
+    # before the kill keeps the spec spent.
+    plan2 = FaultPlan.parse(["window_fire:2"], state_dir=sd)
+    assert plan2.specs[0].fired
+    plan2.fire("window_fire", seq=2)
+    assert deaths == [True]
+
+
+def test_arm_disarm_module_plan():
+    try:
+        p = faults.arm(["window_fire:99:exception"])
+        assert faults.PLAN is p
+    finally:
+        faults.disarm()
+    assert faults.PLAN is None
+
+
+# -- static consistency ------------------------------------------------
+
+
+def _repo_text_files():
+    for root, dirs, files in os.walk(REPO):
+        dirs[:] = [d for d in dirs
+                   if d not in (".git", "__pycache__", ".pytest_cache")]
+        for name in files:
+            if name.endswith((".py", ".md")):
+                yield os.path.join(root, name)
+
+
+def test_every_referenced_site_name_is_registered():
+    """Site names cannot drift: every fault-site reference anywhere in
+    the repo (fire() call sites, --inject-fault examples in docs/tests,
+    spec strings) must be a key of SITES — and every registered site
+    must actually be fired somewhere in the package (no dead entries).
+    """
+    kinds_alt = "|".join(KINDS)
+    patterns = [
+        # fire("<site>", ...) call sites and test invocations
+        re.compile(r'\bfire\(\s*"([a-z_]+)"'),
+        # --inject-fault <spec> in docs / CLI examples / argv lists: the
+        # captured name must be followed by ':' (a spec tail) or '"' (a
+        # bare-site spec in an argv list), so prose like "--inject-fault
+        # spec fires once" doesn't capture the word "spec"
+        re.compile(r'--inject-fault[="\s,]+([a-z_]+)[:"]'),
+        # spec strings: "<site>:...kind..." anywhere (tests build these)
+        re.compile(rf'"([a-z_]+)(?::\d+)?:(?:{kinds_alt})'),
+    ]
+    this_file = os.path.abspath(__file__)
+    referenced = {}
+    for path in _repo_text_files():
+        if os.path.abspath(path) == this_file:
+            continue  # holds deliberately-invalid negative examples
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        for pat in patterns:
+            for m in pat.finditer(text):
+                referenced.setdefault(m.group(1), set()).add(
+                    os.path.relpath(path, REPO))
+    unknown = {name: sorted(where) for name, where in referenced.items()
+               if name not in SITES}
+    assert not unknown, (
+        f"fault-site names referenced but not registered in "
+        f"robustness.faults.SITES: {unknown}")
+    # Reverse direction: every registered site has a live fire() call in
+    # the package source (not just tests), so the table can't hold
+    # entries nothing injects into.
+    pkg_text = ""
+    for path in _repo_text_files():
+        if os.sep + "tpu_cooccurrence" + os.sep in path \
+                and path.endswith(".py"):
+            with open(path, encoding="utf-8", errors="replace") as f:
+                pkg_text += f.read()
+    dead = [s for s in SITES
+            if f'fire("{s}"' not in pkg_text.replace("\n", " ")]
+    assert not dead, f"registered fault sites never fired in package: {dead}"
+
+
+def test_supervised_injection_requires_state_dir():
+    from tpu_cooccurrence.config import Config
+
+    with pytest.raises(ValueError, match="fault-state-dir"):
+        Config(input="x", window_size=10, seed=1,
+               restart_on_failure=2,
+               inject_fault=["window_fire:3:crash"])
+    # Fine with the marker dir (and fine unsupervised without one).
+    Config(input="x", window_size=10, seed=1, restart_on_failure=2,
+           inject_fault=["window_fire:3:crash"], fault_state_dir="/tmp/fs")
+    Config(input="x", window_size=10, seed=1,
+           inject_fault=["window_fire:3:crash"])

@@ -169,9 +169,12 @@ def test_multihost_per_process_checkpoint_resume(tmp_path, stream):
     ck_dir = str(tmp_path / "ck")
     half = 250
     _spawn_pair(tmp_path, "first-half", half, stream_path, ck_dir)
-    # Both per-process snapshots must exist (hosts-major row blocks).
-    assert os.path.exists(os.path.join(ck_dir, "state.p0.npz"))
-    assert os.path.exists(os.path.join(ck_dir, "state.p1.npz"))
+    # Both per-process snapshots must exist (hosts-major row blocks;
+    # generation-numbered since the robustness PR).
+    import glob as _glob
+
+    assert _glob.glob(os.path.join(ck_dir, "state.p0.*.npz"))
+    assert _glob.glob(os.path.join(ck_dir, "state.p1.*.npz"))
     results = _spawn_pair(tmp_path, "resume", half, stream_path, ck_dir)
     _assert_matches_reference(results, users, items, ts)
 

@@ -517,3 +517,27 @@ def test_cli_journal_and_metrics_endpoint_smoke(tmp_path):
     for r in recs:
         validate_record(r)
     assert [r["seq"] for r in recs] == list(range(1, len(recs) + 1))
+
+
+def test_metrics_server_healthz_carries_supervisor_info():
+    """Restart forensics from the supervising parent surface on
+    /healthz as last_restart (cli.py passes the env payload through)."""
+    from tpu_cooccurrence.observability.http import MetricsServer
+
+    info = {"restarts": 2, "last_rc": -9, "backoff_ms": 150,
+            "last_restart_unix": 1234.5, "stepped_back": False}
+    srv = MetricsServer(MetricsRegistry(), stale_after_s=300.0,
+                        supervisor_info=info)
+    try:
+        payload, healthy = srv.health()
+        assert healthy
+        assert payload["last_restart"] == info
+    finally:
+        srv.stop()
+
+    srv = MetricsServer(MetricsRegistry(), stale_after_s=300.0)
+    try:
+        payload, _ = srv.health()
+        assert "last_restart" not in payload
+    finally:
+        srv.stop()

@@ -9,6 +9,7 @@ byte-identical to an uninterrupted run."""
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -323,3 +324,260 @@ def test_cli_restart_flag_healthy_run(tmp_path, capsys):
         capture_output=True, text=True, env=ENV, cwd=REPO, timeout=300)
     assert supervised.returncode == 0, supervised.stderr[-800:]
     assert supervised.stdout == plain.stdout
+
+
+# -- hardened recovery loop (robustness PR) ----------------------------
+
+
+def _fail_n_times_cmd(marker, n, rc=3, final_line="recovered"):
+    """A child that exits ``rc`` its first ``n`` runs, then succeeds."""
+    return [sys.executable, "-c", (
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "k = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(k + 1))\n"
+        f"if k < {n}:\n"
+        f"    sys.exit({rc})\n"
+        f"print({final_line!r})\n")]
+
+
+def test_permanent_exit_code_not_retried(tmp_path):
+    """EX_CONFIG (and argparse's 2) mean a bad flag: restarting cannot
+    help, so the supervisor returns immediately without burning
+    attempts."""
+    from tpu_cooccurrence.supervisor import EX_CONFIG
+
+    marker = tmp_path / "runs"
+    code = (
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "k = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(k + 1))\n"
+        f"sys.exit({EX_CONFIG})\n")
+    sink = _Sink()
+    rc = supervise([sys.executable, "-c", code], attempts=5, delay_s=0,
+                   stdout=sink)
+    assert rc == EX_CONFIG
+    assert marker.read_text() == "1", "a permanent failure must not retry"
+
+
+def test_cli_config_error_exits_ex_config(tmp_path):
+    """cli.main turns a config ValueError into EX_CONFIG (a permanent
+    code), instead of an uncaught traceback's generic rc=1."""
+    from tpu_cooccurrence.supervisor import EX_CONFIG
+
+    f = tmp_path / "in.csv"
+    write_stream(f, n=20)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cooccurrence.cli", "-i", str(f),
+         "-ws", "10", "--checkpoint-retain", "0"],
+        capture_output=True, text=True, env=ENV, cwd=REPO, timeout=300)
+    assert proc.returncode == EX_CONFIG, proc.stderr[-500:]
+    assert "checkpoint-retain" in proc.stderr
+
+
+def test_crash_loop_breaker_steps_back_then_gives_up(tmp_path, caplog):
+    """Threshold failures inside the window: the breaker retires the
+    newest checkpoint generation once (the poisoned-snapshot
+    hypothesis); a re-trip gives up instead of burning every attempt."""
+    import logging
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / "state.1.npz").write_bytes(b"older")
+    (ck / "state.2.npz").write_bytes(b"poisoned")
+    marker = tmp_path / "runs"
+    cmd = _fail_n_times_cmd(marker, n=99)  # never recovers
+    sink = _Sink()
+    with caplog.at_level(logging.WARNING):
+        rc = supervise(cmd, attempts=10, delay_s=0, stdout=sink,
+                       crash_loop_threshold=2, crash_loop_window_s=60.0,
+                       checkpoint_dir=str(ck))
+    assert rc == 3
+    assert (ck / "state.2.npz.rolledback").exists()
+    assert (ck / "state.1.npz").exists()
+    # fail, fail -> step back; fail, fail -> breaker open, give up: the
+    # 10 attempts were NOT exhausted.
+    assert marker.read_text() == "4"
+    assert any("crash-loop breaker open" in r.message
+               for r in caplog.records)
+
+
+def test_breaker_without_checkpoint_keeps_full_attempt_budget(tmp_path):
+    """The breaker only trades attempts for a step-back it actually
+    performed: with no --checkpoint-dir it must NOT override the
+    operator's --restart-on-failure budget."""
+    marker = tmp_path / "runs"
+    sink = _Sink()
+    rc = supervise(_fail_n_times_cmd(marker, n=99), attempts=4,
+                   delay_s=0, stdout=sink, crash_loop_threshold=3,
+                   crash_loop_window_s=60.0)
+    assert rc == 3
+    assert marker.read_text() == "5", "all attempts must burn"
+
+
+def test_breaker_single_generation_warns_and_continues(tmp_path, caplog):
+    """A checkpoint dir with only one generation has nothing to fall
+    back to: the breaker logs once and the full budget still applies."""
+    import logging
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / "state.1.npz").write_bytes(b"only one")
+    marker = tmp_path / "runs"
+    sink = _Sink()
+    with caplog.at_level(logging.WARNING, "tpu_cooccurrence.supervisor"):
+        rc = supervise(_fail_n_times_cmd(marker, n=99), attempts=4,
+                       delay_s=0, stdout=sink, crash_loop_threshold=2,
+                       crash_loop_window_s=60.0, checkpoint_dir=str(ck))
+    assert rc == 3
+    assert marker.read_text() == "5"
+    assert (ck / "state.1.npz").exists()
+    warns = [r for r in caplog.records
+             if "no older checkpoint generation" in r.message]
+    assert len(warns) == 1, "the no-step-back warning must fire once"
+
+
+def test_breaker_off_preserves_attempt_exhaustion(tmp_path):
+    """crash_loop_threshold=0 disables the breaker: all attempts burn
+    (the legacy semantics)."""
+    marker = tmp_path / "runs"
+    sink = _Sink()
+    rc = supervise(_fail_n_times_cmd(marker, n=99), attempts=4,
+                   delay_s=0, stdout=sink, crash_loop_threshold=0)
+    assert rc == 3
+    assert marker.read_text() == "5"
+
+
+def test_backoff_decorrelated_jitter_bounds(tmp_path, monkeypatch):
+    """Backoff draws uniform on [base, prev*3] capped at max — record
+    the draw bounds instead of sleeping through them."""
+    import random as _random
+
+    draws = []
+
+    def fake_uniform(lo, hi):
+        draws.append((round(lo, 6), round(hi, 6)))
+        return hi
+
+    monkeypatch.setattr(_random, "uniform", fake_uniform)
+    naps = []
+    import tpu_cooccurrence.supervisor as sup
+    monkeypatch.setattr(sup, "_POLL_S", 0.01)
+    real_sleep = time.sleep
+    monkeypatch.setattr(
+        time, "sleep",
+        lambda s: naps.append(s) if s > 0.01 else real_sleep(s))
+
+    marker = tmp_path / "runs"
+    sink = _Sink()
+    rc = supervise(_fail_n_times_cmd(marker, n=3), attempts=5,
+                   delay_s=0, stdout=sink, crash_loop_threshold=0,
+                   backoff_base_s=0.05, backoff_max_s=0.2)
+    assert rc == 0 and sink.text == "recovered\n"
+    assert draws[0] == (0.05, round(0.05 * 3, 6))
+    assert draws[1] == (0.05, round(0.15 * 3, 6))
+    # Third delay hit the 0.2 cap: min(0.2, uniform(...)=1.35).
+    assert naps[:3] == pytest.approx([0.15, 0.2, 0.2])
+
+
+def test_journal_forensics_failure_does_not_kill_supervisor(
+        tmp_path, monkeypatch, caplog):
+    """A garbled/unreadable journal must cost the restart log its quote,
+    never the restart itself."""
+    import logging
+
+    from tpu_cooccurrence.observability import journal as journal_mod
+
+    def boom(*a, **kw):
+        raise RuntimeError("journal reader exploded")
+
+    monkeypatch.setattr(journal_mod, "tail", boom)
+    marker = tmp_path / "runs"
+    jpath = tmp_path / "j.jsonl"
+    jpath.write_text("not json at all\n")
+    sink = _Sink()
+    with caplog.at_level(logging.WARNING, "tpu_cooccurrence.supervisor"):
+        rc = supervise(_fail_n_times_cmd(marker, n=1), attempts=2,
+                       delay_s=0, stdout=sink, journal_path=str(jpath))
+    assert rc == 0 and sink.text == "recovered\n"
+    assert any("restarting without the quote" in r.message
+               for r in caplog.records)
+
+
+def test_watchdog_kills_stale_child(tmp_path):
+    """A child whose journal stops growing past the staleness threshold
+    is killed (SIGTERM->SIGKILL) and counted as a failed attempt."""
+    jpath = tmp_path / "j.jsonl"
+    code = (
+        "import sys, time\n"
+        f"f = open({str(jpath)!r}, 'a')\n"
+        "f.write('{\"seq\": 1}\\n')\n"
+        "f.flush()\n"
+        "time.sleep(600)\n")
+    sink = _Sink()
+    t0 = time.monotonic()
+    rc = supervise([sys.executable, "-c", code], attempts=0, delay_s=0,
+                   stdout=sink, journal_path=str(jpath),
+                   watchdog_stale_after_s=1.0)
+    assert rc == 124
+    assert sink.text == ""
+    assert time.monotonic() - t0 < 30, "watchdog should not wait the hang out"
+
+
+def test_watchdog_start_grace_survives_torn_tail_seal(tmp_path,
+                                                      monkeypatch):
+    """A restarted child seals a predecessor's torn journal line with a
+    single newline the moment it opens the journal — before restore.
+    That 1-byte growth must NOT count as progress, or the startup grace
+    collapses to the steady-state threshold and a healthy recovering
+    child is killed mid-restore."""
+    import tpu_cooccurrence.supervisor as sup
+
+    monkeypatch.setattr(sup, "WATCHDOG_START_GRACE_S", 4.0)
+    jpath = tmp_path / "j.jsonl"
+    jpath.write_text('{"seq": 1}\n{"torn": tru')  # predecessor's torn tail
+    code = (
+        "import time\n"
+        f"f = open({str(jpath)!r}, 'a')\n"
+        "f.write('\\n')\n"  # the seal, written at journal open
+        "f.flush()\n"
+        "time.sleep(600)\n")  # "restore/replay" that never progresses
+    sink = _Sink()
+    t0 = time.monotonic()
+    rc = supervise([sys.executable, "-c", code], attempts=0, delay_s=0,
+                   stdout=sink, journal_path=str(jpath),
+                   watchdog_stale_after_s=1.0)
+    elapsed = time.monotonic() - t0
+    assert rc == 124
+    # Killed on the 4s startup grace, not 1s after the seal byte.
+    assert elapsed > 3.0, (
+        f"seal byte collapsed the startup grace (killed after "
+        f"{elapsed:.1f}s)")
+
+
+def test_supervisor_state_env_reaches_child(tmp_path):
+    """The child of a restarted attempt sees restart count/backoff in
+    TPU_COOC_SUPERVISOR_STATE (the scrape plane's input)."""
+    import json as _json
+
+    from tpu_cooccurrence.supervisor import SUPERVISOR_STATE_ENV
+
+    marker = tmp_path / "runs"
+    code = (
+        "import json, os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "k = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(k + 1))\n"
+        "if k < 1:\n"
+        "    sys.exit(3)\n"
+        f"print(os.environ[{SUPERVISOR_STATE_ENV!r}])\n")
+    sink = _Sink()
+    rc = supervise([sys.executable, "-c", code], attempts=2, delay_s=0.01,
+                   stdout=sink)
+    assert rc == 0
+    state = _json.loads(sink.text)
+    assert state["restarts"] == 1
+    assert state["last_rc"] == 3
+    assert state["backoff_ms"] == 10
+    assert state["last_restart_unix"] > 0

@@ -7,7 +7,9 @@ duration and the accumulator dump in the reference's format.
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
 from typing import Optional, Sequence
 
@@ -15,6 +17,7 @@ from .config import Config
 from .io.parse import batched_lines
 from .io.source import FileMonitorSource
 from .job import CooccurrenceJob
+from .supervisor import EX_CONFIG, SUPERVISOR_STATE_ENV
 
 LOG = logging.getLogger("tpu_cooccurrence")
 
@@ -31,7 +34,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         stream=sys.stderr,  # reference logs INFO to stderr (log4j.properties:1-6)
         format="%(asctime)s %(levelname)s %(name)s - %(message)s",
     )
-    config = Config.from_args(argv)
+    try:
+        config = Config.from_args(argv)
+    except ValueError as exc:
+        # EX_CONFIG (sysexits): a permanent failure the supervisor must
+        # not retry — a bad flag does not get better with restarts.
+        LOG.error("configuration error: %s", exc)
+        return EX_CONFIG
 
     if config.restart_on_failure > 0:
         # Supervisor mode (Flink restart-strategy analogue, SURVEY §5):
@@ -45,10 +54,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         LOG.info("supervising job (up to %d restart(s), delay %d ms)",
                  config.restart_on_failure, config.restart_delay_ms)
         # --journal flows through to the child (it writes the records);
-        # the supervisor only reads the tail for crash forensics.
-        return supervise(cmd, config.restart_on_failure,
-                         delay_s=config.restart_delay_ms / 1000.0,
-                         journal_path=config.journal)
+        # the supervisor only reads the tail for crash forensics and the
+        # hang watchdog's liveness signal. --inject-fault flows through
+        # too: faults fire in the job child, never in the supervisor.
+        return supervise(
+            cmd, config.restart_on_failure,
+            delay_s=config.restart_delay_ms / 1000.0,
+            journal_path=config.journal,
+            backoff_base_s=(config.restart_backoff_base_ms / 1000.0
+                            if config.restart_backoff_base_ms > 0 else None),
+            backoff_max_s=config.restart_backoff_max_ms / 1000.0,
+            crash_loop_threshold=config.crash_loop_threshold,
+            crash_loop_window_s=config.crash_loop_window_s,
+            watchdog_stale_after_s=(config.watchdog_stale_after_s
+                                    if config.watchdog_stale_after_s > 0
+                                    else None),
+            checkpoint_dir=config.checkpoint_dir)
+
+    if config.inject_fault:
+        # Armed only on the job path: a supervising parent passes the
+        # specs through to its child instead of firing them itself.
+        from .robustness import faults
+
+        faults.arm(config.inject_fault, config.fault_state_dir)
+        LOG.warning("fault injection armed: %s", config.inject_fault)
 
     config.log_configuration(LOG)
     if config.pipeline_depth > 0:
@@ -62,6 +91,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                  config.pipeline_depth)
 
     job = CooccurrenceJob(config)
+    # Supervisor state rides in on an env var (the scrape plane lives in
+    # this child process, not the parent): restart/backoff gauges on
+    # /metrics, last-restart info on /healthz.
+    supervisor_info = None
+    raw_state = os.environ.get(SUPERVISOR_STATE_ENV)
+    if raw_state:
+        try:
+            supervisor_info = json.loads(raw_state)
+        except ValueError:
+            LOG.warning("unparseable %s=%r; ignoring",
+                        SUPERVISOR_STATE_ENV, raw_state)
     metrics_server = None
     if config.metrics_port is not None:
         # Live scrape plane (observability/http.py): a long-running job is
@@ -71,10 +111,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .observability.http import MetricsServer
         from .observability.registry import REGISTRY
 
+        if supervisor_info is not None:
+            REGISTRY.gauge(
+                "cooc_supervisor_restarts",
+                help="restarts the supervising parent has performed "
+                     "this run").set(supervisor_info.get("restarts", 0))
+            REGISTRY.gauge(
+                "cooc_supervisor_backoff_ms",
+                help="restart backoff delay the supervisor applied "
+                     "before this attempt").set(
+                         supervisor_info.get("backoff_ms", 0))
         metrics_server = MetricsServer(
             REGISTRY, counters=job.counters, ledger=LEDGER,
             port=config.metrics_port,
-            stale_after_s=config.healthz_stale_after_s).start()
+            stale_after_s=config.healthz_stale_after_s,
+            supervisor_info=supervisor_info).start()
     source = FileMonitorSource(
         config.input, job.counters,
         process_continuously=config.process_continuously)

@@ -15,7 +15,7 @@ import argparse
 import dataclasses
 import enum
 import time
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 
 class WindowUnit(enum.Enum):
@@ -100,12 +100,31 @@ class Config:
     # --partition-sampling is the ingest scale-out axis
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
+    checkpoint_retain: int = 3  # generation-numbered checkpoints kept
+    # (state.<gen>.npz; restore falls back to the newest generation that
+    # verifies its digest, quarantining corrupt ones as *.corrupt)
     restart_on_failure: int = 0  # supervisor: respawn the job up to N
     # times on abnormal exit, resuming from --checkpoint-dir when set
     # (the reference delegates this to Flink's restart strategies,
     # SURVEY §5); 0 = no supervision
     restart_delay_ms: int = 1000  # fixed delay between restart attempts
     # (the analogue of Flink's fixed-delay restart strategy)
+    restart_backoff_base_ms: int = 0  # >0 switches restart delays to
+    # exponential backoff with decorrelated jitter, starting here
+    restart_backoff_max_ms: int = 30000  # backoff delay cap
+    crash_loop_threshold: int = 3  # failures within the sliding window
+    # that open the crash-loop breaker (step back one checkpoint
+    # generation, then give up on a re-trip); 0 = breaker off
+    crash_loop_window_s: float = 60.0  # breaker sliding-window seconds
+    watchdog_stale_after_s: float = 0.0  # supervisor hang watchdog: kill
+    # a child whose --journal has not grown for this many seconds (the
+    # /healthz "no window fired" liveness signal); 0 = off
+    inject_fault: Optional[List[str]] = None  # fault-injection specs
+    # (robustness/faults.py): site[:window_seq][:kind[:arg]], each fires
+    # exactly once; None/[] = injection off (zero hot-path cost)
+    fault_state_dir: Optional[str] = None  # markers making injected
+    # faults fire once per RUN (across supervised restarts), not once
+    # per attempt
     profile_dir: Optional[str] = None  # XLA profiler trace output (TensorBoard)
     journal: Optional[str] = None  # run-journal JSONL path: one flushed
     # record per fired window (observability/journal.py flight recorder);
@@ -186,6 +205,44 @@ class Config:
                 raise ValueError(
                     "--partition-sampling is a multi-host mode — it needs "
                     "--coordinator/--num-processes/--process-id")
+        if self.inject_fault is None:
+            self.inject_fault = []
+        if self.inject_fault:
+            # Fail fast on a bad spec (unknown site/kind, missing
+            # delay arg) — at config time, not mid-run at first fire.
+            from .robustness.faults import FaultPlan
+
+            FaultPlan.parse(self.inject_fault)
+            if self.restart_on_failure > 0 and not self.fault_state_dir:
+                raise ValueError(
+                    "--inject-fault under --restart-on-failure needs "
+                    "--fault-state-dir: without persisted fired-markers "
+                    "every respawned attempt re-injects the same faults "
+                    "and the run can only exhaust its restarts")
+        if self.checkpoint_retain < 1:
+            raise ValueError(
+                f"--checkpoint-retain must be >= 1, got "
+                f"{self.checkpoint_retain}")
+        if self.restart_backoff_base_ms < 0 or self.restart_backoff_max_ms < 0:
+            raise ValueError("restart backoff values must be >= 0")
+        if (self.restart_backoff_base_ms
+                and self.restart_backoff_max_ms < self.restart_backoff_base_ms):
+            raise ValueError(
+                "--restart-backoff-max-ms must be >= "
+                "--restart-backoff-base-ms")
+        if self.watchdog_stale_after_s < 0:
+            raise ValueError(
+                f"--watchdog-stale-after-s must be >= 0, got "
+                f"{self.watchdog_stale_after_s}")
+        if self.watchdog_stale_after_s > 0:
+            if self.restart_on_failure <= 0:
+                raise ValueError(
+                    "--watchdog-stale-after-s is supervisor machinery — "
+                    "it needs --restart-on-failure")
+            if not self.journal:
+                raise ValueError(
+                    "--watchdog-stale-after-s watches the run journal "
+                    "for liveness — it needs --journal")
         if self.metrics_port is not None and not (
                 0 <= self.metrics_port <= 65535):
             raise ValueError(
@@ -325,6 +382,11 @@ class Config:
         p.add_argument("--checkpoint-dir", default=None, dest="checkpoint_dir")
         p.add_argument("--checkpoint-every-windows", type=int, default=0,
                        dest="checkpoint_every_windows")
+        p.add_argument("--checkpoint-retain", type=int, default=3,
+                       dest="checkpoint_retain",
+                       help="Generation-numbered checkpoints to keep "
+                            "(restore falls back to the newest one that "
+                            "verifies; default: 3)")
         p.add_argument("--restart-on-failure", type=int, default=0,
                        dest="restart_on_failure",
                        help="Supervise the run: respawn the job up to N "
@@ -334,6 +396,44 @@ class Config:
         p.add_argument("--restart-delay-ms", type=int, default=1000,
                        dest="restart_delay_ms",
                        help="Fixed delay between restart attempts")
+        p.add_argument("--restart-backoff-base-ms", type=int, default=0,
+                       dest="restart_backoff_base_ms",
+                       help="Enable exponential restart backoff with "
+                            "decorrelated jitter, starting at this delay "
+                            "(0 = fixed --restart-delay-ms)")
+        p.add_argument("--restart-backoff-max-ms", type=int, default=30000,
+                       dest="restart_backoff_max_ms",
+                       help="Backoff delay cap (default: 30000)")
+        p.add_argument("--crash-loop-threshold", type=int, default=3,
+                       dest="crash_loop_threshold",
+                       help="Failures within --crash-loop-window-s that "
+                            "open the crash-loop breaker: step back one "
+                            "checkpoint generation, then give up on a "
+                            "re-trip (0 = breaker off; default: 3)")
+        p.add_argument("--crash-loop-window-s", type=float, default=60.0,
+                       dest="crash_loop_window_s",
+                       help="Crash-loop breaker sliding window seconds "
+                            "(default: 60)")
+        p.add_argument("--watchdog-stale-after-s", type=float, default=0.0,
+                       dest="watchdog_stale_after_s",
+                       help="Supervisor hang watchdog: SIGTERM/SIGKILL a "
+                            "child whose --journal has not grown for this "
+                            "many seconds and count a failed attempt "
+                            "(0 = off; needs --restart-on-failure and "
+                            "--journal)")
+        p.add_argument("--inject-fault", action="append", default=None,
+                       dest="inject_fault", metavar="SITE[:SEQ][:KIND[:ARG]]",
+                       help="Fault injection (repeatable): fire KIND "
+                            "(crash|exception|delay_ms|torn_write; default "
+                            "crash) once at the named site, optionally at "
+                            "window ordinal SEQ — e.g. "
+                            "--inject-fault checkpoint_post_write:3:"
+                            "torn_write (sites: robustness/faults.py)")
+        p.add_argument("--fault-state-dir", default=None,
+                       dest="fault_state_dir",
+                       help="Directory persisting fired-fault markers so "
+                            "each --inject-fault spec fires once per run, "
+                            "across supervised restarts")
         p.add_argument("--emit-updates", action="store_true",
                        dest="emit_updates",
                        help="Stream each window's updated top-K rows to "

@@ -26,6 +26,7 @@ import time
 from typing import Iterator, List, Optional, Tuple
 
 from ..metrics import Counters, SPLIT_READER_NUM_SPLITS
+from ..robustness import faults
 
 
 class FileMonitorSource:
@@ -114,6 +115,7 @@ class FileMonitorSource:
         skip_file = self._current_file
         skip_mtime = self._current_mtime
         skip_lines = self._current_line
+        files_opened = 0
         while True:
             splits = self._list_splits()
             if skip_file is not None:
@@ -123,6 +125,9 @@ class FileMonitorSource:
                 # filter alone cannot know that).
                 splits = [s for s in splits if s >= (skip_mtime, skip_file)]
             for pos, (mtime, p) in enumerate(splits):
+                files_opened += 1
+                if faults.PLAN is not None:
+                    faults.PLAN.fire("source_read", seq=files_opened)
                 self.counters.add(SPLIT_READER_NUM_SPLITS, 1)
                 to_skip = skip_lines if (p == skip_file
                                          and mtime == skip_mtime) else 0

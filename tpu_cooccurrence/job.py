@@ -35,6 +35,7 @@ from .sampling.reservoir import UserReservoirSampler
 from .sampling.sliding import SlidingBasketSampler
 from .observability import LEDGER, StepTimer, WindowStats, clock
 from .observability.registry import BYTES_BUCKETS, REGISTRY
+from .robustness import faults
 from .state.rescorer import HostRescorer, WindowTopK
 from .state.results import LatestResults, TopKBatch
 from .state.vocab import IdMap
@@ -380,6 +381,8 @@ class CooccurrenceJob:
     def _drain(self, final: bool) -> None:
         for ts, users, items in self.engine.fire_ready(final=final):
             self.windows_fired += 1
+            if faults.PLAN is not None:
+                faults.PLAN.fire("window_fire", seq=self.windows_fired)
             with clock() as sample_clock:
                 if self.sliding:
                     pairs = self.sampler.fire(users, items)
@@ -411,6 +414,9 @@ class CooccurrenceJob:
                     seq=self.windows_fired, stall_seconds=stall))
             else:
                 # Score on the backend.
+                if faults.PLAN is not None:
+                    faults.PLAN.fire("scorer_dispatch",
+                                     seq=self.windows_fired)
                 with clock() as score_clock:
                     window_out: WindowTopK = self.scorer.process_window(ts, pairs)
                 # Pipelined backends return the previous window's results;

@@ -43,11 +43,16 @@ class MetricsServer:
 
     def __init__(self, registry: MetricsRegistry, counters=None, ledger=None,
                  port: int = 0, host: str = "127.0.0.1",
-                 stale_after_s: float = 300.0) -> None:
+                 stale_after_s: float = 300.0,
+                 supervisor_info: Optional[dict] = None) -> None:
         self.registry = registry
         self.counters = counters
         self.ledger = ledger
         self.stale_after_s = stale_after_s
+        # Restart forensics from the supervising parent (cli.py passes
+        # the env-var payload through): surfaced on /healthz so "is this
+        # process a restart, and why" is scrapeable.
+        self.supervisor_info = supervisor_info
         self._started_unix = time.time()
         outer = self
 
@@ -103,6 +108,8 @@ class MetricsServer:
                    "windows_fired": windows,
                    "last_window_age_seconds": round(age, 3),
                    "stale_after_seconds": self.stale_after_s}
+        if self.supervisor_info is not None:
+            payload["last_restart"] = self.supervisor_info
         return payload, status != "stale"
 
     def start(self) -> "MetricsServer":

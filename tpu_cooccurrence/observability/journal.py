@@ -32,6 +32,8 @@ import json
 import os
 from typing import Dict, Iterator, List, Optional
 
+from ..robustness import faults
+
 #: Journal format version (bump on breaking schema changes).
 VERSION = 1
 
@@ -111,6 +113,11 @@ class RunJournal:
     def record(self, rec: dict) -> None:
         if self._f is None:
             raise ValueError("journal is closed")
+        if faults.PLAN is not None:
+            # torn_write here appends half a record then dies — the
+            # exact SIGKILL-mid-write shape readers must tolerate.
+            faults.PLAN.fire("journal_append", seq=rec.get("seq", 0),
+                             path=self.path)
         # One write syscall per record + explicit flush: a SIGKILL can
         # truncate at most the line being written, never reorder lines.
         self._f.write(json.dumps(rec, sort_keys=True) + "\n")

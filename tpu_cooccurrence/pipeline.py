@@ -69,6 +69,7 @@ import numpy as np
 from .observability import WindowStats, clock
 from .observability.registry import REGISTRY
 from .ops.aggregate import AggregatedPairs
+from .robustness import faults
 
 #: Queue sentinel: process everything already enqueued, then exit.
 _SHUTDOWN = object()
@@ -258,6 +259,8 @@ class PipelineDriver:
         # Windows still queued behind this one — the journal's per-window
         # ring-depth (how far the producer ran ahead of the scorer).
         ring_depth = self._queue.qsize()
+        if faults.PLAN is not None:
+            faults.PLAN.fire("scorer_dispatch", seq=item.seq)
         with clock() as score_clock:
             window_out = job.scorer.process_window(item.ts, item.payload)
         self.scorer_busy_seconds += score_clock.seconds

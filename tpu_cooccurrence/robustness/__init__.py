@@ -1,0 +1,18 @@
+"""Robustness plane: deterministic fault injection + recovery hardening.
+
+The reference delegates every failure mode to Flink's restart strategies
+(SURVEY §5); this standalone build owns its whole recovery loop
+(``supervisor.py`` + ``state/checkpoint.py``) — which means nothing
+proves that loop except injected faults. :mod:`.faults` is the injection
+plane: named sites threaded through the hot path that a
+:class:`~.faults.FaultPlan` (CLI ``--inject-fault``) triggers exactly
+once per spec, off by default with zero hot-path cost.
+"""
+
+from .faults import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    KINDS,
+    SITES,
+)

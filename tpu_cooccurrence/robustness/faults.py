@@ -1,0 +1,249 @@
+"""Deterministic fault-injection plane.
+
+Recovery code that is never exercised is recovery code that does not
+work. The reference gets its failure coverage for free from Flink's own
+test matrix; this standalone build injects its own: named **sites**
+threaded through the hot path fire a configured fault *exactly once per
+spec*, at a deterministic point (a window ordinal), in one of four
+**kinds** — so every failure domain the recovery loop claims to survive
+(``supervisor.py`` restarts, ``state/checkpoint.py`` generation
+fallback, the hang watchdog) has a test that actually kills the process
+there (``tests/test_chaos.py``).
+
+Spec grammar (CLI ``--inject-fault``, repeatable)::
+
+    site[:window_seq][:kind[:arg]]
+
+* ``site`` — a key of :data:`SITES` (the registered injection points).
+* ``window_seq`` — optional integer: trigger on the first hit whose
+  sequence number is >= this (sites inside the window loop pass the
+  fired-window ordinal; ``source_read`` passes the file-open ordinal).
+  Omitted = first hit.
+* ``kind`` — one of :data:`KINDS`, default ``crash``:
+    - ``crash``      — SIGKILL the process (uncatchable hard death);
+    - ``exception``  — raise :class:`InjectedFault` (clean-ish failure
+      that unwinds through normal error handling);
+    - ``delay_ms``   — sleep ``arg`` milliseconds (a hang, for the
+      supervisor watchdog); ``arg`` is required;
+    - ``torn_write`` — tear the file the site is mid-writing (whole-file
+      writers: truncate to half and complete the pending rename with the
+      torn bytes; appenders: leave a newline-less partial record), then
+      SIGKILL: the torn-media crash that defeats a naive restore.
+
+Exactly-once across restarts: a supervised child is respawned with the
+same argv, so the same specs re-arm on every attempt. With
+``--fault-state-dir`` each spec persists a ``fault<i>.fired`` marker
+*before* executing (the marker must survive the SIGKILL that follows),
+and already-marked specs arm spent — one injection per spec per
+directory, however many attempts the supervisor makes.
+
+Zero-cost-when-off contract: every site guards with
+``if faults.PLAN is not None`` — one module-attribute load and a
+pointer compare on the hot path, nothing else. Arming is explicit
+(:func:`arm`, called by the CLI after config parse).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import threading
+import time
+from typing import List, Optional, Sequence
+
+LOG = logging.getLogger("tpu_cooccurrence.faults")
+
+#: Registered injection sites: name -> where it fires. The static
+#: consistency test (``tests/test_faults.py``) greps the repo for every
+#: referenced site name and asserts membership here, so a site cannot
+#: drift out of this table silently.
+SITES = {
+    "source_read": "io/source.py — opening the next input file "
+                   "(seq = 1-based file-open ordinal)",
+    "window_fire": "job.py — a window just fired, before sampling "
+                   "(seq = fired-window ordinal)",
+    "scorer_dispatch": "job.py / pipeline.py — immediately before "
+                       "scorer.process_window (seq = window ordinal)",
+    "checkpoint_pre_write": "state/checkpoint.py — before the snapshot "
+                            "tmp file is written",
+    "checkpoint_post_write": "state/checkpoint.py — snapshot fully "
+                             "written, before the atomic rename",
+    "journal_append": "observability/journal.py — before appending a "
+                      "window record",
+}
+
+KINDS = ("crash", "exception", "delay_ms", "torn_write")
+
+
+class InjectedFault(RuntimeError):
+    """The ``exception`` fault kind: a deliberate, attributable failure."""
+
+
+def _die() -> None:
+    """Hard process death (SIGKILL self: uncatchable, like the OOM
+    killer). A module function so unit tests can monkeypatch it."""
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One parsed ``--inject-fault`` spec."""
+
+    site: str
+    window_seq: Optional[int]
+    kind: str
+    arg: Optional[int]
+    index: int  # position in the plan (the persistence-marker key)
+    fired: bool = False
+
+    @classmethod
+    def parse(cls, raw: str, index: int) -> "FaultSpec":
+        parts = raw.split(":")
+        site = parts[0]
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} in --inject-fault {raw!r}; "
+                f"registered sites: {', '.join(sorted(SITES))}")
+        rest = parts[1:]
+        window_seq: Optional[int] = None
+        if rest and _is_int(rest[0]):
+            window_seq = int(rest[0])
+            if window_seq < 1:
+                raise ValueError(
+                    f"window_seq must be >= 1 in --inject-fault {raw!r}")
+            rest = rest[1:]
+        kind = rest[0] if rest else "crash"
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in --inject-fault {raw!r}; "
+                f"kinds: {', '.join(KINDS)}")
+        rest = rest[1:]
+        arg: Optional[int] = None
+        if rest:
+            if kind != "delay_ms":
+                raise ValueError(
+                    f"fault kind {kind!r} takes no argument "
+                    f"(--inject-fault {raw!r})")
+            if not _is_int(rest[0]) or len(rest) > 1:
+                raise ValueError(
+                    f"delay_ms needs one integer argument "
+                    f"(--inject-fault {raw!r})")
+            arg = int(rest[0])
+            if arg < 0:
+                raise ValueError(
+                    f"delay_ms must be non-negative "
+                    f"(--inject-fault {raw!r})")
+        elif kind == "delay_ms":
+            raise ValueError(
+                f"delay_ms needs an argument, e.g. "
+                f"{site}:delay_ms:5000 (--inject-fault {raw!r})")
+        return cls(site=site, window_seq=window_seq, kind=kind, arg=arg,
+                   index=index)
+
+
+def _is_int(s: str) -> bool:
+    try:
+        int(s)
+        return True
+    except ValueError:
+        return False
+
+
+class FaultPlan:
+    """The armed set of fault specs. Sites call :meth:`fire`; each spec
+    triggers at most once (persisted across restarts via ``state_dir``)."""
+
+    def __init__(self, specs: List[FaultSpec],
+                 state_dir: Optional[str] = None) -> None:
+        self.specs = specs
+        self.state_dir = state_dir
+        self._lock = threading.Lock()
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            for spec in self.specs:
+                if os.path.exists(self._marker(spec)):
+                    spec.fired = True
+
+    @classmethod
+    def parse(cls, raw_specs: Sequence[str],
+              state_dir: Optional[str] = None) -> "FaultPlan":
+        return cls([FaultSpec.parse(raw, i)
+                    for i, raw in enumerate(raw_specs)], state_dir)
+
+    def _marker(self, spec: FaultSpec) -> str:
+        return os.path.join(self.state_dir, f"fault{spec.index}.fired")
+
+    def fire(self, site: str, seq: int = 0, path: Optional[str] = None,
+             rename_to: Optional[str] = None) -> None:
+        """Trigger any armed spec matching ``site`` at ``seq``.
+
+        ``path``/``rename_to`` give ``torn_write`` its target: the file
+        the site is mid-writing, and the final name a pending atomic
+        rename would commit it to.
+        """
+        for spec in self.specs:
+            if spec.fired or spec.site != site:
+                continue
+            if spec.window_seq is not None and seq < spec.window_seq:
+                continue
+            with self._lock:
+                if spec.fired:  # lost the race to another thread
+                    continue
+                spec.fired = True
+                if self.state_dir:
+                    # Persist BEFORE executing: the kinds that kill the
+                    # process must not re-fire on the supervised restart.
+                    with open(self._marker(spec), "w") as f:
+                        f.write(f"{spec.site}:{seq}:{spec.kind}\n")
+                        f.flush()
+                        os.fsync(f.fileno())
+            self._execute(spec, seq, path, rename_to)
+
+    def _execute(self, spec: FaultSpec, seq: int, path: Optional[str],
+                 rename_to: Optional[str]) -> None:
+        LOG.warning("injecting fault: site=%s seq=%d kind=%s arg=%s",
+                    spec.site, seq, spec.kind, spec.arg)
+        if spec.kind == "crash":
+            _die()
+        elif spec.kind == "exception":
+            raise InjectedFault(
+                f"injected fault at {spec.site} (seq={seq})")
+        elif spec.kind == "delay_ms":
+            time.sleep(spec.arg / 1000.0)
+        elif spec.kind == "torn_write":
+            if rename_to is not None and path is not None \
+                    and os.path.exists(path):
+                # Whole-file writers (checkpoint snapshots): truncate the
+                # staged file to half and commit the torn bytes where the
+                # good file would have landed — the media-corruption shape
+                # the digest-verified restore must survive.
+                os.truncate(path, os.path.getsize(path) // 2)
+                os.replace(path, rename_to)
+            elif path is not None:
+                # Appenders (the journal): leave a torn, newline-less
+                # partial record at the tail — the SIGKILL-mid-write
+                # shape readers and the next attempt's seal must absorb.
+                with open(path, "a") as f:
+                    f.write('{"torn": tru')
+                    f.flush()
+            _die()
+
+
+#: The armed plan; ``None`` = injection off (the hot-path guard).
+PLAN: Optional[FaultPlan] = None
+
+
+def arm(raw_specs: Sequence[str],
+        state_dir: Optional[str] = None) -> FaultPlan:
+    """Parse and arm ``raw_specs`` as the process-wide plan."""
+    global PLAN
+    PLAN = FaultPlan.parse(raw_specs, state_dir)
+    return PLAN
+
+
+def disarm() -> None:
+    """Drop the armed plan (tests)."""
+    global PLAN
+    PLAN = None

@@ -13,30 +13,241 @@ continues bit-identically (validated in ``tests/test_checkpoint.py``).
 Format: a single ``.npz`` holding the arrays AND the JSON-encoded scalars
 (``meta_json``), committed by one atomic rename; a ``meta.json`` sidecar is
 written afterwards for human inspection only and plays no part in restore.
+
+Durability (the recovery-loop contract, ``tests/test_chaos.py``):
+
+* **Integrity digest** — a sha256 over every array's bytes rides inside
+  the ``.npz`` (``digest_sha256``). A torn or bit-rotted file — the one
+  failure an atomic rename cannot rule out (rename is atomic; the
+  preceding writes are only as durable as the filesystem's journaling) —
+  fails verification instead of restoring garbage or crash-looping
+  ``np.load``.
+* **Generations** — each save commits ``state<suffix>.<gen>.npz`` with a
+  monotonically increasing generation number and updates an atomic
+  ``LATEST<suffix>`` pointer; ``--checkpoint-retain`` newest generations
+  are kept. Restore walks newest-to-oldest, quarantines any generation
+  that fails verification as ``*.corrupt`` (counted on
+  ``cooc_checkpoint_quarantined_total``), and restores the newest one
+  that verifies — a corrupt latest checkpoint costs one generation of
+  progress, not the job.
+* Orphaned ``*.tmp`` files (a crash between ``mkstemp`` and
+  ``os.replace``) are swept by the next :func:`save` once they are old
+  enough to be provably dead.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
+import re
 import tempfile
+import time
 
 import numpy as np
 
 from ..metrics import RESCORED_ITEMS
+from ..observability.registry import REGISTRY
+from ..robustness import faults
+
+LOG = logging.getLogger("tpu_cooccurrence.checkpoint")
+
+#: Orphaned ``*.tmp`` snapshots younger than this are left alone by the
+#: sweep: they may belong to a live writer (another process of a
+#: multi-host run saving into the same directory).
+TMP_SWEEP_AGE_S = 900.0
+
+#: Quarantine counter (metrics plane): checkpoint files that failed
+#: verification and were renamed ``*.corrupt``.
+QUARANTINE_GAUGE = "cooc_checkpoint_quarantined_total"
+
+#: Generation-in-use gauge: set by :func:`save` (generation written) and
+#: :func:`restore` (generation restored).
+GENERATION_GAUGE = "cooc_checkpoint_generation"
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint file failed to load or verify its digest."""
+
+
+# -- naming ------------------------------------------------------------
+
+
+def _legacy_path(directory: str, suffix: str) -> str:
+    return os.path.join(directory, f"state{suffix}.npz")
+
+
+def _gen_path(directory: str, suffix: str, gen: int) -> str:
+    return os.path.join(directory, f"state{suffix}.{gen}.npz")
+
+
+def _latest_path(directory: str, suffix: str) -> str:
+    return os.path.join(directory, f"LATEST{suffix}")
+
+
+def generations(directory: str, suffix: str) -> "list[tuple[int, str]]":
+    """Restorable generations in ``directory`` for this process suffix,
+    newest first, as ``(gen, path)``. A legacy un-numbered
+    ``state<suffix>.npz`` (pre-generation format) appears as generation
+    0, so old checkpoints keep restoring."""
+    pat = re.compile(
+        rf"^state{re.escape(suffix)}\.(\d+)\.npz$")
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = pat.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    legacy = _legacy_path(directory, suffix)
+    if os.path.exists(legacy):
+        out.append((0, legacy))
+    out.sort(reverse=True)
+    return out
 
 
 def exists(job, directory: str) -> bool:
     """True when ``directory`` holds a checkpoint this job could restore
-    (same file-naming scheme as :func:`save`, including the per-process
-    suffix of multi-host runs)."""
+    (any generation, or the legacy un-numbered file; same per-process
+    suffix scheme as :func:`save`)."""
     suffix = getattr(job.scorer, "process_suffix", "")
-    return os.path.exists(os.path.join(directory, f"state{suffix}.npz"))
+    return bool(generations(directory, suffix))
+
+
+# -- integrity ---------------------------------------------------------
+
+
+def compute_digest(arrays: "dict[str, np.ndarray]") -> str:
+    """sha256 over every array's name, dtype, shape and bytes, in sorted
+    name order — the payload the atomic rename commits, independent of
+    zip-container details."""
+    h = hashlib.sha256()
+    for key in sorted(arrays):
+        a = np.ascontiguousarray(arrays[key])
+        h.update(key.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _load_verified(path: str) -> "dict[str, np.ndarray]":
+    """Load ``path`` and verify its embedded digest.
+
+    Raises :class:`CheckpointCorrupt` on any read failure (torn zip,
+    truncated member) or digest mismatch. A file without a digest
+    (written by a pre-digest version) loads with a warning — corruption
+    detection is best-effort for legacy snapshots, not a restore veto.
+    """
+    try:
+        with np.load(path) as data:
+            arrays = {k: data[k] for k in data.files}
+    except (MemoryError, OSError):
+        # Environmental, not corruption: a transient EIO / fd exhaustion
+        # / tight-memory load must not get a good snapshot quarantined —
+        # propagate and let the supervisor's restart retry it.
+        raise
+    except Exception as exc:  # BadZipFile / zlib.error / ValueError ...
+        raise CheckpointCorrupt(f"unreadable checkpoint {path}: {exc}")
+    stored = arrays.pop("digest_sha256", None)
+    if stored is None:
+        LOG.warning("checkpoint %s predates integrity digests; restoring "
+                    "unverified", path)
+        return arrays
+    expected = bytes(stored).decode()
+    actual = compute_digest(arrays)
+    if actual != expected:
+        raise CheckpointCorrupt(
+            f"checkpoint digest mismatch in {path}: stored {expected[:12]}…, "
+            f"recomputed {actual[:12]}…")
+    return arrays
+
+
+def _update_latest(directory: str, suffix: str) -> None:
+    """Point ``LATEST<suffix>`` at the newest surviving generation (or
+    remove it when none survive) — kept fresh across quarantine and
+    step-back so the operator breadcrumb never names a gone file."""
+    gens = generations(directory, suffix)
+    latest = _latest_path(directory, suffix)
+    try:
+        if not gens:
+            os.remove(latest)
+            return
+        tmp = latest + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(os.path.basename(gens[0][1]) + "\n")
+        os.replace(tmp, latest)
+    except OSError:
+        pass  # the pointer is advisory; never fail recovery over it
+
+
+def _quarantine(path: str, directory: str, suffix: str) -> None:
+    """Move a failed-verification file aside as ``<path>.corrupt`` so the
+    crash-restart loop cannot hit it again, and count it."""
+    target = path + ".corrupt"
+    try:
+        os.replace(path, target)
+    except OSError as exc:
+        LOG.error("could not quarantine corrupt checkpoint %s: %s",
+                  path, exc)
+        return
+    _update_latest(directory, suffix)
+    REGISTRY.gauge(
+        QUARANTINE_GAUGE,
+        help="checkpoint files that failed verification, moved aside "
+             "as *.corrupt").add(1)
+    LOG.error("quarantined corrupt checkpoint %s -> %s", path, target)
+
+
+def step_back(directory: str, suffix: str = "") -> "int | None":
+    """Retire the newest generation (crash-loop breaker: the supervisor
+    calls this when restarts keep dying post-restore, so the next
+    attempt restores the previous generation). The file is kept as
+    ``*.rolledback`` for forensics. Returns the retired generation, or
+    ``None`` when there is no older generation to fall back to."""
+    gens = generations(directory, suffix)
+    if len(gens) < 2:
+        return None
+    gen, path = gens[0]
+    os.replace(path, path + ".rolledback")
+    _update_latest(directory, suffix)
+    LOG.warning("crash-loop breaker: stepped back checkpoint generation "
+                "%d (%s -> *.rolledback); next restore uses generation %d",
+                gen, path, gens[1][0])
+    return gen
+
+
+def _sweep_orphan_tmps(directory: str) -> None:
+    """Delete ``*.tmp`` snapshots abandoned by a crash between
+    ``mkstemp`` and ``os.replace``. Age-gated: a fresh tmp may be a
+    live writer's (multi-host processes share the directory)."""
+    now = time.time()
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for name in names:
+        if not name.endswith(".tmp"):
+            continue
+        p = os.path.join(directory, name)
+        try:
+            if now - os.path.getmtime(p) > TMP_SWEEP_AGE_S:
+                os.remove(p)
+                LOG.info("swept orphaned checkpoint tmp %s", p)
+        except OSError:
+            continue  # raced with another sweeper or the owner's rename
+
+
+# -- save / restore ----------------------------------------------------
 
 
 def save(job, directory: str, source=None) -> str:
     """Write a checkpoint of ``job`` (and optionally its file source)."""
     os.makedirs(directory, exist_ok=True)
+    _sweep_orphan_tmps(directory)
     arrays = {}
     meta = {
         "seed": job.config.seed,
@@ -113,15 +324,45 @@ def save(job, directory: str, source=None) -> str:
     arrays["meta_json"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8)
 
+    # Normalize before digesting: the digest must hash exactly the
+    # arrays savez will store (asarray-converted), not pre-conversion
+    # Python objects.
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    arrays["digest_sha256"] = np.frombuffer(
+        compute_digest(arrays).encode(), dtype=np.uint8)
+
     # Multi-host runs checkpoint per process (each host owns a row block
     # and its partition of the results); the scorer supplies the suffix.
     suffix = getattr(job.scorer, "process_suffix", "")
+    gens = generations(directory, suffix)
+    gen = (gens[0][0] + 1) if gens else 1
+    if faults.PLAN is not None:
+        faults.PLAN.fire("checkpoint_pre_write", seq=job.windows_fired)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     os.close(fd)
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
-    npz_path = os.path.join(directory, f"state{suffix}.npz")
+    npz_path = _gen_path(directory, suffix, gen)
+    if faults.PLAN is not None:
+        faults.PLAN.fire("checkpoint_post_write", seq=job.windows_fired,
+                         path=tmp, rename_to=npz_path)
     os.replace(tmp, npz_path)
+    # Atomic LATEST pointer: an operator breadcrumb only — restore
+    # always directory-scans (ordering by generation number), so the
+    # pointer is advisory, never load-bearing. Quarantine and step-back
+    # refresh it so it never names a gone file.
+    _update_latest(directory, suffix)
+    # Retention: keep the newest N generations (quarantined/rolled-back
+    # files keep their renamed forms and are not counted).
+    retain = max(1, getattr(job.config, "checkpoint_retain", 3))
+    for _old_gen, old_path in generations(directory, suffix)[retain:]:
+        try:
+            os.remove(old_path)
+        except OSError:
+            pass
+    REGISTRY.gauge(
+        GENERATION_GAUGE,
+        help="checkpoint generation last written or restored").set(gen)
     meta_tmp = os.path.join(directory, f"meta{suffix}.json.tmp")
     with open(meta_tmp, "w") as f:
         json.dump(meta, f)
@@ -130,9 +371,37 @@ def save(job, directory: str, source=None) -> str:
 
 
 def restore(job, directory: str, source=None) -> None:
-    """Restore ``job`` (constructed with the same Config) from a checkpoint."""
+    """Restore ``job`` (constructed with the same Config) from the newest
+    checkpoint generation that verifies.
+
+    Fallback walk: generations newest-to-oldest, ordered by the
+    generation number in the filename (the ``LATEST`` pointer is an
+    operator breadcrumb, not an input). A generation that fails
+    to load or verify is quarantined as ``*.corrupt`` and the walk
+    continues — a torn latest checkpoint costs one generation, not a
+    crash loop. Config mismatches and legacy-format errors are operator
+    errors, not corruption: they raise immediately without quarantining.
+    """
     suffix = getattr(job.scorer, "process_suffix", "")
-    data = np.load(os.path.join(directory, f"state{suffix}.npz"))
+    gens = generations(directory, suffix)
+    if not gens:
+        raise FileNotFoundError(
+            f"no checkpoint for suffix {suffix!r} in {directory}")
+    data = None
+    restored_gen = None
+    for gen, path in gens:
+        try:
+            data = _load_verified(path)
+            restored_gen = gen
+            break
+        except CheckpointCorrupt as exc:
+            LOG.error("checkpoint generation %d failed verification: %s",
+                      gen, exc)
+            _quarantine(path, directory, suffix)
+    if data is None:
+        raise CheckpointCorrupt(
+            f"no checkpoint generation in {directory} verifies "
+            f"(all {len(gens)} quarantined)")
     # Meta comes from inside the npz (the atomic commit point); the
     # meta.json sidecar is informational only and may lag by a crash.
     if "meta_json" not in data:
@@ -202,3 +471,11 @@ def restore(job, directory: str, source=None) -> None:
 
     if source is not None and "source" in meta:
         source.restore_state(meta["source"])
+    REGISTRY.gauge(
+        GENERATION_GAUGE,
+        help="checkpoint generation last written or restored").set(
+            restored_gen)
+    if restored_gen != gens[0][0]:
+        LOG.warning("restored checkpoint generation %d (newest was %d; "
+                    "newer generations failed verification)",
+                    restored_gen, gens[0][0])

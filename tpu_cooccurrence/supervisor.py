@@ -9,6 +9,28 @@ the child resumes from the latest checkpoint on its own
 (``state/checkpoint.py`` restores all state including the source's
 mid-file position), so recovery needs zero operator action.
 
+Hardened recovery loop (proven by injected faults, ``tests/test_chaos.py``):
+
+* **Backoff** — restart delays use exponential backoff with
+  decorrelated jitter (``--restart-backoff-base-ms`` /
+  ``--restart-backoff-max-ms``) so a flapping job does not hammer a
+  shared resource in lockstep; the legacy fixed ``--restart-delay-ms``
+  remains the default.
+* **Crash-loop breaker** — ``--crash-loop-threshold`` failures inside a
+  ``--crash-loop-window-s`` sliding window mean restarting alone is not
+  working (the classic cause: a poisoned latest checkpoint). The
+  breaker steps the checkpoint back one generation
+  (``state/checkpoint.step_back``) and grants one more round; if the
+  loop re-trips, it gives up instead of burning attempts forever.
+* **Permanent failures** — usage/config exit codes
+  (:data:`PERMANENT_EXIT_CODES`) are never retried: a bad flag does not
+  get better with restarts.
+* **Hang watchdog** — a child whose run journal has gone stale past
+  ``--watchdog-stale-after-s`` (same liveness signal as ``/healthz``:
+  "no window fired") is SIGTERM→SIGKILLed and counted as a failed
+  attempt, so a wedged device dispatch costs one restart, not the whole
+  ``timeout_s``.
+
 Output discipline: each attempt's stdout is spooled to an anonymous
 temp file and only forwarded when that attempt exits cleanly, so a
 crashed attempt's partial output is discarded and the supervised run's
@@ -27,6 +49,7 @@ import io
 import json
 import logging
 import os
+import random
 import shutil
 import subprocess
 import sys
@@ -37,8 +60,39 @@ from typing import List, Optional, Sequence
 LOG = logging.getLogger("tpu_cooccurrence.supervisor")
 
 #: Flags the supervisor strips from the child's argv (the child must run
-#: the job directly, not recurse into supervision).
-_SUPERVISOR_FLAGS = ("--restart-on-failure", "--restart-delay-ms")
+#: the job directly, not recurse into supervision; the watchdog/backoff/
+#: breaker flags are supervisor-side policy the child has no use for —
+#: and ``--watchdog-stale-after-s`` would fail the child's config
+#: validation once ``--restart-on-failure`` is stripped).
+_SUPERVISOR_FLAGS = ("--restart-on-failure", "--restart-delay-ms",
+                     "--restart-backoff-base-ms", "--restart-backoff-max-ms",
+                     "--crash-loop-threshold", "--crash-loop-window-s",
+                     "--watchdog-stale-after-s")
+
+#: ``EX_CONFIG`` from sysexits(3): the CLI exits with it on a
+#: configuration ValueError, and argparse exits 2 on usage errors.
+EX_CONFIG = 78
+
+#: Child exit codes that mean "retrying cannot help" (usage / config
+#: errors): the supervisor returns them immediately without burning a
+#: restart attempt.
+PERMANENT_EXIT_CODES = frozenset({2, EX_CONFIG})
+
+#: Environment variable carrying supervisor state into the child, which
+#: surfaces it on ``/metrics`` (restart/backoff gauges) and ``/healthz``
+#: (last-restart info) — the scrape plane runs in the child, not here.
+SUPERVISOR_STATE_ENV = "TPU_COOC_SUPERVISOR_STATE"
+
+#: Watchdog: before the child's first journal growth, staleness is
+#: measured against ``max(stale_after, this)`` — interpreter + jax
+#: startup must not read as a hang.
+WATCHDOG_START_GRACE_S = 30.0
+
+#: Watchdog/timeout poll period while the child runs.
+_POLL_S = 0.2
+
+#: SIGTERM-to-SIGKILL escalation grace for a hung child.
+_TERM_GRACE_S = 5.0
 
 
 def child_argv(argv: Sequence[str]) -> List[str]:
@@ -82,10 +136,20 @@ def _quote_journal_tail(journal_path: str, size_before: int,
     before recording anything (startup crash, bad restore) — or one that
     wrote fewer than ``n`` records — can never have an earlier attempt's
     (or an earlier run's) windows quoted as its own last act.
-    """
-    from .observability.journal import tail
 
-    records = tail(journal_path, n=n, start_offset=size_before)
+    Forensics must never kill the patient: any failure reading or
+    parsing the journal (unreadable file, binary garbage) is logged and
+    swallowed — the restart proceeds without the quote.
+    """
+    try:
+        from .observability.journal import tail
+
+        records = tail(journal_path, n=n, start_offset=size_before)
+    except Exception as exc:
+        LOG.warning("could not read dead child's journal %s for "
+                    "forensics (%s: %s); restarting without the quote",
+                    journal_path, type(exc).__name__, exc)
+        return
     if not records:
         LOG.warning("dead child wrote no journal records this attempt "
                     "(%s); it died before its first window fired",
@@ -97,12 +161,78 @@ def _quote_journal_tail(journal_path: str, size_before: int,
         LOG.warning("  journal: %s", json.dumps(rec, sort_keys=True))
 
 
+def _kill_child(proc: "subprocess.Popen") -> None:
+    """SIGTERM, a short grace, then SIGKILL — and reap."""
+    proc.terminate()
+    try:
+        proc.wait(timeout=_TERM_GRACE_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+def _run_attempt(cmd: Sequence[str], spool, timeout_s: Optional[float],
+                 watchdog_stale_after_s: Optional[float],
+                 journal_path: Optional[str], env: dict) -> int:
+    """Spawn one child attempt and wait for it, enforcing the overall
+    ``timeout_s`` and the journal-staleness watchdog. Returns the exit
+    code (124 for a timeout or watchdog kill, matching timeout(1))."""
+    proc = subprocess.Popen(list(cmd), stdout=spool, env=env)
+    start = time.monotonic()
+    last_activity = start
+    last_size = _journal_size(journal_path)
+    seen_growth = False
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            return rc
+        now = time.monotonic()
+        if timeout_s is not None and now - start > timeout_s:
+            LOG.error("job attempt exceeded timeout_s=%.1f; killing",
+                      timeout_s)
+            _kill_child(proc)
+            return 124
+        if watchdog_stale_after_s and journal_path:
+            size = _journal_size(journal_path)
+            # First growth must exceed 1 byte: a restarted child seals a
+            # predecessor's torn final line with a single "\n" the moment
+            # it opens the journal — before restore/replay — and that
+            # seal must not collapse the startup grace down to the
+            # steady-state threshold (a real record is far larger).
+            if size > last_size + (0 if seen_growth else 1):
+                last_size = size
+                last_activity = now
+                seen_growth = True
+            # Same liveness signal as /healthz: "no window fired for N
+            # seconds" — with a startup grace before the first record
+            # (imports + restore are not a hang).
+            threshold = (watchdog_stale_after_s if seen_growth
+                         else max(watchdog_stale_after_s,
+                                  WATCHDOG_START_GRACE_S))
+            if now - last_activity > threshold:
+                LOG.error(
+                    "hang watchdog: journal %s stale for %.1fs "
+                    "(> %.1fs); SIGTERM then SIGKILL, counting a "
+                    "failed attempt", journal_path, now - last_activity,
+                    threshold)
+                _kill_child(proc)
+                return 124
+        time.sleep(_POLL_S)
+
+
 def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
               stdout=None, timeout_s: Optional[float] = None,
-              journal_path: Optional[str] = None) -> int:
+              journal_path: Optional[str] = None,
+              backoff_base_s: Optional[float] = None,
+              backoff_max_s: float = 30.0,
+              crash_loop_threshold: int = 3,
+              crash_loop_window_s: float = 60.0,
+              watchdog_stale_after_s: Optional[float] = None,
+              checkpoint_dir: Optional[str] = None) -> int:
     """Run ``cmd`` to successful completion, restarting up to ``attempts``
     times on abnormal exit. Returns the final exit code (0 on success,
-    the last failure's code once attempts are exhausted).
+    the last failure's code once attempts are exhausted, or immediately
+    on a permanent failure code).
 
     ``stdout`` (default ``sys.stdout``) receives the successful attempt's
     spooled output; failed attempts' partial output is discarded with a
@@ -113,27 +243,39 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
 
     ``journal_path`` (the child's ``--journal`` file, when configured):
     on every abnormal exit the last few journal records are quoted into
-    the restart log — the crashed attempt's final fired windows, which
-    would otherwise vanish with its discarded stdout.
+    the restart log, and (with ``watchdog_stale_after_s``) its growth is
+    the liveness signal the hang watchdog polls.
+
+    ``backoff_base_s=None`` keeps the legacy fixed ``delay_s`` between
+    attempts; a value enables exponential backoff with decorrelated
+    jitter capped at ``backoff_max_s``. ``checkpoint_dir`` arms the
+    crash-loop breaker's generation step-back.
     """
     sink = stdout if stdout is not None else sys.stdout
     restarts = 0
+    stepped_back = False
+    breaker_warned = False
+    failure_times: List[float] = []
+    prev_delay = backoff_base_s if backoff_base_s is not None else delay_s
+    last_rc = 0
     while True:
         # Journal size at spawn: the crash-forensics quote below must only
         # fire for records THIS attempt wrote (append mode keeps earlier
         # attempts' records in the same file).
         journal_size_before = _journal_size(journal_path)
+        env = dict(os.environ)
+        env[SUPERVISOR_STATE_ENV] = json.dumps({
+            "restarts": restarts,
+            "last_rc": last_rc,
+            "backoff_ms": int(prev_delay * 1000) if restarts else 0,
+            "last_restart_unix": round(time.time(), 3) if restarts else 0,
+            "stepped_back": stepped_back,
+        })
         # One anonymous spool per attempt: auto-deleted on close, so a
         # failed attempt's partial output vanishes without cleanup code.
         with tempfile.TemporaryFile() as spool:
-            try:
-                proc = subprocess.run(list(cmd), stdout=spool,
-                                      timeout=timeout_s)
-                rc = proc.returncode
-            except subprocess.TimeoutExpired:
-                # A hung attempt counts as a failed one (subprocess.run
-                # has already killed the child); 124 matches timeout(1).
-                rc = 124
+            rc = _run_attempt(cmd, spool, timeout_s,
+                              watchdog_stale_after_s, journal_path, env)
             # The child wrote through the shared fd; our handle's position
             # never moved, so size comes from the file, not tell().
             out_bytes = os.fstat(spool.fileno()).st_size
@@ -156,6 +298,11 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
                 if restarts:
                     LOG.info("job completed after %d restart(s)", restarts)
                 return 0
+        last_rc = rc
+        if rc in PERMANENT_EXIT_CODES:
+            LOG.error("job failed with rc=%d (usage/config error — "
+                      "permanent); not restarting", rc)
+            return rc
         restarts += 1
         if journal_path:
             _quote_journal_tail(journal_path, journal_size_before)
@@ -163,10 +310,57 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
             LOG.error("job failed with rc=%d; restart attempts exhausted "
                       "(%d)", rc, attempts)
             return rc
+        now = time.monotonic()
+        failure_times.append(now)
+        failure_times[:] = [t for t in failure_times
+                            if now - t <= crash_loop_window_s]
+        if (crash_loop_threshold > 0
+                and len(failure_times) >= crash_loop_threshold):
+            # Restarting alone is not working. Step the checkpoint back a
+            # generation once (the poisoned-latest-snapshot hypothesis);
+            # a RE-trip after the step-back means the failure is not
+            # checkpoint-shaped — give up rather than crash-loop through
+            # every attempt. A run with nothing to step back (no
+            # --checkpoint-dir, or a single generation; supervised runs
+            # are single-process by config, so the default suffix is the
+            # right namespace) keeps its full --restart-on-failure
+            # budget: the breaker only ever trades attempts for a
+            # recovery action it actually performed.
+            if stepped_back:
+                LOG.error(
+                    "crash-loop breaker open: %d failures within %.0fs "
+                    "after stepping back a generation; giving up with "
+                    "rc=%d", len(failure_times), crash_loop_window_s, rc)
+                return rc
+            retired = None
+            if checkpoint_dir:
+                from .state.checkpoint import step_back
+
+                retired = step_back(checkpoint_dir)
+            if retired is not None:
+                stepped_back = True
+                failure_times.clear()
+            elif checkpoint_dir and not breaker_warned:
+                breaker_warned = True
+                LOG.warning(
+                    "crash-loop detected (%d failures within %.0fs) but "
+                    "no older checkpoint generation to step back to; "
+                    "continuing with plain restarts",
+                    len(failure_times), crash_loop_window_s)
+        if backoff_base_s is not None:
+            # Decorrelated jitter (AWS architecture-blog shape): each
+            # delay is uniform on [base, prev*3], capped — restarts
+            # spread out instead of synchronizing on the failure period.
+            prev_delay = min(backoff_max_s,
+                             random.uniform(backoff_base_s,
+                                            max(backoff_base_s,
+                                                prev_delay * 3)))
+        else:
+            prev_delay = delay_s
         LOG.warning(
             "job attempt %d failed with rc=%d; discarding %d bytes of "
             "partial output and restarting in %.1fs (%d attempt(s) left)",
-            restarts, rc, out_bytes, delay_s,
+            restarts, rc, out_bytes, prev_delay,
             attempts - restarts)
-        if delay_s > 0:
-            time.sleep(delay_s)
+        if prev_delay > 0:
+            time.sleep(prev_delay)
