@@ -20,6 +20,28 @@ def run_cli(capsys, *argv):
     return capsys.readouterr().out
 
 
+def test_cli_unregistered_fault_site_exits_2_with_site_list(
+        caplog, tmp_path):
+    """A typo'd --inject-fault site is exit code 2 (in the supervisor's
+    PERMANENT_EXIT_CODES — never retried) and the error names the
+    registered sites so the operator can fix the spec blind."""
+    from tpu_cooccurrence.robustness.faults import SITES
+
+    f = tmp_path / "in.csv"
+    write_stream(f, n=50)
+    rc = cli.main(["-i", str(f), "-ws", "50", "--backend", "oracle",
+                   "--inject-fault", "not_a_site:3:crash"])  # cooclint: disable=fault-site
+    assert rc == 2
+    err = "\n".join(r.getMessage() for r in caplog.records)
+    assert "not_a_site" in err
+    for site in SITES:
+        assert site in err  # the full registered list is quoted
+    # Other config errors keep the EX_CONFIG (78) classification.
+    rc = cli.main(["-i", str(f), "-ws", "50", "--backend", "oracle",
+                   "--inject-fault", "window_fire:3:delay_ms"])
+    assert rc == 78
+
+
 def test_cli_oracle_end_to_end(capsys, tmp_path):
     f = tmp_path / "in.csv"
     write_stream(f)

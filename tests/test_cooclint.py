@@ -1,0 +1,564 @@
+"""cooclint (tpu_cooccurrence.analysis): the tier-1 enforcement run plus
+fixture-driven proof that each rule pack catches its seeded violation.
+
+The enforcement test runs the analyzer over the whole checkout and
+expects zero non-baseline findings — this is the commit-time gate the
+analyzer exists for. The fixture tests feed bad-code snippets through
+``analyze_source`` impersonating the file each rule watches, including
+a regression fixture reproducing the PR-2 ``TransferLedger`` race
+pattern (the unlocked ``+=`` on the ledger's byte totals from a worker
+module) that motivated the lock-discipline pack.
+
+This file's raw text necessarily quotes the bad fault-site patterns the
+text-scanning rules hunt (the deleted PR-3 test excluded itself for the
+same reason), so it opts out of that one rule file-wide:
+# cooclint: disable-file=fault-site
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_cooccurrence.analysis import (
+    Analyzer,
+    Finding,
+    RULES,
+    analyze_source,
+    load_baseline,
+)
+from tpu_cooccurrence.analysis.core import save_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Tier-1 runtime budget for the whole-repo pass (ISSUE 4 satellite:
+#: the analyzer must stay under this or fail loudly here, in review).
+RUNTIME_BUDGET_S = 10.0
+
+
+def _rules(f):
+    return sorted({x.rule for x in f})
+
+
+# -- the tier-1 gate ---------------------------------------------------
+
+
+def test_repo_is_clean_under_budget():
+    """Whole-repo pass: no new findings, runtime within the tier-1
+    budget (recorded in the run summary and asserted here)."""
+    result = Analyzer(REPO, baseline=load_baseline()).run()
+    assert not result.findings, "\n".join(map(str, result.findings))
+    assert not result.stale_baseline, (
+        f"stale baseline entries (run --prune-baseline): "
+        f"{result.stale_baseline}")
+    assert result.files_scanned > 50  # sanity: the walker saw the repo
+    print(f"cooclint runtime: {result.elapsed_seconds:.2f}s "
+          f"over {result.files_scanned} files")
+    assert result.elapsed_seconds < RUNTIME_BUDGET_S
+
+
+def test_runner_json_schema_and_exit_code():
+    """``python -m tpu_cooccurrence.analysis --format json`` under
+    JAX_PLATFORMS=cpu (the tier-1 environment): exit 0 on the clean
+    repo, schema round-trips through Finding.from_dict, runtime is in
+    the summary."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cooccurrence.analysis",
+         "--root", REPO, "--format", "json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["exit_code"] == 0
+    assert payload["files_scanned"] > 50
+    assert payload["elapsed_seconds"] < RUNTIME_BUDGET_S
+    # Round-trip: every finding dict reconstructs losslessly.
+    for d in payload["findings"]:
+        assert Finding.from_dict(d).to_dict() == d
+
+
+# -- rule pack 1: lock discipline --------------------------------------
+
+PR2_RACE_FIXTURE = '''
+class PipelineWorker:
+    def record_upload(self, ledger, arrays):
+        n = sum(int(a.nbytes) for a in arrays)
+        ledger.h2d_bytes += n
+        ledger.h2d_calls += 1
+'''
+
+
+def test_lock_discipline_catches_pr2_ledger_race():
+    """The PR-2 regression shape: an unlocked read-modify-write on the
+    TransferLedger byte totals from a worker module."""
+    findings = analyze_source(
+        PR2_RACE_FIXTURE, path="tpu_cooccurrence/pipeline.py",
+        rules=["lock-discipline"])
+    assert len(findings) == 2
+    assert {f.line for f in findings} == {5, 6}
+    assert all(f.rule == "lock-discipline" for f in findings)
+
+
+def test_lock_discipline_allows_locked_and_owner_access():
+    locked = '''
+class PipelineWorker:
+    def record_upload(self, ledger, n):
+        with ledger._lock:
+            ledger.h2d_bytes += n
+'''
+    owner = '''
+class TransferLedger:
+    def up(self, n):
+        with self._lock:
+            self.h2d_bytes += n
+'''
+    assert analyze_source(locked, rules=["lock-discipline"]) == []
+    assert analyze_source(owner, rules=["lock-discipline"]) == []
+
+
+def test_lock_discipline_counters_and_results_state():
+    bad = '''
+def merge_fast(counters, other):
+    for k, v in other._counters.items():
+        counters._counters[k] += v
+'''
+    findings = analyze_source(bad, rules=["lock-discipline"])
+    # one access per line: the iteration read and the augmented write
+    assert {f.line for f in findings} == {3, 4}
+    bad_results = "def poke(latest):\n    return latest._ptr_batch[0]\n"
+    assert _rules(analyze_source(
+        bad_results, rules=["lock-discipline"])) == ["lock-discipline"]
+
+
+def test_lock_annotation_required_in_worker_modules():
+    bad = "import threading\nLOCK = threading.Lock()\n"
+    findings = analyze_source(
+        bad, path="tpu_cooccurrence/pipeline.py",
+        rules=["lock-annotation"])
+    assert _rules(findings) == ["lock-annotation"]
+    good = ("import threading\n"
+            "# lock-ordering: leaf lock, never held across registry "
+            "locks\n"
+            "LOCK = threading.Lock()\n")
+    assert analyze_source(good, path="tpu_cooccurrence/pipeline.py",
+                          rules=["lock-annotation"]) == []
+    # Outside the two-thread worker modules a bare lock is fine.
+    assert analyze_source(bad, path="tpu_cooccurrence/io/source.py",
+                          rules=["lock-annotation"]) == []
+
+
+def test_lock_discipline_is_object_sensitive_inside_owner():
+    """The PR-2 Counters.merge race, reintroduced INSIDE the owning
+    class: self's lock over *other*'s dict must still be a finding —
+    the owner exemption covers `self` only."""
+    bad = '''
+class Counters:
+    def merge(self, other):
+        with self._lock:
+            for k, v in other._counters.items():
+                self._counters[k] += v
+'''
+    findings = analyze_source(bad, rules=["lock-discipline"])
+    assert len(findings) == 1
+    assert "other" in findings[0].message and findings[0].line == 5
+
+
+def test_lock_discipline_wrong_objects_lock_does_not_cover():
+    bad = '''
+def record(a, b, n):
+    with a._lock:
+        b.h2d_bytes += n
+'''
+    findings = analyze_source(bad, rules=["lock-discipline"])
+    assert _rules(findings) == ["lock-discipline"]
+    good = bad.replace("with a._lock:", "with b._lock:")
+    assert analyze_source(good, rules=["lock-discipline"]) == []
+
+
+# -- rule pack 2: jit / device hygiene ---------------------------------
+
+
+def test_jit_purity_flags_host_syncs():
+    bad = '''
+import jax
+import numpy as np
+
+@jax.jit
+def score(c, x):
+    y = np.asarray(x)
+    print("debug", y)
+    return float(x)
+'''
+    findings = analyze_source(bad, rules=["jit-purity"])
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "np.asarray" in msgs and "print" in msgs and "float(x)" in msgs
+
+
+def test_jit_purity_static_args_and_plain_functions_exempt():
+    src = '''
+import functools
+import jax
+import numpy as np
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk(vals, k):
+    return int(k) + vals.sum()
+
+def host_helper(x):
+    return float(np.asarray(x).sum())
+'''
+    assert analyze_source(src, rules=["jit-purity"]) == []
+
+
+def test_jit_purity_block_until_ready_and_rng():
+    bad = '''
+import jax
+import numpy as np
+
+@jax.jit
+def noisy(x):
+    x.sum().block_until_ready()
+    return x + np.random.rand()
+'''
+    findings = analyze_source(bad, rules=["jit-purity"])
+    msgs = " | ".join(f.message for f in findings)
+    assert "block_until_ready" in msgs and "host RNG" in msgs
+
+
+def test_jit_purity_one_hop_closure_in_ops():
+    """A helper called from a jitted function in ops/ is hot-path too."""
+    src = '''
+import jax
+import numpy as np
+
+def helper(x):
+    return np.asarray(x)
+
+@jax.jit
+def entry(x):
+    return helper(x)
+'''
+    findings = analyze_source(src, path="tpu_cooccurrence/ops/llr.py",
+                              rules=["jit-purity"])
+    assert _rules(findings) == ["jit-purity"]
+    # Outside ops/ the closure hop is off (host modules wrap jits in
+    # plain orchestration functions all the time).
+    assert analyze_source(src, path="tpu_cooccurrence/job.py",
+                          rules=["jit-purity"]) == []
+
+
+DONATION_FIXTURE = '''
+import functools
+import jax
+from ..ops.donation import donate_argnums
+
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0))
+def update(c, d):
+    return c + d
+
+class Scorer:
+    def step(self, d):
+        out = update(self.cnt, d)
+        return self.cnt.sum()
+'''
+
+
+def test_donation_reuse_flags_use_after_donate():
+    findings = analyze_source(DONATION_FIXTURE, rules=["donation-reuse"])
+    assert _rules(findings) == ["donation-reuse"]
+    assert "self.cnt" in findings[0].message
+
+
+def test_donation_reuse_allows_same_statement_rebind():
+    good = DONATION_FIXTURE.replace(
+        "        out = update(self.cnt, d)\n        return self.cnt.sum()",
+        "        self.cnt = update(self.cnt, d)\n        return self.cnt.sum()")
+    assert analyze_source(good, rules=["donation-reuse"]) == []
+
+
+# -- rule pack 3: registry drift ---------------------------------------
+
+
+def test_metric_name_rule():
+    bad = ('from .registry import REGISTRY\n'
+           'g = REGISTRY.gauge("cooc_bogus_thing", help="x")\n')
+    findings = analyze_source(bad, rules=["metric-name"])
+    assert _rules(findings) == ["metric-name"]
+    assert "cooc_bogus_thing" in findings[0].message
+    good = bad.replace("cooc_bogus_thing", "cooc_windows_fired")
+    assert analyze_source(good, rules=["metric-name"]) == []
+
+
+def test_metric_name_rule_counter_literals():
+    bad = ('class J:\n'
+           '    def f(self):\n'
+           '        self.counters.add("TotallyMadeUpCounter", 1)\n')
+    findings = analyze_source(bad, rules=["metric-name"])
+    assert _rules(findings) == ["metric-name"]
+    good = bad.replace("TotallyMadeUpCounter",
+                       "ItemInteractionCounterLateElements")
+    assert analyze_source(good, rules=["metric-name"]) == []
+
+
+def test_fault_site_rule_fire_and_spec_strings():
+    bad = ('def f(plan):\n'
+           '    plan.fire("not_a_site", seq=1)\n'
+           '    spec = "not_a_site:3:crash"\n')
+    findings = analyze_source(bad, rules=["fault-site"])
+    # The AST and raw-text scans overlap deliberately (each covers
+    # shapes the other cannot); both anchor the same two lines.
+    assert {f.line for f in findings} == {2, 3}
+    good = bad.replace("not_a_site", "window_fire")
+    assert analyze_source(good, rules=["fault-site"]) == []
+
+
+def test_fault_site_rule_argv_pairs_without_kind():
+    """CLI-test argv shape: the site rides a separate literal with no
+    kind suffix — the text scan must still validate it (coverage the
+    deleted PR-3 test had)."""
+    bad = 'cmd = ["--inject-fault", "windw_fire:3"]\n'  # cooclint: disable=fault-site
+    findings = analyze_source(bad, rules=["fault-site"])
+    assert _rules(findings) == ["fault-site"]
+    assert "windw_fire" in findings[0].message
+    good = 'cmd = ["--inject-fault", "window_fire:3"]\n'
+    assert analyze_source(good, rules=["fault-site"]) == []
+
+
+def test_metric_name_reverse_check_flags_dead_canonical_entries(
+        tmp_path):
+    """A CANONICAL_METRICS entry nothing in the package emits is a dead
+    registry row (mirrors the fault-site dead-entry check)."""
+    from tpu_cooccurrence.observability.registry import CANONICAL_METRICS
+
+    pkg = tmp_path / "tpu_cooccurrence" / "observability"
+    pkg.mkdir(parents=True)
+    (pkg / "registry.py").write_text(
+        'G = REGISTRY.gauge("cooc_windows_fired")\n')
+    result = Analyzer(str(tmp_path), rules=[RULES["metric-name"]]).run()
+    dead = {f.message.split("'")[1] for f in result.findings}
+    assert dead == CANONICAL_METRICS - {"cooc_windows_fired"}
+
+
+def test_fault_site_rule_midstring_and_bare_fire():
+    """Coverage parity with the deleted PR-3 scan: a quoted spec
+    embedded mid-docstring and a bare imported fire() call must both
+    be validated."""
+    doc = ('def f():\n'
+           '    """Example: pass "typo_site:3:crash" to the CLI."""\n')
+    findings = analyze_source(doc, rules=["fault-site"])
+    assert _rules(findings) == ["fault-site"]
+    assert "typo_site" in findings[0].message
+    bare = ('from tpu_cooccurrence.robustness.faults import fire\n'
+            'fire("typo_site", seq=1)\n')
+    findings = analyze_source(bare, rules=["fault-site"])
+    assert _rules(findings) == ["fault-site"]
+    # Quoted spec in a doc line (no --inject-fault token on the line).
+    md = 'pass "typo_site:2:torn_write" to the child\n'
+    findings = analyze_source(md, path="docs/RUNBOOK.md",
+                              rules=["fault-site"])
+    assert _rules(findings) == ["fault-site"]
+
+
+def test_metric_name_reverse_check_ignores_definition_literals(
+        tmp_path):
+    """The CANONICAL_METRICS assignment itself is not an emission: a
+    dead entry must be flagged even though it textually appears at its
+    own definition site."""
+    from tpu_cooccurrence.observability.registry import CANONICAL_METRICS
+
+    pkg = tmp_path / "tpu_cooccurrence" / "observability"
+    pkg.mkdir(parents=True)
+    names = ",\n    ".join(f'"{n}"' for n in sorted(CANONICAL_METRICS))
+    (pkg / "registry.py").write_text(
+        "CANONICAL_METRICS = frozenset({\n    " + names + ",\n})\n"
+        'G = REGISTRY.gauge("cooc_windows_fired")\n')
+    result = Analyzer(str(tmp_path), rules=[RULES["metric-name"]]).run()
+    dead = {f.message.split("'")[1] for f in result.findings}
+    assert dead == CANONICAL_METRICS - {"cooc_windows_fired"}
+
+
+def test_cli_flag_rule_on_a_mini_repo(tmp_path):
+    pkg = tmp_path / "tpu_cooccurrence"
+    pkg.mkdir()
+    (pkg / "config.py").write_text(
+        "import argparse\n"
+        "import dataclasses\n\n\n"
+        "@dataclasses.dataclass\n"
+        "class Config:\n"
+        "    top_k: int = 10\n\n\n"
+        "def from_args():\n"
+        "    p = argparse.ArgumentParser()\n"
+        '    p.add_argument("--top-k", type=int, dest="top_k")\n'
+        '    p.add_argument("--mystery-flag", type=int, dest="mystery")\n'
+        "    return p\n")
+    (tmp_path / "README.md").write_text("Flags: `--top-k`.\n")
+    result = Analyzer(str(tmp_path), rules=[RULES["cli-flag"]]).run()
+    msgs = " | ".join(f.message for f in result.findings)
+    assert len(result.findings) == 2  # undocumented + orphaned dest
+    assert "--mystery-flag" in msgs and "mystery" in msgs
+    assert "--top-k" not in msgs
+
+
+# -- rule pack 4: native / fold dtype ----------------------------------
+
+
+def test_native_dtype_rule():
+    bad = ('import numpy as np\n'
+           'def call(x):\n'
+           '    lib.kernel(_ptr64(x), 3)\n')
+    findings = analyze_source(
+        bad, path="tpu_cooccurrence/native/__init__.py",
+        rules=["native-dtype"])
+    assert _rules(findings) == ["native-dtype"]
+    good_contig = ('import numpy as np\n'
+                   'def call(x):\n'
+                   '    x = np.ascontiguousarray(x, dtype=np.int64)\n'
+                   '    lib.kernel(_ptr64(x), 3)\n')
+    good_assert = ('import numpy as np\n'
+                   'def call(scratch):\n'
+                   '    assert scratch.buf.dtype == np.int32\n'
+                   '    lib.kernel(_ptr32(scratch.buf), 1)\n')
+    for good in (good_contig, good_assert):
+        assert analyze_source(
+            good, path="tpu_cooccurrence/native/__init__.py",
+            rules=["native-dtype"]) == []
+
+
+def test_fold_dtype_guard_rule():
+    bad = ('import numpy as np\n'
+           'def aggregate_window_coo(src, dst, delta, return_key=False):\n'
+           '    return src, dst, delta\n')
+    findings = analyze_source(
+        bad, path="tpu_cooccurrence/ops/aggregate.py",
+        rules=["fold-dtype-guard"])
+    assert _rules(findings) == ["fold-dtype-guard"]
+    good = ('import numpy as np\n'
+            'def aggregate_window_coo(src, dst, delta, return_key=False):\n'
+            '    if not np.issubdtype(delta.dtype, np.integer):\n'
+            '        raise TypeError("delta dtype")\n'
+            '    return src, dst, delta\n')
+    assert analyze_source(
+        good, path="tpu_cooccurrence/ops/aggregate.py",
+        rules=["fold-dtype-guard"]) == []
+
+
+# -- suppressions ------------------------------------------------------
+
+
+def test_suppression_exact_line_named_rule():
+    src = PR2_RACE_FIXTURE.replace(
+        "ledger.h2d_bytes += n",
+        "ledger.h2d_bytes += n  # cooclint: disable=lock-discipline")
+    findings = analyze_source(src, path="tpu_cooccurrence/pipeline.py",
+                              rules=["lock-discipline"])
+    assert {f.line for f in findings} == {6}  # only the unsuppressed line
+
+
+def test_suppression_bare_disables_all_rules_on_line():
+    src = PR2_RACE_FIXTURE.replace(
+        "ledger.h2d_calls += 1",
+        "ledger.h2d_calls += 1  # cooclint: disable")
+    findings = analyze_source(src, path="tpu_cooccurrence/pipeline.py",
+                              rules=["lock-discipline"])
+    assert {f.line for f in findings} == {5}
+
+
+def test_suppression_file_level_named_rule():
+    """`# cooclint: disable-file=rule` opts the whole file out of one
+    rule (the fixture-holder escape hatch) without touching others."""
+    src = ('# cooclint: disable-file=fault-site\n'
+           'def f(plan, ledger, n):\n'
+           '    plan.fire("typo_site")\n'
+           '    ledger.h2d_bytes += n\n')
+    assert analyze_source(src, rules=["fault-site"]) == []
+    # Other rules still fire in the same file.
+    assert _rules(analyze_source(
+        src, rules=["lock-discipline"])) == ["lock-discipline"]
+
+
+def test_suppression_wrong_rule_name_does_not_silence():
+    src = PR2_RACE_FIXTURE.replace(
+        "ledger.h2d_bytes += n",
+        "ledger.h2d_bytes += n  # cooclint: disable=metric-name")
+    findings = analyze_source(src, path="tpu_cooccurrence/pipeline.py",
+                              rules=["lock-discipline"])
+    assert {f.line for f in findings} == {5, 6}
+
+
+# -- baseline ----------------------------------------------------------
+
+
+def _mini_repo_with_race(tmp_path):
+    pkg = tmp_path / "tpu_cooccurrence"
+    pkg.mkdir()
+    (pkg / "pipeline.py").write_text(PR2_RACE_FIXTURE)
+    return tmp_path
+
+
+def test_baseline_grandfathers_and_reports_stale(tmp_path):
+    root = _mini_repo_with_race(tmp_path)
+    baseline = [
+        {"rule": "lock-discipline", "file": "tpu_cooccurrence/pipeline.py",
+         "line": 5, "justification": "grandfathered for the test"},
+        {"rule": "lock-discipline", "file": "tpu_cooccurrence/gone.py",
+         "line": 1, "justification": "stale entry"},
+    ]
+    result = Analyzer(str(root), rules=[RULES["lock-discipline"]],
+                      baseline=baseline).run()
+    assert {f.line for f in result.findings} == {6}  # line 5 baselined
+    assert len(result.baselined) == 1
+    assert [e["file"] for e in result.stale_baseline] == [
+        "tpu_cooccurrence/gone.py"]
+
+
+def test_prune_baseline_rewrites_file(tmp_path):
+    from tpu_cooccurrence.analysis.__main__ import main
+
+    root = _mini_repo_with_race(tmp_path)
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline([
+        {"rule": "lock-discipline", "file": "tpu_cooccurrence/pipeline.py",
+         "line": 5, "justification": "kept"},
+        {"rule": "lock-discipline", "file": "tpu_cooccurrence/pipeline.py",
+         "line": 6, "justification": "kept"},
+        {"rule": "lock-discipline", "file": "tpu_cooccurrence/gone.py",
+         "line": 1, "justification": "stale"},
+    ], bl_path)
+    rc = main(["--root", str(root), "--baseline", bl_path,
+               "--prune-baseline"])
+    assert rc == 0  # everything real is baselined, stale was pruned
+    kept = load_baseline(bl_path)
+    assert len(kept) == 2
+    assert all(e["file"] == "tpu_cooccurrence/pipeline.py" for e in kept)
+    # A second run sees no stale entries.
+    result = Analyzer(str(root), rules=[RULES["lock-discipline"]],
+                      baseline=kept).run()
+    assert not result.findings and not result.stale_baseline
+
+
+def test_explicit_missing_baseline_path_is_usage_error(tmp_path):
+    """A typo'd --baseline must not silently run with an empty baseline
+    (full re-report); it is exit 2. The DEFAULT path staying optional
+    is separate (a clean repo has an empty baseline file anyway)."""
+    from tpu_cooccurrence.analysis.__main__ import main
+
+    root = _mini_repo_with_race(tmp_path)
+    rc = main(["--root", str(root),
+               "--baseline", str(tmp_path / "nope.json")])
+    assert rc == 2
+
+
+def test_malformed_baseline_rejected(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text('{"findings": [{"rule": "x"}]}')
+    with pytest.raises(ValueError, match="malformed baseline entry"):
+        load_baseline(str(p))
+
+
+def test_finding_json_round_trip():
+    f = Finding(rule="lock-discipline", file="a/b.py", line=7,
+                message="msg")
+    assert Finding.from_dict(json.loads(json.dumps(f.to_dict()))) == f

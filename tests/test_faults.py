@@ -2,7 +2,6 @@
 and the static consistency of site names across the repo."""
 
 import os
-import re
 import time
 
 import pytest
@@ -12,8 +11,7 @@ from tpu_cooccurrence.robustness.faults import (
     FaultPlan,
     FaultSpec,
     InjectedFault,
-    KINDS,
-    SITES,
+    UnknownFaultSiteError,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -57,9 +55,9 @@ def test_parse_rejects(bad, match):
 def test_config_validates_specs_at_parse_time():
     from tpu_cooccurrence.config import Config
 
-    with pytest.raises(ValueError, match="unknown fault site"):
+    with pytest.raises(UnknownFaultSiteError, match="registered sites"):
         Config(input="x", window_size=10, seed=1,
-               inject_fault=["bogus_site:crash"])
+               inject_fault=["bogus_site:crash"])  # cooclint: disable=fault-site
 
 
 # -- firing semantics --------------------------------------------------
@@ -149,61 +147,21 @@ def test_arm_disarm_module_plan():
 # -- static consistency ------------------------------------------------
 
 
-def _repo_text_files():
-    for root, dirs, files in os.walk(REPO):
-        dirs[:] = [d for d in dirs
-                   if d not in (".git", "__pycache__", ".pytest_cache")]
-        for name in files:
-            if name.endswith((".py", ".md")):
-                yield os.path.join(root, name)
-
-
 def test_every_referenced_site_name_is_registered():
     """Site names cannot drift: every fault-site reference anywhere in
     the repo (fire() call sites, --inject-fault examples in docs/tests,
     spec strings) must be a key of SITES — and every registered site
     must actually be fired somewhere in the package (no dead entries).
+
+    Thin wrapper over cooclint's ``fault-site`` rule
+    (``tpu_cooccurrence.analysis.rules_registry``) so there is exactly
+    one implementation of the scan; deliberately-bad site names in
+    tests carry per-line ``# cooclint: disable=fault-site`` markers.
     """
-    kinds_alt = "|".join(KINDS)
-    patterns = [
-        # fire("<site>", ...) call sites and test invocations
-        re.compile(r'\bfire\(\s*"([a-z_]+)"'),
-        # --inject-fault <spec> in docs / CLI examples / argv lists: the
-        # captured name must be followed by ':' (a spec tail) or '"' (a
-        # bare-site spec in an argv list), so prose like "--inject-fault
-        # spec fires once" doesn't capture the word "spec"
-        re.compile(r'--inject-fault[="\s,]+([a-z_]+)[:"]'),
-        # spec strings: "<site>:...kind..." anywhere (tests build these)
-        re.compile(rf'"([a-z_]+)(?::\d+)?:(?:{kinds_alt})'),
-    ]
-    this_file = os.path.abspath(__file__)
-    referenced = {}
-    for path in _repo_text_files():
-        if os.path.abspath(path) == this_file:
-            continue  # holds deliberately-invalid negative examples
-        with open(path, encoding="utf-8", errors="replace") as f:
-            text = f.read()
-        for pat in patterns:
-            for m in pat.finditer(text):
-                referenced.setdefault(m.group(1), set()).add(
-                    os.path.relpath(path, REPO))
-    unknown = {name: sorted(where) for name, where in referenced.items()
-               if name not in SITES}
-    assert not unknown, (
-        f"fault-site names referenced but not registered in "
-        f"robustness.faults.SITES: {unknown}")
-    # Reverse direction: every registered site has a live fire() call in
-    # the package source (not just tests), so the table can't hold
-    # entries nothing injects into.
-    pkg_text = ""
-    for path in _repo_text_files():
-        if os.sep + "tpu_cooccurrence" + os.sep in path \
-                and path.endswith(".py"):
-            with open(path, encoding="utf-8", errors="replace") as f:
-                pkg_text += f.read()
-    dead = [s for s in SITES
-            if f'fire("{s}"' not in pkg_text.replace("\n", " ")]
-    assert not dead, f"registered fault sites never fired in package: {dead}"
+    from tpu_cooccurrence.analysis import Analyzer, RULES
+
+    result = Analyzer(REPO, rules=[RULES["fault-site"]]).run()
+    assert not result.findings, "\n".join(map(str, result.findings))
 
 
 def test_supervised_injection_requires_state_dir():

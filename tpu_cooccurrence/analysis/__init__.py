@@ -1,0 +1,62 @@
+"""cooclint: repo-native static analysis for conventions nothing else enforces.
+
+PRs 1-3 grew the codebase around invariants that exist only as prose and
+one-off tests: locked shared state (``Counters`` / ``TransferLedger`` /
+``LatestResults``) must be touched through its own methods or under its
+``_lock`` across the pipeline's two threads; jit-compiled hot paths must
+stay free of host syncs; donated device buffers must not be read after
+the dispatch that consumed them; and the string registries (metric
+names, fault sites, CLI flags vs ``config.py`` fields vs docs) must stay
+in sync. Each of these already caused a real bug (the PR-2
+``TransferLedger``/``Counters.merge`` races) or is pinned by a single
+brittle test. This package makes them fail in tier-1 at commit time,
+not on a TPU mid-soak.
+
+Layout:
+
+* :mod:`.core` — the ``ast``-based framework: file walker, rule
+  registry, :class:`~.core.Finding`, per-line
+  ``# cooclint: disable=<rule>`` suppressions and the checked-in
+  ``baseline.json`` for grandfathered findings;
+* :mod:`.rules_lock` — lock discipline on the shared-state classes and
+  annotation requirements for new locks in worker code paths;
+* :mod:`.rules_jit` — jit/device hygiene (host syncs inside jitted
+  functions, donated-buffer reuse);
+* :mod:`.rules_registry` — registry drift (metric names, fault sites,
+  CLI flags vs config fields vs docs);
+* :mod:`.rules_native` — dtype discipline at the native (ctypes) and
+  fold boundaries;
+* ``__main__`` — the runner: ``python -m tpu_cooccurrence.analysis``
+  exits 1 on non-baseline findings (``--format json|text``).
+
+The analyzer imports only stdlib plus the repo's own stdlib-only
+registry modules (``metrics``, ``robustness.faults``,
+``observability.registry``) — it runs under ``JAX_PLATFORMS=cpu`` with
+no device and never imports jax.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    Analyzer,
+    AnalysisResult,
+    Finding,
+    RULES,
+    analyze_source,
+    load_baseline,
+)
+
+# Importing the rule modules registers their rules in RULES.
+from . import rules_jit  # noqa: F401,E402
+from . import rules_lock  # noqa: F401,E402
+from . import rules_native  # noqa: F401,E402
+from . import rules_registry  # noqa: F401,E402
+
+__all__ = [
+    "Analyzer",
+    "AnalysisResult",
+    "Finding",
+    "RULES",
+    "analyze_source",
+    "load_baseline",
+]

@@ -1,0 +1,85 @@
+"""cooclint runner: ``python -m tpu_cooccurrence.analysis``.
+
+Exit codes: 0 = clean (baseline-covered findings allowed), 1 = new
+findings, 2 = usage error. The run summary always records the
+analyzer's own runtime — the tier-1 lane budget is <10 s and a slow
+rule should fail loudly in review, not quietly tax every commit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from . import Analyzer, load_baseline
+from .core import default_baseline_path, save_baseline
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_cooccurrence.analysis",
+        description=("cooclint: AST-based invariant checker (lock "
+                     "discipline, jit purity, registry drift, native "
+                     "dtype boundaries)"))
+    p.add_argument("--root", default=None,
+                   help="repo root to scan (default: the checkout "
+                        "containing this package)")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   dest="fmt", help="finding output format")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON path (default: the checked-in "
+                        "analysis/baseline.json)")
+    p.add_argument("--prune-baseline", action="store_true",
+                   dest="prune_baseline",
+                   help="rewrite the baseline dropping entries no "
+                        "current finding matches (stale entries)")
+    args = p.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    baseline_path = args.baseline or default_baseline_path()
+    if args.baseline is not None and not os.path.isfile(baseline_path):
+        # A missing DEFAULT baseline means "empty" (the common clean
+        # repo); an explicitly named one that does not exist is a typo
+        # the operator must hear about, not a silent full re-report.
+        print(f"error: --baseline {baseline_path!r} does not exist",
+              file=sys.stderr)
+        return 2
+    try:
+        baseline = load_baseline(baseline_path)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    result = Analyzer(root, baseline=baseline).run()
+
+    if args.prune_baseline and result.stale_baseline:
+        stale_keys = {(e["rule"], e["file"], int(e["line"]))
+                      for e in result.stale_baseline}
+        kept = [e for e in baseline
+                if (e["rule"], e["file"], int(e["line"]))
+                not in stale_keys]
+        save_baseline(kept, baseline_path)
+
+    if args.fmt == "json":
+        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(str(f))
+        for e in result.stale_baseline:
+            tag = ("pruned" if args.prune_baseline
+                   else "stale baseline entry (--prune-baseline "
+                        "candidate)")
+            print(f"{e['file']}:{e['line']}: {e['rule']}: {tag}")
+        print(f"cooclint: {len(result.findings)} new finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{len(result.stale_baseline)} stale baseline entr(y/ies) "
+              f"across {result.files_scanned} files in "
+              f"{result.elapsed_seconds:.2f}s")
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

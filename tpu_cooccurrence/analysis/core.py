@@ -1,0 +1,358 @@
+"""cooclint framework: findings, rules, the walker, suppressions, baseline.
+
+Design constraints (the reasons this is repo-native instead of a generic
+linter plugin):
+
+* rules need the repo's own truth tables (``metrics.py`` constants,
+  ``faults.SITES``, ``CANONICAL_METRICS``) — imported directly, so the
+  tables can never drift from what the analyzer enforces;
+* findings must be *suppressable at the line* with a justification
+  visible in the diff (``# cooclint: disable=<rule>``) and
+  *grandfatherable* in a checked-in ``baseline.json`` so the analyzer
+  can land strict and the repo can be paid down incrementally;
+* it must run in tier-1: stdlib only, no jax import, whole-repo pass in
+  single-digit seconds.
+
+A rule is a subclass of :class:`Rule` registered with :func:`register`.
+File-scoped checks implement :meth:`Rule.check`; repo-scoped invariants
+(e.g. "every registered fault site is fired somewhere") implement
+:meth:`Rule.finalize`, called once after every file was visited.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import time
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+#: Suppression comment grammar: ``# cooclint: disable`` silences every
+#: rule on that line; ``# cooclint: disable=rule-a,rule-b`` silences the
+#: named rules only. The comment must sit on the exact line the finding
+#: anchors to (findings carry one line; block pragmas invite rot).
+#: ``# cooclint: disable-file=rule-a`` (anywhere in the file, named
+#: rules only — no blanket form) opts a whole file out of a rule: the
+#: escape hatch for fixture-holding test files whose *text* quotes the
+#: exact bad patterns the text-scanning rules hunt.
+_SUPPRESS_RE = re.compile(
+    r"#\s*cooclint:\s*disable(?!-file)(?:=([a-z0-9_,-]+))?")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*cooclint:\s*disable-file=([a-z0-9_,-]+)")
+
+#: Directories never walked (caches, VCS, the analyzer's own package —
+#: its rule definitions quote the very patterns they hunt for).
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules"}
+_SKIP_SUFFIXES = ("/tpu_cooccurrence/analysis",)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation, anchored to ``file:line``."""
+
+    rule: str
+    file: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def key(self) -> Tuple[str, str, int]:
+        """Identity for baseline/suppression matching."""
+        return (self.rule, self.file, self.line)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "Finding":
+        return cls(rule=str(d["rule"]), file=str(d["file"]),
+                   line=int(d["line"]), message=str(d.get("message", "")))
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule}: {self.message}"
+
+
+class FileContext:
+    """One scanned file: source, (lazy) AST, suppression map.
+
+    ``path`` is repo-relative with forward slashes — rules filter on it
+    (``ctx.path.endswith("pipeline.py")``). Markdown files have
+    ``tree=None``; rules that read docs use ``ctx.source`` directly.
+    """
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self._tree: Optional[ast.Module] = None
+        self._parse_error: Optional[SyntaxError] = None
+        self._suppress: Optional[Dict[int, Optional[set]]] = None
+        self._file_suppress: Optional[set] = None
+
+    @property
+    def is_python(self) -> bool:
+        return self.path.endswith(".py")
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if not self.is_python:
+            return None
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.source)
+            except SyntaxError as exc:
+                self._parse_error = exc
+        return self._tree
+
+    def suppressions(self) -> Dict[int, Optional[set]]:
+        """``{lineno: None (all rules) | {rule names}}`` for this file."""
+        if self._suppress is None:
+            self._suppress = {}
+            for i, line in enumerate(self.lines, start=1):
+                m = _SUPPRESS_RE.search(line)
+                if not m:
+                    continue
+                names = m.group(1)
+                self._suppress[i] = (None if names is None
+                                     else set(names.split(",")))
+        return self._suppress
+
+    def file_suppressions(self) -> set:
+        """Rule names disabled for this whole file."""
+        if self._file_suppress is None:
+            self._file_suppress = set()
+            for line in self.lines:
+                m = _SUPPRESS_FILE_RE.search(line)
+                if m:
+                    self._file_suppress.update(m.group(1).split(","))
+        return self._file_suppress
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_suppressions():
+            return True
+        rules = self.suppressions().get(finding.line, False)
+        if rules is False:
+            return False
+        return rules is None or finding.rule in rules
+
+
+class RepoContext:
+    """Every scanned file, for repo-scoped ``finalize`` checks."""
+
+    def __init__(self, root: str, files: List[FileContext]) -> None:
+        self.root = root
+        self.files = files
+
+    def python_files(self) -> Iterator[FileContext]:
+        return (f for f in self.files if f.is_python)
+
+    def package_files(self) -> Iterator[FileContext]:
+        """Package source only (``tpu_cooccurrence/``) — the scope for
+        rules about what production code *does* (tests deliberately poke
+        internals and seed bad patterns as fixtures)."""
+        return (f for f in self.python_files()
+                if f.path.startswith("tpu_cooccurrence/"))
+
+
+class Rule:
+    """Base rule. Subclasses set ``name`` (kebab-case, the suppression /
+    baseline key) and implement ``check`` and/or ``finalize``."""
+
+    name = ""
+    description = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        return ()
+
+
+#: Registered rules by name (import of the rules_* modules populates it).
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate and register a rule."""
+    rule = rule_cls()
+    if not rule.name:
+        raise ValueError(f"rule {rule_cls.__name__} has no name")
+    if rule.name in RULES:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    RULES[rule.name] = rule
+    return rule_cls
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    """One analyzer pass: surviving findings + bookkeeping."""
+
+    findings: List[Finding]            # new (non-baseline, non-suppressed)
+    baselined: List[Finding]           # matched a baseline entry
+    stale_baseline: List[dict]         # baseline entries nothing matched
+    files_scanned: int
+    elapsed_seconds: float
+
+    def to_dict(self) -> Dict[str, object]:
+        """The ``--format json`` schema (round-trips through
+        ``Finding.from_dict`` for the findings list)."""
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": len(self.baselined),
+            "stale_baseline": self.stale_baseline,
+            "files_scanned": self.files_scanned,
+            "elapsed_seconds": round(self.elapsed_seconds, 3),
+            "exit_code": 1 if self.findings else 0,
+        }
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> List[dict]:
+    """Baseline entries (``[{rule, file, line, justification}]``).
+    Missing file = empty baseline."""
+    path = path or default_baseline_path()
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return []
+    entries = data.get("findings", []) if isinstance(data, dict) else data
+    for e in entries:
+        if not isinstance(e, dict) or not {"rule", "file", "line"} <= set(e):
+            raise ValueError(
+                f"malformed baseline entry (need rule/file/line): {e!r}")
+    return entries
+
+
+def save_baseline(entries: List[dict], path: Optional[str] = None) -> None:
+    path = path or default_baseline_path()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"findings": entries}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _walk_files(root: str) -> Iterator[str]:
+    for dirpath, dirs, files in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+        dirs[:] = sorted(
+            d for d in dirs
+            if d not in _SKIP_DIRS
+            and not ("/" + rel_dir + "/" + d).endswith(_SKIP_SUFFIXES))
+        for name in sorted(files):
+            if name.endswith((".py", ".md")):
+                yield os.path.join(dirpath, name)
+
+
+class Analyzer:
+    """Walk ``root``, run every registered rule, fold in suppressions
+    and the baseline."""
+
+    def __init__(self, root: str,
+                 rules: Optional[Iterable[Rule]] = None,
+                 baseline: Optional[List[dict]] = None) -> None:
+        self.root = os.path.abspath(root)
+        self.rules = list(rules) if rules is not None else list(
+            RULES.values())
+        self.baseline = baseline if baseline is not None else []
+
+    def _contexts(self) -> List[FileContext]:
+        out = []
+        for path in _walk_files(self.root):
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    out.append(FileContext(rel, f.read()))
+            except OSError:
+                continue
+        return out
+
+    def run(self) -> AnalysisResult:
+        t0 = time.perf_counter()
+        contexts = self._contexts()
+        repo = RepoContext(self.root, contexts)
+        raw: List[Finding] = []
+        by_path = {c.path: c for c in contexts}
+        for rule in self.rules:
+            for ctx in contexts:
+                raw.extend(rule.check(ctx))
+            raw.extend(rule.finalize(repo))
+        # Dedup (two scan shapes can anchor to the same line), then
+        # per-line suppressions, then the baseline.
+        seen = set()
+        kept: List[Finding] = []
+        for f in raw:
+            ident = (*f.key(), f.message)
+            if ident in seen:
+                continue
+            seen.add(ident)
+            ctx = by_path.get(f.file)
+            if ctx is not None and ctx.is_suppressed(f):
+                continue
+            kept.append(f)
+        baseline_keys = {(e["rule"], e["file"], int(e["line"]))
+                         for e in self.baseline}
+        matched_keys = set()
+        new: List[Finding] = []
+        baselined: List[Finding] = []
+        for f in kept:
+            if f.key() in baseline_keys:
+                matched_keys.add(f.key())
+                baselined.append(f)
+            else:
+                new.append(f)
+        stale = [e for e in self.baseline
+                 if (e["rule"], e["file"], int(e["line"]))
+                 not in matched_keys]
+        new.sort(key=lambda f: (f.file, f.line, f.rule))
+        return AnalysisResult(
+            findings=new, baselined=baselined, stale_baseline=stale,
+            files_scanned=len(contexts),
+            elapsed_seconds=time.perf_counter() - t0)
+
+
+def analyze_source(source: str, path: str = "tpu_cooccurrence/_fixture.py",
+                   rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run rules over one in-memory snippet (the fixture-test entry
+    point). ``path`` is the pretended repo-relative path — rules filter
+    on it, so fixtures choose which file they impersonate. Suppressions
+    apply; the baseline does not."""
+    ctx = FileContext(path, source)
+    repo = RepoContext("<memory>", [ctx])
+    selected = ([RULES[name] for name in rules] if rules is not None
+                else list(RULES.values()))
+    out: List[Finding] = []
+    seen = set()
+    for rule in selected:
+        for f in list(rule.check(ctx)) + list(rule.finalize(repo)):
+            ident = (*f.key(), f.message)
+            if ident not in seen:
+                seen.add(ident)
+                out.append(f)
+    return [f for f in out if not ctx.is_suppressed(f)]
+
+
+# -- shared AST helpers (used by the rule packs) ------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def string_constants(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Every string literal in a module with its line."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.lineno, node.value

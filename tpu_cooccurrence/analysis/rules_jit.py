@@ -1,0 +1,276 @@
+"""jit / device hygiene.
+
+The scorers' hot paths are jit-compiled (``@jax.jit`` /
+``functools.partial(jax.jit, ...)`` / ``jax.jit(shard_map(...))``) and
+stay fast only while they remain *pure device programs*: a stray
+``np.asarray``/``float()`` on a traced value forces a host sync per
+window, a ``print`` retraces, host RNG silently freezes into the traced
+constant. Separately, the state-carrying jits donate their input
+buffers (``ops/donation.py``); a donated array is dead the moment the
+dispatch is enqueued, and reading it afterwards is exactly the TFRT
+use-after-donate crash class the CPU backend gating exists for.
+
+* ``jit-purity`` — inside a jitted function (decorated, wrapped at
+  module level, or reachable by one intra-module call hop from one),
+  flag host syncs: ``np.asarray``/``np.array``, ``float()``/``int()``
+  on non-static traced parameters, ``.block_until_ready()``, ``print``,
+  and host RNG (``np.random.*`` / ``random.*``).
+* ``donation-reuse`` — after a call to a donating jit (its
+  ``donate_argnums`` positions read straight from the AST), any read of
+  the same argument expression before it is reassigned is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+_NUMPY_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_RNG_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """Does this expression reference jax.jit / pjit?"""
+    name = dotted_name(node) or ""
+    return name in ("jax.jit", "jit", "pjit", "jax.pjit") or \
+        name.endswith(".pjit")
+
+
+def _partial_of_jit(call: ast.Call) -> bool:
+    """``functools.partial(jax.jit, ...)``"""
+    fname = dotted_name(call.func) or ""
+    return (fname in ("functools.partial", "partial") and call.args
+            and _is_jit_ref(call.args[0]))
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, str):
+                return {kw.value.value}
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                return {e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)}
+    return set()
+
+
+def _donated_positions(call: ast.Call) -> Tuple[int, ...]:
+    """Literal argnums out of ``donate_argnums=donate_argnums(0, 1)`` /
+    ``donate_argnums=(0, 1)`` / ``donate_argnums=0``."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Call):  # the ops.donation.donate_argnums gate
+            return tuple(a.value for a in v.args
+                         if isinstance(a, ast.Constant))
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in v.elts
+                         if isinstance(e, ast.Constant))
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+    return ()
+
+
+class _JitInfo:
+    def __init__(self, fn: ast.FunctionDef, static: Set[str]) -> None:
+        self.fn = fn
+        self.static = static
+
+
+def _collect_jitted(tree: ast.Module, in_ops: bool
+                    ) -> Tuple[List[_JitInfo], Dict[str, Tuple[int, ...]]]:
+    """(jitted function defs, donating-callable name -> donated argnums).
+
+    Donating callables are keyed by how call sites spell them:
+    a bare name (module-level def / assignment) or ``self.<attr>``.
+    """
+    fns_by_name = {n.name: n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)}
+    jitted: Dict[str, _JitInfo] = {}
+    donating: Dict[str, Tuple[int, ...]] = {}
+
+    def mark(fn: Optional[ast.FunctionDef], static: Set[str]) -> None:
+        if fn is not None and fn.name not in jitted:
+            jitted[fn.name] = _JitInfo(fn, static)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec):
+                    mark(node, set())
+                elif isinstance(dec, ast.Call) and (
+                        _is_jit_ref(dec.func) or _partial_of_jit(dec)):
+                    mark(node, _static_argnames(dec))
+                    pos = _donated_positions(dec)
+                    if pos:
+                        donating[node.name] = pos
+        elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            call = node.value
+            jit_call = None
+            if _is_jit_ref(call.func):  # name = jax.jit(fn, ...)
+                jit_call = call
+                inner = call.args[0] if call.args else None
+            elif isinstance(call.func, ast.Call) and _partial_of_jit(
+                    call.func):  # name = partial(jax.jit, ...)(fn)
+                jit_call = call.func
+                inner = call.args[0] if call.args else None
+            else:
+                continue
+            if isinstance(inner, ast.Name):
+                mark(fns_by_name.get(inner.id), _static_argnames(jit_call))
+            elif isinstance(inner, ast.Lambda):
+                pass  # lambda bodies are single exprs; purity scan below
+            pos = _donated_positions(jit_call)
+            if pos:
+                for tgt in node.targets:
+                    key = dotted_name(tgt)
+                    if key:
+                        donating[key] = pos
+    # One intra-module call hop: ops/ scorers factor their jitted bodies
+    # into helpers; a host sync inside the helper is the same bug.
+    if in_ops:
+        changed = True
+        while changed:
+            changed = False
+            for info in list(jitted.values()):
+                for node in ast.walk(info.fn):
+                    if isinstance(node, ast.Call) and isinstance(
+                            node.func, ast.Name):
+                        callee = fns_by_name.get(node.func.id)
+                        if callee is not None and callee.name not in jitted:
+                            jitted[callee.name] = _JitInfo(callee, set())
+                            changed = True
+    return list(jitted.values()), donating
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = ("host syncs (np.asarray, float()/int() on traced "
+                   "params, block_until_ready, print, host RNG) inside "
+                   "jit-compiled functions")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.path.startswith("tpu_cooccurrence/"):
+            return ()
+        tree = ctx.tree
+        if tree is None:
+            return ()
+        in_ops = "/ops/" in ("/" + ctx.path)
+        jitted, _ = _collect_jitted(tree, in_ops)
+        out: List[Finding] = []
+        for info in jitted:
+            params = {a.arg for a in info.fn.args.args}
+            traced = params - info.static
+            for node in ast.walk(info.fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                bad = None
+                if name in _NUMPY_SYNC:
+                    bad = f"{name}() materializes the traced value on host"
+                elif name == "print":
+                    bad = "print() inside a traced function (retraces)"
+                elif name.startswith(_RNG_PREFIXES):
+                    bad = (f"host RNG {name}() freezes into the trace; "
+                           f"use jax.random with a threaded key")
+                elif name in ("float", "int") and len(node.args) == 1:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) and arg.id in traced:
+                        bad = (f"{name}({arg.id}) forces a host sync on "
+                               f"a traced parameter")
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "block_until_ready":
+                    bad = ("block_until_ready() inside a jitted "
+                           "function defeats async dispatch")
+                if bad is not None:
+                    out.append(Finding(
+                        rule=self.name, file=ctx.path, line=node.lineno,
+                        message=(f"in jitted `{info.fn.name}`: {bad}")))
+        return out
+
+
+@register
+class DonationReuseRule(Rule):
+    name = "donation-reuse"
+    description = ("a buffer passed at a donate_argnums position is "
+                   "read again before reassignment")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.path.startswith("tpu_cooccurrence/"):
+            return ()
+        tree = ctx.tree
+        if tree is None:
+            return ()
+        _, donating = _collect_jitted(tree, "/ops/" in ("/" + ctx.path))
+        if not donating:
+            return ()
+        out: List[Finding] = []
+        for fn in ast.walk(tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            stmts = [n for n in ast.walk(fn) if isinstance(n, ast.stmt)]
+
+            def innermost_stmt(node: ast.AST) -> ast.stmt:
+                """Smallest statement span containing ``node`` — the
+                dispatch-and-rebind unit treated as atomic."""
+                containing = [s for s in stmts
+                              if s.lineno <= node.lineno
+                              <= (s.end_lineno or s.lineno)]
+                return min(containing,
+                           key=lambda s: (s.end_lineno or s.lineno)
+                           - s.lineno)
+
+            # (donated key, end line of the donating statement).
+            donated: List[Tuple[str, int]] = []
+            loads: List[Tuple[str, int]] = []
+            stores: List[Tuple[str, int]] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = dotted_name(node.func)
+                    pos = donating.get(callee or "")
+                    if not pos:
+                        continue
+                    stmt = innermost_stmt(node)
+                    stmt_end = stmt.end_lineno or stmt.lineno
+                    stmt_keys = {
+                        dotted_name(s)
+                        for s in ast.walk(stmt)
+                        if isinstance(s, (ast.Name, ast.Attribute))
+                        and isinstance(getattr(s, "ctx", None), ast.Store)}
+                    for i in pos:
+                        if i < len(node.args):
+                            key = dotted_name(node.args[i])
+                            # A rebind inside the same statement
+                            # (`x, y = f(x, y)`) revives the buffer.
+                            if key and key not in stmt_keys:
+                                donated.append((key, stmt_end))
+                elif isinstance(node, (ast.Name, ast.Attribute)):
+                    key = dotted_name(node)
+                    if key is None:
+                        continue
+                    if isinstance(getattr(node, "ctx", None), ast.Store):
+                        stores.append((key, node.lineno))
+                    elif isinstance(getattr(node, "ctx", None), ast.Load):
+                        loads.append((key, node.lineno))
+            for key, stmt_end in donated:
+                rebind = min((ln for k, ln in stores
+                              if k == key and ln > stmt_end),
+                             default=None)
+                for k, ln in loads:
+                    if k != key or ln <= stmt_end:
+                        continue
+                    if rebind is not None and ln >= rebind:
+                        continue
+                    out.append(Finding(
+                        rule=self.name, file=ctx.path, line=ln,
+                        message=(f"`{key}` was donated to a "
+                                 f"donate_argnums call ending on line "
+                                 f"{stmt_end} and is read again before "
+                                 f"reassignment (use-after-donate)")))
+        return out

@@ -1,0 +1,171 @@
+"""Lock discipline on the pipeline's shared state.
+
+The pipelined execution mode (``pipeline.py``) runs two threads — the
+sampling/caller thread and the scorer worker — against three shared
+registries: ``metrics.Counters``, ``observability.TransferLedger`` and
+``state.results.LatestResults``. Each guards its mutable state with a
+``_lock``; the PR-2 races happened exactly where code outside those
+classes touched the raw attributes (an unlocked ``+=`` on the ledger's
+byte totals, ``Counters.merge`` folding a mid-add snapshot). These rules
+make that shape un-committable:
+
+* ``lock-discipline`` — any attribute read/write of a protected class's
+  internal state outside the owning class body and outside a
+  ``with <obj>._lock:`` block is a finding. Attribute *names* identify
+  the state (``_counters``, ``h2d_bytes``, ``_ptr_batch``, ...): the
+  names are distinctive enough that a non-owner touching one is either
+  the bug we hunt or close enough to deserve a justification comment.
+* ``lock-annotation`` — a new ``threading.Lock()``/``RLock()`` acquired
+  in the worker code paths (``pipeline.py`` / ``job.py``) must carry a
+  ``lock-ordering:`` annotation (same or preceding line) stating its
+  acquisition order relative to the registries' locks or its timeout
+  strategy — the two-thread deadlock the PR-1/PR-2 design avoided by
+  never holding two locks at once.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+#: Owning class -> the internal-state attribute names only it (or a
+#: ``with x._lock`` block) may touch. Names are chosen to be distinctive
+#: (``events`` is deliberately absent: too generic to key on).
+PROTECTED_STATE = {
+    "Counters": {"_counters"},
+    "TransferLedger": {"h2d_bytes", "d2h_bytes", "h2d_calls", "d2h_calls"},
+    "LatestResults": {"_batches", "_ptr_batch", "_ptr_row", "_total_rows"},
+}
+
+_ALL_PROTECTED: Set[str] = set().union(*PROTECTED_STATE.values())
+
+#: Files whose module-level worker threads make a bare new lock a
+#: deadlock hazard (the ``lock-annotation`` rule's scope).
+_WORKER_FILES = ("tpu_cooccurrence/pipeline.py", "tpu_cooccurrence/job.py")
+
+_ANNOTATION_TOKEN = "lock-ordering:"
+
+
+def _with_lock_spans(tree: ast.Module) -> List[tuple]:
+    """``(start, end, lock_base)`` line spans of ``with <expr>._lock``
+    (or ``.acquire()``-style context) bodies. ``lock_base`` is the
+    dotted name of the object whose lock is held (``self``, ``ledger``,
+    ...) — the exemption is object-sensitive: holding ``a._lock`` says
+    nothing about ``b``'s state (the PR-2 ``Counters.merge`` race was
+    exactly self's lock over *other*'s dict)."""
+    spans = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            # unwrap `with obj._lock:` and `with obj._lock.acquire_timeout(...)`
+            target = expr.func if isinstance(expr, ast.Call) else expr
+            name = dotted_name(target) or ""
+            if name.endswith("._lock") or "._lock." in name:
+                base = name.split("._lock")[0]
+                spans.append((node.lineno,
+                              max(getattr(n, 'lineno', node.lineno)
+                                  for n in ast.walk(node)),
+                              base))
+                break
+    return spans
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("internal state of Counters/TransferLedger/"
+                   "LatestResults touched outside the owning class and "
+                   "outside a `with obj._lock:` block")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.path.startswith("tpu_cooccurrence/"):
+            return ()
+        tree = ctx.tree
+        if tree is None:
+            return ()
+        # Line spans of owning-class bodies in this file.
+        owner_spans = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ClassDef)
+                    and node.name in PROTECTED_STATE):
+                owner_spans.append(
+                    (node.name, node.lineno,
+                     max(getattr(n, "lineno", node.lineno)
+                         for n in ast.walk(node))))
+        lock_spans = _with_lock_spans(tree)
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if node.attr not in _ALL_PROTECTED:
+                continue
+            base = dotted_name(node.value)
+            # `self._counters` inside class Counters et al. is the
+            # owner's own (locked-method) access — but ONLY on `self`:
+            # inside `Counters.merge`, `other._counters` is a foreign
+            # instance and holding self's lock does not cover it (the
+            # PR-2 merge race, object-sensitively).
+            owner = next((name for name, lo, hi in owner_spans
+                          if lo <= node.lineno <= hi
+                          and node.attr in PROTECTED_STATE[name]), None)
+            if owner is not None and base == "self":
+                continue
+            # A surrounding `with <base>._lock:` covers accesses on
+            # that same object only; an unresolvable lock base (a
+            # complex expression) is trusted, an identified-but-
+            # different one is not.
+            if any(lo <= node.lineno <= hi
+                   and (lock_base == "" or base is None
+                        or base == lock_base)
+                   for lo, hi, lock_base in lock_spans):
+                continue
+            out.append(Finding(
+                rule=self.name, file=ctx.path, line=node.lineno,
+                message=(f"access to protected attribute "
+                         f"{node.attr!r} on {base or 'an expression'} "
+                         f"outside its owning class's self-methods and "
+                         f"outside a `with {base or 'obj'}._lock:` "
+                         f"block (two-thread pipeline state; use "
+                         f"snapshot()/locked methods)")))
+        return out
+
+
+@register
+class LockAnnotationRule(Rule):
+    name = "lock-annotation"
+    description = ("new threading.Lock/RLock in pipeline.py/job.py "
+                   "worker paths without a `lock-ordering:` annotation")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path not in _WORKER_FILES:
+            return ()
+        tree = ctx.tree
+        if tree is None:
+            return ()
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name not in ("threading.Lock", "threading.RLock",
+                            "Lock", "RLock"):
+                continue
+            if not name.startswith("threading.") and not any(
+                    "import threading" in ln or "from threading" in ln
+                    for ln in ctx.lines):
+                continue  # a local Lock() that isn't threading's
+            nearby = ctx.lines[max(0, node.lineno - 2):node.lineno]
+            if any(_ANNOTATION_TOKEN in ln for ln in nearby):
+                continue
+            out.append(Finding(
+                rule=self.name, file=ctx.path, line=node.lineno,
+                message=(f"{name}() acquired in a two-thread worker "
+                         f"module without a `{_ANNOTATION_TOKEN}` "
+                         f"annotation (state its order relative to the "
+                         f"registry locks, or its timeout strategy, on "
+                         f"the same or preceding line)")))
+        return out

@@ -1,0 +1,142 @@
+"""Dtype discipline at the native (ctypes) and fold boundaries.
+
+The C kernels in ``native/`` read raw pointers: an array that reaches
+``lib.<fn>(...)`` with the wrong dtype or layout is silent memory
+corruption, not an exception (the numpy fallbacks raise; the C loop
+reads past buffers). And the shared fold (``ops/aggregate.py``) sums
+deltas into exact int64 — a float delta sneaking in would truncate
+differently on the native path than the float64-bincount fallback.
+
+* ``native-dtype`` — in ``native/__init__.py``, every array handed to a
+  ``lib.<fn>(...)`` call through ``_ptr64``/``_ptr32``/``_ptr8`` must
+  have a visible dtype guarantee in the enclosing function: an
+  ``np.ascontiguousarray(x, dtype=...)`` rebind, an
+  ``np.empty/zeros(..., dtype=...)`` allocation, an ``x.astype(...)``
+  rebind, or an ``assert`` mentioning ``x.dtype``. Attribute-held
+  buffers (scratch arrays) need the assert form — allocation elsewhere
+  is invisible at the call site and refactors silently break it.
+* ``fold-dtype-guard`` — ``ops/aggregate.py``'s
+  ``aggregate_window_coo`` must keep an integer-dtype guard on its
+  ``delta`` parameter (an ``np.issubdtype`` check): both fold paths sum
+  exactly only for integer deltas, and the guard is the single place
+  that keeps a future float-delta caller from diverging by buffer size.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+_PTR_WRAPPERS = {"_ptr64", "_ptr32", "_ptr8"}
+_DTYPE_ALLOCATORS = {"np.empty", "np.zeros", "np.ones", "np.full",
+                     "numpy.empty", "numpy.zeros", "numpy.ones",
+                     "numpy.full"}
+_CONTIG = {"np.ascontiguousarray", "numpy.ascontiguousarray"}
+
+
+def _guarded_names(fn: ast.FunctionDef) -> Set[str]:
+    """Dotted names with a visible dtype guarantee inside ``fn``."""
+    guarded: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            call = node.value
+            if isinstance(call, ast.Call):
+                fname = dotted_name(call.func) or ""
+                has_dtype = (any(kw.arg == "dtype"
+                                 for kw in call.keywords)
+                             or len(call.args) >= 2)
+                is_astype = (isinstance(call.func, ast.Attribute)
+                             and call.func.attr == "astype")
+                if is_astype or ((fname in _CONTIG
+                                  or fname in _DTYPE_ALLOCATORS)
+                                 and has_dtype):
+                    for tgt in node.targets:
+                        name = dotted_name(tgt)
+                        if name:
+                            guarded.add(name)
+        elif isinstance(node, ast.Assert):
+            # Any dotted name whose `.dtype` the assert inspects.
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Attribute) and sub.attr == "dtype":
+                    name = dotted_name(sub.value)
+                    if name:
+                        guarded.add(name)
+    return guarded
+
+
+@register
+class NativeDtypeRule(Rule):
+    name = "native-dtype"
+    description = ("arrays crossing the ctypes boundary must carry a "
+                   "visible dtype guarantee in the calling function")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path != "tpu_cooccurrence/native/__init__.py":
+            return ()
+        tree = ctx.tree
+        if tree is None:
+            return ()
+        out: List[Finding] = []
+        for fn in ast.walk(tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            guarded = _guarded_names(fn)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "lib"):
+                    continue
+                for arg in node.args:
+                    if not (isinstance(arg, ast.Call)
+                            and isinstance(arg.func, ast.Name)
+                            and arg.func.id in _PTR_WRAPPERS):
+                        continue
+                    target = dotted_name(arg.args[0]) if arg.args else None
+                    if target is None:
+                        continue
+                    if target in guarded:
+                        continue
+                    out.append(Finding(
+                        rule=self.name, file=ctx.path, line=arg.lineno,
+                        message=(f"`{target}` crosses the ctypes "
+                                 f"boundary via {arg.func.id} without a "
+                                 f"dtype guarantee in "
+                                 f"`{fn.name}` (ascontiguousarray/"
+                                 f"dtype= allocation/astype rebind, or "
+                                 f"an assert on its .dtype)")))
+        return out
+
+
+@register
+class FoldDtypeGuardRule(Rule):
+    name = "fold-dtype-guard"
+    description = ("aggregate_window_coo must keep an integer-dtype "
+                   "guard (np.issubdtype) on its delta parameter")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path != "tpu_cooccurrence/ops/aggregate.py":
+            return ()
+        tree = ctx.tree
+        if tree is None:
+            return ()
+        fn: Optional[ast.FunctionDef] = next(
+            (n for n in ast.walk(tree)
+             if isinstance(n, ast.FunctionDef)
+             and n.name == "aggregate_window_coo"), None)
+        if fn is None:
+            return ()  # renamed/removed: the import sites break loudly
+        has_guard = any(
+            isinstance(n, ast.Call)
+            and (dotted_name(n.func) or "").endswith("issubdtype")
+            for n in ast.walk(fn))
+        if has_guard:
+            return ()
+        return [Finding(
+            rule=self.name, file=ctx.path, line=fn.lineno,
+            message=("aggregate_window_coo lost its integer-dtype "
+                     "guard on `delta` — a float delta would truncate "
+                     "on the native path and sum exactly on the numpy "
+                     "path (fold diverges by buffer size)"))]

@@ -1,0 +1,327 @@
+"""Registry drift: string registries that must stay in sync.
+
+Three registries hold names that appear as plain strings across the
+repo, each previously guarded by at most one brittle test:
+
+* **metric names** — every ``cooc_*`` gauge/histogram name emitted by a
+  ``REGISTRY.gauge(...)``/``REGISTRY.histogram(...)`` call (or quoted in
+  docs) must be in
+  :data:`~tpu_cooccurrence.observability.registry.CANONICAL_METRICS`;
+  counter names passed to ``counters.add/get`` must be constants of
+  ``metrics.py``. A misspelled name creates a parallel series the
+  dashboards never see.
+* **fault sites** — every ``fire("<site>")`` call, spec string, or
+  ``--inject-fault`` doc example must name a key of
+  :data:`~tpu_cooccurrence.robustness.faults.SITES`, and every
+  registered site must actually be fired somewhere in the package
+  (no dead entries). Generalizes (and is wrapped by) the PR-3 static
+  consistency test.
+* **CLI flags** — every ``--flag`` registered by ``add_argument`` in
+  ``config.py`` must map to a ``Config`` dataclass field and be
+  mentioned in README.md or docs/, so a new flag cannot land
+  undocumented or orphaned from config state.
+
+The truth tables are imported from the modules that own them (all
+stdlib-only), so the analyzer can never enforce a stale copy.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional, Set
+
+from .. import metrics as _metrics_mod
+from ..observability.registry import CANONICAL_METRICS
+from ..robustness.faults import KINDS, SITES
+from .core import (
+    FileContext,
+    Finding,
+    RepoContext,
+    Rule,
+    dotted_name,
+    register,
+    string_constants,
+)
+
+#: Every string-valued module constant of ``metrics.py`` — the full
+#: legal counter-name set (CANONICAL_COUNTERS plus dev-mode names).
+KNOWN_COUNTER_NAMES: Set[str] = {
+    v for k, v in vars(_metrics_mod).items()
+    if k.isupper() and isinstance(v, str)}
+
+#: A complete metric name: ``cooc_`` then word chars, not ending in
+#: ``_`` and not followed by more name chars or a glob ``*`` — so doc
+#: prose like ``cooc_window_*`` (a family glob) is not a name.
+_METRIC_NAME_RE = re.compile(r"cooc_[a-z0-9_]*[a-z0-9](?![a-z0-9_*])")
+
+_SPEC_RE = re.compile(rf"^([a-z_]+)(?::\d+)?:(?:{'|'.join(KINDS)})")
+#: Quoted spec embedded anywhere in raw text ("pass \"x:3:crash\" to
+#: ..."), the shape docstrings and docs use — the AST constant check
+#: above it only sees specs that ARE the whole literal.
+_TEXT_SPEC_RE = re.compile(
+    rf'"([a-z_]+)(?::\d+)?:(?:{"|".join(KINDS)})')
+#: Doc/CLI examples: ``--inject-fault <site>[:...]`` — the captured name
+#: must be followed by ``:`` (spec tail) or ``"`` (bare site in an argv
+#: list) so prose like "--inject-fault spec fires once" doesn't match.
+_MD_INJECT_RE = re.compile(r'--inject-fault[="\s,]+([a-z_]+)[:"]')
+_MD_FIRE_RE = re.compile(r'\bfire\(\s*"([a-z_]+)"')
+
+
+def _is_fire_call(node: ast.Call) -> bool:
+    """``plan.fire(...)`` or a bare imported ``fire(...)``."""
+    return ((isinstance(node.func, ast.Attribute)
+             and node.func.attr == "fire")
+            or (isinstance(node.func, ast.Name)
+                and node.func.id == "fire"))
+
+
+@register
+class MetricNameRule(Rule):
+    name = "metric-name"
+    description = ("cooc_* metric names and counter-name literals must "
+                   "be registered in CANONICAL_METRICS / metrics.py")
+
+    def _check_py(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        # cooc_* literals anywhere in package source (registration call
+        # sites, constants, docstrings — a doc name that drifts is the
+        # same operator-facing lie as a misregistered gauge).
+        for lineno, value in string_constants(tree):
+            for m in _METRIC_NAME_RE.finditer(value):
+                if m.group(0) not in CANONICAL_METRICS:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=lineno,
+                        message=(f"metric name {m.group(0)!r} is not in "
+                                 f"observability.registry."
+                                 f"CANONICAL_METRICS — register it or "
+                                 f"fix the spelling"))
+        # Counter-name literals at counters.add/get call sites.
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("add", "get")):
+                continue
+            recv = dotted_name(node.func.value) or ""
+            if not recv.endswith("counters"):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+                if name not in KNOWN_COUNTER_NAMES:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=node.lineno,
+                        message=(f"counter name {name!r} is not a "
+                                 f"metrics.py constant — add it there "
+                                 f"(and to CANONICAL_COUNTERS if it "
+                                 f"must appear on /metrics at zero)"))
+
+    def _check_md(self, ctx: FileContext) -> Iterable[Finding]:
+        for i, line in enumerate(ctx.lines, start=1):
+            for m in _METRIC_NAME_RE.finditer(line):
+                if m.group(0) not in CANONICAL_METRICS:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=i,
+                        message=(f"doc quotes metric name "
+                                 f"{m.group(0)!r} which is not in "
+                                 f"CANONICAL_METRICS (stale doc or "
+                                 f"unregistered metric)"))
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.startswith("tpu_cooccurrence/") and ctx.is_python:
+            return self._check_py(ctx)
+        if ctx.path.endswith(".md"):
+            return self._check_md(ctx)
+        return ()
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        # Reverse direction (mirrors the fault-site dead-entry check):
+        # every CANONICAL_METRICS name must appear as a literal
+        # somewhere in package source — a registration call site or a
+        # named constant. A name in the table that nothing emits is a
+        # dead registry row blessing stale docs.
+        anchor = "tpu_cooccurrence/observability/registry.py"
+        if not any(c.path == anchor for c in repo.files):
+            return
+        emitted: Set[str] = set()
+        for ctx in repo.package_files():
+            tree = ctx.tree
+            if tree is None:
+                continue
+            # The CANONICAL_METRICS definition itself must not count as
+            # an emission, or the reverse check is vacuous (every entry
+            # trivially "appears" at its own definition). Skip literals
+            # inside that assignment's span in the anchor file.
+            skip_spans = []
+            if ctx.path == anchor:
+                for node in ast.walk(tree):
+                    if (isinstance(node, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "CANONICAL_METRICS"
+                                    for t in node.targets)):
+                        skip_spans.append(
+                            (node.lineno,
+                             node.end_lineno or node.lineno))
+            for lineno, value in string_constants(tree):
+                if any(lo <= lineno <= hi for lo, hi in skip_spans):
+                    continue
+                emitted.update(m.group(0)
+                               for m in _METRIC_NAME_RE.finditer(value))
+        for name in sorted(CANONICAL_METRICS - emitted):
+            yield Finding(
+                rule=self.name, file=anchor, line=1,
+                message=(f"CANONICAL_METRICS entry {name!r} is never "
+                         f"emitted anywhere in the package (dead "
+                         f"registry entry — remove it, and fix any "
+                         f"docs still quoting it)"))
+
+
+@register
+class FaultSiteRule(Rule):
+    name = "fault-site"
+    description = ("fault-site strings must be keys of faults.SITES; "
+                   "every registered site must be fired in the package")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.is_python:
+            tree = ctx.tree
+            if tree is None:
+                return
+            flagged_lines = set()
+            for node in ast.walk(tree):
+                # fire("<site>", ...) call sites (package and tests) —
+                # both plan.fire(...) and a bare imported fire(...).
+                if (isinstance(node, ast.Call)
+                        and _is_fire_call(node)
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    site = node.args[0].value
+                    if site not in SITES:
+                        flagged_lines.add(node.lineno)
+                        yield Finding(
+                            rule=self.name, file=ctx.path,
+                            line=node.lineno,
+                            message=(f"fire({site!r}) names an "
+                                     f"unregistered fault site "
+                                     f"(register it in faults.SITES)"))
+            # Spec strings ("site[:seq]:kind") in any literal.
+            for lineno, value in string_constants(tree):
+                m = _SPEC_RE.match(value)
+                if m and m.group(1) not in SITES:
+                    flagged_lines.add(lineno)
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=lineno,
+                        message=(f"fault spec {value!r} names an "
+                                 f"unregistered site {m.group(1)!r}"))
+            # Raw-text scans (the deleted PR-3 test's coverage): argv
+            # pairs whose spec omits the kind, and quoted specs
+            # embedded mid-string (docstring examples) that the
+            # whole-literal check above cannot see. Lines the AST scans
+            # already flagged are skipped — one defect, one finding.
+            for i, line in enumerate(ctx.lines, start=1):
+                if i in flagged_lines:
+                    continue
+                for pat in (_MD_INJECT_RE, _TEXT_SPEC_RE, _MD_FIRE_RE):
+                    for m in pat.finditer(line):
+                        if m.group(1) not in SITES:
+                            yield Finding(
+                                rule=self.name, file=ctx.path, line=i,
+                                message=(f"text references "
+                                         f"unregistered fault site "
+                                         f"{m.group(1)!r}"))
+        elif ctx.path.endswith(".md"):
+            for i, line in enumerate(ctx.lines, start=1):
+                for pat in (_MD_INJECT_RE, _TEXT_SPEC_RE, _MD_FIRE_RE):
+                    for m in pat.finditer(line):
+                        if m.group(1) not in SITES:
+                            yield Finding(
+                                rule=self.name, file=ctx.path, line=i,
+                                message=(f"doc references unregistered "
+                                         f"fault site {m.group(1)!r}"))
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        # Reverse direction: a SITES entry nothing in the package fires
+        # is a dead registry row (the old test's second assertion).
+        # Only meaningful on a full-repo pass — a single-fixture run
+        # (analyze_source) has no business declaring sites dead.
+        if not any(c.path == "tpu_cooccurrence/robustness/faults.py"
+                   for c in repo.files):
+            return
+        fired: Set[str] = set()
+        for ctx in repo.package_files():
+            tree = ctx.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and _is_fire_call(node)
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    fired.add(node.args[0].value)
+        for site in sorted(set(SITES) - fired):
+            yield Finding(
+                rule=self.name,
+                file="tpu_cooccurrence/robustness/faults.py", line=1,
+                message=(f"registered fault site {site!r} is never "
+                         f"fired anywhere in the package (dead "
+                         f"registry entry)"))
+
+
+def _config_fields(tree: ast.Module) -> Set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return {stmt.target.id for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)}
+    return set()
+
+
+@register
+class CliFlagRule(Rule):
+    name = "cli-flag"
+    description = ("every --flag in config.py must map to a Config "
+                   "field and be documented in README.md or docs/")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        cfg: Optional[FileContext] = next(
+            (c for c in repo.files
+             if c.path == "tpu_cooccurrence/config.py"), None)
+        if cfg is None or cfg.tree is None:
+            return
+        fields = _config_fields(cfg.tree)
+        docs_text = "\n".join(
+            c.source for c in repo.files
+            if c.path == "README.md" or c.path.startswith("docs/"))
+        for node in ast.walk(cfg.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"):
+                continue
+            long_flag = next(
+                (a.value for a in node.args
+                 if isinstance(a, ast.Constant)
+                 and isinstance(a.value, str)
+                 and a.value.startswith("--")), None)
+            if long_flag is None:
+                continue
+            dest = next(
+                (kw.value.value for kw in node.keywords
+                 if kw.arg == "dest"
+                 and isinstance(kw.value, ast.Constant)),
+                long_flag[2:].replace("-", "_"))
+            if dest not in fields:
+                yield Finding(
+                    rule=self.name, file=cfg.path, line=node.lineno,
+                    message=(f"{long_flag} parses into dest "
+                             f"{dest!r} which is not a Config "
+                             f"dataclass field"))
+            if docs_text and long_flag not in docs_text:
+                yield Finding(
+                    rule=self.name, file=cfg.path, line=node.lineno,
+                    message=(f"{long_flag} is not mentioned in "
+                             f"README.md or docs/ — document it "
+                             f"(even one line in the Flags section)"))

@@ -34,8 +34,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         stream=sys.stderr,  # reference logs INFO to stderr (log4j.properties:1-6)
         format="%(asctime)s %(levelname)s %(name)s - %(message)s",
     )
+    from .robustness.faults import UnknownFaultSiteError
+
     try:
         config = Config.from_args(argv)
+    except UnknownFaultSiteError as exc:
+        # Exit 2 (already in the supervisor's PERMANENT_EXIT_CODES): a
+        # typo'd --inject-fault site must kill the run outright, not
+        # spend the restart budget on a child that can never arm. The
+        # message lists the registered sites (faults.SITES).
+        LOG.error("configuration error: %s", exc)
+        return 2
     except ValueError as exc:
         # EX_CONFIG (sysexits): a permanent failure the supervisor must
         # not retry — a bad flag does not get better with restarts.

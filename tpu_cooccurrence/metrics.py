@@ -45,7 +45,15 @@ class Counters:
             self._counters[name] += delta
 
     def get(self, name: str) -> int:
-        return self._counters.get(name, 0)
+        # Locked like every other accessor: the bench-summary and
+        # flush-balance paths read counters the scorer worker may be
+        # mid-`add`-ing in pipelined mode, and an unlocked dict read
+        # interleaving with a defaultdict __missing__ insertion is
+        # exactly the torn-read shape the PR-2 races taught us to ban
+        # (cooclint rule `lock-discipline` now enforces the class's
+        # outside view; this closes the inside one).
+        with self._lock:
+            return self._counters.get(name, 0)
 
     def as_dict(self) -> Dict[str, int]:
         with self._lock:

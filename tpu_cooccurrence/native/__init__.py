@@ -299,6 +299,13 @@ def sliding_expand(users: np.ndarray, items: np.ndarray, f_max: int,
     max_item = int(items.max())
     max_user = int(users.max())
     scratch._ensure(max_item, max_user)
+    # Scratch buffers cross the ctypes boundary below; their dtypes are
+    # fixed at allocation in SlidingScratch but that is invisible here —
+    # assert at the boundary so a scratch refactor cannot silently hand
+    # the C loops mis-sized cells.
+    assert (scratch.item_count.dtype == np.int32
+            and scratch.user_count.dtype == np.int32
+            and scratch.user_start.dtype == np.int64)
     # Zero the used prefixes (phase 1 contract). user_start needs none:
     # only touched entries are written-then-read.
     scratch.item_count[: max_item + 1].fill(0)
@@ -320,6 +327,7 @@ def sliding_expand(users: np.ndarray, items: np.ndarray, f_max: int,
         return z, z
     # Ascending user-id group order — matches argsort(users) grouping.
     touched_sorted = np.sort(touched[:nt])
+    assert touched_sorted.dtype == np.int64  # np.sort preserves int64
     grouped = np.empty(n_kept, dtype=np.int64)
     src = np.empty(total, dtype=np.int64)
     dst = np.empty(total, dtype=np.int64)
@@ -344,6 +352,9 @@ def sliding_cut_mask(users: np.ndarray, items: np.ndarray, f_max: int,
     max_item = int(items.max())
     max_user = int(users.max())
     scratch._ensure(max_item, max_user)
+    # Boundary dtype assert — see sliding_expand.
+    assert (scratch.item_count.dtype == np.int32
+            and scratch.user_count.dtype == np.int32)
     scratch.item_count[: max_item + 1].fill(0)
     scratch.user_count[: max_user + 1].fill(0)
     keep = np.empty(n, dtype=np.uint8)

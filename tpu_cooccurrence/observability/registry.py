@@ -54,6 +54,50 @@ def log_buckets(lo: float, hi: float, base: float = 2.0) -> List[float]:
 SECONDS_BUCKETS = log_buckets(2.0 ** -14, 2.0 ** 6)
 BYTES_BUCKETS = log_buckets(2.0 ** 6, 2.0 ** 32)
 
+#: Every ``cooc_*`` gauge/histogram name the process may register or
+#: expose, in one place. This is the metric-name registry the static
+#: analyzer (``tpu_cooccurrence.analysis``, rule ``metric-name``)
+#: enforces: a ``REGISTRY.gauge("cooc_...")`` call site — or a doc
+#: quoting a metric — whose name is not listed here fails tier-1, so a
+#: typo cannot silently create a parallel series dashboards never see.
+#: Add the name here in the same PR that introduces the metric.
+CANONICAL_METRICS = frozenset({
+    # per-window stage timing / liveness (job.py)
+    "cooc_window_sample_seconds",
+    "cooc_window_score_seconds",
+    "cooc_window_total_seconds",
+    "cooc_window_uplink_bytes",
+    "cooc_windows_fired",
+    "cooc_last_window_unix_seconds",
+    # pipelined execution (pipeline.py)
+    "cooc_pipeline_queue_wait_seconds",
+    "cooc_pipeline_ring_depth",
+    # checkpoint plane (state/checkpoint.py)
+    "cooc_checkpoint_quarantined_total",
+    "cooc_checkpoint_generation",
+    # sharded scorers (parallel/sharded.py)
+    "cooc_scorer_dispatch_rows",
+    "cooc_shard_row_imbalance",
+    # supervisor state relayed into the child (cli.py)
+    "cooc_supervisor_restarts",
+    "cooc_supervisor_backoff_ms",
+    # TransferLedger totals rendered by render_prometheus below
+    "cooc_transfer_h2d_bytes_total",
+    "cooc_transfer_h2d_calls_total",
+    "cooc_transfer_d2h_bytes_total",
+    "cooc_transfer_d2h_calls_total",
+})
+
+#: TransferLedger snapshot key -> exposition series name. Explicit
+#: literals (not an f-string template) so the analyzer's reverse check
+#: can see every canonical transfer name at a real emission site.
+TRANSFER_METRICS = {
+    "h2d_bytes": "cooc_transfer_h2d_bytes_total",
+    "h2d_calls": "cooc_transfer_h2d_calls_total",
+    "d2h_bytes": "cooc_transfer_d2h_bytes_total",
+    "d2h_calls": "cooc_transfer_d2h_calls_total",
+}
+
 
 class Gauge:
     """A single instantaneous value (last write wins)."""
@@ -235,8 +279,7 @@ class MetricsRegistry:
                 lines.append(f"{name} {value}")
         if ledger is not None:
             snap = ledger.snapshot()
-            for key in ("h2d_bytes", "h2d_calls", "d2h_bytes", "d2h_calls"):
-                name = f"cooc_transfer_{key}_total"
+            for key, name in TRANSFER_METRICS.items():
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {snap[key]}")
         for g in gauges:

@@ -34,6 +34,14 @@ def aggregate_window_coo(src: np.ndarray, dst: np.ndarray,
     are kept — a zero scatter-add is a no-op, and the reference also emits
     (and rescores rows for) net-zero cells.
     """
+    if not np.issubdtype(np.asarray(delta).dtype, np.integer):
+        # Both fold paths are exact only for integer deltas; a float
+        # delta would truncate in the native sort-and-fold but sum
+        # exactly in the float64 bincount fallback — the fold result
+        # must never depend on which path the window size selects.
+        raise TypeError(
+            f"aggregate_window_coo: delta dtype must be integer, got "
+            f"{np.asarray(delta).dtype}")
     key = (src.astype(np.int64) << 32) | dst.astype(np.int64)
     folded = None
     if len(key) >= NATIVE_FOLD_MIN:

@@ -81,6 +81,17 @@ class InjectedFault(RuntimeError):
     """The ``exception`` fault kind: a deliberate, attributable failure."""
 
 
+class UnknownFaultSiteError(ValueError):
+    """``--inject-fault`` named a site not registered in :data:`SITES`.
+
+    A distinct subclass so the CLI can map it to exit code 2 (already
+    classified permanent by the supervisor's ``PERMANENT_EXIT_CODES``):
+    a chaos-test argv with a typo'd site must stop the run immediately,
+    not burn the restart budget re-spawning a child that can never arm.
+    The message carries the registered-site list for the operator.
+    """
+
+
 def _die() -> None:
     """Hard process death (SIGKILL self: uncatchable, like the OOM
     killer). A module function so unit tests can monkeypatch it."""
@@ -103,7 +114,7 @@ class FaultSpec:
         parts = raw.split(":")
         site = parts[0]
         if site not in SITES:
-            raise ValueError(
+            raise UnknownFaultSiteError(
                 f"unknown fault site {site!r} in --inject-fault {raw!r}; "
                 f"registered sites: {', '.join(sorted(SITES))}")
         rest = parts[1:]
