@@ -59,8 +59,18 @@ def run(backend: str, users, items, ts, num_items: int, window_ms: int,
     # <= ~100%, an overlapped run exceeds it. Latency: per-window
     # p50/p95/p99 from the fixed-log-bucket histograms — BENCH_* carries
     # tails, not just means (a 2x p99 regression is invisible in a mean).
+    # Degradation counters ride along (robustness/degrade.py): a bench
+    # number earned by shedding load is not the same bench number — zero
+    # here is the claim that nothing was shed or quarantined.
+    degradation = {
+        "level": int(REGISTRY.gauge("cooc_degradation_level").get()),
+        "shed_events_total": int(
+            REGISTRY.gauge("cooc_shed_events_total").get()),
+        "quarantined_total": int(
+            REGISTRY.gauge("cooc_quarantined_lines_total").get()),
+    }
     return pairs, elapsed, job.step_timer.occupancy(elapsed), \
-        REGISTRY.summaries()
+        REGISTRY.summaries(), degradation
 
 
 # Shared execute-a-real-op probe (grant_watch imports no jax, so this
@@ -72,14 +82,16 @@ from tpu_cooccurrence.bench.grant_watch import probe_backend
 
 def _record_onchip(value: float, vs_baseline: float, backend: str,
                    pipeline_depth: int, occupancy: dict,
-                   latency: dict = None) -> None:
+                   latency: dict = None, degradation: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
     overlap win (host-busy% + score-busy% > 100) is visible in the
     trajectory, not just in a single run's stdout; ``latency`` carries
     the per-window p50/p95/p99 summaries for the same reason — tail
-    regressions must be visible across PRs.
+    regressions must be visible across PRs; ``degradation`` carries the
+    shed/quarantine counters so a throughput number earned by shedding
+    load is marked as such in the trajectory.
     """
     entry = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
              "pairs_per_sec": value, "vs_baseline": vs_baseline,
@@ -87,6 +99,8 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
              "occupancy": occupancy}
     if latency:
         entry["latency"] = latency
+    if degradation:
+        entry["degradation"] = degradation
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -153,12 +167,13 @@ def measure() -> None:
     # contention. The occupancy/latency published are the median run's.
     samples = []
     for _ in range(3):
-        pairs, elapsed, occupancy, latency = run(
+        pairs, elapsed, occupancy, latency, degradation = run(
             "device", users, items, ts, num_items=n_items, window_ms=100,
             pipeline_depth=pipeline_depth, journal=journal)
-        samples.append((pairs / max(elapsed, 1e-9), occupancy, latency))
+        samples.append((pairs / max(elapsed, 1e-9), occupancy, latency,
+                        degradation))
     samples.sort(key=lambda s: s[0])
-    pairs_per_sec, occupancy, latency = samples[1]
+    pairs_per_sec, occupancy, latency, degradation = samples[1]
 
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.
@@ -167,8 +182,8 @@ def measure() -> None:
         with open(baseline_path) as f:
             baseline = json.load(f)["pairs_per_sec"]
     else:
-        b_pairs, b_elapsed, _, _ = run("oracle", users, items, ts,
-                                       num_items=n_items, window_ms=100)
+        b_pairs, b_elapsed, _, _, _ = run("oracle", users, items, ts,
+                                          num_items=n_items, window_ms=100)
         baseline = b_pairs / max(b_elapsed, 1e-9)
         with open(baseline_path, "w") as f:
             json.dump({"pairs_per_sec": baseline}, f)
@@ -184,6 +199,7 @@ def measure() -> None:
         "pipeline_depth": pipeline_depth,
         "occupancy": occupancy,
         "latency": latency,
+        "degradation": degradation,
     }
     if journal:
         out["journal"] = journal
@@ -203,7 +219,7 @@ def measure() -> None:
             }
     else:
         _record_onchip(out["value"], out["vs_baseline"], backend,
-                       pipeline_depth, occupancy, latency)
+                       pipeline_depth, occupancy, latency, degradation)
     print(json.dumps(out))
 
 

@@ -134,6 +134,33 @@ def test_chaos_exception_kind_recovers_too(tmp_path):
     _assert_all_fired(tmp_path, 1)
 
 
+def test_chaos_scorer_breaker_trips_and_run_completes_on_fallback(tmp_path):
+    """Graceful-degradation capstone (ISSUE 5): an injected dispatch
+    failure inside the device scorer trips the circuit breaker mid-run;
+    the run completes on the host-oracle fallback WITHOUT a supervisor
+    or restart — degrade, don't die — and the trip is visible in the
+    journal's ``breaker_state`` field."""
+    f = tmp_path / "in.csv"
+    write_stream(f, n=600)
+    jpath = tmp_path / "journal.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cooccurrence.cli", "-i", str(f),
+         "-ws", "40", "-ic", "8", "-uc", "5", "-s", "0xC0FFEE",
+         "--backend", "device", "--journal", str(jpath),
+         "--scorer-breaker-threshold", "1",
+         "--scorer-breaker-probe-windows", "3",
+         "--inject-fault", "scorer_breaker:3:exception"],
+        capture_output=True, text=True, env=ENV, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert proc.stdout, "run completed but emitted no results"
+    from tpu_cooccurrence.observability.journal import read_records
+
+    states = [r["breaker_state"] for r in read_records(str(jpath))]
+    assert "open" in states, states          # the trip is journaled
+    assert states[0] == "closed"             # and it happened mid-run
+    assert states[-1] == "closed", states    # half-open probe recovered
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("depth", [0, 2])
 def test_chaos_soak_multi_site_parity(tmp_path, depth):

@@ -562,3 +562,81 @@ def test_finding_json_round_trip():
     f = Finding(rule="lock-discipline", file="a/b.py", line=7,
                 message="msg")
     assert Finding.from_dict(json.loads(json.dumps(f.to_dict()))) == f
+
+
+# ---------------------------------------------------------------------------
+# degrade-registry rule (ISSUE 5)
+
+_DEGRADE_OK = '''
+import enum
+
+class DegradationLevel(enum.IntEnum):
+    NORMAL = 0
+    SHED_SAMPLING = 1
+
+TRANSITION_RULES = {
+    "NORMAL": "healthy",
+    "SHED_SAMPLING": "overloaded",
+}
+LEVEL_EVENTS = {
+    "NORMAL": "degrade/enter_normal",
+    "SHED_SAMPLING": "degrade/enter_shed_sampling",
+}
+'''
+
+
+def test_degrade_registry_clean_fixture_passes():
+    assert analyze_source(
+        _DEGRADE_OK, path="tpu_cooccurrence/robustness/degrade.py",
+        rules=["degrade-registry"]) == []
+
+
+def test_degrade_registry_flags_member_missing_from_tables():
+    bad = _DEGRADE_OK.replace('    "SHED_SAMPLING": "overloaded",\n', "")
+    findings = analyze_source(
+        bad, path="tpu_cooccurrence/robustness/degrade.py",
+        rules=["degrade-registry"])
+    assert _rules(findings) == ["degrade-registry"]
+    assert "TRANSITION_RULES" in findings[0].message
+    assert "SHED_SAMPLING" in findings[0].message
+
+
+def test_degrade_registry_flags_dead_table_row():
+    # A key naming no member must be flagged. (Scope note: the rule
+    # reads dict-LITERAL keys only — a row added later via subscript
+    # assignment is outside its reach, like every registry rule here.)
+    bad = _DEGRADE_OK.replace(
+        '    "SHED_SAMPLING": "degrade/enter_shed_sampling",\n',
+        '    "SHED_SAMPLING": "degrade/enter_shed_sampling",\n'
+        '    "GONE": "degrade/enter_gone",\n')
+    findings = analyze_source(
+        bad, path="tpu_cooccurrence/robustness/degrade.py",
+        rules=["degrade-registry"])
+    assert _rules(findings) == ["degrade-registry"]
+    assert "dead registry row" in findings[0].message
+
+
+def test_degrade_registry_flags_removed_table():
+    bad = _DEGRADE_OK.replace("TRANSITION_RULES", "RENAMED_TABLE")
+    findings = analyze_source(
+        bad, path="tpu_cooccurrence/robustness/degrade.py",
+        rules=["degrade-registry"])
+    assert any("TRANSITION_RULES dict literal not found" in f.message
+               for f in findings)
+
+
+def test_degrade_registry_requires_architecture_mention(tmp_path):
+    """With docs/ARCHITECTURE.md present but missing a level name, the
+    rule flags it — the level table is part of the registry."""
+    root = tmp_path / "repo"
+    pkg = root / "tpu_cooccurrence" / "robustness"
+    pkg.mkdir(parents=True)
+    (root / "docs").mkdir()
+    (pkg / "degrade.py").write_text(_DEGRADE_OK)
+    (root / "docs" / "ARCHITECTURE.md").write_text(
+        "# arch\n\nonly NORMAL is documented here\n")
+    result = Analyzer(str(root), rules=[RULES["degrade-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["degrade-registry"]
+    assert "SHED_SAMPLING" in result.findings[0].message
+    assert "ARCHITECTURE" in result.findings[0].message

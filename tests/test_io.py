@@ -163,3 +163,47 @@ def test_parse_lines_fast_path_rejects_divergent_inputs():
             parse_lines(bad)
     with pytest.raises((ValueError, OverflowError)):
         parse_lines(["99999999999999999999,1,2"])
+
+
+def test_parse_error_carries_provenance_and_raw_line():
+    """Satellite fix (ISSUE 5): every parse rejection names path:lineno
+    and the offending raw line — independent of quarantine being on."""
+    import pytest
+
+    from tpu_cooccurrence.io.parse import ParseError, parse_lines
+
+    with pytest.raises(ParseError) as ei:
+        parse_lines(["1,2,3", "not-a-record", "4,5,6"],
+                    provenance=[("data.csv", 10), ("data.csv", 11),
+                                ("data.csv", 12)])
+    err = ei.value
+    assert err.source_path == "data.csv" and err.lineno == 11
+    assert err.raw == "not-a-record"
+    assert "data.csv:11" in str(err) and "not-a-record" in str(err)
+    # Without provenance: batch-relative position against "<stream>".
+    with pytest.raises(ParseError) as ei:
+        parse_lines(["1,2,3", "9,9"])
+    assert ei.value.source_path == "<stream>" and ei.value.lineno == 2
+    # Out-of-int64-range ids are a provenance-carrying rejection too,
+    # not an opaque array-conversion overflow.
+    with pytest.raises(ParseError, match="out of int64 range"):
+        parse_lines(["99999999999999999999,1,2"])
+
+
+def test_batched_lines_captures_origin_per_line(tmp_path):
+    """The batcher records (path, lineno) per buffered line from the
+    source's origin hook, so a mid-batch poison line is named exactly
+    (blank lines are counted in file linenos but never buffered)."""
+    import pytest
+
+    from tpu_cooccurrence.io.parse import ParseError, batched_lines
+    from tpu_cooccurrence.io.source import FileMonitorSource
+
+    p = tmp_path / "in.csv"
+    p.write_text("1,2,3\n\n4,5,6\nBAD\n7,8,9\n")
+    src = FileMonitorSource(str(p))
+    with pytest.raises(ParseError) as ei:
+        list(batched_lines(src.lines(), origin=src.origin))
+    assert ei.value.source_path == str(p)
+    assert ei.value.lineno == 4  # raw file lineno, blank line included
+    assert ei.value.raw == "BAD"

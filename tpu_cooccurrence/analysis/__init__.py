@@ -26,6 +26,9 @@ Layout:
   CLI flags vs config fields vs docs);
 * :mod:`.rules_native` — dtype discipline at the native (ctypes) and
   fold boundaries;
+* :mod:`.rules_degrade` — degradation-level registry drift (every
+  ``DegradationLevel`` member documented, journaled, and in the
+  ARCHITECTURE level table);
 * ``__main__`` — the runner: ``python -m tpu_cooccurrence.analysis``
   exits 1 on non-baseline findings (``--format json|text``).
 
@@ -47,6 +50,7 @@ from .core import (  # noqa: F401
 )
 
 # Importing the rule modules registers their rules in RULES.
+from . import rules_degrade  # noqa: F401,E402
 from . import rules_jit  # noqa: F401,E402
 from . import rules_lock  # noqa: F401,E402
 from . import rules_native  # noqa: F401,E402

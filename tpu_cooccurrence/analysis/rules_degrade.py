@@ -1,0 +1,105 @@
+"""Degradation-level registry drift.
+
+The degradation plane (``robustness/degrade.py``) is a state machine
+whose levels are operator-facing contract: every level must have a
+documented transition rule (``TRANSITION_RULES``), a journal event
+token (``LEVEL_EVENTS`` — what the window record's ``degrade_events``
+carries when the level is entered), and a row in the ARCHITECTURE
+"Backpressure & degradation" level table. A level added to the enum
+without all three is a silent operational lie — the journal would show
+a numeric level nothing documents.
+
+AST-checked (the enum members and both dict literals are read from the
+source, not imported) and baseline-free by construction: the rule ships
+with a clean repo and there is nothing to grandfather.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import FileContext, Finding, RepoContext, Rule, register
+
+_DEGRADE_PATH = "tpu_cooccurrence/robustness/degrade.py"
+_ARCH_PATH = "docs/ARCHITECTURE.md"
+
+
+def _enum_members(tree: ast.Module, class_name: str) -> Dict[str, int]:
+    """``{member: lineno}`` of a module-level enum class's assignments."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {t.id: stmt.lineno
+                    for stmt in node.body if isinstance(stmt, ast.Assign)
+                    for t in stmt.targets if isinstance(t, ast.Name)}
+    return {}
+
+
+def _dict_literal_keys(tree: ast.Module, name: str) -> Optional[Set[str]]:
+    """String keys of a module-level ``NAME = {...}`` dict literal, or
+    ``None`` when no such literal assignment exists."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+@register
+class DegradeRegistryRule(Rule):
+    name = "degrade-registry"
+    description = ("every DegradationLevel member needs a TRANSITION_RULES "
+                   "entry, a LEVEL_EVENTS journal token, and an "
+                   "ARCHITECTURE level-table mention")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        src: Optional[FileContext] = next(
+            (c for c in repo.files if c.path == _DEGRADE_PATH), None)
+        if src is None or src.tree is None:
+            return
+        members = _enum_members(src.tree, "DegradationLevel")
+        if not members:
+            yield Finding(
+                rule=self.name, file=_DEGRADE_PATH, line=1,
+                message="DegradationLevel enum not found (the degrade "
+                        "plane's level registry is gone)")
+            return
+        for table in ("TRANSITION_RULES", "LEVEL_EVENTS"):
+            keys = _dict_literal_keys(src.tree, table)
+            if keys is None:
+                kind = ("transition-rule" if table == "TRANSITION_RULES"
+                        else "journal-event")
+                yield Finding(
+                    rule=self.name, file=_DEGRADE_PATH, line=1,
+                    message=(f"{table} dict literal not found in "
+                             f"degrade.py (the per-level {kind} "
+                             f"registry is gone)"))
+                continue
+            for member, lineno in sorted(members.items()):
+                if member not in keys:
+                    yield Finding(
+                        rule=self.name, file=_DEGRADE_PATH, line=lineno,
+                        message=(f"DegradationLevel.{member} has no "
+                                 f"{table} entry — every level needs a "
+                                 f"documented transition rule and a "
+                                 f"journal event token"))
+            for key in sorted(keys - set(members)):
+                yield Finding(
+                    rule=self.name, file=_DEGRADE_PATH, line=1,
+                    message=(f"{table} entry {key!r} names no "
+                             f"DegradationLevel member (dead registry "
+                             f"row)"))
+        arch = next((c for c in repo.files if c.path == _ARCH_PATH), None)
+        if arch is not None:
+            for member, lineno in sorted(members.items()):
+                if member not in arch.source:
+                    yield Finding(
+                        rule=self.name, file=_DEGRADE_PATH, line=lineno,
+                        message=(f"DegradationLevel.{member} is not "
+                                 f"mentioned in {_ARCH_PATH} — add it to "
+                                 f"the Backpressure & degradation level "
+                                 f"table"))

@@ -89,6 +89,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         LOG.warning("fault injection armed: %s", config.inject_fault)
 
     config.log_configuration(LOG)
+    if config.degrade:
+        LOG.info("graceful degradation armed: wall>%.3fs trips after %d "
+                 "windows, clears after %d; shed factor %d; pause %d ms",
+                 config.degrade_window_wall_s, config.degrade_trip_windows,
+                 config.degrade_clear_windows, config.degrade_shed_factor,
+                 config.degrade_pause_ms)
     if config.pipeline_depth > 0:
         # Make the execution mode unmissable in the run log: with
         # --emit-updates the result stream is produced by the pipeline's
@@ -179,16 +185,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(_render_row(item, job.latest[item]),
                       flush=config.process_continuously)
 
-    from .observability import xla_trace
+    # Poison-input quarantine (robustness/quarantine.py): malformed
+    # lines divert to the dead-letter file under the rate breaker
+    # instead of crashing the job.
+    quarantine = None
+    if config.quarantine_file:
+        from .robustness.quarantine import Quarantine
 
-    with xla_trace(config.profile_dir):
-        # --buffer-timeout bounds how long a parsed line may wait in a
-        # partial batch (reference: FlinkCooccurrences.java:46); it only
-        # matters when tailing input continuously — process-once runs
-        # always flush at end of stream.
-        latency = (config.buffer_timeout / 1000.0
-                   if config.process_continuously else None)
-        job.run(batched_lines(source.lines(), max_latency_s=latency))
+        quarantine = Quarantine(config.quarantine_file,
+                                max_rate=config.max_quarantine_rate)
+        LOG.info("quarantine armed: dead-letter %s, max rate %.2f%%",
+                 config.quarantine_file, config.max_quarantine_rate * 100)
+
+    from .observability import xla_trace
+    from .robustness.quarantine import QuarantineRateExceeded
+
+    try:
+        with xla_trace(config.profile_dir):
+            # --buffer-timeout bounds how long a parsed line may wait in a
+            # partial batch (reference: FlinkCooccurrences.java:46); it only
+            # matters when tailing input continuously — process-once runs
+            # always flush at end of stream.
+            latency = (config.buffer_timeout / 1000.0
+                       if config.process_continuously else None)
+            job.run(batched_lines(source.lines(), max_latency_s=latency,
+                                  origin=source.origin,
+                                  quarantine=quarantine))
+        if quarantine is not None:
+            # End-of-stream verdict (warm-up waived): a short input that
+            # was mostly garbage must exit 2, not succeed on its crumbs.
+            quarantine.check_final()
+    except QuarantineRateExceeded as exc:
+        # Exit 2 (permanent): a systematically malformed input does not
+        # get better with supervised restarts — stop the run and point
+        # the operator at the dead-letter file. The breaker fires inside
+        # the ingest generator, before finish() is reachable: tear the
+        # job down explicitly (join the scorer worker, seal the journal,
+        # drop the degradation controller).
+        job.abort()
+        LOG.error("quarantine rate breaker tripped: %s", exc)
+        return 2
+    finally:
+        if quarantine is not None:
+            quarantine.close()
 
     if config.development_mode:
         for w in job.step_timer.slowest():

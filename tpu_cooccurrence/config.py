@@ -119,6 +119,39 @@ class Config:
     watchdog_stale_after_s: float = 0.0  # supervisor hang watchdog: kill
     # a child whose --journal has not grown for this many seconds (the
     # /healthz "no window fired" liveness signal); 0 = off
+    degrade: bool = False  # graceful-degradation controller
+    # (robustness/degrade.py): watch per-window health signals and step
+    # NORMAL -> SHED_SAMPLING -> SHED_K -> PAUSE_INGEST, tightening the
+    # paper's frequency cuts / emitted top-K and finally applying
+    # bounded admission delay at the source; off = today's behavior
+    degrade_window_wall_s: float = 1.0  # a window slower than this
+    # wall-clock (sample+score) counts as overloaded
+    degrade_trip_windows: int = 3  # consecutive overloaded windows that
+    # escalate one level (hysteresis: escalation is never single-sample)
+    degrade_clear_windows: int = 8  # consecutive healthy windows that
+    # de-escalate one level (asymmetric on purpose: recover slower than
+    # you shed, so the level cannot flap)
+    degrade_shed_factor: int = 2  # cut/top-K divisor per shedding level
+    degrade_pause_ms: int = 200  # bounded per-admit delay at PAUSE_INGEST
+    # (a throttle, never an unbounded stall — no self-deadlock)
+    degrade_stale_after_s: float = 30.0  # ingest-side staleness signal:
+    # no window completed for this long while lines keep arriving
+    # escalates one level (rate-limited to one step per stale period)
+    quarantine_file: Optional[str] = None  # poison-input dead-letter
+    # JSONL (robustness/quarantine.py): malformed lines divert here with
+    # path:lineno provenance instead of crashing the job; None = off
+    # (a malformed line raises, with the same provenance in the error)
+    max_quarantine_rate: float = 0.01  # quarantine breaker: abort (exit
+    # 2, permanent) once more than this fraction of input lines has
+    # been quarantined — a systematically wrong input must not
+    # "succeed" on its crumbs
+    scorer_breaker_threshold: int = 0  # scorer circuit breaker
+    # (robustness/degrade.py): N consecutive process_window failures
+    # open the breaker onto the exact host-oracle fallback scorer, so a
+    # failing device dispatch degrades the run instead of killing it;
+    # 0 = off (single-process device/sparse backends only)
+    scorer_breaker_probe_windows: int = 8  # windows the breaker stays
+    # open before a half-open probe retries the primary scorer
     inject_fault: Optional[List[str]] = None  # fault-injection specs
     # (robustness/faults.py): site[:window_seq][:kind[:arg]], each fires
     # exactly once; None/[] = injection off (zero hot-path cost)
@@ -251,6 +284,61 @@ class Config:
             raise ValueError(
                 f"--healthz-stale-after-s must be positive, got "
                 f"{self.healthz_stale_after_s}")
+        if self.degrade_window_wall_s <= 0:
+            raise ValueError(
+                f"--degrade-window-wall-s must be positive, got "
+                f"{self.degrade_window_wall_s}")
+        if self.degrade_trip_windows < 1 or self.degrade_clear_windows < 1:
+            raise ValueError(
+                "--degrade-trip-windows and --degrade-clear-windows "
+                "must be >= 1")
+        if self.degrade_shed_factor < 2:
+            raise ValueError(
+                f"--degrade-shed-factor must be >= 2, got "
+                f"{self.degrade_shed_factor}")
+        if self.degrade_pause_ms < 0:
+            raise ValueError(
+                f"--degrade-pause-ms must be >= 0, got "
+                f"{self.degrade_pause_ms}")
+        if self.degrade_stale_after_s <= 0:
+            raise ValueError(
+                f"--degrade-stale-after-s must be positive, got "
+                f"{self.degrade_stale_after_s}")
+        if self.degrade and (self.partition_sampling
+                             or self.coordinator is not None):
+            # Shedding decisions are per-process, keyed on local wall
+            # times; multi-host runs need every process's sampling state
+            # identical (replicated, or partition-allgathered) — one
+            # host tripping to SHED_SAMPLING alone would diverge the
+            # pair streams feeding the mesh collectives.
+            raise ValueError(
+                "--degrade is single-process only (per-process shedding "
+                "would diverge the replicated/partitioned sampling "
+                "state across hosts)")
+        if not (0.0 < self.max_quarantine_rate <= 1.0):
+            raise ValueError(
+                f"--max-quarantine-rate must be in (0, 1], got "
+                f"{self.max_quarantine_rate}")
+        if self.scorer_breaker_threshold < 0:
+            raise ValueError(
+                f"--scorer-breaker-threshold must be >= 0, got "
+                f"{self.scorer_breaker_threshold}")
+        if self.scorer_breaker_probe_windows < 1:
+            raise ValueError(
+                f"--scorer-breaker-probe-windows must be >= 1, got "
+                f"{self.scorer_breaker_probe_windows}")
+        if self.scorer_breaker_threshold > 0:
+            if self.backend == Backend.ORACLE:
+                raise ValueError(
+                    "--scorer-breaker-threshold: the oracle backend IS "
+                    "the breaker's fallback — there is nothing to break "
+                    "over")
+            if (self.backend == Backend.SHARDED or self.num_shards > 1
+                    or self.coordinator is not None):
+                raise ValueError(
+                    "--scorer-breaker-threshold is single-process "
+                    "device/sparse only (a per-process host fallback "
+                    "cannot substitute for a mesh collective)")
         if self.pipeline_depth not in (0, 1, 2):
             raise ValueError(
                 f"--pipeline-depth must be 0, 1 or 2, got "
@@ -421,6 +509,57 @@ class Config:
                             "many seconds and count a failed attempt "
                             "(0 = off; needs --restart-on-failure and "
                             "--journal)")
+        p.add_argument("--degrade", action="store_true", dest="degrade",
+                       help="Enable the graceful-degradation controller: "
+                            "shed load (tighter cuts, narrower top-K, "
+                            "bounded admission delay) under sustained "
+                            "overload instead of stalling or dying")
+        p.add_argument("--degrade-window-wall-s", type=float, default=1.0,
+                       dest="degrade_window_wall_s",
+                       help="Per-window wall-time threshold above which a "
+                            "window counts as overloaded (default: 1.0)")
+        p.add_argument("--degrade-trip-windows", type=int, default=3,
+                       dest="degrade_trip_windows",
+                       help="Consecutive overloaded windows that escalate "
+                            "one degradation level (default: 3)")
+        p.add_argument("--degrade-clear-windows", type=int, default=8,
+                       dest="degrade_clear_windows",
+                       help="Consecutive healthy windows that de-escalate "
+                            "one level (default: 8)")
+        p.add_argument("--degrade-shed-factor", type=int, default=2,
+                       dest="degrade_shed_factor",
+                       help="Cut/top-K divisor applied per shedding level "
+                            "(default: 2)")
+        p.add_argument("--degrade-pause-ms", type=int, default=200,
+                       dest="degrade_pause_ms",
+                       help="Bounded per-admit source delay at "
+                            "PAUSE_INGEST (default: 200)")
+        p.add_argument("--degrade-stale-after-s", type=float, default=30.0,
+                       dest="degrade_stale_after_s",
+                       help="Escalate one level when no window has "
+                            "completed for this long while ingest "
+                            "continues (default: 30)")
+        p.add_argument("--quarantine-file", default=None,
+                       dest="quarantine_file",
+                       help="Divert malformed input lines to this "
+                            "dead-letter JSONL (path:lineno provenance + "
+                            "raw line) instead of crashing the job")
+        p.add_argument("--max-quarantine-rate", type=float, default=0.01,
+                       dest="max_quarantine_rate",
+                       help="Abort (exit 2, permanent) once more than "
+                            "this fraction of input lines has been "
+                            "quarantined (default: 0.01)")
+        p.add_argument("--scorer-breaker-threshold", type=int, default=0,
+                       dest="scorer_breaker_threshold",
+                       help="Scorer circuit breaker: consecutive dispatch "
+                            "failures that open onto the host-oracle "
+                            "fallback scorer (0 = off; single-process "
+                            "device/sparse backends)")
+        p.add_argument("--scorer-breaker-probe-windows", type=int,
+                       default=8, dest="scorer_breaker_probe_windows",
+                       help="Windows the scorer breaker stays open before "
+                            "a half-open probe retries the primary "
+                            "(default: 8)")
         p.add_argument("--inject-fault", action="append", default=None,
                        dest="inject_fault", metavar="SITE[:SEQ][:KIND[:ARG]]",
                        help="Fault injection (repeatable): fire KIND "

@@ -26,7 +26,12 @@ import time
 from typing import Iterator, List, Optional, Tuple
 
 from ..metrics import Counters, SPLIT_READER_NUM_SPLITS
-from ..robustness import faults
+from ..robustness import degrade, faults
+
+#: Lines between admission-gate checks while a degradation controller is
+#: installed: cheap enough to bound burst admission at sub-batch
+#: granularity, coarse enough to stay off the per-line hot path.
+ADMIT_EVERY_LINES = 4096
 
 
 class FileMonitorSource:
@@ -92,6 +97,14 @@ class FileMonitorSource:
         splits.sort()
         return splits
 
+    # -- provenance ------------------------------------------------------
+
+    def origin(self) -> Tuple[str, int]:
+        """``(path, lineno)`` of the line most recently yielded by
+        :meth:`lines` — the per-line provenance hook ``batched_lines``
+        captures for parse errors and the quarantine dead-letter file."""
+        return (self._current_file or self.path, self._current_line)
+
     # -- reading ---------------------------------------------------------
 
     def lines(self) -> Iterator[str]:
@@ -116,6 +129,7 @@ class FileMonitorSource:
         skip_mtime = self._current_mtime
         skip_lines = self._current_line
         files_opened = 0
+        since_gate = 0
         while True:
             splits = self._list_splits()
             if skip_file is not None:
@@ -128,6 +142,10 @@ class FileMonitorSource:
                 files_opened += 1
                 if faults.PLAN is not None:
                     faults.PLAN.fire("source_read", seq=files_opened)
+                if degrade.CONTROLLER is not None:
+                    # Admission control (bounded delay) at the split
+                    # boundary: a burst of small files is gated too.
+                    degrade.CONTROLLER.admit()
                 self.counters.add(SPLIT_READER_NUM_SPLITS, 1)
                 to_skip = skip_lines if (p == skip_file
                                          and mtime == skip_mtime) else 0
@@ -143,6 +161,14 @@ class FileMonitorSource:
                         self._current_line += 1
                         line = line.rstrip("\n")
                         if line:
+                            if degrade.CONTROLLER is not None:
+                                # Source-side admission gate (degrade.py
+                                # PAUSE_INGEST): at most pause_ms delay
+                                # per check — bounded, never a stall.
+                                since_gate += 1
+                                if since_gate >= ADMIT_EVERY_LINES:
+                                    since_gate = 0
+                                    degrade.CONTROLLER.admit()
                             yield line
                 # Advance the marker only once the LAST file sharing this
                 # mtime completes: the marker's invariant is "everything at

@@ -52,6 +52,22 @@ class CooccurrenceJob:
             raise ValueError("window size must be positive")
         self.config = config
         self.counters = Counters()
+        # Graceful-degradation plane (--degrade, robustness/degrade.py):
+        # installed process-globally so the source's admission gate can
+        # reach it without plumbing; identity at NORMAL (parity-tested),
+        # uninstalled in finish().
+        self.degrade = None
+        if config.degrade:
+            from .robustness import degrade as degrade_mod
+
+            self.degrade = degrade_mod.install(
+                degrade_mod.DegradationController(
+                    window_wall_s=config.degrade_window_wall_s,
+                    trip_windows=config.degrade_trip_windows,
+                    clear_windows=config.degrade_clear_windows,
+                    shed_factor=config.degrade_shed_factor,
+                    pause_ms=config.degrade_pause_ms,
+                    stale_after_s=config.degrade_stale_after_s))
         # Sliding mode (framework extension; the reference is tumbling-only,
         # FlinkCooccurrences.java:139,153) switches the sampler to stateless
         # windowed-basket co-occurrence — see sampling/sliding.py for the
@@ -138,6 +154,14 @@ class CooccurrenceJob:
             from .observability.journal import RunJournal
 
             self.journal = RunJournal(config.journal)
+            if self.degrade is not None:
+                # Durable sink for admission-side (stale-ingest)
+                # transitions: they must reach the journal even when no
+                # window ever completes again (the stalled-scorer
+                # scenario the escalation exists for). RunJournal.record
+                # is locked, so the ingest thread may write concurrently
+                # with the window-record thread.
+                self.degrade.journal_event = self._journal_degrade_event
         self._prev_counters: Dict[str, int] = {}
         self._prev_wire: Dict[str, int] = LEDGER.snapshot()
         # Metrics plane (observability/registry.py): latency/byte
@@ -181,6 +205,21 @@ class CooccurrenceJob:
 
             self.pipeline = PipelineDriver(self, config.pipeline_depth)
 
+    def _maybe_breaker(self, scorer):
+        """Wrap a single-process device scorer in the circuit breaker
+        (--scorer-breaker-threshold > 0): consecutive dispatch failures
+        fail over to the exact host-oracle scorer instead of killing
+        the run (config validation restricts the flag to the backends
+        where a host fallback is sound)."""
+        if self.config.scorer_breaker_threshold <= 0:
+            return scorer
+        from .robustness.degrade import ScorerCircuitBreaker
+
+        return ScorerCircuitBreaker(
+            scorer, self.config.top_k, self.counters,
+            threshold=self.config.scorer_breaker_threshold,
+            probe_after_windows=self.config.scorer_breaker_probe_windows)
+
     def _parse_fixed_score(self):
         fixed = {"auto": None, "on": True,
                  "off": False}.get(self.config.fixed_score, KeyError)
@@ -223,11 +262,12 @@ class CooccurrenceJob:
             # capacity check, enforced in add_batch.
             num_items = self.config.num_items
             # defer_results: see the sparse branch below.
-            return DeviceScorer(num_items, self.config.top_k, self.counters,
-                                max_pairs_per_step=self.config.max_pairs_per_step,
-                                use_pallas=self.config.pallas,
-                                count_dtype=self.config.count_dtype,
-                                defer_results=not self.config.emit_updates)
+            return self._maybe_breaker(DeviceScorer(
+                num_items, self.config.top_k, self.counters,
+                max_pairs_per_step=self.config.max_pairs_per_step,
+                use_pallas=self.config.pallas,
+                count_dtype=self.config.count_dtype,
+                defer_results=not self.config.emit_updates))
         if backend == Backend.SPARSE:
             fixed = self._parse_fixed_score()
             if self.config.num_shards > 1:
@@ -263,12 +303,13 @@ class CooccurrenceJob:
             # result transfer drops to zero (the dominant wall cost of
             # large windows on a high-latency link). Streaming consumers
             # keep the per-window pipeline.
-            return SparseDeviceScorer(self.config.top_k, self.counters,
-                                      self.config.development_mode,
-                                      score_ladder=self.config.score_ladder,
-                                      defer_results=not self.config.emit_updates,
-                                      fixed_shapes=fixed,
-                                      use_pallas=self.config.pallas)
+            return self._maybe_breaker(SparseDeviceScorer(
+                self.config.top_k, self.counters,
+                self.config.development_mode,
+                score_ladder=self.config.score_ladder,
+                defer_results=not self.config.emit_updates,
+                fixed_shapes=fixed,
+                use_pallas=self.config.pallas))
         if backend == Backend.SHARDED:
             from .parallel.distributed import maybe_multihost_mesh
 
@@ -313,6 +354,37 @@ class CooccurrenceJob:
     def finish(self) -> None:
         """End of stream — Watermark(MAX_VALUE) fires everything."""
         try:
+            self._finish()
+        finally:
+            if self.degrade is not None:
+                # Drop the process-global controller whatever happened —
+                # a failed job must not keep gating a successor's source
+                # (instance-checked, so it never evicts a newer job's).
+                from .robustness import degrade as degrade_mod
+
+                degrade_mod.uninstall(self.degrade)
+
+    def abort(self) -> None:
+        """Best-effort teardown after an externally-raised abort mid-run
+        (e.g. the quarantine rate breaker firing inside the ingest
+        generator, before ``finish`` was ever reachable): join the
+        scorer worker so no daemon thread keeps dispatching, close the
+        journal so its tail is durable, and drop the process-global
+        degradation controller. Idempotent; never raises over the
+        original failure."""
+        try:
+            if self.pipeline is not None:
+                self.pipeline._shutdown_worker()
+        finally:
+            if self.journal is not None:
+                self.journal.close()
+            if self.degrade is not None:
+                from .robustness import degrade as degrade_mod
+
+                degrade_mod.uninstall(self.degrade)
+
+    def _finish(self) -> None:
+        try:
             self._drain(final=True)
         except BaseException:
             if self.pipeline is not None:
@@ -329,7 +401,13 @@ class CooccurrenceJob:
             self.pipeline.close()
         if (self.config.development_mode
                 and not getattr(self.scorer, "process_suffix", "")
-                and not getattr(self.scorer, "defer_results", False)):
+                and not getattr(self.scorer, "defer_results", False)
+                and not getattr(self.scorer, "trips", 0)):
+            # A tripped scorer breaker is exempt too: rows the primary
+            # dispatched (and counted) before failing may have been
+            # re-scored by the fallback and filtered from the final
+            # flush — the imbalance is the documented fidelity trade,
+            # not a lost window.
             # Pipeline-drain invariant (the moral equivalent of the
             # reference's buffered-element balance counters,
             # UserInteractionCounterOneInputStreamOperator.java:134-137):
@@ -383,6 +461,29 @@ class CooccurrenceJob:
             self.windows_fired += 1
             if faults.PLAN is not None:
                 faults.PLAN.fire("window_fire", seq=self.windows_fired)
+            if self.degrade is not None:
+                # Apply the level in force to this window's cuts BEFORE
+                # sampling (sampling-thread-only writes; identity at
+                # NORMAL). Tumbling mode sheds via the item cut only —
+                # the user reservoir's kMax is structural state whose
+                # mid-run shrink would corrupt eviction deltas.
+                if self.sliding:
+                    self.sampler.set_effective_cuts(
+                        self.degrade.effective_item_cut(self.config.item_cut),
+                        self.degrade.effective_user_cut(self.config.user_cut))
+                elif not self.config.skip_cuts:
+                    self.item_cut.set_effective_cut(
+                        self.degrade.effective_item_cut(self.config.item_cut))
+                if self.pipeline is None:
+                    # Host backends can shed at the heap itself (fewer
+                    # offers kept per row). Serial mode only: in
+                    # pipelined mode the scorer worker owns the heap and
+                    # a producer-side swap would race it — the _absorb
+                    # truncation below sheds for that mode instead.
+                    setk = getattr(self.scorer, "set_effective_top_k", None)
+                    if setk is not None:
+                        setk(self.degrade.effective_top_k(
+                            self.config.top_k))
             with clock() as sample_clock:
                 if self.sliding:
                     pairs = self.sampler.fire(users, items)
@@ -478,10 +579,19 @@ class CooccurrenceJob:
         self._hist_uplink.observe(wire_delta["h2d_bytes"])
         self._gauge_windows.set(seq)
         self._gauge_last_window.set(time.time())
+        level = degrade_events = None
+        if self.degrade is not None:
+            # Feed the controller this window's health signals; any
+            # transition it applies is journaled on this very record.
+            level, degrade_events = self.degrade.observe_window(
+                wall_seconds=stats.seconds, ring_depth=ring_depth,
+                ring_capacity=(self.pipeline.depth
+                               if self.pipeline is not None else 0),
+                stall_seconds=stall_seconds)
         if self.journal is not None:
             from .observability.journal import VERSION
 
-            self.journal.record({
+            rec = {
                 "v": VERSION, "seq": seq, "ts": stats.timestamp,
                 "events": stats.events, "pairs": stats.pairs,
                 "rows_scored": stats.rows_scored,
@@ -492,13 +602,41 @@ class CooccurrenceJob:
                 "wall_unix": round(time.time(), 3),
                 "counters": counter_delta,
                 "wire": wire_delta,
-            })
+            }
+            if level is not None:
+                rec["degradation_level"] = level
+                if degrade_events:
+                    rec["degrade_events"] = degrade_events
+            breaker_state = getattr(self.scorer, "breaker_state", None)
+            if breaker_state is not None:
+                rec["breaker_state"] = breaker_state
+            self.journal.record(rec)
+
+    def _journal_degrade_event(self, event: str) -> None:
+        """Append one out-of-band degradation event record (the
+        admission-side transition path — see journal.EVENT_SCHEMA)."""
+        from .observability.journal import VERSION
+
+        self.journal.record({"v": VERSION, "event": event,
+                             "wall_unix": round(time.time(), 3)})
 
     def _flush_scorer(self) -> WindowTopK:
         flush = getattr(self.scorer, "flush", None)
         return flush() if flush is not None else []
 
     def _absorb(self, window_out: WindowTopK) -> None:
+        if self.degrade is not None and len(window_out):
+            # Result-side shedding (level SHED_K): narrow the emitted
+            # top-K at absorption — a host-side slice, so device
+            # backends keep their compiled K and nothing recompiles.
+            # Row count is untouched (the emissions balance holds).
+            k = self.degrade.effective_top_k(self.config.top_k)
+            if k < self.config.top_k:
+                if isinstance(window_out, TopKBatch):
+                    window_out = window_out.truncated(k)
+                else:
+                    window_out = [(item, top[:k])
+                                  for item, top in window_out]
         if isinstance(window_out, TopKBatch):
             self.latest.absorb_batch(window_out)
             self.emissions += len(window_out)

@@ -37,6 +37,11 @@ LOG = logging.getLogger("tpu_cooccurrence.metrics_http")
 #: Gauge (set by the job per window) the health check reads.
 LAST_WINDOW_GAUGE = "cooc_last_window_unix_seconds"
 
+#: Degradation-plane gauges surfaced on /healthz (robustness plane):
+#: operators page on "paused" the same way they page on "stale".
+DEGRADATION_GAUGE = "cooc_degradation_level"
+QUARANTINE_GAUGE = "cooc_quarantined_lines_total"
+
 
 class MetricsServer:
     """Background scrape server over a registry + counters + ledger."""
@@ -93,10 +98,18 @@ class MetricsServer:
         return self._server.server_address[1]
 
     def health(self) -> "tuple[dict, bool]":
-        """(payload, healthy): last-window age vs the staleness threshold."""
+        """(payload, healthy): last-window age vs the staleness threshold,
+        plus the degradation plane's level and quarantine count.
+
+        ``PAUSE_INGEST`` reports unhealthy even inside the staleness
+        window: a paused job is *deliberately* not firing windows, and
+        letting the recency of its last pre-pause window read as "ok"
+        would hide exactly the condition an operator pages on.
+        """
         now = time.time()
         last = self.registry.gauge(LAST_WINDOW_GAUGE).get()
         windows = int(self.registry.gauge("cooc_windows_fired").get())
+        level = int(self.registry.gauge(DEGRADATION_GAUGE).get())
         if last > 0:
             age = now - last
             status = "ok" if age <= self.stale_after_s else "stale"
@@ -104,13 +117,20 @@ class MetricsServer:
             # No window yet: grace-period from server start, then stale.
             age = now - self._started_unix
             status = "starting" if age <= self.stale_after_s else "stale"
+        from ..robustness.degrade import DegradationLevel
+
+        if level >= DegradationLevel.PAUSE_INGEST and status != "stale":
+            status = "paused"
         payload = {"status": status,
                    "windows_fired": windows,
                    "last_window_age_seconds": round(age, 3),
-                   "stale_after_seconds": self.stale_after_s}
+                   "stale_after_seconds": self.stale_after_s,
+                   "degradation_level": level,
+                   "quarantined_total": int(
+                       self.registry.gauge(QUARANTINE_GAUGE).get())}
         if self.supervisor_info is not None:
             payload["last_restart"] = self.supervisor_info
-        return payload, status != "stale"
+        return payload, status not in ("stale", "paused")
 
     def start(self) -> "MetricsServer":
         self._thread = threading.Thread(

@@ -23,6 +23,11 @@ parity contract covers logical fields only.
 Readers (:func:`read_records`, :func:`tail`) tolerate a truncated final
 line — the expected shape of a file whose writer was SIGKILLed mid-
 ``write`` — and skip it rather than failing the whole read.
+
+Besides window records, the file may carry out-of-band **event
+records** (:data:`EVENT_SCHEMA`, distinguished by an ``"event"`` key):
+today the degradation plane's admission-side level transitions, which
+must reach disk even when no window ever completes again.
 """
 
 from __future__ import annotations
@@ -30,6 +35,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import threading
 from typing import Dict, Iterator, List, Optional
 
 from ..robustness import faults
@@ -55,13 +61,48 @@ SCHEMA = {
     "wall_unix": (True, float),  # host wall clock at record time
     "counters": (True, dict),    # counter name -> delta since last record
     "wire": (True, dict),        # TransferLedger delta: h2d/d2h bytes+calls
+    # Degradation plane (robustness/degrade.py, --degrade): present only
+    # while a controller / scorer breaker is attached.
+    "degradation_level": (False, int),   # level in force after this
+                                         # window's observation
+    "degrade_events": (False, list),     # transition event tokens this
+                                         # window's observation applied
+    "breaker_state": (False, str),       # scorer circuit breaker state
+                                         # (closed | half_open | open)
+}
+
+
+#: Out-of-band event record (no window attached): ``{"v", "event",
+#: "wall_unix"}``. Today's only producer is the degradation plane's
+#: admission-side escalation (robustness/degrade.py), which must journal
+#: a transition even when no window ever completes again.
+EVENT_SCHEMA = {
+    "v": (True, int),
+    "event": (True, str),
+    "wall_unix": (True, float),
 }
 
 
 def validate_record(rec: dict) -> None:
-    """Raise ``ValueError`` unless ``rec`` matches :data:`SCHEMA`."""
+    """Raise ``ValueError`` unless ``rec`` matches :data:`SCHEMA` (window
+    records) or :data:`EVENT_SCHEMA` (out-of-band event records)."""
     if not isinstance(rec, dict):
         raise ValueError(f"journal record is not an object: {rec!r}")
+    if "event" in rec:
+        for field, (required, typ) in EVENT_SCHEMA.items():
+            v = rec.get(field)
+            ok = (isinstance(v, (int, float)) if typ is float
+                  else isinstance(v, typ)) and not isinstance(v, bool)
+            if required and not ok:
+                raise ValueError(
+                    f"journal event record field {field!r} bad: {rec}")
+        unknown = set(rec) - set(EVENT_SCHEMA)
+        if unknown:
+            raise ValueError(
+                f"journal event record has unknown fields {unknown}: {rec}")
+        if rec["v"] != VERSION:
+            raise ValueError(f"journal version {rec['v']} != {VERSION}")
+        return
     for field, (required, typ) in SCHEMA.items():
         if field not in rec:
             if required:
@@ -109,6 +150,12 @@ class RunJournal:
         if torn:
             self._f.write("\n")
             self._f.flush()
+        # Window records come from one thread per execution mode, but
+        # out-of-band event records (degradation-plane admission-side
+        # transitions) arrive from the ingest thread concurrently — two
+        # buffered writes must not interleave mid-line.
+        # lock-ordering: leaf lock, held only around the write+flush
+        self._lock = threading.Lock()
 
     def record(self, rec: dict) -> None:
         if self._f is None:
@@ -120,8 +167,9 @@ class RunJournal:
                              path=self.path)
         # One write syscall per record + explicit flush: a SIGKILL can
         # truncate at most the line being written, never reorder lines.
-        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
-        self._f.flush()
+        with self._lock:
+            self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._f.flush()
 
     def close(self) -> None:
         if self._f is not None:

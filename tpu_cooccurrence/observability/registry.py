@@ -81,6 +81,12 @@ CANONICAL_METRICS = frozenset({
     # supervisor state relayed into the child (cli.py)
     "cooc_supervisor_restarts",
     "cooc_supervisor_backoff_ms",
+    # graceful-degradation plane (robustness/degrade.py, quarantine.py)
+    "cooc_degradation_level",
+    "cooc_shed_events_total",
+    "cooc_quarantined_lines_total",
+    "cooc_scorer_breaker_state",
+    "cooc_scorer_breaker_trips_total",
     # TransferLedger totals rendered by render_prometheus below
     "cooc_transfer_h2d_bytes_total",
     "cooc_transfer_h2d_calls_total",

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..observability import LEDGER
+from ..robustness import faults
 from ..sampling.reservoir import PairDeltaBatch
 from ..state.results import TopKBatch
 from .aggregate import (aggregate_window_coo, distinct_sorted,
@@ -487,6 +488,10 @@ class DeviceScorer:
         # returns the final in-flight window.
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
+        # scorer_breaker fault-site ordinal (robustness plane): counts
+        # this scorer's process_window calls so chaos tests can fail a
+        # specific dispatch and trip the circuit breaker wrapper.
+        self._breaker_seq = 0
         # Deferred-results mode (final-state consumption, no streaming):
         # see DeferredResultsTable.
         self.defer_results = bool(defer_results)
@@ -510,6 +515,11 @@ class DeviceScorer:
             self._results.resize(n)
 
     def process_window(self, ts: int, pairs: PairDeltaBatch) -> TopKBatch:
+        self._breaker_seq += 1
+        if faults.PLAN is not None:
+            # The breaker's trip input: an injected exception here is a
+            # failed device dispatch the ScorerCircuitBreaker absorbs.
+            faults.PLAN.fire("scorer_breaker", seq=self._breaker_seq)
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
             if self.defer_results:

@@ -69,7 +69,7 @@ import numpy as np
 from .observability import WindowStats, clock
 from .observability.registry import REGISTRY
 from .ops.aggregate import AggregatedPairs
-from .robustness import faults
+from .robustness import degrade, faults
 
 #: Queue sentinel: process everything already enqueued, then exit.
 _SHUTDOWN = object()
@@ -191,6 +191,10 @@ class PipelineDriver:
         self.queue_wait_seconds += wait.seconds
         self._hist_queue_wait.observe(wait.seconds)
         self._gauge_ring_depth.set(self._queue.qsize())
+        if degrade.CONTROLLER is not None:
+            # Queue-bound backpressure signal for the degradation plane:
+            # a long submit block means the scorer is the bottleneck.
+            degrade.CONTROLLER.note_queue_wait(wait.seconds)
 
     def barrier(self) -> None:
         """Block until every submitted window is scored and absorbed.

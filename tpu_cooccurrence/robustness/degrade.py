@@ -1,0 +1,485 @@
+"""Graceful-degradation plane: adaptive load shedding + scorer breaker.
+
+The reference inherits Flink's backpressure for free; this standalone
+build previously had exactly two answers to overload — stall (and get
+killed by the PR-3 watchdog) or die. This module is the third answer:
+*degrade*. A process-global :class:`DegradationController` watches the
+per-window health signals the observability plane already produces
+(window wall time, staging-ring saturation/stall, journal staleness)
+and steps through explicit levels::
+
+    NORMAL -> SHED_SAMPLING -> SHED_K -> PAUSE_INGEST
+
+Each level trades result fidelity for liveness using the paper's own
+knobs: the Schelter-style per-item/per-user frequency cuts (PAPER.md
+§0) are a *principled* shedding lever — tightening them drops exactly
+the highest-frequency tail interactions the cuts were designed to
+bound — and the emitted top-K width is the result-side equivalent.
+``PAUSE_INGEST`` is the last resort: bounded-delay admission control at
+the source (each admit may be delayed at most ``pause_ms``; never an
+unbounded stall, so a paused job cannot deadlock itself).
+
+**Hysteresis.** Escalation needs ``trip_windows`` *consecutive*
+overloaded windows; de-escalation needs ``clear_windows`` consecutive
+healthy ones, and both move exactly one level per decision — the
+journal therefore shows monotone, step-wise transitions, never
+flapping (``tests/test_degrade.py`` pins this).
+
+**Parity.** Every effective-cut/top-K function is the identity at
+``NORMAL``: a run whose controller never leaves ``NORMAL`` is
+bit-identical to a run without the controller (parity-tested at
+pipeline depths 0 and 2).
+
+Zero-cost-when-off contract (same as :mod:`.faults`): hot paths guard
+with ``if degrade.CONTROLLER is not None`` — one module-attribute load
+and a pointer compare. Arming is explicit (:func:`install`, done by
+``CooccurrenceJob.__init__`` under ``--degrade``).
+
+This module stays stdlib-only at import time (the cooclint
+``degrade-registry`` rule and ``observability/http.py`` read it without
+pulling numpy/jax); the breaker's host fallback imports lazily.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..observability.registry import REGISTRY
+from . import faults
+
+LOG = logging.getLogger("tpu_cooccurrence.degrade")
+
+
+class DegradationLevel(enum.IntEnum):
+    """Explicit degradation ladder; higher = more load shed."""
+
+    NORMAL = 0
+    SHED_SAMPLING = 1
+    SHED_K = 2
+    PAUSE_INGEST = 3
+
+
+#: Level -> one-line transition rule (the operator-facing contract,
+#: mirrored in docs/ARCHITECTURE.md "Backpressure & degradation").
+#: The cooclint ``degrade-registry`` rule AST-checks that every
+#: :class:`DegradationLevel` member has an entry here, an event token in
+#: :data:`LEVEL_EVENTS`, and a mention in the ARCHITECTURE level table —
+#: a new level cannot land undocumented or unjournaled.
+TRANSITION_RULES = {
+    "NORMAL": "entered after clear_windows consecutive healthy windows "
+              "at SHED_SAMPLING; all cuts and top-K at configured values",
+    "SHED_SAMPLING": "entered after trip_windows consecutive overloaded "
+                     "windows at NORMAL (or clear_windows healthy at "
+                     "SHED_K); item/user cuts tighten by shed_factor",
+    "SHED_K": "entered after trip_windows consecutive overloaded windows "
+              "at SHED_SAMPLING (or clear_windows healthy at "
+              "PAUSE_INGEST); cuts tighten by shed_factor^2 and emitted "
+              "top-K shrinks by shed_factor",
+    "PAUSE_INGEST": "entered after trip_windows consecutive overloaded "
+                    "windows at SHED_K (ingest-side staleness — no "
+                    "window completed for stale_after_s while lines "
+                    "keep arriving — also climbs toward here, one "
+                    "level per stale period); each source admit is "
+                    "delayed up to pause_ms",
+}
+
+#: Level -> journal event token, emitted in the window record
+#: (``degrade_events``) of the window whose observation applied the
+#: transition into that level. Explicit literals (not ``f"...{name}"``)
+#: so the degrade-registry rule can see every member's event statically.
+LEVEL_EVENTS = {
+    "NORMAL": "degrade/enter_normal",
+    "SHED_SAMPLING": "degrade/enter_shed_sampling",
+    "SHED_K": "degrade/enter_shed_k",
+    "PAUSE_INGEST": "degrade/enter_pause_ingest",
+}
+
+
+class DegradationController:
+    """Level state machine over per-window health signals.
+
+    Thread contract: :meth:`observe_window` runs on whichever thread
+    records windows (caller serially, scorer worker pipelined);
+    :meth:`admit` runs on the ingest thread; the cut/top-K readers run
+    on the sampling thread. All state transitions happen under one
+    internal leaf lock, and every public reader is either locked or a
+    single int read (atomic under the GIL).
+    """
+
+    def __init__(self, window_wall_s: float = 1.0, trip_windows: int = 3,
+                 clear_windows: int = 8, shed_factor: int = 2,
+                 pause_ms: int = 200, stale_after_s: float = 30.0) -> None:
+        if window_wall_s <= 0 or stale_after_s <= 0:
+            raise ValueError("degrade thresholds must be positive")
+        if trip_windows < 1 or clear_windows < 1:
+            raise ValueError("trip/clear window counts must be >= 1")
+        if shed_factor < 2:
+            raise ValueError(f"shed_factor must be >= 2, got {shed_factor}")
+        if pause_ms < 0:
+            raise ValueError(f"pause_ms must be >= 0, got {pause_ms}")
+        self.window_wall_s = window_wall_s
+        self.trip_windows = trip_windows
+        self.clear_windows = clear_windows
+        self.shed_factor = shed_factor
+        self.pause_s = pause_ms / 1000.0
+        self.stale_after_s = stale_after_s
+        self._level = DegradationLevel.NORMAL
+        self._bad = 0
+        self._good = 0
+        self._queue_pressure = False
+        # Transition event tokens not yet drained into a journal record.
+        # Observe-side transitions drain in the same observe_window call;
+        # admission-side (stale-ingest) escalations drain through
+        # ``journal_event`` IMMEDIATELY when the job attached one —
+        # in exactly the stalled-scorer scenario this path exists for,
+        # no further window may ever be observed, so waiting for one
+        # would lose the forensic record. Without a hook they ride the
+        # next observed window's record.
+        self._pending_events: List[str] = []
+        # Optional durable event sink (job wires its journal here):
+        # called with each transition token outside the controller lock.
+        self.journal_event: Optional[Callable[[str], None]] = None
+        self._transitions = 0
+        # Staleness baseline before any window completes: controller
+        # construction time — a scorer that wedges on its very FIRST
+        # dispatch must still trip the stale gate (construction-to-now
+        # covers warm-up, so set stale_after_s above worst-case cold
+        # compile time on slow targets).
+        self._started_monotonic = time.monotonic()
+        self._last_window_monotonic: Optional[float] = None
+        self._last_stale_escalation = 0.0
+        self._lock = threading.Lock()
+        self._gauge_level = REGISTRY.gauge(
+            "cooc_degradation_level",
+            help="current degradation level (0=NORMAL 1=SHED_SAMPLING "
+                 "2=SHED_K 3=PAUSE_INGEST)")
+        self._gauge_shed = REGISTRY.gauge(
+            "cooc_shed_events_total",
+            help="windows processed under a degraded level plus "
+                 "admission pauses applied")
+        self._gauge_level.set(int(self._level))
+
+    # -- level state machine ---------------------------------------------
+
+    @property
+    def level(self) -> DegradationLevel:
+        return self._level
+
+    def _transition(self, new: DegradationLevel) -> None:
+        """Apply one level change (lock held); the event token is queued
+        for the next journal record (:attr:`_pending_events`)."""
+        self._transitions += 1
+        if faults.PLAN is not None:
+            faults.PLAN.fire("degrade_step", seq=self._transitions)
+        old, self._level = self._level, new
+        self._bad = 0
+        self._good = 0
+        self._gauge_level.set(int(new))
+        event = LEVEL_EVENTS[new.name]
+        self._pending_events.append(event)
+        LOG.warning("degradation level %s -> %s (%s): %s",
+                    old.name, new.name, event, TRANSITION_RULES[new.name])
+
+    def observe_window(self, wall_seconds: float, ring_depth: int = 0,
+                       ring_capacity: int = 0, stall_seconds: float = 0.0
+                       ) -> "Tuple[int, List[str]]":
+        """Feed one completed window's health signals.
+
+        Returns ``(level, events)`` for the window's journal record:
+        the level in force after this observation and every transition
+        event token applied since the last observation — including
+        admission-side (stale-ingest) escalations, drained here so no
+        transition ever misses the journal.
+        """
+        with self._lock:
+            overloaded = (
+                wall_seconds > self.window_wall_s
+                or (ring_capacity > 0 and ring_depth >= ring_capacity)
+                or stall_seconds > self.window_wall_s / 4
+                or self._queue_pressure)
+            self._queue_pressure = False
+            self._last_window_monotonic = time.monotonic()
+            if overloaded:
+                self._bad += 1
+                self._good = 0
+            else:
+                self._good += 1
+                self._bad = 0
+            if (self._bad >= self.trip_windows
+                    and self._level < DegradationLevel.PAUSE_INGEST):
+                self._transition(DegradationLevel(self._level + 1))
+            elif (self._good >= self.clear_windows
+                    and self._level > DegradationLevel.NORMAL):
+                self._transition(DegradationLevel(self._level - 1))
+            if self._level > DegradationLevel.NORMAL:
+                self._gauge_shed.add(1)
+            events, self._pending_events = self._pending_events, []
+            return int(self._level), events
+
+    def note_queue_wait(self, seconds: float) -> None:
+        """Producer-side pipeline backpressure signal: a submit that
+        blocked this long marks the *next* observed window overloaded
+        (the wait is attributed to the window whose slot it waited for).
+        """
+        if seconds > self.window_wall_s / 4:
+            with self._lock:
+                self._queue_pressure = True
+
+    # -- admission control (ingest thread) -------------------------------
+
+    def admit(self) -> float:
+        """Source-side admission gate; returns the delay applied.
+
+        At ``PAUSE_INGEST`` each call sleeps ``pause_ms`` — *bounded*
+        admission delay, so a paused job throttles intake without ever
+        deadlocking against a scorer that needs ingest to progress.
+        Below ``PAUSE_INGEST`` the gate also carries the journal-
+        staleness signal: if windows have stopped completing for
+        ``stale_after_s`` while ingest keeps arriving, escalate one
+        level (rate-limited to one escalation per stale period).
+        """
+        if self._level >= DegradationLevel.PAUSE_INGEST:
+            with self._lock:
+                self._gauge_shed.add(1)
+            if self.pause_s > 0:
+                time.sleep(self.pause_s)
+            return self.pause_s
+        pending: List[str] = []
+        with self._lock:
+            # Before the first window completes, staleness is measured
+            # from construction — a first-dispatch wedge escalates too.
+            last = self._last_window_monotonic or self._started_monotonic
+            now = time.monotonic()
+            if (now - last > self.stale_after_s
+                    and now - self._last_stale_escalation > self.stale_after_s
+                    and self._level < DegradationLevel.PAUSE_INGEST):
+                self._last_stale_escalation = now
+                self._transition(DegradationLevel(self._level + 1))
+                if self.journal_event is not None:
+                    # Journal NOW: in the stalled-scorer scenario this
+                    # escalation responds to, the next observe_window
+                    # (the other drain point) may never come.
+                    pending, self._pending_events = self._pending_events, []
+        for event in pending:  # outside the lock: the sink does file I/O
+            self.journal_event(event)
+        return 0.0
+
+    # -- shedding knobs (identity at NORMAL — the parity contract) -------
+
+    def _cut_divisor(self) -> int:
+        if self._level >= DegradationLevel.SHED_K:
+            return self.shed_factor * self.shed_factor
+        if self._level >= DegradationLevel.SHED_SAMPLING:
+            return self.shed_factor
+        return 1
+
+    def effective_item_cut(self, base: int) -> int:
+        """Per-item frequency cut in force (fMax; never below 1)."""
+        return max(1, base // self._cut_divisor())
+
+    def effective_user_cut(self, base: int) -> int:
+        """Per-user cut in force (kMax; sliding-mode per-window cap)."""
+        return max(1, base // self._cut_divisor())
+
+    def effective_top_k(self, base: int) -> int:
+        """Emitted top-K width in force (never below 1)."""
+        if self._level >= DegradationLevel.SHED_K:
+            return max(1, base // self.shed_factor)
+        return base
+
+
+class ScorerCircuitBreaker:
+    """Availability wrapper around a device scorer.
+
+    ``threshold`` consecutive ``process_window`` failures open the
+    breaker; while open, windows are scored on a host oracle fallback
+    (the exact float64 rescorer, ``state/rescorer.HostRescorer`` — the
+    ``--backend oracle`` engine) so the run *completes* instead of
+    dying. After ``probe_after_windows`` windows open, the next window
+    is a half-open probe against the primary: success closes the
+    breaker, failure re-opens it. Any individual primary failure —
+    tripped or not — routes that window to the fallback, so no window's
+    pairs are ever dropped.
+
+    Documented fidelity trade (the SMASH-style precision-for-liveness
+    swap): the fallback starts from empty co-occurrence state at the
+    first failure, and windows scored while open never reach the
+    primary's device state — scores after a trip are degraded, not
+    wrong-shaped, and a checkpoint taken while open snapshots the
+    primary's (stale) state. ``breaker_state`` rides every journal
+    record so the trip is visible in forensics.
+    """
+
+    _STATE_CODES = {"closed": 0, "half_open": 1, "open": 2}
+
+    def __init__(self, primary, top_k: int, counters=None,
+                 threshold: int = 3, probe_after_windows: int = 8) -> None:
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, "
+                             f"got {threshold}")
+        if probe_after_windows < 1:
+            raise ValueError(f"probe_after_windows must be >= 1, "
+                             f"got {probe_after_windows}")
+        self.primary = primary
+        self.top_k = top_k
+        self.counters = counters
+        self.threshold = threshold
+        self.probe_after_windows = probe_after_windows
+        self.breaker_state = "closed"
+        self.trips = 0
+        self.last_dispatched_rows = 0
+        self._failures = 0
+        self._windows = 0
+        self._opened_at_window = 0
+        self._fallback = None
+        # Items whose LAST scoring happened on the fallback (dense-id
+        # space): rows the primary's final flush must not overwrite.
+        # Primary successes reclaim their dispatched items, so a
+        # transient blip — or a recovered breaker — does not leave the
+        # fallback's single-window rows shadowing fresher primary state.
+        self._fallback_owned: set = set()
+        self._gauge_state = REGISTRY.gauge(
+            "cooc_scorer_breaker_state",
+            help="scorer circuit breaker state "
+                 "(0=closed 1=half-open 2=open)")
+        self._gauge_trips = REGISTRY.gauge(
+            "cooc_scorer_breaker_trips_total",
+            help="times the scorer breaker opened onto the host fallback")
+        self._gauge_state.set(0)
+
+    # Pipeline staging consults this before folding; the fallback
+    # (HostRescorer) accepts aggregated deltas, so the wrapper simply
+    # mirrors the primary's preference.
+    @property
+    def accepts_aggregated(self) -> bool:
+        return getattr(self.primary, "accepts_aggregated", False)
+
+    def __getattr__(self, name):
+        # Checkpoint hooks, capacity knobs, defer_results, … — everything
+        # not owned by the breaker delegates to the primary scorer.
+        return getattr(object.__getattribute__(self, "primary"), name)
+
+    def _set_state(self, state: str) -> None:
+        self.breaker_state = state
+        self._gauge_state.set(self._STATE_CODES[state])
+
+    def _ensure_fallback(self):
+        if self._fallback is None:
+            from ..state.rescorer import HostRescorer
+
+            self._fallback = HostRescorer(self.top_k, self.counters)
+        return self._fallback
+
+    def _fallback_process(self, ts, pairs):
+        out = self._ensure_fallback().process_window(ts, pairs)
+        self._fallback_owned.update(item for item, _ in out)
+        self.last_dispatched_rows = len(out)
+        return out
+
+    def process_window(self, ts, pairs):
+        self._windows += 1
+        if self.breaker_state == "open":
+            if self._windows - self._opened_at_window >= self.probe_after_windows:
+                self._set_state("half_open")
+                LOG.warning("scorer breaker half-open: probing the "
+                            "primary scorer at window %d", self._windows)
+            else:
+                return self._fallback_process(ts, pairs)
+        try:
+            out = self.primary.process_window(ts, pairs)
+        except Exception as exc:
+            self._failures += 1
+            probe_failed = self.breaker_state == "half_open"
+            LOG.error("primary scorer dispatch failed (%d consecutive): "
+                      "%s: %s", self._failures, type(exc).__name__, exc)
+            if probe_failed or self._failures >= self.threshold:
+                self.trips += 1
+                self._gauge_trips.add(1)
+                self._opened_at_window = self._windows
+                self._set_state("open")
+                LOG.error("scorer breaker OPEN (trip %d): scoring on the "
+                          "host oracle fallback", self.trips)
+            return self._fallback_process(ts, pairs)
+        self._failures = 0
+        if self.breaker_state != "closed":
+            self._set_state("closed")
+            LOG.warning("scorer breaker closed: primary scorer recovered "
+                        "at window %d", self._windows)
+        if self._fallback_owned and len(pairs):
+            # The primary just re-scored these items: its state is the
+            # fresher one again, so the final flush may emit them.
+            self._fallback_owned.difference_update(
+                int(i) for i in set(pairs.src.tolist()))
+        self.last_dispatched_rows = getattr(
+            self.primary, "last_dispatched_rows", len(out))
+        return out
+
+    def flush(self):
+        """Drain the primary's result pipeline (the fallback scores
+        synchronously — it never holds results in flight), keeping the
+        fallback's rows authoritative.
+
+        The last scorer of an item owns its row: items whose most
+        recent scoring happened on the fallback (``_fallback_owned`` —
+        primary successes reclaim their dispatched items) are filtered
+        out of the primary's flush, which for deferred-results backends
+        is the WHOLE run's table, absorbed last — so the final
+        absorption cannot overwrite fresher fallback rows with stale
+        device state, while items the primary re-scored after recovery
+        flow through normally. A primary whose flush
+        fails while the breaker is open costs its unflushed results —
+        for deferred-results backends that is every primary-scored
+        window still in the device table (they live on the broken
+        device; nothing host-side can recover them) — never the
+        fallback's rows, which were absorbed as they were scored."""
+        primary_flush = getattr(self.primary, "flush", None)
+        if primary_flush is None:
+            return []
+        try:
+            out = primary_flush()
+        except Exception as exc:
+            if self.breaker_state != "open":
+                raise
+            LOG.error(
+                "primary scorer flush failed while breaker open — "
+                "dropping its unflushed results (for deferred-results "
+                "backends: every primary-scored window; fallback-scored "
+                "rows are already absorbed): %s", exc)
+            return []
+        owned = self._fallback_owned  # dense ids, same space as rows
+        if not owned or not len(out):
+            return out
+        from ..state.results import TopKBatch
+
+        if isinstance(out, TopKBatch):
+            import numpy as np
+
+            keep = np.array([int(r) not in owned
+                             for r in out.rows.tolist()], dtype=bool)
+            return TopKBatch(out.rows[keep], out.idx[keep], out.vals[keep])
+        return [(item, top) for item, top in out if item not in owned]
+
+
+#: The installed controller; ``None`` = degradation plane off (the
+#: hot-path guard, same shape as ``faults.PLAN``).
+CONTROLLER: Optional[DegradationController] = None
+
+
+def install(controller: DegradationController) -> DegradationController:
+    """Install ``controller`` as the process-wide degradation plane."""
+    global CONTROLLER
+    CONTROLLER = controller
+    return controller
+
+
+def uninstall(controller: Optional[DegradationController] = None) -> None:
+    """Remove the installed controller (job teardown / tests). With an
+    argument, only uninstalls if that instance is still the one
+    installed — a stale job's teardown cannot evict its successor's."""
+    global CONTROLLER
+    if controller is None or CONTROLLER is controller:
+        CONTROLLER = None

@@ -72,6 +72,15 @@ SITES = {
                              "written, before the atomic rename",
     "journal_append": "observability/journal.py — before appending a "
                       "window record",
+    "parse_record": "io/parse.py — before parsing a buffered line batch "
+                    "(seq = 1-based batch ordinal)",
+    "degrade_step": "robustness/degrade.py — a degradation-level "
+                    "transition is about to apply (seq = 1-based "
+                    "transition ordinal)",
+    "scorer_breaker": "ops/device_scorer.py / state/sparse_scorer.py — "
+                      "inside process_window before device dispatch "
+                      "(seq = 1-based scorer-window ordinal; the "
+                      "exception kind is the breaker's trip input)",
 }
 
 KINDS = ("crash", "exception", "delay_ms", "torn_write")

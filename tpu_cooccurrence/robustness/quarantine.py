@@ -1,0 +1,124 @@
+"""Poison-input quarantine: dead-letter file + rate breaker.
+
+The reference rides Flink's dead-letter idiom; this standalone build
+previously hard-crashed the whole job on the first malformed interaction
+line. With a quarantine attached (CLI ``--quarantine-file``), a line the
+parser rejects is *diverted* instead: one flushed JSONL record with full
+``path:lineno`` provenance, the offending raw line (truncated), and the
+parse error — then ingest continues. The good lines of the same batch
+still flow.
+
+The ``--max-quarantine-rate`` breaker bounds the blast radius of the
+opposite failure: a systematically wrong input (wrong delimiter, wrong
+schema, binary garbage) must not silently quarantine an entire dataset
+and "succeed" on its crumbs. Once more than ``max_rate`` of the lines
+seen have been quarantined, :class:`QuarantineRateExceeded` aborts the
+run — the CLI maps it to exit code 2, which the supervisor classifies
+permanent (a poisoned *dataset* does not get better with restarts).
+The ``min_lines`` warm-up only defers the *mid-stream* trip until the
+denominator is meaningful (a bad first line must not abort a healthy
+25M-line ingest); :meth:`check_final` applies the pure rate at end of
+stream, so a short fully-garbage input still exits 2 rather than
+"succeeding" with zero output.
+
+Single-writer contract: all methods run on the ingest thread (the only
+thread that parses), so counters are plain ints and the file needs no
+lock. Records are flushed per write — a crash loses at most the line
+being written, same durability bar as the run journal.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+from ..observability.registry import REGISTRY
+
+LOG = logging.getLogger("tpu_cooccurrence.quarantine")
+
+#: Longest raw-line prefix quoted anywhere for a rejected line — the
+#: dead-letter record here and the ParseError message preview
+#: (``io/parse.py`` imports it): provenance, not a second copy of the
+#: dataset, and one constant so the two can never disagree.
+RAW_TRUNCATE = 160
+
+
+class QuarantineRateExceeded(RuntimeError):
+    """The quarantine breaker: too large a fraction of input rejected."""
+
+
+class Quarantine:
+    """Dead-letter writer with a quarantine-rate circuit breaker."""
+
+    def __init__(self, path: str, max_rate: float = 0.01,
+                 min_lines: int = 1000) -> None:
+        if not (0.0 < max_rate <= 1.0):
+            raise ValueError(
+                f"max_rate must be in (0, 1], got {max_rate}")
+        if min_lines < 1:
+            raise ValueError(f"min_lines must be >= 1, got {min_lines}")
+        self.path = path
+        self.max_rate = max_rate
+        self.min_lines = min_lines
+        self.quarantined = 0
+        self.seen = 0
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")  # noqa: SIM115 - long-lived
+        self._gauge = REGISTRY.gauge(
+            "cooc_quarantined_lines_total",
+            help="malformed input lines diverted to the dead-letter file")
+
+    def note_lines(self, n: int) -> None:
+        """Count ``n`` lines entering the parser (the rate denominator)."""
+        self.seen += n
+
+    def quarantine(self, source_path: str, lineno: int, raw: str,
+                   reason: object) -> None:
+        """Divert one rejected line to the dead-letter file."""
+        rec = {
+            "path": source_path,
+            "lineno": lineno,
+            "raw": raw[:RAW_TRUNCATE],
+            "reason": str(reason)[:200],
+            "wall_unix": round(time.time(), 3),
+        }
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._f.flush()
+        self.quarantined += 1
+        self._gauge.add(1)
+        LOG.warning("quarantined %s:%d (%d so far): %s",
+                    source_path, lineno, self.quarantined, rec["reason"])
+        if (self.seen >= self.min_lines
+                and self.quarantined > self.max_rate * self.seen):
+            raise QuarantineRateExceeded(
+                f"{self.quarantined} of {self.seen} input lines "
+                f"quarantined (> {self.max_rate:.2%}) — the input looks "
+                f"systematically malformed, not poisoned; inspect "
+                f"{self.path} (last: {source_path}:{lineno})")
+
+    def check_final(self) -> None:
+        """End-of-stream rate check, warm-up waived: with the whole
+        input seen, the rate IS the verdict — a 300-line file that was
+        100% garbage must exit 2 like a 3M-line one, not "succeed" on
+        zero output because it never reached the mid-stream warm-up."""
+        if self.seen > 0 and self.quarantined > self.max_rate * self.seen:
+            raise QuarantineRateExceeded(
+                f"{self.quarantined} of {self.seen} input lines "
+                f"quarantined (> {self.max_rate:.2%}) by end of stream — "
+                f"the input looks systematically malformed; inspect "
+                f"{self.path}")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "Quarantine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -60,7 +60,17 @@ class ItemInteractionCut:
 
     def __init__(self, item_cut: int, capacity: int) -> None:
         self.item_cut = item_cut
+        # Degradation plane (robustness/degrade.py): the cut actually
+        # applied this window. Tighten-only (clamped to the configured
+        # fMax), identity while the controller is at NORMAL — shedding
+        # can only *remove* interactions a looser cut would have sampled
+        # (the monotonicity contract, tests/test_degrade.py).
+        self.effective_cut = item_cut
         self.counts = np.zeros(capacity, dtype=np.int32)
+
+    def set_effective_cut(self, cut: int) -> None:
+        """Set the cut applied by the next :meth:`fire` (shedding knob)."""
+        self.effective_cut = max(1, min(self.item_cut, cut))
 
     def _ensure(self, max_id: int) -> None:
         if max_id >= len(self.counts):
@@ -75,7 +85,11 @@ class ItemInteractionCut:
             return np.zeros(0, dtype=bool)
         self._ensure(int(items.max()))
         ranks = grouped_rank(items)
-        sampled = (self.counts[items] + ranks) < self.item_cut
+        sampled = (self.counts[items] + ranks) < self.effective_cut
+        # Counter evolution stays governed by the configured fMax (the
+        # clamp), whatever cut the mask applied: a shed window must not
+        # corrupt the cumulative-acceptance state a later NORMAL window
+        # resumes from.
         uniq, n_window = np.unique(items, return_counts=True)
         self.counts[uniq] = np.minimum(self.item_cut, self.counts[uniq] + n_window)
         return sampled

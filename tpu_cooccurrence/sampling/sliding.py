@@ -40,11 +40,22 @@ class SlidingBasketSampler:
                  counters: Optional[Counters] = None) -> None:
         self.item_cut = item_cut
         self.user_cut = user_cut
+        # Degradation plane (robustness/degrade.py): the per-window caps
+        # actually applied. Tighten-only; identity at NORMAL. The sampler
+        # is stateless across windows, so a shed window's tighter caps
+        # can only drop pairs — never reorder or add them.
+        self.effective_item_cut = item_cut
+        self.effective_user_cut = user_cut
         self.skip_cuts = skip_cuts
         self.counters = counters if counters is not None else Counters()
         from ..native import SlidingScratch
 
         self._scratch = SlidingScratch()
+
+    def set_effective_cuts(self, item_cut: int, user_cut: int) -> None:
+        """Set the caps applied by the next :meth:`fire` (shedding knob)."""
+        self.effective_item_cut = max(1, min(self.item_cut, item_cut))
+        self.effective_user_cut = max(1, min(self.user_cut, user_cut))
 
     def fire(self, users: np.ndarray, items: np.ndarray) -> PairDeltaBatch:
         if len(users) == 0:
@@ -56,7 +67,8 @@ class SlidingBasketSampler:
         # sliding oracle.
         from ..native import sliding_expand
 
-        native = sliding_expand(users, items, self.item_cut, self.user_cut,
+        native = sliding_expand(users, items, self.effective_item_cut,
+                                self.effective_user_cut,
                                 self.skip_cuts, self._scratch)
         if native is not None:
             src, dst = native
@@ -68,8 +80,8 @@ class SlidingBasketSampler:
     def _fire_numpy(self, users: np.ndarray,
                     items: np.ndarray) -> PairDeltaBatch:
         if not self.skip_cuts:
-            keep = ((grouped_rank(items) < self.item_cut)
-                    & (grouped_rank(users) < self.user_cut))
+            keep = ((grouped_rank(items) < self.effective_item_cut)
+                    & (grouped_rank(users) < self.effective_user_cut))
             users, items = users[keep], items[keep]
             if len(users) == 0:
                 return PairDeltaBatch.concat([])

@@ -38,12 +38,24 @@ class HostRescorer:
     def __init__(self, top_k: int, counters: Optional[Counters] = None,
                  development_mode: bool = False) -> None:
         self.top_k = top_k
+        # Degradation plane (robustness/degrade.py): the top-K width
+        # actually emitted. Tighten-only; identity at NORMAL. Only the
+        # emitted heap narrows — row/row-sum state is untouched, so a
+        # later NORMAL window re-emits full-width rows from exact state.
+        self.effective_top_k = top_k
         self.counters = counters if counters is not None else Counters()
         self.development_mode = development_mode
         self.item_rows: Dict[int, Dict[int, int]] = {}
         self.global_row_sums: Dict[int, int] = {}
         self.observed: int = 0
         self._heap = TopKHeap(top_k)
+
+    def set_effective_top_k(self, k: int) -> None:
+        """Set the emitted top-K width (shedding knob)."""
+        k = max(1, min(self.top_k, k))
+        if k != self.effective_top_k:
+            self.effective_top_k = k
+            self._heap = TopKHeap(k)
 
     def process_window(self, ts: int, pairs: PairDeltaBatch) -> WindowTopK:
         if len(pairs) == 0:

@@ -52,6 +52,19 @@ class TopKBatch:
         return TopKBatch(np.concatenate(rows_l), np.concatenate(idx_l),
                          np.concatenate(vals_l))
 
+    def truncated(self, k: int) -> "TopKBatch":
+        """This batch narrowed to its first ``k`` result columns.
+
+        Scores are stored descending, so column truncation IS top-k'
+        selection — the degradation plane's result-side shedding knob
+        (``robustness/degrade.py``, level SHED_K): an O(1) numpy slice,
+        no device round-trip and no recompile. Identity when ``k``
+        already covers the batch.
+        """
+        if k >= self.idx.shape[1]:
+            return self
+        return TopKBatch(self.rows, self.idx[:, :k], self.vals[:, :k])
+
 
 def materialize_dense(window_out) -> List[Tuple[int, List[Tuple[int, float]]]]:
     """Expand a backend's window output to (dense item, [(dense, score)]).

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..observability import LEDGER
+from ..robustness import faults
 from ..ops.aggregate import (AggregatedPairs, aggregate_window_coo,
                              distinct_sorted, merge_sorted_insert,
                              narrow_deltas_int32)
@@ -849,6 +850,8 @@ class SparseDeviceScorer:
         # One-window-deep result pipeline (see ops/device_scorer.py).
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
+        # scorer_breaker fault-site ordinal (see ops/device_scorer.py).
+        self._breaker_seq = 0
         # Deferred-results mode: each score dispatch scatters its top-K
         # into a device-resident [2, items_cap, K] table instead of
         # returning it; ``flush()`` fetches the table's touched rows once.
@@ -929,6 +932,10 @@ class SparseDeviceScorer:
     # -- the window step --------------------------------------------------
 
     def process_window(self, ts: int, pairs: PairDeltaBatch):
+        self._breaker_seq += 1
+        if faults.PLAN is not None:
+            # The breaker's trip input (see ops/device_scorer.py).
+            faults.PLAN.fire("scorer_breaker", seq=self._breaker_seq)
         self.last_dispatched_rows = 0
         if len(pairs) == 0:
             if self.defer_results:
