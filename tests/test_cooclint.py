@@ -640,3 +640,75 @@ def test_degrade_registry_requires_architecture_mention(tmp_path):
     assert [f.rule for f in result.findings] == ["degrade-registry"]
     assert "SHED_SAMPLING" in result.findings[0].message
     assert "ARCHITECTURE" in result.findings[0].message
+
+
+# -- rule pack 6: pallas kernel registry --------------------------------
+
+
+def _mini_pallas_repo(tmp_path, *, test_body, arch_body):
+    """A minimal repo for the pallas-kernel-registry rule: one kernel
+    core issuing pallas_call plus a public wrapper calling it."""
+    root = tmp_path / "repo"
+    ops = root / "tpu_cooccurrence" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "pallas_score.py").write_text(
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def _my_kernel_core(x):\n"
+        "    return pl.pallas_call(None)(x)\n\n\n"
+        "def my_kernel_wrapper(x):\n"
+        "    return _my_kernel_core(x)\n")
+    (root / "tests").mkdir()
+    (root / "tests" / "test_parity_fixture.py").write_text(test_body)
+    (root / "docs").mkdir()
+    (root / "docs" / "ARCHITECTURE.md").write_text(arch_body)
+    return root
+
+
+def test_pallas_kernel_registry_wrapper_coverage_passes(tmp_path):
+    """A parity test referencing the public WRAPPER covers the private
+    kernel core (one call hop — the surface tests actually drive)."""
+    root = _mini_pallas_repo(
+        tmp_path,
+        test_body="def test_parity():\n    assert my_kernel_wrapper\n",
+        arch_body="| `_my_kernel_core` | streaming thing |\n")
+    result = Analyzer(str(root), rules=[RULES["pallas-kernel-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_pallas_kernel_registry_flags_untested_kernel(tmp_path):
+    root = _mini_pallas_repo(
+        tmp_path,
+        test_body="def test_nothing():\n    pass\n",
+        arch_body="| `_my_kernel_core` | streaming thing |\n")
+    result = Analyzer(str(root), rules=[RULES["pallas-kernel-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["pallas-kernel-registry"]
+    assert "no registered parity test" in result.findings[0].message
+    assert "_my_kernel_core" in result.findings[0].message
+
+
+def test_pallas_kernel_registry_flags_missing_arch_row(tmp_path):
+    root = _mini_pallas_repo(
+        tmp_path,
+        test_body="def test_parity():\n    assert my_kernel_wrapper\n",
+        arch_body="# arch\n\nno kernel table here\n")
+    result = Analyzer(str(root), rules=[RULES["pallas-kernel-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["pallas-kernel-registry"]
+    assert "Pallas kernel table" in result.findings[0].message
+
+
+def test_pallas_kernel_registry_flags_empty_registry(tmp_path):
+    """ops/pallas_score.py with every pallas_call gone = the registry
+    this rule guards no longer exists; that is a finding, not silence."""
+    root = _mini_pallas_repo(
+        tmp_path,
+        test_body="def test_parity():\n    assert my_kernel_wrapper\n",
+        arch_body="| `_my_kernel_core` |\n")
+    (root / "tpu_cooccurrence" / "ops" / "pallas_score.py").write_text(
+        "def plain(x):\n    return x\n")
+    result = Analyzer(str(root), rules=[RULES["pallas-kernel-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["pallas-kernel-registry"]
+    assert "no pallas_call entry points" in result.findings[0].message

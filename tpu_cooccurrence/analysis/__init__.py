@@ -29,6 +29,9 @@ Layout:
 * :mod:`.rules_degrade` — degradation-level registry drift (every
   ``DegradationLevel`` member documented, journaled, and in the
   ARCHITECTURE level table);
+* :mod:`.rules_fused` — Pallas kernel registry drift (every
+  ``pallas_call`` entry point in ``ops/pallas_score.py`` parity-tested
+  from ``tests/`` and listed in the ARCHITECTURE kernel table);
 * ``__main__`` — the runner: ``python -m tpu_cooccurrence.analysis``
   exits 1 on non-baseline findings (``--format json|text``).
 
@@ -51,6 +54,7 @@ from .core import (  # noqa: F401
 
 # Importing the rule modules registers their rules in RULES.
 from . import rules_degrade  # noqa: F401,E402
+from . import rules_fused  # noqa: F401,E402
 from . import rules_jit  # noqa: F401,E402
 from . import rules_lock  # noqa: F401,E402
 from . import rules_native  # noqa: F401,E402

@@ -1,0 +1,126 @@
+"""Pallas-kernel registry drift.
+
+Every Pallas program entry point in ``ops/pallas_score.py`` — a
+module-level function whose body issues a ``pl.pallas_call`` — is a
+compiled device artifact whose correctness rests entirely on a parity
+test (kernel output vs the XLA/oracle formulation; TPU behavior cannot
+be unit-tested any other way on this CPU-only CI) and whose existence
+is operator-facing contract: the ARCHITECTURE "Pallas kernel table"
+names each one with its role and routing rule. A kernel added without
+both is exactly how the fused-window plane would rot — a Mosaic
+miscompile class (see the float32-id workaround in
+``_score_topk_kernel``) that nothing ever compares against a reference
+implementation, documented nowhere an operator looks.
+
+Coverage is one call hop wide: a private kernel core (e.g.
+``_pallas_topk_gathered``) counts as parity-tested when a module-level
+wrapper that calls it is referenced from ``tests/`` — the wrappers are
+the public surface the tests drive. AST-checked (nothing imported) and
+baseline-free by construction, mirroring the ``degrade-registry`` rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from .core import FileContext, Finding, RepoContext, Rule, register
+
+_PALLAS_PATH = "tpu_cooccurrence/ops/pallas_score.py"
+_ARCH_PATH = "docs/ARCHITECTURE.md"
+
+
+def _module_functions(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    return {node.name: node for node in tree.body
+            if isinstance(node, ast.FunctionDef)}
+
+
+def _called_names(fn: ast.FunctionDef) -> Set[str]:
+    """Last segments of every callee in ``fn``'s body (``pl.pallas_call``
+    -> ``pallas_call``; ``foo(...)`` -> ``foo``)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            out.add(f.attr)
+        elif isinstance(f, ast.Name):
+            out.add(f.id)
+    return out
+
+
+def _kernel_entry_points(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    """Module-level functions that issue a ``pallas_call`` directly."""
+    return {name: fn for name, fn in _module_functions(tree).items()
+            if "pallas_call" in _called_names(fn)}
+
+
+def _test_referenced_names(repo: RepoContext) -> Set[str]:
+    """Every identifier the test suite mentions (names, attributes,
+    imported aliases) — the "registered parity test" evidence."""
+    refs: Set[str] = set()
+    for ctx in repo.python_files():
+        if not ctx.path.startswith("tests/"):
+            continue
+        tree = ctx.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                refs.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                refs.add(node.attr)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    refs.add(alias.name.rsplit(".", 1)[-1])
+    return refs
+
+
+@register
+class FusedKernelRegistryRule(Rule):
+    name = "pallas-kernel-registry"
+    description = ("every Pallas kernel entry point in ops/pallas_score.py "
+                   "needs a registered parity test (referenced from tests/, "
+                   "directly or via a calling wrapper) and a row in the "
+                   "ARCHITECTURE Pallas kernel table")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        src: Optional[FileContext] = next(
+            (c for c in repo.files if c.path == _PALLAS_PATH), None)
+        if src is None or src.tree is None:
+            return
+        kernels = _kernel_entry_points(src.tree)
+        if not kernels:
+            yield Finding(
+                rule=self.name, file=_PALLAS_PATH, line=1,
+                message="no pallas_call entry points found (the kernel "
+                        "registry this rule guards is gone)")
+            return
+        functions = _module_functions(src.tree)
+        # Wrappers: module-level functions that call a kernel entry point
+        # (one hop — the public surface parity tests drive).
+        callers: Dict[str, Set[str]] = {k: set() for k in kernels}
+        for name, fn in functions.items():
+            for callee in _called_names(fn) & set(kernels):
+                if name != callee:
+                    callers[callee].add(name)
+        refs = _test_referenced_names(repo)
+        arch = next((c for c in repo.files if c.path == _ARCH_PATH), None)
+        for kernel, fn in sorted(kernels.items()):
+            covered = kernel in refs or bool(callers[kernel] & refs)
+            if not covered:
+                yield Finding(
+                    rule=self.name, file=_PALLAS_PATH, line=fn.lineno,
+                    message=(f"Pallas kernel entry point {kernel!r} has no "
+                             f"registered parity test: nothing under "
+                             f"tests/ references it (or a wrapper that "
+                             f"calls it) — a kernel nothing compares "
+                             f"against a reference is a silent-miscompile "
+                             f"risk"))
+            if arch is not None and kernel not in arch.source:
+                yield Finding(
+                    rule=self.name, file=_PALLAS_PATH, line=fn.lineno,
+                    message=(f"Pallas kernel entry point {kernel!r} is not "
+                             f"in {_ARCH_PATH} — add it to the Pallas "
+                             f"kernel table"))

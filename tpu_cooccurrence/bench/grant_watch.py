@@ -172,11 +172,25 @@ def _boost_stage_priority(pid: int) -> None:
     background suites/sweeps instead of letting contention inflate
     measured host walls. PRIO_PGRP (the stage leads its own group via
     start_new_session) renices the leader AND any grandchildren it
-    managed to fork before this call lands; later forks inherit."""
+    managed to fork before this call lands; later forks inherit.
+
+    Sandbox caveat (root-caused 2026-08-03): gVisor kernels (``runsc``,
+    reporting Linux 4.4.0) ACCEPT ``PRIO_PGRP`` and return success
+    without applying it — every group member keeps niceness 0. So the
+    group renice is verified via ``getpriority`` on the leader and,
+    when it did not land (gVisor, or the leader's ``setsid`` racing
+    this call so the group id does not exist yet), the leader is
+    reniced directly with ``PRIO_PROCESS``; grandchildren forked after
+    that inherit its niceness."""
     try:
-        os.setpriority(os.PRIO_PGRP, pid, -10)
+        try:
+            os.setpriority(os.PRIO_PGRP, pid, -10)
+        except OSError:
+            pass  # group not born yet: fall through to the leader
+        if os.getpriority(os.PRIO_PROCESS, pid) > -10:
+            os.setpriority(os.PRIO_PROCESS, pid, -10)
     except OSError:
-        pass  # not privileged (needs CAP_SYS_NICE): normal priority
+        pass  # not privileged (needs CAP_SYS_NICE) or stage already gone
 
 
 #: Error-text markers that identify a TRANSIENT on-chip failure — the

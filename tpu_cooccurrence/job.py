@@ -118,6 +118,16 @@ class CooccurrenceJob:
                 config.user_cut, config.seed, config.skip_cuts,
                 counters=self.counters)
         self.scorer = scorer if scorer is not None else self._make_scorer()
+        if (getattr(self.scorer, "use_fused", False)
+                and isinstance(self.sampler, UserReservoirSampler)):
+            # Fused-window uplink (--fused-window, ops/device_scorer):
+            # the sampler hands the scorer un-expanded baskets — host
+            # expansion and the 3x-wider COO uplink disappear for
+            # fused-routable windows; non-routable ones expand host-side
+            # inside the scorer (bit-identical either way). Gated on the
+            # tumbling reservoir sampler: sliding/partitioned samplers
+            # stay on the expanded-COO contract.
+            self.sampler.emit_baskets = True
         if config.partition_sampling and not self.sliding:
             # Sliding mode is exempt: its partitioned sampler is stateless
             # (nothing partition-distinct ever reaches a checkpoint).
@@ -172,6 +182,18 @@ class CooccurrenceJob:
         self._hist_score = REGISTRY.histogram(
             "cooc_window_score_seconds",
             help="scorer stage seconds per fired window")
+        # Fused-vs-chained wall-time split (--fused-window): the same
+        # stage seconds, bucketed by which dispatch path the window
+        # took, so the fused win (or CPU-fallback neutrality) is a
+        # first-class distribution in bench JSON and /metrics.
+        self._hist_score_fused = REGISTRY.histogram(
+            "cooc_window_score_seconds_fused",
+            help="scorer stage seconds for windows on the fused "
+                 "one-dispatch path")
+        self._hist_score_chained = REGISTRY.histogram(
+            "cooc_window_score_seconds_chained",
+            help="scorer stage seconds for windows on the chained "
+                 "scatter+score path")
         self._hist_total = REGISTRY.histogram(
             "cooc_window_total_seconds",
             help="sample+score seconds per fired window")
@@ -267,7 +289,8 @@ class CooccurrenceJob:
                 max_pairs_per_step=self.config.max_pairs_per_step,
                 use_pallas=self.config.pallas,
                 count_dtype=self.config.count_dtype,
-                defer_results=not self.config.emit_updates))
+                defer_results=not self.config.emit_updates,
+                fused_window=self.config.fused_window))
         if backend == Backend.SPARSE:
             fixed = self._parse_fixed_score()
             if self.config.num_shards > 1:
@@ -577,6 +600,12 @@ class CooccurrenceJob:
         self._hist_score.observe(stats.score_seconds)
         self._hist_total.observe(stats.seconds)
         self._hist_uplink.observe(wire_delta["h2d_bytes"])
+        # Dispatch-path split: only backends that expose the flag
+        # (DeviceScorer, incl. behind the breaker wrapper) participate.
+        fused = getattr(self.scorer, "last_dispatch_fused", None)
+        if fused is not None:
+            (self._hist_score_fused if fused
+             else self._hist_score_chained).observe(stats.score_seconds)
         self._gauge_windows.set(seq)
         self._gauge_last_window.set(time.time())
         level = degrade_events = None
@@ -607,6 +636,8 @@ class CooccurrenceJob:
                 rec["degradation_level"] = level
                 if degrade_events:
                     rec["degrade_events"] = degrade_events
+            if fused is not None:
+                rec["fused"] = int(fused)
             breaker_state = getattr(self.scorer, "breaker_state", None)
             if breaker_state is not None:
                 rec["breaker_state"] = breaker_state

@@ -69,6 +69,10 @@ SCHEMA = {
                                          # window's observation applied
     "breaker_state": (False, str),       # scorer circuit breaker state
                                          # (closed | half_open | open)
+    "fused": (False, int),               # 1 = this window took the fused
+                                         # one-dispatch path, 0 = chained
+                                         # (present for device-backend
+                                         # runs only)
 }
 
 

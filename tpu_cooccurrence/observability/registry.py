@@ -72,6 +72,12 @@ CANONICAL_METRICS = frozenset({
     # pipelined execution (pipeline.py)
     "cooc_pipeline_queue_wait_seconds",
     "cooc_pipeline_ring_depth",
+    # fused one-dispatch window path (--fused-window; job.py splits the
+    # score-stage seconds, ops/device_scorer.py counts the dispatches)
+    "cooc_fused_dispatches_total",
+    "cooc_chained_dispatches_total",
+    "cooc_window_score_seconds_fused",
+    "cooc_window_score_seconds_chained",
     # checkpoint plane (state/checkpoint.py)
     "cooc_checkpoint_quarantined_total",
     "cooc_checkpoint_generation",

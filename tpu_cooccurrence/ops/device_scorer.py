@@ -45,8 +45,9 @@ import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..observability import LEDGER
+from ..observability.registry import REGISTRY
 from ..robustness import faults
-from ..sampling.reservoir import PairDeltaBatch
+from ..sampling.reservoir import BasketBatch, PairDeltaBatch
 from ..state.results import TopKBatch
 from .aggregate import (aggregate_window_coo, distinct_sorted,
                         narrow_deltas_int32)
@@ -114,6 +115,26 @@ def resolve_pallas_flag(use_pallas: str, count_dtype, top_k: int) -> bool:
     if use_pallas in ("on", "off"):
         return use_pallas == "on"
     raise ValueError(f"use_pallas must be auto|on|off, got {use_pallas!r}")
+
+
+def resolve_fused_flag(fused_window: str) -> bool:
+    """Resolve an ``auto|on|off`` --fused-window request.
+
+    ``auto`` is the on-chip gate: the fused one-dispatch window only
+    engages on a real TPU, where per-window dispatch count and uplink
+    bytes are wall-clock (the tunneled link's measured regime,
+    TPU_ROUND2.jsonl). Off-TPU the expansion kernel would run
+    interpreted — a debug path, not a fast path — so the CPU fallback
+    stays on the chained scatter+score pipeline ('on' still forces it
+    for parity tests). Default 'off' until the on-chip A/B lands a
+    measured win in bench_history.jsonl.
+    """
+    if fused_window not in ("auto", "on", "off"):
+        raise ValueError(
+            f"fused_window must be auto|on|off, got {fused_window!r}")
+    if fused_window == "auto":
+        return jax.default_backend() == "tpu"
+    return fused_window == "on"
 
 
 def score_row_budget(num_items: int, cap: int) -> int:
@@ -306,8 +327,12 @@ def topk_padded(scores, top_k: int):
     return vals, idx
 
 
-@functools.partial(jax.jit, static_argnames=("top_k", "packed"))
-def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
+def _score_body(C, row_sums, rows, observed, top_k: int,
+                packed: bool = False):
+    # Shared between the chained `_score` jit and the fused window
+    # program (`_fused_window_emit`/`_defer`): one body, so the two
+    # dispatch shapes cannot drift numerically — the fused path's
+    # bit-identical-to-chained contract rides on this.
     counts = C[rows]  # [S, I] int32
     k11 = counts.astype(jnp.float32)
     rs = row_sums.astype(jnp.float32)
@@ -323,6 +348,118 @@ def _score(C, row_sums, rows, observed, top_k: int, packed: bool = False):
         # One fused [2, S, K] float32 result => a single device->host fetch.
         return jnp.stack([vals, jax.lax.bitcast_convert_type(idx, jnp.float32)])
     return vals, idx
+
+
+_score = functools.partial(jax.jit, static_argnames=("top_k", "packed"))(
+    _score_body)
+
+
+def _fused_apply_baskets(C, row_sums, block, num_items: int,
+                         basket_width: int, interpret: bool):
+    """Expansion + scatter half of the fused window program.
+
+    ``block`` is the single packed ``[N, W + 4]`` int32 uplink: the
+    basket rectangle plus the (new, len, skip, sign) meta columns. The
+    expansion runs in the Pallas kernel
+    (``pallas_score.pallas_expand_baskets``); the scatter-add stays an
+    XLA op inside the same program — Mosaic cannot scatter to arbitrary
+    HBM rows, the same boundary that keeps the dense score kernel's
+    ``C[rows]`` gather in XLA. Invalid/padded lanes carry (0, 0, 0):
+    the scatter no-op triple, so no masking is needed here.
+    """
+    from .pallas_score import pallas_expand_baskets
+
+    w = basket_width
+    basket = block[:, :w]
+    new = block[:, w:w + 1]
+    lens = block[:, w + 1:w + 2]
+    skips = block[:, w + 2:w + 3]
+    signs = block[:, w + 3:w + 4]
+    src, dst, delta = pallas_expand_baskets(basket, new, lens, skips, signs,
+                                            interpret=interpret)
+    return _apply_coo(C, row_sums, src.reshape(-1), dst.reshape(-1),
+                      delta.reshape(-1), num_items)
+
+
+def _fused_score_packed(C, row_sums, rows, observed, top_k: int,
+                        use_pallas: bool, tile: int, interpret: bool):
+    """Score half of the fused program: the SAME math as the chained
+    path — ``_score_body`` when the Pallas score kernel is off, the
+    shared ``_pallas_topk_gathered`` core when it is on — so fused and
+    chained results are bitwise equal, not just close."""
+    if not use_pallas:
+        return _score_body(C, row_sums, rows, observed, top_k, packed=True)
+    from .pallas_score import _pallas_topk_gathered, row_block
+
+    blk = row_block(C.dtype)
+    sp = rows.shape[0]  # caller pads to a pow4 bucket (a blk multiple)
+    gathered = C[rows]
+    rsi = row_sums[rows].reshape(sp, 1)
+    rs2d = row_sums.reshape(1, C.shape[0])
+    vals, idx = _pallas_topk_gathered(gathered, rs2d, rsi, observed,
+                                      top_k=top_k, tile=tile, blk=blk,
+                                      interpret=interpret)
+    # Value-space id packing, exactly like pallas_score_topk(packed=True).
+    return jnp.stack([vals[:, :top_k], idx[:, :top_k]])
+
+
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1),
+                   static_argnames=("num_items", "basket_width", "top_k",
+                                    "use_pallas", "tile", "interpret"))
+def _fused_window_emit(C, row_sums, block, rows, observed, *, num_items: int,
+                       basket_width: int, top_k: int, use_pallas: bool,
+                       tile: int, interpret: bool):
+    """ONE-dispatch fused window (streaming-results form): on-chip
+    basket expansion + count scatter + row-sum maintenance + LLR rescore
+    + per-row top-K, one XLA program per (ops-bucket, basket-bucket,
+    rows-bucket) shape triple. Replaces the chained path's separate
+    update and score dispatches and its 3x-wider COO uplink."""
+    C, row_sums = _fused_apply_baskets(C, row_sums, block, num_items,
+                                       basket_width, interpret)
+    packed = _fused_score_packed(C, row_sums, rows, observed, top_k,
+                                 use_pallas, tile, interpret)
+    return C, row_sums, packed
+
+
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1, 2),
+                   static_argnames=("num_items", "basket_width", "top_k",
+                                    "use_pallas", "tile", "interpret"))
+def _fused_window_defer(C, row_sums, tbl, block, rows, scatter_rows,
+                        observed, *, num_items: int, basket_width: int,
+                        top_k: int, use_pallas: bool, tile: int,
+                        interpret: bool):
+    """Deferred-results form of :func:`_fused_window_emit`: the packed
+    top-K scatters into the device-resident results table inside the
+    same program — a steady-state window is literally one dispatch and
+    zero result downlink. Padded score rows carry the ``_SENT_ROW``
+    sentinel and drop out of the scatter."""
+    C, row_sums = _fused_apply_baskets(C, row_sums, block, num_items,
+                                       basket_width, interpret)
+    packed = _fused_score_packed(C, row_sums, rows, observed, top_k,
+                                 use_pallas, tile, interpret)
+    return C, row_sums, tbl.at[:, scatter_rows].set(packed, mode="drop")
+
+
+def check_coo_chunk(coo: np.ndarray, n: int) -> None:
+    """Pad-slot invariant guard for packed COO chunks (regression).
+
+    The chained path's correctness under padding rests on two facts: a
+    chunk's ``n`` real entries fit its padded buffer (a chunk larger
+    than ``max_pairs_per_step``'s bucket must never silently truncate),
+    and every pad slot carries the ``(0, 0) delta == 0`` triple whose
+    scatter-add is a no-op. Both held by construction until someone
+    reuses buffers; this check makes a violation an error at the
+    window that caused it, not a silently-wrong count matrix. O(pad)
+    over a buffer the caller just wrote — noise next to the fold.
+    """
+    if n > coo.shape[1]:
+        raise AssertionError(
+            f"COO chunk holds {n} entries but its padded buffer is only "
+            f"{coo.shape[1]} wide — entries would be silently truncated")
+    if n < coo.shape[1] and coo[:, n:].any():
+        raise AssertionError(
+            "COO pad slots must stay (0, 0) delta == 0: a nonzero pad "
+            "slot would scatter garbage into C")
 
 
 # Result-table scatter sentinel for padded score rows: >= any vocab
@@ -440,7 +577,8 @@ class DeviceScorer:
                  use_pallas: str = "auto",
                  count_dtype: str = "int32",
                  device=None,
-                 defer_results: bool = False) -> None:
+                 defer_results: bool = False,
+                 fused_window: str = "off") -> None:
         from ..xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
@@ -453,6 +591,22 @@ class DeviceScorer:
         self.max_pairs_per_step = max_pairs_per_step
         self.use_pallas = resolve_pallas_flag(use_pallas, self.count_dtype,
                                               top_k)
+        # Fused one-dispatch window path (--fused-window): the sampler
+        # uplinks baskets instead of expanded COO and expansion + count
+        # update + rescore + top-K run as one program per shape triple.
+        # The job enables basket emission iff this resolved True.
+        self.use_fused = resolve_fused_flag(fused_window)
+        # Which path the LAST process_window dispatch took — the job's
+        # fused-vs-chained wall-time split and journal field read it.
+        self.last_dispatch_fused = False
+        self._fused_dispatches = REGISTRY.gauge(
+            "cooc_fused_dispatches_total",
+            help="windows dispatched through the fused one-dispatch "
+                 "window program")
+        self._chained_dispatches = REGISTRY.gauge(
+            "cooc_chained_dispatches_total",
+            help="windows dispatched through the chained "
+                 "scatter+score path")
         # Off-TPU the kernel can only run interpreted (test/debug use).
         self._pallas_interpret = jax.default_backend() != "tpu"
         # num_items == 0: derive the vocab from the data — start at a
@@ -514,13 +668,23 @@ class DeviceScorer:
         if self._results is not None:
             self._results.resize(n)
 
-    def process_window(self, ts: int, pairs: PairDeltaBatch) -> TopKBatch:
+    def process_window(self, ts: int, pairs) -> TopKBatch:
         self._breaker_seq += 1
         if faults.PLAN is not None:
             # The breaker's trip input: an injected exception here is a
             # failed device dispatch the ScorerCircuitBreaker absorbs.
             faults.PLAN.fire("scorer_breaker", seq=self._breaker_seq)
         self.last_dispatched_rows = 0
+        self.last_dispatch_fused = False
+        if isinstance(pairs, BasketBatch):
+            if self.use_fused:
+                routed = self._try_fused(ts, pairs)
+                if routed is not None:
+                    return routed
+            # Not fused-routable (oversized window / kernel limit) or
+            # fused resolved off: expand host-side and run the chained
+            # path — the same pair multiset, so results are identical.
+            pairs = pairs.to_pairs()
         if len(pairs) == 0:
             if self.defer_results:
                 # Nothing in flight; results wait for the final flush.
@@ -562,6 +726,7 @@ class DeviceScorer:
                 update = _update_coo
             coo[0, :n] = src[lo: lo + n]
             coo[1, :n] = dst[lo: lo + n]
+            check_coo_chunk(coo, n)
             parts = split_upload_auto(coo)
             if parts is not None:
                 for p in parts:
@@ -583,6 +748,7 @@ class DeviceScorer:
         rows = distinct_sorted(src)
         self.counters.add(RESCORED_ITEMS, len(rows))
         self.last_dispatched_rows = len(rows)
+        self._chained_dispatches.add(1)
         if self.defer_results:
             self._results.ensure()
         chunks: List[Tuple[np.ndarray, int, object]] = []
@@ -618,6 +784,104 @@ class DeviceScorer:
             self._results.mark(rows)
             return TopKBatch.empty(self.top_k)
         prev, self._pending = self._pending, chunks
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
+
+    def _try_fused(self, ts: int, b: BasketBatch) -> Optional[TopKBatch]:
+        """Run one window through the fused one-dispatch program, or
+        return ``None`` when the window is not fused-routable — the
+        caller then expands host-side and takes the chained path, which
+        produces identical results (same pair multiset, same score
+        math). Not routable: zero-pair windows (the chained empty-window
+        contract applies), windows whose padded expansion lanes exceed
+        the ``max_pairs_per_step`` chunk budget, rescore sets beyond one
+        score chunk, and configurations the Pallas score kernel itself
+        rejects on the chained path (vocab > 2^24, K > lane width) —
+        the chained path raises the canonical error for those.
+        """
+        per_op = b.pairs_per_op()
+        n_pairs = int(per_op.sum())
+        if n_pairs == 0:
+            return None
+        if self.use_pallas:
+            from .pallas_score import _K_PAD
+
+            if self.top_k > _K_PAD or self.num_items > (1 << 24):
+                return None
+        valid = b._valid()
+        active = per_op > 0
+        self._ensure_capacity(int(max(b.new_items[active].max(),
+                                      b.baskets[valid].max())))
+        n_ops = b.n_ops
+        n_cap = pad_pow2(n_ops, minimum=64)
+        l_cap = pad_pow2(max(int(b.baskets.shape[1]), 1), minimum=128)
+        if 2 * n_cap * l_cap > self.max_pairs_per_step:
+            # The expanded lanes would exceed the chained path's COO
+            # chunk budget (HBM working-set bound): oversized windows
+            # stay chained, where chunking already handles them.
+            return None
+        # Rescore set: every item touched by an emitted pair — the
+        # union of active star items and valid basket cells, exactly
+        # the chained path's distinct_sorted(src) set (np.unique sorts).
+        rows = np.unique(np.concatenate([
+            b.new_items[active].astype(np.int64),
+            b.baskets[valid].astype(np.int64)])).astype(np.int32)
+        if len(rows) > self.max_score_rows:
+            return None
+
+        # Single packed uplink: basket rectangle + 4 meta columns. Pad
+        # ops carry (len 0, sign 0) — zero expanded lanes. Basket cells
+        # beyond each op's len ride up unspecified and are masked
+        # in-kernel, same contract as the sampler's storage.
+        blockbuf = np.zeros((n_cap, l_cap + 4), dtype=np.int32)
+        w = b.baskets.shape[1]
+        if w:
+            blockbuf[:n_ops, :w] = b.baskets
+        blockbuf[:, l_cap + 2] = -1
+        blockbuf[:n_ops, l_cap] = b.new_items
+        blockbuf[:n_ops, l_cap + 1] = b.lens
+        blockbuf[:n_ops, l_cap + 2] = b.skips
+        blockbuf[:n_ops, l_cap + 3] = b.signs
+
+        # Exact host-side observed tracking, identical to the chained
+        # path's pairs.delta.sum(): each op contributes 2 * sign * pairs.
+        window_sum = int((2 * b.signs.astype(np.int64) * per_op).sum())
+        self.observed += window_sum
+        self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
+        self.counters.add(RESCORED_ITEMS, len(rows))
+        self.last_dispatched_rows = len(rows)
+        self.last_dispatch_fused = True
+        self._fused_dispatches.add(1)
+
+        s = len(rows)
+        pad_s = min(pad_pow4(s, minimum=64), self.max_score_rows)
+        rows_padded = np.zeros(pad_s, dtype=np.int32)
+        rows_padded[:s] = rows
+        observed = np.float32(self.observed)
+        if self.defer_results:
+            self._results.ensure()
+            # Padded entries gather row 0 but must NOT scatter there.
+            scatter_rows = np.full(pad_s, _SENT_ROW, dtype=np.int32)
+            scatter_rows[:s] = rows
+            LEDGER.up("fused-window", blockbuf, rows_padded, scatter_rows)
+            self.C, self.row_sums, self._results.tbl = _fused_window_defer(
+                self.C, self.row_sums, self._results.tbl, blockbuf,
+                rows_padded, scatter_rows, observed,
+                num_items=self.num_items, basket_width=l_cap,
+                top_k=self.top_k, use_pallas=self.use_pallas,
+                tile=self.PALLAS_TILE, interpret=self._pallas_interpret)
+            self._results.mark(rows)
+            return TopKBatch.empty(self.top_k)
+        LEDGER.up("fused-window", blockbuf, rows_padded)
+        self.C, self.row_sums, packed = _fused_window_emit(
+            self.C, self.row_sums, blockbuf, rows_padded, observed,
+            num_items=self.num_items, basket_width=l_cap,
+            top_k=self.top_k, use_pallas=self.use_pallas,
+            tile=self.PALLAS_TILE, interpret=self._pallas_interpret)
+        if hasattr(packed, "copy_to_host_async"):
+            packed.copy_to_host_async()
+        # Same one-window-behind result pipeline as the chained path.
+        prev, self._pending = self._pending, [(rows, s, packed)]
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
 

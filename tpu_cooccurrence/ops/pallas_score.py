@@ -433,6 +433,94 @@ def pallas_score_rect(cnt, dst, row_sums, meta, observed, *, top_k: int,
                       jax.lax.bitcast_convert_type(ids, jnp.float32)])
 
 
+def _expand_kernel(basket_ref, new_ref, len_ref, skip_ref, sign_ref,
+                   src_ref, dst_ref, delta_ref, *, width, block):
+    """On-chip basket expansion: one star op per row.
+
+    Row ``r`` expands op ``(new, basket[:len], skip, sign)`` into the
+    ``2 * width`` COO lanes ``[new -> basket[j] | j] ++ [basket[j] ->
+    new | j]`` with ``delta = sign`` on the valid lanes (``j < len``,
+    ``j != skip``) and the padded ``(0, 0, 0)`` no-op triple everywhere
+    else — the same pad-slot invariant the chained COO upload carries
+    (``device_scorer.process_window``), so the scatter that consumes
+    these lanes needs no masking. Pure VPU selects over a column iota;
+    no cross-lane traffic.
+    """
+    R = block
+    basket = basket_ref[...]                            # [R, W] int32
+    new = new_ref[...]                                  # [R, 1] int32
+    lens = len_ref[...]                                 # [R, 1] int32
+    skip = skip_ref[...]                                # [R, 1] int32
+    sign = sign_ref[...]                                # [R, 1] int32
+    j = jax.lax.broadcasted_iota(jnp.int32, (R, width), dimension=1)
+    valid = (j < lens) & (j != skip)
+    zero = jnp.zeros((R, width), dtype=jnp.int32)
+    fwd_src = jnp.where(valid, new + zero, zero)
+    fwd_dst = jnp.where(valid, basket, zero)
+    d = jnp.where(valid, sign + zero, zero)
+    src_ref[...] = jnp.concatenate([fwd_src, fwd_dst], axis=1)
+    dst_ref[...] = jnp.concatenate([fwd_dst, fwd_src], axis=1)
+    delta_ref[...] = jnp.concatenate([d, d], axis=1)
+
+
+#: Ops-axis block of the expansion kernel (int32 sublane tile).
+_EXPAND_BLOCK = 8
+
+
+def pallas_expand_baskets(basket, new, lens, skips, signs, *,
+                          interpret: bool = False):
+    """Expand a padded basket tensor into COO pair-delta lanes on chip.
+
+    The device half of the fused window dispatch
+    (``device_scorer._fused_window_emit``/``_defer``): takes the padded
+    per-op basket rectangle the host uplinked and produces the
+    ``(src, dst, delta)`` lanes the count scatter consumes, replacing
+    the host-side ``native/reservoir_expand.cpp`` expansion plus the
+    3x-wider COO uplink.
+
+    basket [N, W] int32 — partner rows (cells at ``j >= len`` are
+                          UNSPECIFIED, masked in-kernel; ``W % 128 == 0``)
+    new/lens/skips/signs [N, 1] int32 — star item, valid-cell count,
+                          excluded column (-1 = none), delta sign
+                          (padded ops: len 0, sign 0)
+    Returns ``(src, dst, delta)`` each [N, 2W] int32; invalid lanes
+    carry the (0, 0, 0) scatter no-op triple.
+    """
+    n, width = basket.shape
+    if n % _EXPAND_BLOCK:
+        raise ValueError(
+            f"op count {n} must be a multiple of {_EXPAND_BLOCK} "
+            f"(pad the ops axis)")
+    if width % 128:
+        raise ValueError(
+            f"basket width {width} must be a multiple of 128 lanes")
+    kernel = functools.partial(_expand_kernel, width=width,
+                               block=_EXPAND_BLOCK)
+    blk = _EXPAND_BLOCK
+    return pl.pallas_call(
+        kernel,
+        grid=(n // blk,),
+        in_specs=[
+            pl.BlockSpec((blk, width), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((blk, 2 * width), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 2 * width), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 2 * width), lambda i: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, 2 * width), jnp.int32),
+            jax.ShapeDtypeStruct((n, 2 * width), jnp.int32),
+            jax.ShapeDtypeStruct((n, 2 * width), jnp.int32),
+        ),
+        interpret=interpret,
+    )(basket, new, lens, skips, signs)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("top_k", "tile", "interpret", "packed"))
 def pallas_score_topk(C, row_sums, rows, observed, *, top_k: int,

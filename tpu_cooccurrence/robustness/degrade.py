@@ -374,10 +374,21 @@ class ScorerCircuitBreaker:
             self._fallback = HostRescorer(self.top_k, self.counters)
         return self._fallback
 
+    def _mirror_dispatch_path(self, fused) -> None:
+        """Keep the journal's ``fused`` field honest through the
+        wrapper: once the primary exposes ``last_dispatch_fused``, the
+        breaker shadows it per window — a fallback-scored window is
+        never a fused dispatch, whatever the primary's stale flag says.
+        Backends without the flag stay without it (the field remains
+        absent from their journal records)."""
+        if getattr(self.primary, "last_dispatch_fused", None) is not None:
+            self.last_dispatch_fused = fused
+
     def _fallback_process(self, ts, pairs):
         out = self._ensure_fallback().process_window(ts, pairs)
         self._fallback_owned.update(item for item, _ in out)
         self.last_dispatched_rows = len(out)
+        self._mirror_dispatch_path(False)
         return out
 
     def process_window(self, ts, pairs):
@@ -416,6 +427,8 @@ class ScorerCircuitBreaker:
                 int(i) for i in set(pairs.src.tolist()))
         self.last_dispatched_rows = getattr(
             self.primary, "last_dispatched_rows", len(out))
+        self._mirror_dispatch_path(
+            getattr(self.primary, "last_dispatch_fused", False))
         return out
 
     def flush(self):
