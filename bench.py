@@ -38,19 +38,24 @@ from tpu_cooccurrence.bench.grant_watch import (
 
 def run(backend: str, users, items, ts, num_items: int, window_ms: int,
         pipeline_depth: int = 0, journal: str = None,
-        fused_window: str = "off"):
+        fused_window: str = "off", wire_format: str = "auto",
+        cell_dtype: str = "auto"):
     from tpu_cooccurrence.config import Backend, Config
     from tpu_cooccurrence.job import CooccurrenceJob
     from tpu_cooccurrence.metrics import OBSERVED_COOCCURRENCES
+    from tpu_cooccurrence.observability import LEDGER
     from tpu_cooccurrence.observability.registry import REGISTRY
 
-    # Per-run metrics scope: the registry is process-global, so clear it
-    # here and the summaries below describe exactly this run's windows.
+    # Per-run metrics scope: the registry and ledger are process-global,
+    # so clear them here and the summaries below describe exactly this
+    # run's windows.
     REGISTRY.reset()
+    LEDGER.reset()
     cfg = Config(window_size=window_ms, seed=0xC0FFEE, item_cut=500,
                  user_cut=500, backend=Backend(backend), num_items=num_items,
                  pipeline_depth=pipeline_depth, journal=journal,
-                 fused_window=fused_window)
+                 fused_window=fused_window, wire_format=wire_format,
+                 cell_dtype=cell_dtype)
     job = CooccurrenceJob(cfg)
     start = time.monotonic()
     job.add_batch(users, items, ts)
@@ -80,8 +85,25 @@ def run(backend: str, users, items, ts, num_items: int, window_ms: int,
         "chained_dispatches": int(
             REGISTRY.gauge("cooc_chained_dispatches_total").get()),
     }
+    # Compressed-state accounting (sparse backend; zeros elsewhere): the
+    # raw-vs-encoded uplink pair from the ledger, plus the host index /
+    # device slab footprint gauges the scorer refreshes per window.
+    snap = LEDGER.snapshot()
+    windows = max(int(REGISTRY.gauge("cooc_windows_fired").get()), 1)
+    wire = {
+        "windows": windows,
+        "uplink_bytes_raw": snap["uplink_raw_bytes"],
+        "uplink_bytes_encoded": snap["uplink_enc_bytes"],
+        "h2d_bytes": snap["h2d_bytes"],
+        "host_index_rss_bytes": int(
+            REGISTRY.gauge("cooc_host_index_rss_bytes").get()),
+        "slab_device_bytes": int(
+            REGISTRY.gauge("cooc_slab_device_bytes").get()),
+        "slab_live_cells": int(
+            REGISTRY.gauge("cooc_slab_live_cells").get()),
+    }
     return pairs, elapsed, job.step_timer.occupancy(elapsed), \
-        REGISTRY.summaries(), degradation, dispatches
+        REGISTRY.summaries(), degradation, dispatches, wire
 
 
 def _uplink_per_window(latency: dict) -> float:
@@ -104,7 +126,7 @@ from tpu_cooccurrence.bench.grant_watch import probe_backend
 def _record_onchip(value: float, vs_baseline: float, backend: str,
                    pipeline_depth: int, occupancy: dict,
                    latency: dict = None, degradation: dict = None,
-                   fused: dict = None) -> None:
+                   fused: dict = None, compression: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -129,6 +151,11 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         entry["degradation"] = degradation
     if fused:
         entry["fused"] = fused
+    if compression:
+        # The PR-7 A/B: uplink_bytes_raw / uplink_bytes_encoded /
+        # host_index_rss_bytes and effective-cells-per-byte per dtype,
+        # trajectory-visible like the fused arm.
+        entry["compression"] = compression
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -195,7 +222,7 @@ def measure() -> None:
     # contention. The occupancy/latency published are the median run's.
     samples = []
     for _ in range(3):
-        pairs, elapsed, occupancy, latency, degradation, _ = run(
+        pairs, elapsed, occupancy, latency, degradation, _, _ = run(
             "device", users, items, ts, num_items=n_items, window_ms=100,
             pipeline_depth=pipeline_depth, journal=journal)
         samples.append((pairs / max(elapsed, 1e-9), occupancy, latency,
@@ -218,7 +245,7 @@ def measure() -> None:
         pipeline_depth=pipeline_depth, fused_window="auto")
     f_samples = []
     for _ in range(3):
-        f_pairs, f_elapsed, _, f_latency, _, f_dispatches = run(
+        f_pairs, f_elapsed, _, f_latency, _, f_dispatches, _ = run(
             "device", users, items, ts, num_items=n_items, window_ms=100,
             pipeline_depth=pipeline_depth, journal=journal,
             fused_window="auto")
@@ -235,6 +262,62 @@ def measure() -> None:
         **f_dispatches,
     }
 
+    # Compression A/B arm (sparse backend): raw int32 slab + raw wire vs
+    # the PR-7 compressed default (int16 cells with wide-promotion +
+    # packed delta/bit-packed uplink + bitmap row index). Same
+    # methodology as the fused arm — per-arm untimed warmup, median of
+    # three — on a truncated stream (the sparse CPU path is slower than
+    # dense and the arm measures *wire/footprint* ratios, which converge
+    # long before throughput medians do). Ledger-measured: the uplink
+    # cut and the effective-cells-per-slab-byte pair are the tentpole's
+    # headline numbers.
+    comp_events = min(len(users),
+                      int(os.environ.get("BENCH_COMPRESS_EVENTS", 120_000)))
+    cu, ci, ct = users[:comp_events], items[:comp_events], ts[:comp_events]
+
+    def _comp_arm(wire, cell):
+        run("sparse", cu, ci, ct, num_items=n_items, window_ms=100,
+            wire_format=wire, cell_dtype=cell)  # warmup (compiles)
+        arm = []
+        for _ in range(3):
+            c_pairs, c_elapsed, _, _, _, _, c_wire = run(
+                "sparse", cu, ci, ct, num_items=n_items, window_ms=100,
+                wire_format=wire, cell_dtype=cell)
+            arm.append((c_pairs / max(c_elapsed, 1e-9), c_wire))
+        arm.sort(key=lambda s: s[0])
+        return arm[1]
+
+    raw_rate, raw_wire = _comp_arm("raw", "int32")
+    pkd_rate, pkd_wire = _comp_arm("packed", "int16")
+
+    def _cells_per_byte(w):
+        return round(w["slab_live_cells"] / max(w["slab_device_bytes"], 1),
+                     4)
+
+    windows_pkd = max(pkd_wire["windows"], 1)
+    compression = {
+        "events": comp_events,
+        "pairs_per_sec_raw": round(raw_rate, 1),
+        "pairs_per_sec_packed": round(pkd_rate, 1),
+        "vs_raw": round(pkd_rate / max(raw_rate, 1e-9), 3),
+        # Ledger-measured per-window uplink pair: what the raw layout
+        # would have shipped vs what the packed encoder actually shipped
+        # (same run, so the two describe identical windows).
+        "uplink_bytes_raw": round(
+            pkd_wire["uplink_bytes_raw"] / windows_pkd, 1),
+        "uplink_bytes_encoded": round(
+            pkd_wire["uplink_bytes_encoded"] / windows_pkd, 1),
+        "uplink_cut": round(
+            pkd_wire["uplink_bytes_raw"]
+            / max(pkd_wire["uplink_bytes_encoded"], 1), 2),
+        "host_index_rss_bytes": pkd_wire["host_index_rss_bytes"],
+        "host_index_rss_bytes_raw_arm": raw_wire["host_index_rss_bytes"],
+        "effective_cells_per_byte": {
+            "int32": _cells_per_byte(raw_wire),
+            "int16": _cells_per_byte(pkd_wire),
+        },
+    }
+
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.
     baseline_path = os.path.join(REPO, ".bench_baseline.json")
@@ -242,7 +325,7 @@ def measure() -> None:
         with open(baseline_path) as f:
             baseline = json.load(f)["pairs_per_sec"]
     else:
-        b_pairs, b_elapsed, _, _, _, _ = run("oracle", users, items, ts,
+        b_pairs, b_elapsed, _, _, _, _, _ = run("oracle", users, items, ts,
                                              num_items=n_items,
                                              window_ms=100)
         baseline = b_pairs / max(b_elapsed, 1e-9)
@@ -262,6 +345,7 @@ def measure() -> None:
         "latency": latency,
         "degradation": degradation,
         "fused": fused_info,
+        "compression": compression,
     }
     if journal:
         out["journal"] = journal
@@ -282,7 +366,7 @@ def measure() -> None:
     else:
         _record_onchip(out["value"], out["vs_baseline"], backend,
                        pipeline_depth, occupancy, latency, degradation,
-                       fused_info)
+                       fused_info, compression)
     print(json.dumps(out))
 
 

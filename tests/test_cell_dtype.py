@@ -1,0 +1,231 @@
+"""Narrow cell dtypes (int16/int8 slabs with wide-promotion) and the
+packed wire format: the PR-7 acceptance matrix.
+
+* int16 overflow-promotion exercised by counts crossing 32767, output
+  bit-identical to the int32 slab;
+* sparse top-K vs the host oracle with compression on (Config default:
+  cell int16 + packed wire) at pipeline depths 0 and 2;
+* restore from both checkpoint generations (pre-codec raw layout and
+  the delta+varint packed layout), across cell dtypes.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config
+from tpu_cooccurrence.observability import LEDGER
+from tpu_cooccurrence.sampling.reservoir import PairDeltaBatch
+from tpu_cooccurrence.state.sparse_scorer import SparseDeviceScorer
+
+from test_pipeline import (assert_latest_close, random_stream,
+                           run_production)
+
+
+def _feed_hot_pair(sc, windows=14, hot_delta=5000):
+    """Windows carrying a hot pair whose counts cross 32767 plus
+    background noise; returns every emitted batch (incl. final flush)."""
+    outs = []
+    rng = np.random.default_rng(7)
+    for w in range(windows):
+        src = np.concatenate([[0, 1], rng.integers(2, 40, 30)])
+        dst = np.concatenate([[1, 0], rng.integers(2, 40, 30)])
+        src, dst = src.astype(np.int64), dst.astype(np.int64)
+        keep = src != dst
+        delta = np.concatenate(
+            [[hot_delta, hot_delta], np.ones(30, np.int64)])[keep]
+        outs.append(sc.process_window(
+            w * 10, PairDeltaBatch(src[keep], dst[keep],
+                                   delta.astype(np.int32))))
+    outs.append(sc.flush())
+    return outs
+
+
+def _assert_batches_equal(oa, ob):
+    for x, y in zip(oa, ob):
+        ox, oy = np.argsort(x.rows), np.argsort(y.rows)
+        np.testing.assert_array_equal(x.rows[ox], y.rows[oy])
+        np.testing.assert_array_equal(x.vals[ox], y.vals[oy])
+        np.testing.assert_array_equal(x.idx[ox], y.idx[oy])
+
+
+def _scorer(cell, wire="packed", **kw):
+    kw.setdefault("development_mode", True)
+    kw.setdefault("capacity", 64)
+    kw.setdefault("items_capacity", 8)
+    kw.setdefault("compact_min_heap", 256)
+    return SparseDeviceScorer(5, cell_dtype=cell, wire_format=wire, **kw)
+
+
+@pytest.mark.parametrize("cell", ["int16", "int8"])
+def test_promotion_crossing_dtype_max_bit_identical(cell):
+    """The acceptance test: counts cross 32767 (and 127), rows promote
+    to the wide side-table BEFORE saturation, and every emitted batch is
+    bit-identical to the int32 slab's."""
+    ref = _scorer("int32", wire="raw")
+    nar = _scorer(cell)
+    oa, ob = _feed_hot_pair(ref), _feed_hot_pair(nar)
+    assert int(nar.wide_rows.sum()) >= 2, "promotion never fired"
+    assert int(nar.row_sums_host.max()) > 32767
+    # The hot rows really live in the wide side-table...
+    assert nar.index_w.heap_end > 0
+    # ...and the dev-mode row-sum check ran over both residencies.
+    _assert_batches_equal(oa, ob)
+
+
+def test_promotion_before_first_cell():
+    """A row whose FIRST window already exceeds the bound: promoted with
+    no narrow cells to move (the empty row_cells path)."""
+    ref = _scorer("int32", wire="raw")
+    nar = _scorer("int16")
+    batch = PairDeltaBatch(np.asarray([0, 1], np.int64),
+                           np.asarray([1, 0], np.int64),
+                           np.asarray([40000, 40000], np.int32))
+    a = [ref.process_window(0, batch), ref.flush()]
+    b = [nar.process_window(0, batch), nar.flush()]
+    assert nar.wide_rows[:2].all()
+    _assert_batches_equal(a, b)
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_sparse_compression_on_matches_oracle(depth):
+    """Config-default compression (auto -> int16 cells + packed wire)
+    vs the exact host oracle, at pipeline depths 0 and 2."""
+    kw = dict(window_size=10, seed=0xBEEF, item_cut=5, user_cut=4,
+              development_mode=True)
+    users, items, ts = random_stream(31, n=2500)
+    a = run_production(Config(**kw, backend=Backend.ORACLE), users, items,
+                       ts)
+    LEDGER.reset()
+    b = run_production(Config(**kw, backend=Backend.SPARSE,
+                              pipeline_depth=depth), users, items, ts)
+    assert_latest_close(a.latest, b.latest)
+    snap = LEDGER.snapshot()
+    # Compression actually engaged and actually cut wire bytes >= 2x.
+    assert snap["uplink_enc_bytes"] > 0
+    assert snap["uplink_raw_bytes"] >= 2 * snap["uplink_enc_bytes"]
+
+
+def test_explicit_flags_reach_scorer():
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    cfg = Config(window_size=10, seed=1, backend=Backend.SPARSE,
+                 cell_dtype="int8", wire_format="raw")
+    job = CooccurrenceJob(cfg)
+    assert job.scorer.cell_dtype == "int8"
+    assert not job.scorer.wire_packed
+    cfg2 = Config(window_size=10, seed=1, backend=Backend.DEVICE)
+    job2 = CooccurrenceJob(cfg2)  # auto degrades to int32/raw elsewhere
+    assert not hasattr(job2.scorer, "wire_packed")
+
+
+def test_narrow_flags_rejected_off_sparse():
+    with pytest.raises(ValueError, match="cell-dtype"):
+        Config(window_size=10, seed=1, backend=Backend.DEVICE,
+               cell_dtype="int16")
+    with pytest.raises(ValueError, match="wire-format"):
+        Config(window_size=10, seed=1, backend=Backend.DEVICE,
+               wire_format="packed")
+    with pytest.raises(ValueError, match="cell-dtype"):
+        Config(window_size=10, seed=1, backend=Backend.SPARSE,
+               num_shards=2, cell_dtype="int16")
+
+
+@pytest.mark.parametrize("wire_a,wire_b", [
+    ("raw", "auto"),    # old-format checkpoint restored by codec build
+    ("auto", "raw"),    # packed checkpoint restored by raw-config build
+    ("auto", "auto"),   # packed end to end
+])
+def test_checkpoint_format_interchange(tmp_path, wire_a, wire_b):
+    """Both checkpoint generations restore, both directions, with the
+    run continuing bit-compatibly (the old-format fixture is simply a
+    --wire-format raw save)."""
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    users, items, ts = random_stream(33, n=500)
+    half = 220
+    kw = dict(window_size=10, seed=4, item_cut=5, user_cut=3,
+              backend=Backend.SPARSE,
+              checkpoint_dir=str(tmp_path / "ck"),
+              development_mode=True)
+
+    ref = CooccurrenceJob(Config(**kw, wire_format=wire_b))
+    ref.add_batch(users, items, ts)
+    ref.finish()
+
+    a = CooccurrenceJob(Config(**kw, wire_format=wire_a))
+    a.add_batch(users[:half], items[:half], ts[:half])
+    a.checkpoint()
+    import glob
+
+    import numpy as np_mod
+
+    path = sorted(glob.glob(str(tmp_path / "ck" / "state.*.npz")))[-1]
+    with np_mod.load(path) as data:
+        packed_names = [k for k in data.files if k.endswith("__packed")]
+    if wire_a == "raw":
+        assert packed_names == []  # the pre-codec generation layout
+    else:
+        assert any("rows_key" in k for k in packed_names)
+    b = CooccurrenceJob(Config(**kw, wire_format=wire_b))
+    b.restore()
+    b.add_batch(users[half:], items[half:], ts[half:])
+    b.finish()
+    assert_latest_close(ref.latest, b.latest, rtol=1e-6, atol=1e-6)
+
+
+def test_checkpoint_interchange_across_cell_dtypes(tmp_path):
+    """A checkpoint written by an int32 slab restores onto an int16 one
+    (and back) — residency is an in-memory layout, not a format."""
+    from tpu_cooccurrence.job import CooccurrenceJob
+
+    users, items, ts = random_stream(35, n=500)
+    half = 240
+    for first, second in [("int32", "int16"), ("int16", "int32"),
+                          ("int16", "int8")]:
+        kw = dict(window_size=10, seed=9, item_cut=5, user_cut=3,
+                  backend=Backend.SPARSE,
+                  checkpoint_dir=str(tmp_path / f"ck-{first}-{second}"),
+                  development_mode=True)
+        ref = CooccurrenceJob(Config(**kw, cell_dtype=second))
+        ref.add_batch(users, items, ts)
+        ref.finish()
+        a = CooccurrenceJob(Config(**kw, cell_dtype=first))
+        a.add_batch(users[:half], items[:half], ts[:half])
+        a.checkpoint()
+        b = CooccurrenceJob(Config(**kw, cell_dtype=second))
+        b.restore()
+        b.add_batch(users[half:], items[half:], ts[half:])
+        b.finish()
+        assert_latest_close(ref.latest, b.latest, rtol=1e-6, atol=1e-6)
+
+
+def test_restore_with_promoted_rows(tmp_path):
+    """Checkpoint taken AFTER promotion: the restoring scorer re-splits
+    rows by threshold and continues bit-identically."""
+    sc = _scorer("int16")
+    _feed_hot_pair(sc, windows=10)
+    assert int(sc.wide_rows.sum()) >= 2
+    st = sc.checkpoint_state()
+    fresh = _scorer("int16")
+    fresh.restore_state(st)
+    assert int(fresh.wide_rows.sum()) >= 2
+    np.testing.assert_array_equal(fresh.row_sums_host, sc.row_sums_host)
+    # Continue both: identical batches.
+    more = PairDeltaBatch(np.asarray([0, 2], np.int64),
+                          np.asarray([2, 0], np.int64),
+                          np.asarray([3, 3], np.int32))
+    a = [sc.process_window(500, more), sc.flush()]
+    b = [fresh.process_window(500, more), fresh.flush()]
+    _assert_batches_equal(a, b)
+
+
+def test_state_gauges_populate():
+    from tpu_cooccurrence.observability.registry import REGISTRY
+
+    REGISTRY.reset()
+    sc = _scorer("int16")
+    _feed_hot_pair(sc, windows=3)
+    assert REGISTRY.gauge("cooc_host_index_rss_bytes").get() > 0
+    assert REGISTRY.gauge("cooc_slab_device_bytes").get() > 0
+    assert REGISTRY.gauge("cooc_slab_live_cells").get() == sc.live_cells
+    assert sc.live_cells > 0

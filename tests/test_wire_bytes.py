@@ -102,8 +102,7 @@ def test_deferred_flush_fetches_dirty_rows_only():
     # Nothing new scored: a second flush moves zero bytes.
     LEDGER.reset()
     assert len(sc.flush().rows) == 0
-    assert LEDGER.summary() == {"h2d_bytes": 0, "h2d_calls": 0,
-                                "d2h_bytes": 0, "d2h_calls": 0}
+    assert all(v == 0 for v in LEDGER.summary().values())
 
 
 def test_deferred_idle_window_moves_nothing():

@@ -1,0 +1,294 @@
+"""Compressed wire/checkpoint codecs (state/wire.py): exact round trips,
+device-decode parity, and the cooclint rules that guard them.
+
+Every encoder/decoder pair is exercised here by name — the
+``wire-codec-roundtrip`` rule counts these references as the round-trip
+evidence a codec needs to exist at all.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_cooccurrence.state.wire import (
+    SENT, cell_promote_threshold, checked_narrow, checkpoint_codec,
+    decode_sorted_u64, decode_update, decode_update_host, decode_varint,
+    encode_sorted_u64, encode_update, encode_varint, pack_bits,
+    packed_nbytes, resolve_cell_dtype, resolve_wire_format, unpack_bits)
+
+
+# -- bit packing -------------------------------------------------------
+
+
+@pytest.mark.parametrize("width", [1, 2, 7, 13, 17, 24, 31, 32])
+def test_pack_bits_roundtrip(width):
+    rng = np.random.default_rng(width)
+    for n in (0, 1, 2, 63, 64, 65, 1000):
+        hi = np.uint64(1) << np.uint64(width)
+        vals = rng.integers(0, int(hi), n, dtype=np.uint64)
+        if n:
+            vals[0] = hi - np.uint64(1)  # max value must survive
+            vals[-1] = 0
+        words = pack_bits(vals, width)
+        assert words.dtype == np.uint32
+        assert len(words) == (n * width + 31) // 32
+        np.testing.assert_array_equal(unpack_bits(words, width, n), vals)
+
+
+def test_pack_bits_rejects_bad_inputs():
+    with pytest.raises(ValueError, match="width"):
+        pack_bits(np.zeros(1, np.uint64), 0)
+    with pytest.raises(ValueError, match="width"):
+        pack_bits(np.zeros(1, np.uint64), 33)
+    with pytest.raises(ValueError, match="fit"):
+        pack_bits(np.asarray([4], np.uint64), 2)
+
+
+# -- varint ------------------------------------------------------------
+
+
+def test_varint_roundtrip():
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 500):
+        vals = rng.integers(0, 2**62, n, dtype=np.uint64)
+        if n:
+            vals[0] = 0
+            vals[-1] = np.uint64(2**62)
+        buf = encode_varint(vals)
+        np.testing.assert_array_equal(decode_varint(buf, n), vals)
+    with pytest.raises(ValueError, match="nonnegative"):
+        encode_varint(np.asarray([-1], np.int64))
+    with pytest.raises(ValueError, match="expected"):
+        decode_varint(encode_varint(np.asarray([1, 2], np.uint64)), 3)
+
+
+def test_sorted_u64_roundtrip_and_compression():
+    rng = np.random.default_rng(1)
+    # Realistic cell keys (row << 32 | dst): tiny deltas within a row's
+    # segment, one big jump per row boundary.
+    rows = np.repeat(np.arange(200, dtype=np.int64), 100)
+    dsts = rng.integers(0, 5000, 20000).astype(np.int64)
+    keys = np.unique((rows << 32) | dsts)
+    blob = encode_sorted_u64(keys)
+    np.testing.assert_array_equal(decode_sorted_u64(blob, len(keys)), keys)
+    # Sorted deltas must beat the raw 8 B/key layout by a wide margin.
+    assert blob.nbytes * 2 < keys.nbytes
+    with pytest.raises(ValueError, match="sorted"):
+        encode_sorted_u64(np.asarray([5, 3], np.int64))
+    assert len(encode_sorted_u64(np.zeros(0, np.int64))) == 0
+
+
+# -- the packed update buffer ------------------------------------------
+
+
+def _make_update(rng, n_new, n_d, n_rs, heap=1 << 18, items=5000):
+    n = n_new + n_d + n_rs
+    n_pad = 1 << max(6, int(np.ceil(np.log2(max(n, 1)))) + 1)
+    upd = np.full((2, n_pad), SENT, dtype=np.int32)
+    upd[1] = 0
+    slots = rng.choice(heap, n_new + n_d, replace=False).astype(np.int32)
+    upd[0, :n_new] = slots[:n_new]
+    upd[1, :n_new] = rng.integers(0, items, n_new)
+    upd[0, n_new:n_new + n_d] = slots[n_new:]
+    upd[1, n_new:n_new + n_d] = rng.integers(-(2**31), 2**31, n_d)
+    rows = rng.choice(items, n_rs, replace=False).astype(np.int32)
+    upd[0, n_new + n_d:n] = rows
+    upd[1, n_new + n_d:n] = rng.integers(-30000, 30000, n_rs)
+    return upd, np.asarray([n_new, n_new + n_d], np.int32), n, n_pad
+
+
+def _section_multiset(upd, lo, hi):
+    return sorted(zip(upd[0, lo:hi].tolist(), upd[1, lo:hi].tolist()))
+
+
+@pytest.mark.parametrize("shape", [
+    (10, 300, 60), (0, 500, 90), (7, 0, 0), (0, 0, 0), (1, 1, 1),
+    (0, 0, 40),
+])
+def test_encode_update_roundtrip_host(shape):
+    rng = np.random.default_rng(hash(shape) & 0xFFFF)
+    upd, bounds, n, n_pad = _make_update(rng, *shape)
+    words_i, words_v, header = encode_update(upd, bounds, n)
+    dec, dec_bounds = decode_update_host(words_i, words_v, header, n_pad)
+    np.testing.assert_array_equal(dec_bounds, bounds)
+    b0, b1 = int(bounds[0]), int(bounds[1])
+    # Sections survive as multisets (the codec sorts within a section —
+    # legal because every section's scatter is order-independent) and the
+    # padding region is bit-identical to the raw buffer's.
+    for lo, hi in ((0, b0), (b0, b1), (b1, n)):
+        assert _section_multiset(dec, lo, hi) == _section_multiset(
+            upd, lo, hi)
+    np.testing.assert_array_equal(dec[:, n:], upd[:, n:])
+
+
+def test_decode_update_jit_matches_host():
+    """The device decode prologue is bit-identical to the host decoder
+    (and therefore to the raw buffer modulo in-section order)."""
+    rng = np.random.default_rng(9)
+    for shape in ((25, 400, 80), (0, 64, 0), (3, 3, 3)):
+        upd, bounds, n, n_pad = _make_update(rng, *shape)
+        words_i, words_v, header = encode_update(upd, bounds, n)
+
+        def pad(words):
+            out = np.zeros(max(8, 2 * (len(words) + 1)), np.uint32)
+            out[: len(words)] = words
+            return out
+
+        dec_host, b_host = decode_update_host(words_i, words_v, header,
+                                              n_pad)
+        dec_jit, b_jit = decode_update(jnp.asarray(pad(words_i)),
+                                       jnp.asarray(pad(words_v)),
+                                       jnp.asarray(header), n_pad)
+        np.testing.assert_array_equal(np.asarray(dec_jit), dec_host)
+        np.testing.assert_array_equal(np.asarray(b_jit), b_host)
+
+
+def test_encode_update_compresses_realistic_window():
+    """The acceptance yardstick at codec level: a realistic steady-state
+    window (small deltas, sorted-ish slots) encodes to <= half the raw
+    buffer's bytes."""
+    rng = np.random.default_rng(3)
+    upd, bounds, n, n_pad = _make_update(rng, 0, 20000, 4000)
+    upd[1, :20000] = rng.integers(-5, 50, 20000)  # realistic deltas
+    words_i, words_v, header = encode_update(upd, bounds, n)
+    raw = upd.nbytes + bounds.nbytes
+    assert packed_nbytes(words_i, words_v, header) * 2 <= raw
+
+
+# -- narrow dtypes -----------------------------------------------------
+
+
+def test_checked_narrow_guards():
+    a = np.asarray([1, 32767], np.int64)
+    assert checked_narrow(a, np.int16).dtype == np.int16
+    with pytest.raises(OverflowError):
+        checked_narrow(np.asarray([32768], np.int64), np.int16)
+    with pytest.raises(OverflowError):
+        checked_narrow(np.asarray([-129], np.int64), np.int8)
+    assert checked_narrow(np.zeros(0, np.int64), np.int8).dtype == np.int8
+
+
+def test_cell_promote_threshold():
+    assert cell_promote_threshold("int32") is None
+    assert cell_promote_threshold("int16") == 1 << 15
+    assert cell_promote_threshold("int8") == 1 << 7
+
+
+def test_flag_resolution():
+    assert resolve_cell_dtype("auto", True) == "int16"
+    assert resolve_cell_dtype("auto", False) == "int32"
+    assert resolve_cell_dtype("int8", True) == "int8"
+    assert resolve_wire_format("auto", True) == "packed"
+    assert resolve_wire_format("auto", False) == "raw"
+    assert checkpoint_codec("raw") == "raw"
+    assert checkpoint_codec("auto") == "packed"
+    assert checkpoint_codec("packed") == "packed"
+
+
+# -- ledger accounting --------------------------------------------------
+
+
+def test_ledger_encoded_and_basket_counters():
+    """The raw/encoded uplink pair and the BasketBatch counter (PR-6
+    packed uplink split out of the generic h2d totals)."""
+    from tpu_cooccurrence.observability import TransferLedger
+
+    led = TransferLedger()
+    buf = np.zeros(256, np.uint32)
+    led.up("plain", buf)
+    led.up_encoded("update-packed", 8192, buf, buf)
+    led.up_basket("fused-window", buf)
+    snap = led.snapshot()
+    assert snap["h2d_calls"] == 3
+    assert snap["h2d_bytes"] == 4 * buf.nbytes
+    assert snap["uplink_raw_bytes"] == 8192
+    assert snap["uplink_enc_bytes"] == 2 * buf.nbytes
+    assert snap["basket_h2d_bytes"] == buf.nbytes
+    assert snap["basket_h2d_calls"] == 1
+    led.reset()
+    assert all(v == 0 for v in led.snapshot().values())
+
+
+def test_fused_window_uplink_rides_basket_counter():
+    """End to end: a --fused-window on run books its packed basket
+    uploads on the basket counter, not just the generic totals."""
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.observability import LEDGER
+
+    rng = np.random.default_rng(5)
+    users = rng.integers(0, 30, 1500).astype(np.int64)
+    items = rng.integers(0, 60, 1500).astype(np.int64)
+    ts = np.cumsum(rng.integers(0, 2, 1500)).astype(np.int64)
+    LEDGER.reset()
+    cfg = Config(window_size=20, seed=3, item_cut=8, user_cut=6,
+                 backend=Backend.DEVICE, fused_window="on")
+    job = CooccurrenceJob(cfg)
+    job.add_batch(users, items, ts)
+    job.finish()
+    snap = LEDGER.snapshot()
+    assert snap["basket_h2d_calls"] > 0
+    assert 0 < snap["basket_h2d_bytes"] <= snap["h2d_bytes"]
+    LEDGER.reset()
+
+
+# -- cooclint rules guarding this module --------------------------------
+
+
+def test_wire_codec_rule_flags_missing_decoder():
+    from tpu_cooccurrence.analysis import analyze_source
+
+    bad = "def encode_thing(x):\n    return x\n"
+    findings = analyze_source(bad, path="tpu_cooccurrence/state/wire.py",
+                              rules=["wire-codec-roundtrip"])
+    assert any("decode_thing" in f.message for f in findings)
+
+
+def test_wire_codec_rule_requires_test_reference():
+    from tpu_cooccurrence.analysis import analyze_source
+
+    src = ("def encode_thing(x):\n    return x\n"
+           "def decode_thing(x):\n    return x\n")
+    findings = analyze_source(src, path="tpu_cooccurrence/state/wire.py",
+                              rules=["wire-codec-roundtrip"])
+    assert any("round-trip evidence" in f.message for f in findings)
+
+
+def test_narrow_cast_rule():
+    from tpu_cooccurrence.analysis import analyze_source
+
+    bad = ("import numpy as np\n"
+           "def f(a):\n"
+           "    return a.astype(np.int16)\n")
+    findings = analyze_source(bad, rules=["narrow-cast-guard"])
+    assert findings and "guard" in findings[0].message
+    guarded = ("import numpy as np\n"
+               "def f(a):\n"
+               "    if a.max() > 32767:\n"
+               "        raise OverflowError\n"
+               "    return a.astype(np.int16)\n")
+    assert analyze_source(guarded, rules=["narrow-cast-guard"]) == []
+    helper = ("from tpu_cooccurrence.state.wire import checked_narrow\n"
+              "import numpy as np\n"
+              "def f(a):\n"
+              "    return checked_narrow(a, np.int16)\n")
+    assert analyze_source(helper, rules=["narrow-cast-guard"]) == []
+    sign_extend = ("import jax.numpy as jnp\n"
+                   "def f(a):\n"
+                   "    return a.astype(jnp.int16).astype(jnp.int32)\n")
+    assert analyze_source(sign_extend, rules=["narrow-cast-guard"]) == []
+
+
+def test_repo_is_clean_of_unguarded_narrow_casts():
+    """The rules hold over the live tree (baseline-free, like
+    rules_fused): run them through the real analyzer entry point."""
+    import os
+
+    from tpu_cooccurrence.analysis import Analyzer
+    from tpu_cooccurrence.analysis.core import RULES
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = Analyzer(repo, rules=[RULES["narrow-cast-guard"],
+                                   RULES["wire-codec-roundtrip"]]).run()
+    assert result.findings == [], result.findings

@@ -29,6 +29,10 @@ Layout:
 * :mod:`.rules_degrade` — degradation-level registry drift (every
   ``DegradationLevel`` member documented, journaled, and in the
   ARCHITECTURE level table);
+* :mod:`.rules_wire` — wire/checkpoint codec round-trip evidence and
+  narrow-dtype cast guards (every encoder needs its decoder + a test
+  referencing both; every int16/int8 cast needs a visible overflow
+  guard);
 * :mod:`.rules_fused` — Pallas kernel registry drift (every
   ``pallas_call`` entry point in ``ops/pallas_score.py`` parity-tested
   from ``tests/`` and listed in the ARCHITECTURE kernel table);
@@ -59,6 +63,7 @@ from . import rules_jit  # noqa: F401,E402
 from . import rules_lock  # noqa: F401,E402
 from . import rules_native  # noqa: F401,E402
 from . import rules_registry  # noqa: F401,E402
+from . import rules_wire  # noqa: F401,E402
 
 __all__ = [
     "Analyzer",

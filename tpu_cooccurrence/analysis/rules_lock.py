@@ -35,7 +35,9 @@ from .core import FileContext, Finding, Rule, dotted_name, register
 #: (``events`` is deliberately absent: too generic to key on).
 PROTECTED_STATE = {
     "Counters": {"_counters"},
-    "TransferLedger": {"h2d_bytes", "d2h_bytes", "h2d_calls", "d2h_calls"},
+    "TransferLedger": {"h2d_bytes", "d2h_bytes", "h2d_calls", "d2h_calls",
+                       "uplink_raw_bytes", "uplink_enc_bytes",
+                       "basket_h2d_bytes", "basket_h2d_calls"},
     "LatestResults": {"_batches", "_ptr_batch", "_ptr_row", "_total_rows"},
 }
 

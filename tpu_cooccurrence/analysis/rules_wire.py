@@ -1,0 +1,206 @@
+"""Wire/checkpoint codec and narrow-dtype invariants (baseline-free).
+
+Two rules guarding the compression layer (``state/wire.py``):
+
+* ``wire-codec-roundtrip`` — every encoder entry point in the wire
+  module (a module-level ``encode_*`` / ``pack_*`` function) must have
+  its matching decoder (``decode_*`` / ``unpack_*``, same stem) in the
+  module, and BOTH must be referenced from ``tests/`` — the round-trip
+  test is the only thing standing between an encoding tweak and a
+  checkpoint that silently restores garbage. Mirrors the
+  ``pallas-kernel-registry`` rule's evidence model.
+
+* ``narrow-cast-guard`` — every cast to a narrow integer dtype
+  (``astype(np.int16 / np.int8 / jnp.int16 / jnp.int8)``, or their
+  string forms) anywhere in the package must sit behind a VISIBLE
+  saturation/overflow guard: the enclosing function either routes
+  through a registered guard helper (``checked_narrow``,
+  ``narrow_deltas_int32``), consults dtype bounds (``np.iinfo`` /
+  ``cell_promote_threshold``), or compares against an explicit dtype
+  limit literal. The immediate sign-extend idiom
+  (``.astype(int16).astype(int32)``) is exempt — it never stores a
+  narrow value. Everything else is exactly how the reference's silent
+  Java-short wraparound class of bug re-enters the codebase.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from .core import FileContext, Finding, RepoContext, Rule, register
+
+_WIRE_PATH = "tpu_cooccurrence/state/wire.py"
+
+#: Encoder-name prefix -> required decoder prefix.
+_CODEC_PAIRS = {"encode_": "decode_", "pack_": "unpack_"}
+
+#: Call names that count as a visible overflow guard in a function.
+_GUARD_CALLS = {"checked_narrow", "narrow_deltas_int32", "iinfo",
+                "cell_promote_threshold"}
+
+#: Literals that count as an explicit dtype-bound check.
+_LIMIT_LITERALS = {127, -128, 255, 32767, -32768, 65535}
+
+_NARROW_NAMES = {"int16", "int8"}
+_WIDE_NAMES = {"int32", "int64"}
+
+
+def _dtype_token(node: ast.AST) -> Optional[str]:
+    """``np.int16`` / ``jnp.int8`` / ``"int16"`` -> the dtype name."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _astype_to(node: ast.AST, names: Set[str]) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and len(node.args) == 1
+            and _dtype_token(node.args[0]) in names)
+
+
+def _test_referenced_names(repo: RepoContext) -> Set[str]:
+    refs: Set[str] = set()
+    for ctx in repo.python_files():
+        if not ctx.path.startswith("tests/") or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name):
+                refs.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                refs.add(node.attr)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    refs.add(alias.name.rsplit(".", 1)[-1])
+    return refs
+
+
+@register
+class WireCodecRoundtripRule(Rule):
+    name = "wire-codec-roundtrip"
+    description = ("every encoder in state/wire.py needs its matching "
+                   "decoder and a round-trip test referencing both from "
+                   "tests/")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        src: Optional[FileContext] = next(
+            (c for c in repo.files if c.path == _WIRE_PATH), None)
+        if src is None or src.tree is None:
+            return
+        fns: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in src.tree.body
+            if isinstance(n, ast.FunctionDef)}
+        encoders = {name: fn for name, fn in fns.items()
+                    if any(name.startswith(p) for p in _CODEC_PAIRS)}
+        if not encoders:
+            yield Finding(
+                rule=self.name, file=_WIRE_PATH, line=1,
+                message="no encoder entry points found (the codec "
+                        "registry this rule guards is gone)")
+            return
+        refs = _test_referenced_names(repo)
+        for name, fn in sorted(encoders.items()):
+            prefix = next(p for p in _CODEC_PAIRS if name.startswith(p))
+            stem = name[len(prefix):]
+            decoder = _CODEC_PAIRS[prefix] + stem
+            if decoder not in fns:
+                yield Finding(
+                    rule=self.name, file=_WIRE_PATH, line=fn.lineno,
+                    message=(f"encoder {name!r} has no matching decoder "
+                             f"{decoder!r} in {_WIRE_PATH} — a one-way "
+                             f"wire format is unrecoverable state"))
+                continue
+            missing = [n for n in (name, decoder) if n not in refs]
+            if missing:
+                yield Finding(
+                    rule=self.name, file=_WIRE_PATH, line=fn.lineno,
+                    message=(f"codec pair ({name}, {decoder}) has no "
+                             f"round-trip evidence: {missing} never "
+                             f"referenced from tests/"))
+
+
+@register
+class NarrowCastGuardRule(Rule):
+    name = "narrow-cast-guard"
+    description = ("casts to int16/int8 must sit behind a visible "
+                   "saturation/overflow guard (checked_narrow, iinfo, "
+                   "an explicit bound literal) or be an immediate "
+                   "sign-extend")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None or not ctx.path.startswith("tpu_cooccurrence/"):
+            return
+        # Narrow casts that are immediately re-widened never store a
+        # narrow value: collect the inner nodes of `.astype(narrow)
+        # .astype(wide)` chains to exempt them.
+        sign_extended = set()
+        for node in ast.walk(ctx.tree):
+            if (_astype_to(node, _WIDE_NAMES)
+                    and _astype_to(node.func.value, _NARROW_NAMES)):
+                sign_extended.add(id(node.func.value))
+        # Guard evidence is function-scoped: map every node to its
+        # enclosing function, then check that function's body.
+        for fn in [n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))] + [ctx.tree]:
+            owns = (ast.walk(fn) if isinstance(fn, ast.Module)
+                    else ast.walk(fn))
+            casts = [n for n in owns
+                     if _astype_to(n, _NARROW_NAMES)
+                     and id(n) not in sign_extended]
+            if not casts:
+                continue
+            if isinstance(fn, ast.Module):
+                # Module-level casts: only flag ones not inside any
+                # function (function-scoped pass already covered those).
+                in_fn = set()
+                for f in ast.walk(fn):
+                    if isinstance(f, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        for sub in ast.walk(f):
+                            in_fn.add(id(sub))
+                casts = [c for c in casts if id(c) not in in_fn]
+                if not casts:
+                    continue
+                guarded = False
+            else:
+                guarded = self._has_guard(fn)
+            if guarded:
+                continue
+            for c in casts:
+                yield Finding(
+                    rule=self.name, file=ctx.path, line=c.lineno,
+                    message=("narrow-dtype cast without a visible "
+                             "saturation/overflow guard — route through "
+                             "state/wire.checked_narrow or add an "
+                             "explicit bounds check in this function "
+                             "(silent wraparound is the reference's "
+                             "Java-short bug class)"))
+
+    @staticmethod
+    def _has_guard(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                callee = (f.attr if isinstance(f, ast.Attribute)
+                          else f.id if isinstance(f, ast.Name) else None)
+                if callee in _GUARD_CALLS:
+                    return True
+            elif (isinstance(node, ast.Constant)
+                  and isinstance(node.value, int)
+                  and not isinstance(node.value, bool)
+                  and node.value in _LIMIT_LITERALS):
+                return True
+            elif (isinstance(node, ast.UnaryOp)
+                  and isinstance(node.op, ast.USub)
+                  and isinstance(node.operand, ast.Constant)
+                  and isinstance(node.operand.value, int)
+                  and -node.operand.value in _LIMIT_LITERALS):
+                return True
+        return False

@@ -199,6 +199,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from .observability import xla_trace
     from .robustness.quarantine import QuarantineRateExceeded
+    from .state.sparse_scorer import SlabCapacityError
 
     try:
         with xla_trace(config.profile_dir):
@@ -225,6 +226,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         job.abort()
         LOG.error("quarantine rate breaker tripped: %s", exc)
         return 2
+    except SlabCapacityError as exc:
+        # EX_CONFIG (permanent): the stream outgrew the int32 cell-slot
+        # space of one slab — a capacity/topology decision (shard it),
+        # not a transient failure; restarts would only replay the growth.
+        job.abort()
+        LOG.error("slab capacity exhausted: %s", exc)
+        return EX_CONFIG
     finally:
         if quarantine is not None:
             quarantine.close()

@@ -187,6 +187,20 @@ class Config:
     count_dtype: str = "int32"  # dense C cell dtype; int16 halves HBM
     # (reference-style short counts incl. its wraparound, doubles the
     # dense/sharded vocab ceiling)
+    cell_dtype: str = "auto"  # sparse slab cnt cell dtype: auto|int32|
+    # int16|int8 (state/wire.py). Narrow cells stay EXACT — a row is
+    # promoted to the wide int32 side-table before any cell could
+    # saturate — unlike the dense --count-dtype, which wraps like the
+    # reference's Java shorts. auto = int16 on the single-process sparse
+    # backend, int32 elsewhere.
+    wire_format: str = "auto"  # sparse per-window uplink encoding:
+    # auto|raw|packed. packed = per-section sorted delta + zigzag +
+    # bit-pack of the update buffer, decoded on device by a jit prologue
+    # (state/wire.py) — fewer uplink bytes at bit-identical results; an
+    # explicit TPU_COOC_UPLOAD_CHUNKS/_CHUNK_KB split request pins the
+    # raw chunked path. Also selects the checkpoint blob codec
+    # (raw = pre-codec layout, else delta+varint). auto = packed on the
+    # single-process sparse backend, raw elsewhere.
     pipeline_depth: int = 0  # pipelined execution: the caller thread
     # samples window N+1 while a worker thread runs the scorer for
     # window N (pipeline.py). 0 = serial (today's behavior); 1 =
@@ -345,6 +359,30 @@ class Config:
                     "--scorer-breaker-threshold is single-process "
                     "device/sparse only (a per-process host fallback "
                     "cannot substitute for a mesh collective)")
+        if self.cell_dtype not in ("auto", "int32", "int16", "int8"):
+            raise ValueError(
+                f"--cell-dtype must be auto|int32|int16|int8, got "
+                f"{self.cell_dtype!r}")
+        if self.wire_format not in ("auto", "raw", "packed"):
+            raise ValueError(
+                f"--wire-format must be auto|raw|packed, got "
+                f"{self.wire_format!r}")
+        sparse_single = (self.backend in (Backend.SPARSE, Backend.HYBRID)
+                         and self.num_shards == 1
+                         and self.coordinator is None)
+        if self.cell_dtype in ("int16", "int8") and not sparse_single:
+            # 'auto' degrades gracefully; an explicit narrow request the
+            # backend cannot honor must fail loudly (same rule as
+            # --fused-window on).
+            raise ValueError(
+                f"--cell-dtype {self.cell_dtype} is single-process "
+                f"--backend sparse only (the wide-promotion side-table "
+                f"is per-process slab state)")
+        if self.wire_format == "packed" and not sparse_single:
+            raise ValueError(
+                "--wire-format packed applies to the single-process "
+                "sparse backend's update uplink (other backends ship "
+                "raw COO or basket formats)")
         if self.fused_window not in ("auto", "on", "off"):
             raise ValueError(
                 f"--fused-window must be auto|on|off, got "
@@ -483,6 +521,20 @@ class Config:
                        help="Dense count-matrix cell dtype (int16 halves "
                             "device memory; counts then wrap like the "
                             "reference's Java shorts)")
+        p.add_argument("--cell-dtype",
+                       choices=["auto", "int32", "int16", "int8"],
+                       default="auto", dest="cell_dtype",
+                       help="Sparse slab cell dtype — EXACT narrow "
+                            "counts: rows promote to a wide int32 "
+                            "side-table before saturation (auto: int16 "
+                            "on the single-process sparse backend)")
+        p.add_argument("--wire-format", choices=["auto", "raw", "packed"],
+                       default="auto", dest="wire_format",
+                       help="Sparse per-window uplink + checkpoint blob "
+                            "encoding: packed = sorted delta + zigzag + "
+                            "bit-pack, decoded on device, bit-identical "
+                            "results (auto: packed on the single-process "
+                            "sparse backend)")
         p.add_argument("--score-ladder", type=int, default=None,
                        dest="score_ladder",
                        help="Sparse-backend score-bucket ladder base "

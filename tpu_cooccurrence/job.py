@@ -320,6 +320,7 @@ class CooccurrenceJob:
                     "--coordinator with --backend sparse needs "
                     "--num-shards > 1 (the sharded-sparse mesh)")
             from .state.sparse_scorer import SparseDeviceScorer
+            from .state.wire import resolve_cell_dtype, resolve_wire_format
 
             # Final-state consumption (no --emit-updates): keep results in
             # a device-resident table and fetch once at flush — per-window
@@ -332,7 +333,11 @@ class CooccurrenceJob:
                 score_ladder=self.config.score_ladder,
                 defer_results=not self.config.emit_updates,
                 fixed_shapes=fixed,
-                use_pallas=self.config.pallas))
+                use_pallas=self.config.pallas,
+                cell_dtype=resolve_cell_dtype(
+                    self.config.cell_dtype, sparse_single_device=True),
+                wire_format=resolve_wire_format(
+                    self.config.wire_format, sparse_single_device=True)))
         if backend == Backend.SHARDED:
             from .parallel.distributed import maybe_multihost_mesh
 

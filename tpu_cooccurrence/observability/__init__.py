@@ -158,6 +158,17 @@ class TransferLedger:
             self.d2h_bytes = 0
             self.h2d_calls = 0
             self.d2h_calls = 0
+            # Compressed-uplink accounting (state/wire.py): for every
+            # encoded upload, the bytes the raw layout would have shipped
+            # vs the bytes that actually crossed — the compression cut is
+            # a first-class bench/journal metric, not a derived guess.
+            self.uplink_raw_bytes = 0
+            self.uplink_enc_bytes = 0
+            # PR-6 BasketBatch packed uplink under its own counter: the
+            # fused-vs-chained wire comparison needs basket bytes split
+            # out of the generic h2d total they used to fold into.
+            self.basket_h2d_bytes = 0
+            self.basket_h2d_calls = 0
             self.events.clear()
 
     def up(self, label: str, *arrays) -> None:
@@ -166,6 +177,30 @@ class TransferLedger:
         with self._lock:
             self.h2d_bytes += n
             self.h2d_calls += 1
+            self.events.append(TransferEvent("h2d", label, n))
+
+    def up_encoded(self, label: str, raw_nbytes: int, *arrays) -> None:
+        """Record one ENCODED host->device upload: ``arrays`` are the
+        buffers that actually ship (counted on the h2d totals like any
+        upload); ``raw_nbytes`` is what the raw wire format would have
+        shipped for the same window, tracked on the raw/encoded pair."""
+        n = sum(int(a.nbytes) for a in arrays)
+        with self._lock:
+            self.h2d_bytes += n
+            self.h2d_calls += 1
+            self.uplink_raw_bytes += int(raw_nbytes)
+            self.uplink_enc_bytes += n
+            self.events.append(TransferEvent("h2d", label, n))
+
+    def up_basket(self, label: str, *arrays) -> None:
+        """Record one packed BasketBatch upload (--fused-window): rides
+        the h2d totals AND its own byte/call pair."""
+        n = sum(int(a.nbytes) for a in arrays)
+        with self._lock:
+            self.h2d_bytes += n
+            self.h2d_calls += 1
+            self.basket_h2d_bytes += n
+            self.basket_h2d_calls += 1
             self.events.append(TransferEvent("h2d", label, n))
 
     def down(self, label: str, *arrays) -> None:
@@ -185,7 +220,11 @@ class TransferLedger:
         set of recorded transfers (no torn mid-``up()`` reads)."""
         with self._lock:
             return {"h2d_bytes": self.h2d_bytes, "h2d_calls": self.h2d_calls,
-                    "d2h_bytes": self.d2h_bytes, "d2h_calls": self.d2h_calls}
+                    "d2h_bytes": self.d2h_bytes, "d2h_calls": self.d2h_calls,
+                    "uplink_raw_bytes": self.uplink_raw_bytes,
+                    "uplink_enc_bytes": self.uplink_enc_bytes,
+                    "basket_h2d_bytes": self.basket_h2d_bytes,
+                    "basket_h2d_calls": self.basket_h2d_calls}
 
     def summary(self) -> Dict[str, int]:
         return self.snapshot()

@@ -98,6 +98,17 @@ CANONICAL_METRICS = frozenset({
     "cooc_transfer_h2d_calls_total",
     "cooc_transfer_d2h_bytes_total",
     "cooc_transfer_d2h_calls_total",
+    # compressed wire format (state/wire.py): encoded-uplink accounting
+    # and the BasketBatch packed uplink split out of the generic totals
+    "cooc_transfer_uplink_raw_bytes_total",
+    "cooc_transfer_uplink_encoded_bytes_total",
+    "cooc_transfer_basket_h2d_bytes_total",
+    "cooc_transfer_basket_h2d_calls_total",
+    # compressed sparse state (state/sparse_scorer.py): host index RSS
+    # and device slab footprint, refreshed per window
+    "cooc_host_index_rss_bytes",
+    "cooc_slab_device_bytes",
+    "cooc_slab_live_cells",
 })
 
 #: TransferLedger snapshot key -> exposition series name. Explicit
@@ -108,6 +119,10 @@ TRANSFER_METRICS = {
     "h2d_calls": "cooc_transfer_h2d_calls_total",
     "d2h_bytes": "cooc_transfer_d2h_bytes_total",
     "d2h_calls": "cooc_transfer_d2h_calls_total",
+    "uplink_raw_bytes": "cooc_transfer_uplink_raw_bytes_total",
+    "uplink_enc_bytes": "cooc_transfer_uplink_encoded_bytes_total",
+    "basket_h2d_bytes": "cooc_transfer_basket_h2d_bytes_total",
+    "basket_h2d_calls": "cooc_transfer_basket_h2d_calls_total",
 }
 
 

@@ -863,7 +863,8 @@ class DeviceScorer:
             # Padded entries gather row 0 but must NOT scatter there.
             scatter_rows = np.full(pad_s, _SENT_ROW, dtype=np.int32)
             scatter_rows[:s] = rows
-            LEDGER.up("fused-window", blockbuf, rows_padded, scatter_rows)
+            LEDGER.up_basket("fused-window", blockbuf, rows_padded,
+                             scatter_rows)
             self.C, self.row_sums, self._results.tbl = _fused_window_defer(
                 self.C, self.row_sums, self._results.tbl, blockbuf,
                 rows_padded, scatter_rows, observed,
@@ -872,7 +873,7 @@ class DeviceScorer:
                 tile=self.PALLAS_TILE, interpret=self._pallas_interpret)
             self._results.mark(rows)
             return TopKBatch.empty(self.top_k)
-        LEDGER.up("fused-window", blockbuf, rows_padded)
+        LEDGER.up_basket("fused-window", blockbuf, rows_padded)
         self.C, self.row_sums, packed = _fused_window_emit(
             self.C, self.row_sums, blockbuf, rows_padded, observed,
             num_items=self.num_items, basket_width=l_cap,

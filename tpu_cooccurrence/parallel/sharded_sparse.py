@@ -49,6 +49,7 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from ..observability import LEDGER
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              narrow_deltas_int32)
 from ..ops.device_scorer import pad_pow2, pad_pow4
@@ -477,6 +478,7 @@ class ShardedSparseScorer:
             for d, (mv, _) in enumerate(mv_blocks):
                 if mv is not None:
                     mv_all[d, :, : mv.shape[1]] = mv
+            LEDGER.up("update-moves-sharded", mv_all)
             self.cnt, self.dst = self._moves_fn(mv_len)(
                 self.cnt, self.dst,
                 self._put_global(mv_all, self.mesh, P(ITEM_AXIS)))
@@ -508,6 +510,10 @@ class ShardedSparseScorer:
             k = int(sel.sum())
             rs_part[d, 0, :k] = rows[sel]
             rs_part[d, 1, :k] = rs_delta[sel].astype(np.int32)
+        # Wire accounting (the single-device scorer's discipline): the
+        # sharded update step never recorded its uploads, leaving
+        # fused-vs-sharded wire comparisons blind on one side.
+        LEDGER.up("update-sharded", upd, bounds, rs_part)
         self.cnt, self.dst, self.row_sums = self._update(
             self.cnt, self.dst, self.row_sums,
             self._put_global(upd, self.mesh, P(ITEM_AXIS)),
@@ -535,8 +541,9 @@ class ShardedSparseScorer:
         lens = np.empty(len(rows), dtype=np.int32)
         for d in range(D):
             sel = row_owner == d
-            starts[sel] = self.indexes[d].row_start[local[sel]]
-            lens[sel] = self.indexes[d].row_len[local[sel]]
+            # One registry pass per shard (the _RowField views are the
+            # compat shim; this is the per-window hot path).
+            starts[sel], lens[sel], _ = self.indexes[d].rows.get(local[sel])
         min_r = max(16, self.top_k)
         bucket, order = score_buckets(lens, min_r, self.score_ladder)
         b_sorted = bucket[order]

@@ -316,6 +316,38 @@ def save(job, directory: str, source=None) -> str:
     arrays["latest_others"] = np.asarray(lat_others, dtype=np.int64)
     arrays["latest_scores"] = np.asarray(lat_scores, dtype=np.float64)
 
+    # Checkpoint blob codec (state/wire.py): the sorted cell-key array
+    # delta+varint-encodes to a fraction of its raw bytes (sorted unique
+    # keys -> tiny deltas, before the npz's own deflate even runs), and
+    # the count arrays varint-pack the same way. The codec is recorded in
+    # the embedded meta, so restore self-describes; a file without the
+    # record (pre-codec generations, or --wire-format raw) restores
+    # through the unchanged raw path.
+    from .wire import checkpoint_codec, encode_sorted_u64, encode_varint
+
+    if checkpoint_codec(
+            getattr(job.config, "wire_format", "raw")) == "packed":
+        packed = {}
+        for name, arr in arrays.items():
+            arr = np.asarray(arr)
+            if arr.ndim != 1 or arr.dtype != np.int64 or not len(arr):
+                continue
+            if name.endswith("rows_key"):
+                try:
+                    packed[name] = ("sdv", len(arr), encode_sorted_u64(arr))
+                except ValueError:
+                    continue  # not sorted/nonnegative: stays raw
+            elif name.endswith("_cnt") and int(arr.min()) >= 0:
+                packed[name] = ("v", len(arr), encode_varint(arr))
+        if packed:
+            meta["ckpt_codec"] = {
+                "v": 1,
+                "arrays": {name: [spec, count]
+                           for name, (spec, count, _b) in packed.items()}}
+            for name, (_spec, _count, blob) in packed.items():
+                del arrays[name]
+                arrays[name + "__packed"] = blob
+
     # The meta scalars ride INSIDE the .npz so one atomic rename commits
     # the whole checkpoint — a crash between two file replacements would
     # otherwise leave a mixed-generation (arrays N, meta N-1) state that
@@ -410,6 +442,26 @@ def restore(job, directory: str, source=None) -> None:
             "meta_json (written by a pre-atomic-commit version of this "
             "framework) — re-checkpoint with the current version")
     meta = json.loads(bytes(data["meta_json"]).decode())
+    codec = meta.get("ckpt_codec")
+    if codec:
+        # New-generation format: decode the packed blobs back to the
+        # canonical arrays before any consumer sees them. Absent record
+        # = pre-codec file, restored through the raw path unchanged.
+        from .wire import decode_sorted_u64, decode_varint
+
+        if codec.get("v") != 1:
+            raise ValueError(
+                f"unknown checkpoint codec version {codec.get('v')!r} "
+                f"(written by a newer framework?)")
+        for name, (spec, count) in codec["arrays"].items():
+            blob = data.pop(name + "__packed")
+            if spec == "sdv":
+                data[name] = decode_sorted_u64(blob, count)
+            elif spec == "v":
+                data[name] = decode_varint(blob, count).astype(np.int64)
+            else:
+                raise ValueError(
+                    f"unknown checkpoint array codec {spec!r} for {name}")
     for key in ("seed", "skip_cuts", "item_cut", "user_cut", "top_k",
                 "window_slide"):
         if getattr(job.config, key) != meta.get(key):

@@ -135,6 +135,43 @@ def _apply_moves_update_chunked(cnt, dst, row_sums, mv, upd_parts, bounds,
                         jnp.concatenate(upd_parts, axis=1), bounds)
 
 
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1, 2),
+                   static_argnames=("n_pad",))
+def _apply_update_packed(cnt, dst, row_sums, words_i, words_v, header, *,
+                         n_pad: int):
+    """_apply_update with the window buffer arriving in the compressed
+    wire format (state/wire.py: per-section delta + zigzag + bit-pack);
+    the decode prologue is gathers/shifts/cumsums feeding the SAME
+    ``_update_body`` scatter unchanged."""
+    from .wire import decode_update
+
+    upd, bounds = decode_update(words_i, words_v, header, n_pad)
+    return _update_body(cnt, dst, row_sums, upd, bounds)
+
+
+@functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1, 2),
+                   static_argnames=("n_pad", "L"))
+def _apply_moves_update_packed(cnt, dst, row_sums, mv, words_i, words_v,
+                               header, *, n_pad: int, L: int):
+    from .wire import decode_update
+
+    cnt, dst = _moves_body(cnt, dst, mv, L)
+    upd, bounds = decode_update(words_i, words_v, header, n_pad)
+    return _update_body(cnt, dst, row_sums, upd, bounds)
+
+
+@functools.partial(jax.jit, donate_argnums=donate_argnums(2, 3))
+def _promote_cells(cnt, dst, cnt_w, dst_w, src_slots, dst_slots):
+    """Move promoted rows' cells from the narrow slab into the wide
+    int32 side-table (``src_slots`` padded with 0 — a safe gather —
+    ``dst_slots`` padded with the sentinel, dropped). The cast widens,
+    so it is exact for any narrow cell."""
+    vals = cnt[src_slots].astype(jnp.int32)
+    cnt_w = cnt_w.at[dst_slots].set(vals, mode="drop")
+    dst_w = dst_w.at[dst_slots].set(dst[src_slots], mode="drop")
+    return cnt_w, dst_w
+
+
 @functools.partial(jax.jit, donate_argnums=donate_argnums(0, 1, 2), static_argnames=("L",))
 def _apply_moves_update(cnt, dst, row_sums, mv, upd, bounds, L: int):
     """Row relocations + the window update in ONE dispatch.
@@ -156,7 +193,13 @@ def _apply_moves_update(cnt, dst, row_sums, mv, upd, bounds, L: int):
 
 def _apply_cells(cnt, dst, upd, bounds):
     """New-cell + delta sections of an update buffer (shared with the
-    sharded backend, whose row sums update separately — replicated)."""
+    sharded backend, whose row sums update separately — replicated).
+
+    The delta add narrows to the slab's cell dtype (a no-op for int32
+    slabs): exact by the promotion invariant — a row still on a narrow
+    slab has row sum < 2^(w-1), so every cell value and window delta it
+    can see fits the dtype (state/wire.cell_promote_threshold).
+    """
     idx, val = upd[0], upd[1]
     pos = jnp.arange(upd.shape[1], dtype=jnp.int32)
     is_new = pos < bounds[0]
@@ -165,7 +208,8 @@ def _apply_cells(cnt, dst, upd, bounds):
     dst = dst.at[new_idx].set(val, mode="drop")
     cnt = cnt.at[new_idx].set(0, mode="drop")
     d_idx = jnp.where(is_delta, idx, _SENT)
-    cnt = cnt.at[d_idx].add(jnp.where(is_delta, val, 0), mode="drop")
+    cnt = cnt.at[d_idx].add(
+        jnp.where(is_delta, val, 0).astype(cnt.dtype), mode="drop")
     return cnt, dst
 
 
@@ -296,9 +340,34 @@ def _compact_gather(cnt, dst, gmap, cap: int):
             jnp.zeros((cap,), dst.dtype).at[: gmap.shape[0]].set(dst[gmap]))
 
 
+class SlabCapacityError(ValueError):
+    """Slab/registry capacity crossed the int32 slot space (2^31 cells).
+
+    A permanent configuration error (the cell-addressing wire format is
+    int32 by design): the CLI maps it to the supervisor's EX_CONFIG so a
+    restart loop is never spent on a stream that cannot fit. Raised by
+    the growth paths instead of silently wrapping through
+    ``.astype(np.int32)`` as the pre-guard code did.
+    """
+
+
 def _pow2ceil(x: np.ndarray, minimum: int) -> np.ndarray:
     v = np.maximum(x, minimum).astype(np.int64)
-    return (1 << np.ceil(np.log2(v)).astype(np.int64)).astype(np.int32)
+    out = 1 << np.ceil(np.log2(v)).astype(np.int64)
+    if int(out.max(initial=0)) >= 2**31:
+        raise SlabCapacityError(
+            f"capacity growth to {int(out.max())} cells crosses the int32 "
+            f"slot space (2^31); the sparse backend's cell addressing is "
+            f"int32 — shard the stream (--num-shards) instead")
+    return out.astype(np.int32)
+
+
+def _pad_words(words: np.ndarray) -> np.ndarray:
+    """Pad an encoded word stream to a pow2 transfer bucket with at
+    least one trailing guard word (the jit decode gathers word+1)."""
+    out = np.zeros(pad_pow2(len(words) + 1, minimum=256), dtype=np.uint32)
+    out[: len(words)] = words
+    return out
 
 
 def resolve_fixed_shapes(fixed_shapes, defer_results: bool) -> bool:
@@ -378,6 +447,259 @@ def score_buckets(lens: np.ndarray, min_r: int, ladder: int = 4):
     return bucket, np.argsort(bucket, kind="stable")
 
 
+# -- row registries -----------------------------------------------------
+#
+# The per-row slab placement record (start, len, cap). Two storage
+# strategies behind one batch API:
+#
+#   dense   — the original three int32 arrays over the whole row space
+#             (12 B per *possible* row, O(1) everything).
+#   bitmap  — SMASH-style: a one-bit-per-row occupancy bitmap plus a
+#             per-64-bit-word rank directory (exclusive popcount prefix
+#             sums — the hierarchical index), with (start, len, cap)
+#             packed densely over *occupied* rows in row-id order.
+#             Membership and field gathers are O(1) per row (word rank +
+#             in-word popcount); host RSS is 2 bits per possible row +
+#             12 B per occupied row — at 1M possible rows with a sparse
+#             vocabulary this is an order of magnitude under dense
+#             (pinned by tests/test_slab_registry.py).
+#
+# Default: bitmap (env TPU_COOC_ROW_INDEX=dense opts out for A/B).
+
+
+if hasattr(np, "bitwise_count"):  # numpy >= 2.0
+    def _popcount(words: np.ndarray) -> np.ndarray:
+        return np.bitwise_count(words)
+else:  # portable fallback: byte-table popcount over the uint8 view
+    _POP8 = np.asarray([bin(i).count("1") for i in range(256)],
+                       dtype=np.uint8)
+
+    def _popcount(words: np.ndarray) -> np.ndarray:
+        return _POP8[words.view(np.uint8).reshape(-1, 8)].sum(
+            axis=1).astype(np.uint64)
+
+
+class DenseRowRegistry:
+    """Original dense triple: three int32 arrays over the row space."""
+
+    kind = "dense"
+
+    def __init__(self, rows_capacity: int) -> None:
+        cap = max(int(rows_capacity), 64)
+        self.start = np.zeros(cap, dtype=np.int32)
+        self.length = np.zeros(cap, dtype=np.int32)
+        self.cap = np.zeros(cap, dtype=np.int32)
+
+    @property
+    def rows_cap(self) -> int:
+        return len(self.start)
+
+    @property
+    def nbytes(self) -> int:
+        return self.start.nbytes + self.length.nbytes + self.cap.nbytes
+
+    def ensure(self, max_row: int) -> None:
+        if max_row < self.rows_cap:
+            return
+        new_cap = int(_pow2ceil(np.asarray([max_row + 1]), 1024)[0])
+        for name in ("start", "length", "cap"):
+            old = getattr(self, name)
+            grown = np.zeros(new_cap, dtype=old.dtype)
+            grown[: len(old)] = old
+            setattr(self, name, grown)
+
+    def get(self, rows: np.ndarray):
+        rows = np.asarray(rows, dtype=np.int64)
+        if len(rows) and int(rows.max()) >= self.rows_cap:
+            # Beyond-capacity rows read as absent (0, 0, 0).
+            safe = np.minimum(rows, self.rows_cap - 1)
+            in_r = rows < self.rows_cap
+            return (np.where(in_r, self.start[safe], 0).astype(np.int32),
+                    np.where(in_r, self.length[safe], 0).astype(np.int32),
+                    np.where(in_r, self.cap[safe], 0).astype(np.int32))
+        return self.start[rows], self.length[rows], self.cap[rows]
+
+    def update(self, rows: np.ndarray, start=None, length=None,
+               cap=None) -> None:
+        rows = np.asarray(rows, dtype=np.int64)
+        if len(rows):
+            self.ensure(int(rows.max()))
+        if start is not None:
+            self.start[rows] = start
+        if length is not None:
+            self.length[rows] = length
+        if cap is not None:
+            self.cap[rows] = cap
+
+    def clear(self, rows: np.ndarray) -> None:
+        rows = np.asarray(rows, dtype=np.int64)
+        rows = rows[rows < self.rows_cap]
+        self.start[rows] = 0
+        self.length[rows] = 0
+        self.cap[rows] = 0
+
+    def occupied(self) -> np.ndarray:
+        return np.flatnonzero(self.cap > 0).astype(np.int32)
+
+    def reset(self) -> None:
+        self.start[:] = 0
+        self.length[:] = 0
+        self.cap[:] = 0
+
+
+class BitmapRowRegistry:
+    """Bitmap + rank directory + packed per-occupied-row fields.
+
+    ``bits`` holds one occupancy bit per possible row; ``rank`` holds the
+    exclusive popcount prefix sum per 64-bit word (the hierarchy level
+    that makes rank O(1): packed position of row r =
+    ``rank[r >> 6] + popcount(bits[r >> 6] below bit r)``). The packed
+    field arrays stay in row-id order; batch inserts merge new rows per
+    window (one ``np.insert`` pass, mirroring the sorted cell index's
+    merge cadence). Rows are never removed — ``clear`` zeroes the fields
+    (a freed row costs 12 packed bytes until a rebuild), matching the
+    dense registry's observable behavior exactly.
+    """
+
+    kind = "bitmap"
+
+    def __init__(self, rows_capacity: int) -> None:
+        cap = max(int(rows_capacity), 64)
+        cap = int(_pow2ceil(np.asarray([cap]), 64)[0])
+        self.bits = np.zeros(cap // 64, dtype=np.uint64)
+        self.rank = np.zeros(cap // 64, dtype=np.int64)
+        self.start = np.zeros(0, dtype=np.int32)
+        self.length = np.zeros(0, dtype=np.int32)
+        self.cap = np.zeros(0, dtype=np.int32)
+
+    @property
+    def rows_cap(self) -> int:
+        return len(self.bits) * 64
+
+    @property
+    def nbytes(self) -> int:
+        return (self.bits.nbytes + self.rank.nbytes + self.start.nbytes
+                + self.length.nbytes + self.cap.nbytes)
+
+    def ensure(self, max_row: int) -> None:
+        if max_row < self.rows_cap:
+            return
+        new_cap = int(_pow2ceil(np.asarray([max_row + 1]), 1024)[0])
+        n_words = new_cap // 64
+        grown = np.zeros(n_words, dtype=np.uint64)
+        grown[: len(self.bits)] = self.bits
+        self.bits = grown
+        self.rank = np.zeros(n_words, dtype=np.int64)
+        self._rebuild_rank()  # appended words inherit the running rank
+
+    def _rebuild_rank(self) -> None:
+        pc = _popcount(self.bits).astype(np.int64)
+        np.cumsum(pc[:-1], out=self.rank[1:])
+        self.rank[0] = 0
+
+    def _pos(self, rows: np.ndarray):
+        """(packed position, occupied) per row — O(1) membership.
+        Beyond-capacity rows report unoccupied."""
+        in_r = rows < self.rows_cap
+        w = np.minimum(rows >> 6, len(self.bits) - 1)
+        b = (rows & 63).astype(np.uint64)
+        wbits = self.bits[w]
+        occ = ((wbits >> b) & np.uint64(1)).astype(bool) & in_r
+        below = wbits & ((np.uint64(1) << b) - np.uint64(1))
+        return self.rank[w] + _popcount(below).astype(np.int64), occ
+
+    def get(self, rows: np.ndarray):
+        rows = np.asarray(rows, dtype=np.int64)
+        pos, occ = self._pos(rows)
+        s = np.zeros(len(rows), dtype=np.int32)
+        ln = np.zeros(len(rows), dtype=np.int32)
+        c = np.zeros(len(rows), dtype=np.int32)
+        p = pos[occ]
+        s[occ] = self.start[p]
+        ln[occ] = self.length[p]
+        c[occ] = self.cap[p]
+        return s, ln, c
+
+    def update(self, rows: np.ndarray, start=None, length=None,
+               cap=None) -> None:
+        """Batch insert-or-update. ``rows`` must be unique and sorted
+        ascending (every caller passes ``np.unique`` output) so the
+        packed arrays keep their row-id order through one insert pass."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if not len(rows):
+            return
+        self.ensure(int(rows.max()))
+        pos, occ = self._pos(rows)
+        new = rows[~occ]
+        if len(new):
+            ins = pos[~occ]  # positions in the PRE-insert packed arrays
+            self.start = np.insert(self.start, ins, 0)
+            self.length = np.insert(self.length, ins, 0)
+            self.cap = np.insert(self.cap, ins, 0)
+            np.bitwise_or.at(self.bits, new >> 6,
+                             np.uint64(1) << (new & 63).astype(np.uint64))
+            self._rebuild_rank()
+            pos, _occ = self._pos(rows)
+        if start is not None:
+            self.start[pos] = start
+        if length is not None:
+            self.length[pos] = length
+        if cap is not None:
+            self.cap[pos] = cap
+
+    def clear(self, rows: np.ndarray) -> None:
+        rows = np.asarray(rows, dtype=np.int64)
+        pos, occ = self._pos(rows)
+        p = pos[occ]
+        self.start[p] = 0
+        self.length[p] = 0
+        self.cap[p] = 0
+
+    def occupied(self) -> np.ndarray:
+        ids = np.flatnonzero(np.unpackbits(
+            self.bits.view(np.uint8), bitorder="little"))
+        return ids[self.cap > 0].astype(np.int32)
+
+    def reset(self) -> None:
+        self.bits[:] = 0
+        self.rank[:] = 0
+        self.start = np.zeros(0, dtype=np.int32)
+        self.length = np.zeros(0, dtype=np.int32)
+        self.cap = np.zeros(0, dtype=np.int32)
+
+
+def make_row_registry(rows_capacity: int, kind: Optional[str] = None):
+    """Row-registry factory: ``kind`` or env ``TPU_COOC_ROW_INDEX``
+    (default bitmap — the compressed index is the production layout;
+    dense remains for A/B and as the reference implementation)."""
+    if kind is None:
+        kind = os.environ.get("TPU_COOC_ROW_INDEX", "bitmap").strip().lower()
+    if kind == "dense":
+        return DenseRowRegistry(rows_capacity)
+    if kind == "bitmap":
+        return BitmapRowRegistry(rows_capacity)
+    raise ValueError(
+        f"TPU_COOC_ROW_INDEX must be bitmap or dense, got {kind!r}")
+
+
+class _RowField:
+    """Read-only vectorized view of one registry column — compatibility
+    shim for callers that indexed the old dense arrays directly
+    (``index.row_start[rows]``). Scalar in, scalar out."""
+
+    def __init__(self, reg, field: int) -> None:
+        self._reg = reg
+        self._field = field
+
+    def __getitem__(self, rows):
+        scalar = np.isscalar(rows) or getattr(rows, "ndim", 1) == 0
+        out = self._reg.get(np.atleast_1d(np.asarray(rows)))[self._field]
+        return out[0] if scalar else out
+
+    def __len__(self) -> int:
+        return self._reg.rows_cap
+
+
 @dataclasses.dataclass
 class AllocPlan:
     """Device-facing output of one window's :meth:`SlabIndex.apply`."""
@@ -403,15 +725,18 @@ class SlabIndex:
     Invariant the allocator and compactor rely on: a row's live slots are
     always exactly ``[start, start + len)`` (appends are contiguous and
     cells are never removed), so within-row slot offsets are dense.
+
+    Per-row placement lives in a pluggable row registry (default: the
+    SMASH-style bitmap + rank index, ``BitmapRowRegistry``); the old
+    dense-array access pattern stays available through the read-only
+    ``row_start`` / ``row_len`` / ``row_cap`` views.
     """
 
-    def __init__(self, rows_capacity: int = 1 << 10) -> None:
+    def __init__(self, rows_capacity: int = 1 << 10,
+                 row_index: Optional[str] = None) -> None:
         self.g_key = np.zeros(0, dtype=np.int64)
         self.g_slot = np.zeros(0, dtype=np.int32)
-        self.rows_cap = int(rows_capacity)
-        self.row_start = np.zeros(self.rows_cap, dtype=np.int32)
-        self.row_len = np.zeros(self.rows_cap, dtype=np.int32)
-        self.row_cap = np.zeros(self.rows_cap, dtype=np.int32)
+        self.rows = make_row_registry(rows_capacity, row_index)
         self.heap_end = 0
         self.garbage = 0  # cells in freed (moved-out) regions
         self.compactions = 0
@@ -419,16 +744,31 @@ class SlabIndex:
     def __len__(self) -> int:
         return len(self.g_key)
 
+    @property
+    def rows_cap(self) -> int:
+        return self.rows.rows_cap
+
+    @property
+    def row_start(self) -> _RowField:
+        return _RowField(self.rows, 0)
+
+    @property
+    def row_len(self) -> _RowField:
+        return _RowField(self.rows, 1)
+
+    @property
+    def row_cap(self) -> _RowField:
+        return _RowField(self.rows, 2)
+
+    @property
+    def nbytes(self) -> int:
+        """Host RSS of the index structures (registry + cell index) —
+        the ``cooc_host_index_rss_bytes`` gauge and the bench's
+        ``host_index_rss_bytes`` field read this."""
+        return self.rows.nbytes + self.g_key.nbytes + self.g_slot.nbytes
+
     def ensure_rows(self, max_row: int) -> None:
-        if max_row < self.rows_cap:
-            return
-        new_cap = int(_pow2ceil(np.asarray([max_row + 1]), 1024)[0])
-        for name in ("row_start", "row_len", "row_cap"):
-            old = getattr(self, name)
-            grown = np.zeros(new_cap, dtype=old.dtype)
-            grown[: len(old)] = old
-            setattr(self, name, grown)
-        self.rows_cap = new_cap
+        self.rows.ensure(max_row)
 
     def apply(self, d_key: np.ndarray) -> AllocPlan:
         """Classify one window's (sorted unique) cell keys against the
@@ -482,20 +822,27 @@ class SlabIndex:
             n_src, return_index=True, return_counts=True)
         rows_new32 = rows_new.astype(np.int32)
         self.ensure_rows(int(rows_new32.max()))
-        need = self.row_len[rows_new32] + counts.astype(np.int32)
-        grow_mask = need > self.row_cap[rows_new32]
+        r_start, r_len, r_cap = self.rows.get(rows_new)
+        need = r_len + counts.astype(np.int32)
+        grow_mask = need > r_cap
         mv = None
         mv_len = 0
         if grow_mask.any():
             grow_rows = rows_new32[grow_mask]
             new_caps = _pow2ceil(need[grow_mask], minimum=4)
+            new_end = self.heap_end + int(new_caps.astype(np.int64).sum())
+            if new_end >= 2**31:
+                raise SlabCapacityError(
+                    f"slab heap growth to {new_end} cells crosses the "
+                    f"int32 slot space (2^31); shard the stream "
+                    f"(--num-shards) instead")
             offs = (self.heap_end
                     + np.concatenate([[0], np.cumsum(new_caps)[:-1]])
                     ).astype(np.int32)
-            self.heap_end += int(new_caps.sum())
-            old_start = self.row_start[grow_rows].copy()
-            old_len = self.row_len[grow_rows].copy()
-            self.garbage += int(self.row_cap[grow_rows].sum())
+            self.heap_end = new_end
+            old_start = r_start[grow_mask].copy()
+            old_len = r_len[grow_mask].copy()
+            self.garbage += int(r_cap[grow_mask].sum())
             moved = old_len > 0
             if moved.any():
                 # Growth offsets start at the old heap_end: disjoint.
@@ -509,15 +856,14 @@ class SlabIndex:
                 mv[0, :mv_count] = old_start[moved]
                 mv[1, :mv_count] = offs[moved]
                 mv[2, :mv_count] = old_len[moved]
-            self.row_start[grow_rows] = offs
-            self.row_cap[grow_rows] = new_caps
+            self.rows.update(grow_rows, start=offs, cap=new_caps)
         # Append slots: start + len + within-row rank (new_key is sorted,
         # so same-row entries are contiguous and rank is positional).
         rank = (np.arange(len(new_key))
                 - np.repeat(first_idx, counts)).astype(np.int32)
-        new_slots = (self.row_start[n_src] + self.row_len[n_src]
-                     + rank).astype(np.int32)
-        self.row_len[rows_new32] = need
+        k_start, k_len, _ = self.rows.get(n_src)
+        new_slots = (k_start + k_len + rank).astype(np.int32)
+        self.rows.update(rows_new32, length=need)
         return mv, mv_len, new_slots
 
     def needs_compaction(self, min_heap: int) -> bool:
@@ -526,13 +872,38 @@ class SlabIndex:
         # row vs live cap C), so a 1/2 threshold would never fire.
         return self.garbage * 3 > self.heap_end and self.heap_end > min_heap
 
+    def row_cells(self, rows: np.ndarray):
+        """Live cells of ``rows`` as ``(keys, slots)``, rows concatenated
+        in order (keys sorted within each row — the sorted layout's
+        per-row segments are key-ordered). The promotion path reads a
+        row's cells through this before handing them to the wide index."""
+        lo = np.searchsorted(self.g_key, rows.astype(np.int64) << 32)
+        _s, lens, _c = self.rows.get(rows)
+        idx = np.repeat(lo, lens) + _ragged_arange(lens)
+        return self.g_key[idx], self.g_slot[idx]
+
+    def free_rows(self, rows: np.ndarray) -> None:
+        """Drop rows and their cells from the index (cell-dtype promotion
+        moved them to the wide side-table): the slab region becomes
+        garbage for the next compaction and the keys are really deleted,
+        so a freed key can re-insert later as a fresh cell (the
+        compaction-reinsertion edge case, tests/test_slab_registry.py).
+        Promotions are rare (Zipf head only); the O(total) segment
+        delete is off the steady-state path."""
+        _s, lens, cap = self.rows.get(rows)
+        self.garbage += int(cap.sum())
+        lo = np.searchsorted(self.g_key, rows.astype(np.int64) << 32)
+        idx = np.repeat(lo, lens) + _ragged_arange(lens)
+        self.g_key = np.delete(self.g_key, idx)
+        self.g_slot = np.delete(self.g_slot, idx)
+        self.rows.clear(rows)
+
     def compact(self) -> np.ndarray:
         """Defragment: re-lay rows contiguously (row-id order). Returns
         the slot-space gather map (new slab = old slab[gmap]); updates the
         index in place. The caller runs the device gather."""
-        alloc = np.flatnonzero(self.row_cap > 0).astype(np.int32)
-        lens = self.row_len[alloc]
-        old_starts = self.row_start[alloc]
+        alloc = self.rows.occupied()
+        old_starts, lens, _caps = self.rows.get(alloc)
         new_caps = _pow2ceil(lens, minimum=4)
         new_starts = np.concatenate(
             [[0], np.cumsum(new_caps)[:-1]]).astype(np.int32)
@@ -547,8 +918,7 @@ class SlabIndex:
         # old positions before writing, so overlapping old/new regions of
         # different rows are safe).
         self._shift_moved(alloc, old_starts, lens, new_starts)
-        self.row_start[alloc] = new_starts
-        self.row_cap[alloc] = new_caps
+        self.rows.update(alloc, start=new_starts, cap=new_caps)
         self.heap_end = new_end
         self.garbage = 0
         self.compactions += 1
@@ -558,9 +928,7 @@ class SlabIndex:
         """Reset to a fresh contiguous layout for ``keys`` (sorted packed
         cell keys, e.g. from a checkpoint). Returns the slot per key."""
         rows_all = (keys >> 32).astype(np.int64)
-        self.row_start[:] = 0
-        self.row_len[:] = 0
-        self.row_cap[:] = 0
+        self.rows.reset()
         if len(keys) == 0:
             self.g_key = keys.copy()
             self.g_slot = np.zeros(0, dtype=np.int32)
@@ -572,9 +940,8 @@ class SlabIndex:
         rows_u32 = rows_u.astype(np.int32)
         caps = _pow2ceil(counts.astype(np.int32), minimum=4)
         starts = np.concatenate([[0], np.cumsum(caps)[:-1]]).astype(np.int32)
-        self.row_start[rows_u32] = starts
-        self.row_len[rows_u32] = counts
-        self.row_cap[rows_u32] = caps
+        self.rows.update(rows_u32, start=starts,
+                         length=counts.astype(np.int32), cap=caps)
         self.heap_end = int(caps.sum())
         self.garbage = 0
         self.g_key = keys.copy()
@@ -783,6 +1150,42 @@ class HashSlabIndex(SlabIndex):
         order = np.argsort(keys, kind="stable")
         return keys[order], slots[order]
 
+    @property
+    def nbytes(self) -> int:
+        return (self.rows.nbytes + self._tkeys.nbytes + self._tvals.nbytes
+                + self.slot_key.nbytes)
+
+    def row_cells(self, rows: np.ndarray):
+        """Hash-layout override: recover keys through the reverse map
+        (insertion order within a row; the caller sorts jointly)."""
+        starts, lens, _ = self.rows.get(rows)
+        idx = np.repeat(starts, lens) + _ragged_arange(lens)
+        return self.slot_key[idx].copy(), idx.astype(np.int32)
+
+    def free_rows(self, rows: np.ndarray) -> None:
+        """Hash-layout override: the open-addressing table has no
+        tombstones, so deletion rebuilds it minus the dead keys —
+        promotions are rare enough that the rebuild is off the
+        steady-state path."""
+        starts, lens, cap = self.rows.get(rows)
+        self.garbage += int(cap.sum())
+        idx = np.repeat(starts, lens) + _ragged_arange(lens)
+        dead = self.slot_key[idx]
+        self.slot_key[idx] = -1
+        live = self._tkeys != -1
+        tk, tv = self._tkeys[live], self._tvals[live]
+        keep = ~np.isin(tk, dead)
+        tk = np.ascontiguousarray(tk[keep])
+        tv = np.ascontiguousarray(tv[keep])
+        self._tkeys = np.full(self._cap, -1, dtype=np.int64)
+        self._tvals = np.zeros(self._cap, dtype=np.int32)
+        if len(tk):
+            self._check_probe(self._lib.slab_hash_insert(
+                self._p64(self._tkeys), self._p32(self._tvals),
+                self._cap - 1, self._p64(tk), self._p32(tv), len(tk)))
+        self._n = len(tk)
+        self.rows.clear(rows)
+
 
 def make_slab_index(rows_capacity: int = 1 << 10) -> SlabIndex:
     """Best available cell index: the native hash table, else sorted."""
@@ -824,10 +1227,28 @@ class SparseDeviceScorer:
                  score_ladder: Optional[int] = None,
                  defer_results: bool = False,
                  fixed_shapes: Optional[bool] = None,
-                 use_pallas: str = "auto") -> None:
+                 use_pallas: str = "auto",
+                 cell_dtype: str = "int32",
+                 wire_format: str = "raw") -> None:
         from ..xla_cache import enable_compilation_cache
+        from .wire import CELL_DTYPES, cell_promote_threshold
 
         enable_compilation_cache()
+        if cell_dtype not in CELL_DTYPES:
+            raise ValueError(
+                f"cell_dtype must be one of {sorted(CELL_DTYPES)}, got "
+                f"{cell_dtype!r}")
+        if wire_format not in ("raw", "packed"):
+            raise ValueError(
+                f"wire_format must be raw or packed, got {wire_format!r}")
+        self.cell_dtype = cell_dtype
+        self._cnt_dtype = CELL_DTYPES[cell_dtype]
+        # Narrow-cell promotion bound (None for int32): a row whose sum
+        # reaches it moves to the wide int32 side-table BEFORE this
+        # window's deltas apply, so narrow cells can never saturate and
+        # scores stay bit-identical to an int32 slab.
+        self.promote_threshold = cell_promote_threshold(cell_dtype)
+        self.wire_packed = wire_format == "packed"
         self.top_k = top_k
         # Bucket-ladder base for the scoring dispatches (see score_buckets).
         # Env-tunable so high-latency links can trade padding for fewer
@@ -843,10 +1264,27 @@ class SparseDeviceScorer:
         self.row_sums_host = np.zeros(self.items_cap, dtype=np.int64)
         self.compact_min_heap = int(compact_min_heap)
         self.capacity = int(capacity)
-        self.cnt = jnp.zeros(self.capacity, dtype=jnp.int32)
+        self.cnt = jnp.zeros(self.capacity, dtype=self._cnt_dtype)
         self.dst = jnp.zeros(self.capacity, dtype=jnp.int32)
         self.row_sums = jnp.zeros(self.items_cap, dtype=jnp.int32)
         self.observed = 0
+        # Exact live-cell count (dead promoted index entries excluded) —
+        # feeds cooc_slab_live_cells and the bench's cells-per-byte.
+        self.live_cells = 0
+        # Wide int32 side-table (narrow cell dtypes only): its own
+        # SlabIndex over the same row-id space plus a private slab pair.
+        # Rows promote in whole — a row is entirely narrow or entirely
+        # wide — so scoring stays per-row and the shared kernels run
+        # unchanged over whichever slab pair holds the row.
+        if self.promote_threshold is not None:
+            self.index_w = make_slab_index(rows_capacity=items_capacity)
+            self.capacity_w = 1 << 10
+            self.cnt_w = jnp.zeros(self.capacity_w, dtype=jnp.int32)
+            self.dst_w = jnp.zeros(self.capacity_w, dtype=jnp.int32)
+            self.wide_rows = np.zeros(self.items_cap, dtype=bool)
+        else:
+            self.index_w = None
+        self._plan_buckets_w = {}
         # One-window-deep result pipeline (see ops/device_scorer.py).
         self._pending: Optional[List] = None
         self.last_dispatched_rows = 0
@@ -915,6 +1353,10 @@ class SparseDeviceScorer:
         grown[: len(self.row_sums_host)] = self.row_sums_host
         self.row_sums_host = grown
         self.row_sums = _grow(self.row_sums, n=new_cap)
+        if self.index_w is not None:
+            wide = np.zeros(new_cap, dtype=bool)
+            wide[: len(self.wide_rows)] = self.wide_rows
+            self.wide_rows = wide
         self.items_cap = new_cap
         if self._results is not None:
             self._results.resize(new_cap)
@@ -928,6 +1370,16 @@ class SparseDeviceScorer:
         self.cnt = _grow(self.cnt, n=new_cap)
         self.dst = _grow(self.dst, n=new_cap)
         self.capacity = new_cap
+
+    def _ensure_heap_w(self, need_end: int) -> None:
+        if need_end <= self.capacity_w:
+            return
+        new_cap = self.capacity_w
+        while new_cap < need_end:
+            new_cap *= 2
+        self.cnt_w = _grow(self.cnt_w, n=new_cap)
+        self.dst_w = _grow(self.dst_w, n=new_cap)
+        self.capacity_w = new_cap
 
     # -- the window step --------------------------------------------------
 
@@ -958,6 +1410,15 @@ class SparseDeviceScorer:
             LEDGER.up("compact-gather", gmap_pad)
             self.cnt, self.dst = _compact_gather(self.cnt, self.dst,
                                                  gmap_pad, cap=self.capacity)
+        if (self.index_w is not None
+                and self.index_w.needs_compaction(self.compact_min_heap)):
+            gmap = self.index_w.compact()
+            gmap_pad = np.zeros(min(pad_pow2(len(gmap), minimum=1 << 10),
+                                    self.capacity_w), dtype=np.int32)
+            gmap_pad[: len(gmap)] = gmap
+            LEDGER.up("compact-gather-wide", gmap_pad)
+            self.cnt_w, self.dst_w = _compact_gather(
+                self.cnt_w, self.dst_w, gmap_pad, cap=self.capacity_w)
         self._ensure_items(int(max(pairs.src.max(), pairs.dst.max())))
         if isinstance(pairs, AggregatedPairs):
             src_d, d_val, d_key = pairs.src, pairs.delta, pairs.key
@@ -983,8 +1444,83 @@ class SparseDeviceScorer:
         self.observed += window_sum
         self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
 
-        plan = self.index.apply(d_key)
-        self._ensure_heap(self.index.heap_end)
+        # Narrow-cell promotion, then the per-slab split: a cell routes by
+        # its row's residency, decided BEFORE this window's deltas apply.
+        if self.index_w is not None:
+            self._promote_rows(rows)
+            cell_wide = self.wide_rows[src_d]
+        else:
+            cell_wide = None
+        if cell_wide is not None and cell_wide.any():
+            self._window_update(d_key[~cell_wide], d_val32[~cell_wide],
+                                rows, rs_delta, wide=False)
+            self._window_update(d_key[cell_wide], d_val32[cell_wide],
+                                rows[:0], rs_delta[:0], wide=True)
+        else:
+            self._window_update(d_key, d_val32, rows, rs_delta, wide=False)
+
+        if self.development_mode:
+            self._check_row_sums(rows)
+
+        # Score every updated row, length-bucketed (padding is device-only).
+        self.counters.add(RESCORED_ITEMS, len(rows))
+        self.last_dispatched_rows = len(rows)
+        if self.index_w is not None and self.wide_rows[rows].any():
+            wmask = self.wide_rows[rows]
+            chunks = self._dispatch_scoring(rows[~wmask], wide=False)
+            chunks += self._dispatch_scoring(rows[wmask], wide=True)
+        else:
+            chunks = self._dispatch_scoring(rows)
+        self._record_state_gauges()
+
+        prev, self._pending = self._pending, chunks
+        return (self._materialize(prev) if prev is not None
+                else TopKBatch.empty(self.top_k))
+
+    def _promote_rows(self, rows: np.ndarray) -> None:
+        """Promote rows whose (already-updated) sum crossed the narrow
+        bound: move their cells to the wide side-table before this
+        window's deltas touch them — saturation can never be observed."""
+        thr = self.promote_threshold
+        sel = (self.row_sums_host[rows] >= thr) & ~self.wide_rows[rows]
+        if not sel.any():
+            return
+        newly = rows[sel]
+        self.wide_rows[newly] = True
+        keys, slots = self.index.row_cells(newly)
+        self.index.free_rows(newly)
+        if not len(keys):
+            return  # first-ever window already past the bound: no cells yet
+        order = np.argsort(keys, kind="stable")
+        keys = keys[order]
+        slots = slots[order].astype(np.int32)
+        plan_w = self.index_w.apply(keys)
+        self._ensure_heap_w(self.index_w.heap_end)
+        m = len(keys)
+        m_pad = pad_pow2(m, minimum=64)
+        src = np.zeros(m_pad, dtype=np.int32)
+        src[:m] = slots
+        dsts = np.full(m_pad, _SENT, dtype=np.int32)
+        dsts[:m] = plan_w.slots
+        LEDGER.up("promote-cells", src, dsts)
+        self.cnt_w, self.dst_w = _promote_cells(
+            self.cnt, self.dst, self.cnt_w, self.dst_w, src, dsts)
+
+    def _window_update(self, d_key: np.ndarray, d_val32: np.ndarray,
+                       rows: np.ndarray, rs_delta: np.ndarray,
+                       wide: bool = False) -> None:
+        """Allocate slots and dispatch one slab's window update. The
+        narrow dispatch also carries the shared row-sum section (row
+        sums are slab-independent); the wide dispatch's is empty."""
+        index = self.index_w if wide else self.index
+        plan = index.apply(d_key)
+        if wide:
+            self._ensure_heap_w(index.heap_end)
+            cnt_t, dst_t = self.cnt_w, self.dst_w
+        else:
+            self._ensure_heap(index.heap_end)
+            cnt_t, dst_t = self.cnt, self.dst
+        self.live_cells += plan.n_new
 
         # One packed update upload: new cells | deltas | row sums.
         n_new = plan.n_new
@@ -1002,51 +1538,102 @@ class SparseDeviceScorer:
         upd[0, n_new + n_d: n] = rows
         upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
         bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
+        lbl = "update-wide" if wide else "update"
 
-        parts = split_upload_auto(upd)
-        if parts is not None:
-            # Ledger mirrors the actual transfer pattern: one event per
-            # chunk plus the small metadata buffers (same byte total as
-            # the monolithic event).
-            for p in parts:
-                LEDGER.up("update-chunk", p)
-        if plan.mv is not None:
-            if parts is not None:
-                LEDGER.up("update-meta", bounds, plan.mv)
-                self.cnt, self.dst, self.row_sums = (
-                    _apply_moves_update_chunked(
-                        self.cnt, self.dst, self.row_sums, plan.mv,
-                        parts, bounds, L=plan.mv_len))
+        # An explicit upload-split request (TPU_COOC_UPLOAD_CHUNKS /
+        # _CHUNK_KB) pins the raw chunked path — the two wire levers are
+        # alternatives, and an operator A/B-ing chunk sizes must not
+        # silently measure the packed encoder instead.
+        parts = split_upload_auto(upd) if not wide else None
+        if parts is None and self.wire_packed:
+            from .wire import encode_update
+
+            words_i, words_v, header = encode_update(upd, bounds, n)
+            wi = _pad_words(words_i)
+            wv = _pad_words(words_v)
+            if plan.mv is not None:
+                LEDGER.up("update-moves", plan.mv)
+                LEDGER.up_encoded(lbl + "-packed",
+                                  upd.nbytes + bounds.nbytes, wi, wv, header)
+                cnt_t, dst_t, self.row_sums = _apply_moves_update_packed(
+                    cnt_t, dst_t, self.row_sums, plan.mv, wi, wv, header,
+                    n_pad=n_pad, L=plan.mv_len)
             else:
-                LEDGER.up("update", upd, bounds, plan.mv)
-                self.cnt, self.dst, self.row_sums = _apply_moves_update(
-                    self.cnt, self.dst, self.row_sums, plan.mv, upd,
-                    bounds, L=plan.mv_len)
+                LEDGER.up_encoded(lbl + "-packed",
+                                  upd.nbytes + bounds.nbytes, wi, wv, header)
+                cnt_t, dst_t, self.row_sums = _apply_update_packed(
+                    cnt_t, dst_t, self.row_sums, wi, wv, header, n_pad=n_pad)
         else:
             if parts is not None:
-                LEDGER.up("update-meta", bounds)
-                self.cnt, self.dst, self.row_sums = _apply_update_chunked(
-                    self.cnt, self.dst, self.row_sums, parts, bounds)
+                # Ledger mirrors the actual transfer pattern: one event
+                # per chunk plus the small metadata buffers (same byte
+                # total as the monolithic event).
+                for p in parts:
+                    LEDGER.up("update-chunk", p)
+            if plan.mv is not None:
+                if parts is not None:
+                    LEDGER.up("update-meta", bounds, plan.mv)
+                    cnt_t, dst_t, self.row_sums = _apply_moves_update_chunked(
+                        cnt_t, dst_t, self.row_sums, plan.mv,
+                        parts, bounds, L=plan.mv_len)
+                else:
+                    LEDGER.up(lbl, upd, bounds, plan.mv)
+                    cnt_t, dst_t, self.row_sums = _apply_moves_update(
+                        cnt_t, dst_t, self.row_sums, plan.mv, upd,
+                        bounds, L=plan.mv_len)
             else:
-                LEDGER.up("update", upd, bounds)
-                self.cnt, self.dst, self.row_sums = _apply_update(
-                    self.cnt, self.dst, self.row_sums, upd, bounds)
+                if parts is not None:
+                    LEDGER.up("update-meta", bounds)
+                    cnt_t, dst_t, self.row_sums = _apply_update_chunked(
+                        cnt_t, dst_t, self.row_sums, parts, bounds)
+                else:
+                    LEDGER.up(lbl, upd, bounds)
+                    cnt_t, dst_t, self.row_sums = _apply_update(
+                        cnt_t, dst_t, self.row_sums, upd, bounds)
+        if wide:
+            self.cnt_w, self.dst_w = cnt_t, dst_t
+        else:
+            self.cnt, self.dst = cnt_t, dst_t
 
-        if self.development_mode:
-            self._check_row_sums(rows)
+    def _record_state_gauges(self) -> None:
+        """Per-window state-footprint gauges (the compression layer's
+        headline numbers: host index RSS, device slab bytes, live cells)."""
+        from ..observability.registry import REGISTRY
 
-        # Score every updated row, length-bucketed (padding is device-only).
-        self.counters.add(RESCORED_ITEMS, len(rows))
-        self.last_dispatched_rows = len(rows)
-        chunks = self._dispatch_scoring(rows)
+        rss = self.index.nbytes
+        slab = self.cnt.nbytes + self.dst.nbytes
+        if self.index_w is not None:
+            rss += self.index_w.nbytes + self.wide_rows.nbytes
+            slab += self.cnt_w.nbytes + self.dst_w.nbytes
+        REGISTRY.gauge(
+            "cooc_host_index_rss_bytes",
+            help="host-side slab index footprint (registry + cell "
+                 "index), refreshed per window").set(rss)
+        REGISTRY.gauge(
+            "cooc_slab_device_bytes",
+            help="device slab allocation (cnt + dst, narrow and wide)"
+        ).set(slab)
+        REGISTRY.gauge(
+            "cooc_slab_live_cells",
+            help="live matrix cells across narrow and wide slabs"
+        ).set(self.live_cells)
 
-        prev, self._pending = self._pending, chunks
-        return (self._materialize(prev) if prev is not None
-                else TopKBatch.empty(self.top_k))
-
-    def _dispatch_scoring(self, rows: np.ndarray) -> List[Tuple]:
-        starts = self.index.row_start[rows]
-        lens = self.index.row_len[rows]
+    def _dispatch_scoring(self, rows: np.ndarray,
+                          wide: bool = False) -> List[Tuple]:
+        """Score ``rows`` out of one slab pair (``wide`` routes promoted
+        rows through the int32 side-table; the kernels are dtype- and
+        buffer-polymorphic, so both residencies share every program)."""
+        if wide:
+            index, cnt, dst = self.index_w, self.cnt_w, self.dst_w
+            plan_buckets = self._plan_buckets_w
+        else:
+            index, cnt, dst = self.index, self.cnt, self.dst
+            plan_buckets = self._plan_buckets
+        if len(rows) == 0 and not plan_buckets:
+            return []
+        # One registry pass (the _RowField views are the compat shim for
+        # external callers; this is the per-window hot path).
+        starts, lens, _caps = index.rows.get(rows)
         min_r = max(16, self.top_k)  # lax.top_k needs k <= R
         bucket, order = score_buckets(lens, min_r, self.score_ladder)
         b_sorted = bucket[order]
@@ -1065,8 +1652,7 @@ class SparseDeviceScorer:
                 R = bucket_r(b, min_r, self.score_ladder)
                 S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
                 n_chunks = -(-n_rows // S)
-                self._plan_buckets[b] = max(
-                    self._plan_buckets.get(b, 0), n_chunks)
+                plan_buckets[b] = max(plan_buckets.get(b, 0), n_chunks)
         pos = 0
         while pos < len(order):
             b = int(b_sorted[pos])
@@ -1098,7 +1684,7 @@ class SparseDeviceScorer:
                     # Fused: the scatter rides the scoring dispatch (the
                     # table is donated in and reassigned).
                     self._results.tbl = _score_into_table(
-                        self._results.tbl, self.cnt, self.dst,
+                        self._results.tbl, cnt, dst,
                         self.row_sums, meta, np.float32(self.observed),
                         top_k=self.top_k, R=R,
                         pallas=self._rect_pallas(R),
@@ -1108,7 +1694,7 @@ class SparseDeviceScorer:
                          else _score_slab)
                 kw = ({"interpret": self._pallas_interpret}
                       if self._rect_pallas(R) else {})
-                packed = score(self.cnt, self.dst, self.row_sums,
+                packed = score(cnt, dst, self.row_sums,
                                meta, np.float32(self.observed),
                                top_k=self.top_k, R=R, **kw)
                 if hasattr(packed, "copy_to_host_async"):
@@ -1121,7 +1707,7 @@ class SparseDeviceScorer:
             have = {}
             for R, _S, _c in rects:
                 have[R] = have.get(R, 0) + 1
-            for b, n_chunks in self._plan_buckets.items():
+            for b, n_chunks in plan_buckets.items():
                 R = bucket_r(b, min_r, self.score_ladder)
                 S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
                 for _ in range(n_chunks - have.get(R, 0)):
@@ -1145,7 +1731,7 @@ class SparseDeviceScorer:
                 off += S
             LEDGER.up("window-meta", meta_all)
             self._results.tbl = _score_window_into_table(
-                self._results.tbl, self.cnt, self.dst, self.row_sums,
+                self._results.tbl, cnt, dst, self.row_sums,
                 meta_all, np.float32(self.observed),
                 top_k=self.top_k, plan=tuple(plan),
                 interpret=self._pallas_interpret)
@@ -1156,11 +1742,16 @@ class SparseDeviceScorer:
     def _check_row_sums(self, rows: np.ndarray) -> None:
         """Dev-mode invariant: slab row contents sum to the tracked row sum
         (reference check, ItemRowRescorerTwoInputStreamOperator.java:183-193)."""
-        cnt = np.asarray(self.cnt)
-        starts = self.index.row_start[rows]
-        lens = self.index.row_len[rows]
-        for r, s, ln in zip(rows.tolist(), starts.tolist(), lens.tolist()):
-            actual = int(cnt[s: s + ln].sum())
+        cnt = np.asarray(self.cnt).astype(np.int64)
+        cnt_w = (np.asarray(self.cnt_w) if self.index_w is not None
+                 else None)
+        for r in rows.tolist():
+            if self.index_w is not None and self.wide_rows[r]:
+                s, ln = self.index_w.row_start[r], self.index_w.row_len[r]
+                actual = int(cnt_w[s: s + ln].sum())
+            else:
+                s, ln = self.index.row_start[r], self.index.row_len[r]
+                actual = int(cnt[s: s + ln].sum())
             if actual != int(self.row_sums_host[r]):
                 raise AssertionError(
                     f"Item row {int(self.row_sums_host[r])} does not match "
@@ -1189,16 +1780,37 @@ class SparseDeviceScorer:
 
     def checkpoint_state(self) -> dict:
         """Canonical sparse-matrix snapshot — same keys as the hybrid
-        backend, so checkpoints are interchangeable between the two."""
+        backend, so checkpoints are interchangeable between the two (and
+        between cell dtypes: narrow/wide residency is an in-memory
+        layout, not a checkpoint concern)."""
         keys, slots = self.index.keys_and_slots()
+        if self.index_w is not None:
+            # free_rows deletes promoted rows' narrow entries; the mask
+            # filter is defensive belt-and-braces on top of that.
+            live = ~self.wide_rows[(keys >> 32).astype(np.int64)]
+            keys, slots = keys[live], slots[live]
         if len(slots):
             # Gather live cells ON DEVICE so the fetch is nnz values, not
             # the whole slab (capacity >= 2x nnz from pow-2 slack+garbage).
+            # The ledger books the NARROW fetched array — widening to
+            # int64 happens host-side and never crosses the wire.
             LEDGER.up("checkpoint-slots", slots)
-            vals = np.asarray(self.cnt[jnp.asarray(slots)])
-            LEDGER.down("checkpoint-cells", vals)
+            fetched = np.asarray(self.cnt[jnp.asarray(slots)])
+            LEDGER.down("checkpoint-cells", fetched)
+            vals = fetched.astype(np.int64)
         else:
             vals = np.zeros(0, np.int64)
+        if self.index_w is not None:
+            keys_w, slots_w = self.index_w.keys_and_slots()
+            if len(slots_w):
+                LEDGER.up("checkpoint-slots", slots_w)
+                fetched_w = np.asarray(self.cnt_w[jnp.asarray(slots_w)])
+                LEDGER.down("checkpoint-cells", fetched_w)
+                vals_w = fetched_w.astype(np.int64)
+                keys = np.concatenate([keys, keys_w])
+                vals = np.concatenate([vals, vals_w])
+                order = np.argsort(keys, kind="stable")
+                keys, vals = keys[order], vals[order]
         nz = vals != 0
         return {
             "rows_key": keys[nz],
@@ -1208,6 +1820,8 @@ class SparseDeviceScorer:
         }
 
     def restore_state(self, st: dict) -> None:
+        from .wire import checked_narrow
+
         key = st["rows_key"]
         cnt_vals = st["rows_cnt"]
         max_id = int(max((key >> 32).max(initial=0),
@@ -1219,28 +1833,50 @@ class SparseDeviceScorer:
             new_cap = int(_pow2ceil(np.asarray([max_id + 1]), 1024)[0])
             self.row_sums_host = np.zeros(new_cap, dtype=np.int64)
             self.items_cap = new_cap
-        slots = self.index.rebuild_from_keys(key)
-        while self.capacity < self.index.heap_end:
-            self.capacity *= 2
-        cnt_host = np.zeros(self.capacity, dtype=np.int32)
-        dst_host = np.zeros(self.capacity, dtype=np.int32)
-        cnt_host[slots] = cnt_vals.astype(np.int32)
-        dst_host[slots] = (key & 0xFFFFFFFF).astype(np.int32)
-        LEDGER.up("restore-slab", cnt_host, dst_host)
-        self.cnt = jnp.asarray(cnt_host)
-        self.dst = jnp.asarray(dst_host)
         rs = np.asarray(st["row_sums"], dtype=np.int64)
         if len(rs) > self.items_cap and rs[self.items_cap:].any():
             # Row-sum == sum of the row's cells (dev-mode invariant), so a
             # nonzero sum beyond the max cell id is a corrupt checkpoint.
             raise ValueError("checkpoint row sums extend past its cells")
-        self.row_sums_host[:] = 0
+        self.row_sums_host = np.zeros(self.items_cap, dtype=np.int64)
         m = min(len(rs), self.items_cap)
         self.row_sums_host[:m] = rs[:m]
+        if self.index_w is not None:
+            # Residency from the restored sums: any row at/past the bound
+            # goes wide (a once-promoted row whose sum has since dropped
+            # back under the bound fits narrow again — every cell is at
+            # most the current sum — so the threshold rule is exact).
+            self.wide_rows = self.row_sums_host >= self.promote_threshold
+            wide_cells = self.wide_rows[(key >> 32).astype(np.int64)]
+            key_w, cnt_w_vals = key[wide_cells], cnt_vals[wide_cells]
+            key, cnt_vals = key[~wide_cells], cnt_vals[~wide_cells]
+            slots_w = self.index_w.rebuild_from_keys(key_w)
+            self.capacity_w = 1 << 10
+            while self.capacity_w < self.index_w.heap_end:
+                self.capacity_w *= 2
+            cnt_w_host = np.zeros(self.capacity_w, dtype=np.int32)
+            dst_w_host = np.zeros(self.capacity_w, dtype=np.int32)
+            cnt_w_host[slots_w] = cnt_w_vals.astype(np.int32)
+            dst_w_host[slots_w] = (key_w & 0xFFFFFFFF).astype(np.int32)
+            LEDGER.up("restore-slab", cnt_w_host, dst_w_host)
+            self.cnt_w = jnp.asarray(cnt_w_host)
+            self.dst_w = jnp.asarray(dst_w_host)
+        slots = self.index.rebuild_from_keys(key)
+        while self.capacity < self.index.heap_end:
+            self.capacity *= 2
+        cnt_host = np.zeros(self.capacity, dtype=self._cnt_dtype)
+        dst_host = np.zeros(self.capacity, dtype=np.int32)
+        cnt_host[slots] = checked_narrow(cnt_vals, self._cnt_dtype)
+        dst_host[slots] = (key & 0xFFFFFFFF).astype(np.int32)
+        LEDGER.up("restore-slab", cnt_host, dst_host)
+        self.cnt = jnp.asarray(cnt_host)
+        self.dst = jnp.asarray(dst_host)
         self.row_sums = jnp.asarray(self.row_sums_host.astype(np.int32))
         self.observed = int(st["observed"][0])
+        self.live_cells = len(st["rows_key"])
         # In-flight results belong to windows after the checkpoint.
         self._pending = None
         if self._results is not None:
             self._results.reset(self.items_cap)
         self._plan_buckets = {}
+        self._plan_buckets_w = {}

@@ -1,0 +1,367 @@
+"""Compressed sparse state + wire formats (SMASH / FlashSparse playbook).
+
+Three codecs, one module, so every byte-layout decision the sparse
+backend makes is in one reviewable place:
+
+* **Narrow cell dtypes** — slab ``cnt`` cells stored as int16 (or int8
+  behind ``--cell-dtype``), exactness guaranteed by *promoting a row to
+  the wide int32 side-table BEFORE any of its cells could saturate*
+  (``cell_promote_threshold``: a row whose sum stays under ``2^(w-1)``
+  can never hold a cell at or past the dtype max, because cells are
+  non-negative and sum to the row sum). :func:`checked_narrow` is the
+  canonical guarded narrowing cast — the ``narrow-cast-guard`` cooclint
+  rule (``analysis/rules_wire.py``) rejects bare ``astype(int16/int8)``
+  sites elsewhere.
+
+* **Packed uplink** (``encode_update`` / ``decode_update``) — the
+  per-window COO update buffer (``[2, n_pad] int32``: new cells |
+  cell deltas | row sums, see ``sparse_scorer._update_body``) encoded as
+  per-section *sorted delta + zigzag + fixed-width bit-pack*. Each
+  section's scatter is order-independent (unique indices per section;
+  integer scatter-adds commute), so sorting by index inside a section is
+  free, deltas of sorted unique indices are small, and a per-window bit
+  width packs them. Fixed-width (not varint) on the wire because the
+  decode then needs only gathers, shifts and cumsums — a tiny jit
+  prologue feeding the existing scatter unchanged — where varint's
+  per-element byte boundaries would serialize an on-device decode.
+
+* **Checkpoint blobs** (``encode_varint`` / ``encode_sorted_u64``) —
+  delta + LEB128 varint for the sorted cell-key array and plain varint
+  for the count array (host-decoded on restore, so variable-length is
+  fine there). Rides inside the existing ``.npz`` generation format;
+  ``state/checkpoint.py`` records the codec in the embedded meta and
+  restores pre-codec files unchanged.
+
+All encoders are exact (bit-identical round trip) for the full int32 /
+nonnegative int64 domains they are applied to; property tests in
+``tests/test_wire_format.py`` pin the round trips, and the device decode
+is parity-tested against the host decode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Scatter sentinel: mirrors sparse_scorer._SENT (>= any capacity, dropped
+# by mode="drop") without importing it (this module must stay leaf-level).
+SENT = np.int32(2**31 - 1)
+
+# -- narrow cell dtypes ------------------------------------------------
+
+#: ``--cell-dtype`` values -> numpy dtype of the slab ``cnt`` cells.
+CELL_DTYPES = {"int32": np.int32, "int16": np.int16, "int8": np.int8}
+
+
+def cell_promote_threshold(cell_dtype: str) -> Optional[int]:
+    """Row-sum bound below which every cell of a row provably fits the
+    narrow dtype (cells are non-negative and sum to the row sum, so each
+    cell <= row sum < 2^(w-1) <= dtype max). A row whose running sum
+    reaches this value is promoted to the wide int32 side-table *before*
+    the window's deltas are applied — saturation can never occur.
+    Returns ``None`` for int32 (nothing ever promotes)."""
+    if cell_dtype == "int32":
+        return None
+    bits = np.iinfo(CELL_DTYPES[cell_dtype]).bits
+    return 1 << (bits - 1)
+
+
+def checked_narrow(arr: np.ndarray, dtype) -> np.ndarray:
+    """The canonical guarded narrowing cast: raises instead of wrapping.
+
+    Every host-side cast to a narrower integer dtype must go through
+    here (or carry its own visible bounds check) — enforced by the
+    ``narrow-cast-guard`` rule in ``analysis/rules_wire.py``.
+    """
+    info = np.iinfo(dtype)
+    if len(arr) and (int(arr.min()) < info.min or int(arr.max()) > info.max):
+        raise OverflowError(
+            f"value range [{arr.min()}, {arr.max()}] does not fit "
+            f"{np.dtype(dtype).name} [{info.min}, {info.max}]")
+    return arr.astype(dtype)
+
+
+def resolve_cell_dtype(flag: str, sparse_single_device: bool) -> str:
+    """``--cell-dtype`` resolution: ``auto`` = int16 on the single-device
+    sparse backend (the promotion side-table lives there), int32
+    everywhere else. Explicit narrow requests on backends that cannot
+    honor them are rejected at config time, not here."""
+    if flag == "auto":
+        return "int16" if sparse_single_device else "int32"
+    return flag
+
+
+def resolve_wire_format(flag: str, sparse_single_device: bool) -> str:
+    """``--wire-format`` resolution: ``auto`` = packed uplink on the
+    single-device sparse backend (its update buffer is the steady-state
+    wire cost), raw elsewhere. The checkpoint codec resolves separately
+    (``checkpoint_codec``) — packed checkpoints are host-decoded and
+    backend-independent."""
+    if flag == "auto":
+        return "packed" if sparse_single_device else "raw"
+    return flag
+
+
+def checkpoint_codec(flag: str) -> str:
+    """Checkpoint-blob codec from ``--wire-format``: ``auto``/``packed``
+    write the delta+varint generation format, ``raw`` writes the
+    pre-codec layout (and doubles as the old-format fixture for restore
+    tests). Restore auto-detects from the embedded meta either way."""
+    return "raw" if flag == "raw" else "packed"
+
+
+# -- fixed-width bit packing -------------------------------------------
+
+
+def pack_bits(values: np.ndarray, width: int) -> np.ndarray:
+    """Pack ``values`` (< 2^width each) at ``width`` bits into a little-
+    endian uint32 word stream. ``1 <= width <= 32``."""
+    if not (1 <= width <= 32):
+        raise ValueError(f"pack width must be in [1, 32], got {width}")
+    n = len(values)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint32)
+    vals = values.astype(np.uint64)
+    if int(vals.max()) >> width:
+        raise ValueError(f"value {vals.max()} does not fit {width} bits")
+    bit0 = np.arange(n, dtype=np.uint64) * np.uint64(width)
+    word = (bit0 >> np.uint64(5)).astype(np.int64)
+    off = bit0 & np.uint64(31)
+    n_words = int((n * width + 31) // 32)
+    out = np.zeros(n_words + 1, dtype=np.uint32)  # +1: spill slot
+    lo = ((vals << off) & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    # off == 0 shifts by 32: fine on uint64 (values < 2^32 -> 0).
+    hi = (vals >> (np.uint64(32) - off)).astype(np.uint32)
+    np.bitwise_or.at(out, word, lo)
+    np.bitwise_or.at(out, word + 1, hi)
+    return out[:n_words]
+
+
+def unpack_bits(words: np.ndarray, width: int, n: int) -> np.ndarray:
+    """Host inverse of :func:`pack_bits` -> uint64 array of length ``n``."""
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    w64 = np.append(words.astype(np.uint64), np.uint64(0))
+    bit0 = np.arange(n, dtype=np.uint64) * np.uint64(width)
+    word = (bit0 >> np.uint64(5)).astype(np.int64)
+    off = bit0 & np.uint64(31)
+    combined = w64[word] | (w64[word + 1] << np.uint64(32))
+    mask = (np.uint64(1) << np.uint64(width)) - np.uint64(1) \
+        if width < 64 else np.uint64(0xFFFFFFFFFFFFFFFF)
+    return (combined >> off) & mask
+
+
+# -- the packed update-buffer wire format ------------------------------
+#
+# Layout (see docs/ARCHITECTURE.md "Sparse state" wire table):
+#
+#   header   int32[5]   n, w_idx, w_val, b0, b1
+#   words_i  uint32[.]  index column: per-section delta of the section-
+#                       sorted indices, w_idx bits each (pow2-padded)
+#   words_v  uint32[.]  value column: zigzag(v) at w_val bits each; the
+#                       new-cell section's partner ids are additionally
+#                       delta-coded (sorted slots => near-sorted ids)
+#
+# The decode is exact under int32 wraparound: per-section prefix sums may
+# exceed 2^31 transiently, so both decoders accumulate in uint32 and the
+# final subtraction lands back in the true (< 2^31) value mod 2^32.
+
+
+def _section_starts(n: int, b0: int, b1: int):
+    return (0, b0), (b0, b1), (b1, n)
+
+
+def encode_update(upd: np.ndarray, bounds: np.ndarray,
+                  n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Encode the live prefix ``upd[:, :n]`` of a raw update buffer.
+
+    Returns ``(words_i, words_v, header)`` — unpadded word streams; the
+    caller pads to its transfer buckets. Each section is sorted by index
+    first (scatters inside a section are order-independent: indices are
+    unique per section and integer scatter-adds commute), which makes
+    the index column piecewise-sorted and delta-friendly.
+    """
+    b0, b1 = int(bounds[0]), int(bounds[1])
+    idx = upd[0, :n].astype(np.int64)
+    val = upd[1, :n].astype(np.int64)
+    order = np.concatenate([
+        lo + np.argsort(idx[lo:hi], kind="stable")
+        for lo, hi in _section_starts(n, b0, b1)]) if n else \
+        np.zeros(0, dtype=np.int64)
+    idx_s = idx[order]
+    val_s = val[order]
+    d = np.diff(idx_s, prepend=np.int64(0))
+    for s, _e in _section_starts(n, b0, b1)[1:]:
+        if s < n:
+            d[s] = idx_s[s]  # each section restarts from an absolute index
+    # New-cell section values (partner ids) ride as deltas too: slots are
+    # sorted and same-row slots are dst-ordered, so ids are near-sorted.
+    v_enc = val_s.copy()
+    if b0:
+        v_enc[:b0] = np.diff(val_s[:b0], prepend=np.int64(0))
+    zz = ((v_enc << np.int64(1)) ^ (v_enc >> np.int64(63))).astype(np.uint64)
+    w_i = max(int(d.max()).bit_length(), 1) if n else 1
+    w_v = max(int(zz.max()).bit_length(), 1) if n else 1
+    header = np.asarray([n, w_i, w_v, b0, b1], dtype=np.int32)
+    return (pack_bits(d.astype(np.uint64), w_i),
+            pack_bits(zz, w_v), header)
+
+
+def decode_update_host(words_i: np.ndarray, words_v: np.ndarray,
+                       header: np.ndarray, n_pad: int):
+    """Host inverse of :func:`encode_update` (round-trip tests + the
+    reference the jit decode is parity-tested against). Returns
+    ``(upd[2, n_pad] int32, bounds int32[2])`` with sentinel padding —
+    exactly what the raw path would have shipped, modulo the per-section
+    index sort."""
+    n, w_i, w_v, b0, b1 = (int(x) for x in header)
+    d = unpack_bits(words_i, w_i, n).astype(np.int64)
+    zz = unpack_bits(words_v, w_v, n)
+    v = ((zz >> np.uint64(1)).astype(np.int64)
+         ^ -(zz & np.uint64(1)).astype(np.int64))
+    idx = np.zeros(n, dtype=np.int64)
+    val = np.zeros(n, dtype=np.int64)
+    for lo, hi in _section_starts(n, b0, b1):
+        idx[lo:hi] = np.cumsum(d[lo:hi])
+        val[lo:hi] = v[lo:hi]
+    if b0:
+        val[:b0] = np.cumsum(v[:b0])
+    upd = np.full((2, n_pad), SENT, dtype=np.int32)
+    upd[1] = 0
+    upd[0, :n] = idx.astype(np.int32)
+    upd[1, :n] = val.astype(np.int32)
+    return upd, np.asarray([b0, b1], dtype=np.int32)
+
+
+def decode_update(words_i, words_v, header, n_pad: int):
+    """Traceable (jit) decode: gathers, shifts and cumsums only — the
+    prologue that feeds ``sparse_scorer._update_body`` unchanged. Also
+    runs eagerly for tests. Padding positions carry the scatter sentinel
+    (dropped by ``mode="drop"``), mirroring the raw buffer exactly."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = header[0]
+    w_i = header[1].astype(jnp.uint32)
+    w_v = header[2].astype(jnp.uint32)
+    b0, b1 = header[3], header[4]
+    i = jnp.arange(n_pad, dtype=jnp.int32)
+    live = i < n
+
+    def unpack(words, width):
+        bit0 = (i.astype(jnp.uint32) * width)
+        word = (bit0 >> jnp.uint32(5)).astype(jnp.int32)
+        off = bit0 & jnp.uint32(31)
+        lo = words[word] >> off
+        hi = jnp.where(off > 0,
+                       words[word + 1] << ((jnp.uint32(32) - off)
+                                           & jnp.uint32(31)),
+                       jnp.uint32(0))
+        mask = jnp.where(width >= 32, jnp.uint32(0xFFFFFFFF),
+                         (jnp.uint32(1) << (width & jnp.uint32(31)))
+                         - jnp.uint32(1))
+        return (lo | hi) & mask
+
+    d = jnp.where(live, unpack(words_i, w_i), jnp.uint32(0))
+    zz = jnp.where(live, unpack(words_v, w_v), jnp.uint32(0))
+    # Zigzag decode in int32 bit arithmetic (logical shift emulated).
+    zi = lax.bitcast_convert_type(zz, jnp.int32)
+    v = ((zi >> 1) & 0x7FFFFFFF) ^ -(zi & 1)
+
+    # Per-section prefix sums via one global cumsum minus the section
+    # base (uint32: transient sums may wrap past 2^31; the subtraction
+    # is exact mod 2^32 and true values are < 2^31).
+    c = jnp.cumsum(d, dtype=jnp.uint32)
+
+    def base_at(s):
+        return jnp.where(s > 0, c[jnp.maximum(s - 1, 0)], jnp.uint32(0))
+
+    base = jnp.where(i >= b1, base_at(b1),
+                     jnp.where(i >= b0, base_at(b0), jnp.uint32(0)))
+    idx = lax.bitcast_convert_type(c - base, jnp.int32)
+    # New-cell section: values are deltas of near-sorted partner ids.
+    cv = jnp.cumsum(jnp.where(i < jnp.minimum(b0, n), v, 0),
+                    dtype=jnp.int32)
+    val = jnp.where(i < b0, cv, v)
+    upd = jnp.stack([jnp.where(live, idx, jnp.int32(SENT)),
+                     jnp.where(live, val, 0)])
+    return upd, jnp.stack([b0, b1])
+
+
+def packed_nbytes(words_i: np.ndarray, words_v: np.ndarray,
+                  header: np.ndarray) -> int:
+    return int(words_i.nbytes + words_v.nbytes + header.nbytes)
+
+
+# -- varint (LEB128) checkpoint blobs ----------------------------------
+
+
+def encode_varint(values: np.ndarray) -> np.ndarray:
+    """LEB128-encode nonnegative int64/uint64 values -> uint8 stream."""
+    vals = np.asarray(values)
+    if len(vals) and vals.dtype != np.uint64 and int(vals.min()) < 0:
+        raise ValueError("varint encodes nonnegative values only")
+    vals = vals.astype(np.uint64)
+    n = len(vals)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint8)
+    nb = np.ones(n, dtype=np.int64)
+    for k in range(1, 10):
+        nb += (vals >> np.uint64(7 * k)) != 0
+    offsets = np.concatenate([[0], np.cumsum(nb)[:-1]])
+    out = np.zeros(int(nb.sum()), dtype=np.uint8)
+    for k in range(10):
+        sel = nb > k
+        if not sel.any():
+            break
+        byte = ((vals[sel] >> np.uint64(7 * k)) & np.uint64(0x7F)
+                ).astype(np.uint8)
+        cont = (nb[sel] - 1 > k).astype(np.uint8) << 7
+        out[offsets[sel] + k] = byte | cont
+    return out
+
+
+def decode_varint(buf: np.ndarray, count: int) -> np.ndarray:
+    """Inverse of :func:`encode_varint` -> uint64 array of ``count``."""
+    buf = np.asarray(buf, dtype=np.uint8)
+    if count == 0:
+        if len(buf):
+            raise ValueError("varint blob has trailing bytes")
+        return np.zeros(0, dtype=np.uint64)
+    term = buf < 128
+    if int(term.sum()) != count or not term[-1]:
+        raise ValueError(
+            f"varint blob holds {int(term.sum())} values, expected {count}")
+    gid = np.concatenate([[0], np.cumsum(term)[:-1]]).astype(np.int64)
+    starts = np.concatenate([[0], np.flatnonzero(term)[:-1] + 1])
+    pos = np.arange(len(buf), dtype=np.int64) - starts[gid]
+    if int(pos.max()) > 9:
+        raise ValueError("varint run exceeds 10 bytes")
+    out = np.zeros(count, dtype=np.uint64)
+    np.bitwise_or.at(
+        out, gid,
+        (buf & np.uint8(0x7F)).astype(np.uint64) << (np.uint64(7) *
+                                                     pos.astype(np.uint64)))
+    return out
+
+
+def encode_sorted_u64(keys: np.ndarray) -> np.ndarray:
+    """Delta + varint for a sorted nonnegative int64 array (cell keys:
+    sorted, unique -> tiny deltas). Raises on unsorted input — the
+    caller falls back to the raw layout rather than corrupt a blob."""
+    keys = np.asarray(keys, dtype=np.int64)
+    if len(keys):
+        if int(keys.min()) < 0:
+            raise ValueError("sorted-u64 codec needs nonnegative keys")
+        d = np.diff(keys.astype(np.uint64), prepend=np.uint64(0))
+        if len(keys) > 1 and (np.diff(keys) < 0).any():
+            raise ValueError("sorted-u64 codec needs sorted keys")
+    else:
+        d = np.zeros(0, dtype=np.uint64)
+    return encode_varint(d)
+
+
+def decode_sorted_u64(buf: np.ndarray, count: int) -> np.ndarray:
+    d = decode_varint(buf, count)
+    return np.cumsum(d.astype(np.uint64)).astype(np.int64)
