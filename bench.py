@@ -106,6 +106,120 @@ def run(backend: str, users, items, ts, num_items: int, window_ms: int,
         REGISTRY.summaries(), degradation, dispatches, wire
 
 
+def query_storm(seconds: float = None, threads: int = None,
+                user_space: int = 1_000_000) -> dict:
+    """Closed-loop query storm: a keep-alive HTTP client pool hammers
+    ``/recommend`` on a live ingesting job (PR-8 serving plane).
+
+    The job ingests a Zipfian stream on its own thread (oracle backend:
+    steady host-side window cadence with no compile pauses, so the storm
+    measures the *query plane*, not XLA warm-up) while ``threads``
+    keep-alive clients draw uniform user ids from a million-user space —
+    mostly cold users (the popularity-fallback path, the realistic storm
+    shape) with the Zipf-head users exercising the blend. Client-side
+    latencies give qps + p50/p95/p99; the server-side
+    ``cooc_query_seconds`` histogram rides along for cross-checking, and
+    the snapshot generation span proves the storm overlapped live window
+    swaps.
+    """
+    import http.client
+
+    import numpy as np
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.io.synthetic import zipfian_interactions
+    from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.observability import LEDGER
+    from tpu_cooccurrence.observability.http import MetricsServer
+    from tpu_cooccurrence.observability.registry import REGISTRY
+
+    seconds = seconds if seconds is not None else float(
+        os.environ.get("BENCH_STORM_SECONDS", 3.0))
+    threads = threads if threads is not None else int(
+        os.environ.get("BENCH_STORM_THREADS", 8))
+    n_events = int(os.environ.get("BENCH_STORM_EVENTS", 200_000))
+    REGISTRY.reset()
+    LEDGER.reset()
+    users, items, ts = zipfian_interactions(
+        n_events, n_items=20_000, n_users=user_space, alpha=1.1, seed=9,
+        events_per_ms=200)
+    cfg = Config(window_size=100, seed=0xC0FFEE, item_cut=500,
+                 user_cut=500, backend=Backend.ORACLE, serve_port=0)
+    job = CooccurrenceJob(cfg)
+    srv = MetricsServer(REGISTRY, counters=job.counters, ledger=LEDGER,
+                        port=0, serving=job.serving).start()
+    stop = threading.Event()
+    latencies = [[] for _ in range(threads)]
+    # Per-thread error tallies (summed at the end): a shared += would be
+    # a read-modify-write raced across the pool and could undercount.
+    errors = [0] * threads
+
+    def client(tid: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        rng = np.random.default_rng(tid)
+        lat = latencies[tid]
+        while not stop.is_set():
+            u = int(rng.integers(0, user_space))
+            t0 = time.perf_counter()
+            try:
+                conn.request("GET", f"/recommend?user={u}&n=10")
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    errors[tid] += 1
+                    continue
+            except Exception:
+                errors[tid] += 1
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                                  timeout=10)
+                continue
+            lat.append(time.perf_counter() - t0)
+        conn.close()
+
+    def ingest() -> None:
+        chunk = 4000
+        i = 0
+        while not stop.is_set() and i < n_events:
+            j = min(i + chunk, n_events)
+            job.add_batch(users[i:j], items[i:j], ts[i:j])
+            i = j
+
+    gen0 = job.serving.generation
+    feeder = threading.Thread(target=ingest, daemon=True)
+    pool = [threading.Thread(target=client, args=(t,), daemon=True)
+            for t in range(threads)]
+    feeder.start()
+    for t in pool:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in pool:
+        t.join(timeout=30)
+    feeder.join(timeout=120)
+    job.finish()
+    server_hist = REGISTRY.histogram("cooc_query_seconds").summary()
+    srv.stop()
+    flat = [x for lat in latencies for x in lat]
+    total = len(flat)
+    arr = np.asarray(flat) if flat else np.zeros(1)
+    return {
+        "users": user_space,
+        "threads": threads,
+        "seconds": round(seconds, 3),
+        "queries": total,
+        "errors": sum(errors),
+        "qps": round(total / max(seconds, 1e-9), 1),
+        "query_p50_s": round(float(np.percentile(arr, 50)), 6),
+        "query_p95_s": round(float(np.percentile(arr, 95)), 6),
+        "query_p99_s": round(float(np.percentile(arr, 99)), 6),
+        "generations": [gen0, job.serving.generation],
+        "snapshot_swaps": job.serving.builder.swaps,
+        "server_query_seconds": server_hist,
+    }
+
+
 def _uplink_per_window(latency: dict) -> float:
     """Mean host->device bytes per fired window, from the run's
     ``cooc_window_uplink_bytes`` histogram summary (TransferLedger-fed:
@@ -126,7 +240,8 @@ from tpu_cooccurrence.bench.grant_watch import probe_backend
 def _record_onchip(value: float, vs_baseline: float, backend: str,
                    pipeline_depth: int, occupancy: dict,
                    latency: dict = None, degradation: dict = None,
-                   fused: dict = None, compression: dict = None) -> None:
+                   fused: dict = None, compression: dict = None,
+                   serving: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -156,6 +271,11 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         # host_index_rss_bytes and effective-cells-per-byte per dtype,
         # trajectory-visible like the fused arm.
         entry["compression"] = compression
+    if serving:
+        # The PR-8 storm: qps + query p50/p95/p99 against a live
+        # ingesting job — the user-facing metric every later perf PR
+        # moves, trajectory-visible like the other arms.
+        entry["serving"] = serving
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -318,6 +438,16 @@ def measure() -> None:
         },
     }
 
+    # Query-storm arm (PR-8 serving plane): closed-loop qps + query
+    # latency tails from a keep-alive HTTP pool against a live ingesting
+    # job (million-user id space). Host-side plane, so the arm runs
+    # identically on-chip and on the CPU fallback; it must never kill
+    # the throughput bench it rides along with.
+    try:
+        serving_storm = query_storm()
+    except Exception as exc:
+        serving_storm = {"error": f"{type(exc).__name__}: {exc}"}
+
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.
     baseline_path = os.path.join(REPO, ".bench_baseline.json")
@@ -346,6 +476,7 @@ def measure() -> None:
         "degradation": degradation,
         "fused": fused_info,
         "compression": compression,
+        "serving": serving_storm,
     }
     if journal:
         out["journal"] = journal
@@ -366,7 +497,7 @@ def measure() -> None:
     else:
         _record_onchip(out["value"], out["vs_baseline"], backend,
                        pipeline_depth, occupancy, latency, degradation,
-                       fused_info, compression)
+                       fused_info, compression, serving_storm)
     print(json.dumps(out))
 
 

@@ -712,3 +712,80 @@ def test_pallas_kernel_registry_flags_empty_registry(tmp_path):
                       baseline=[]).run()
     assert [f.rule for f in result.findings] == ["pallas-kernel-registry"]
     assert "no pallas_call entry points" in result.findings[0].message
+
+
+# -- rule pack 8: serving route registry --------------------------------
+
+
+def _mini_serving_repo(tmp_path, *, http_body, readme_body, test_body):
+    """A minimal repo for the serving-route rule: the http module with a
+    ROUTE_METRICS table plus README and tests/ to reference routes."""
+    root = tmp_path / "repo"
+    obs = root / "tpu_cooccurrence" / "observability"
+    obs.mkdir(parents=True)
+    (obs / "http.py").write_text(http_body)
+    (root / "README.md").write_text(readme_body)
+    (root / "tests").mkdir()
+    (root / "tests" / "test_routes_fixture.py").write_text(test_body)
+    return root
+
+
+_GOOD_HTTP = (
+    'ROUTE_METRICS = {\n'
+    '    "/metrics": "cooc_scrape_seconds",\n'
+    '    "/healthz": "cooc_healthz_seconds",\n'
+    '    "/recommend": "cooc_query_seconds",\n'
+    '}\n')
+
+
+def test_serving_route_clean_repo_passes(tmp_path):
+    root = _mini_serving_repo(
+        tmp_path, http_body=_GOOD_HTTP,
+        readme_body="curl /metrics /healthz /recommend\n",
+        test_body='ROUTES = ["/metrics", "/healthz", "/recommend"]\n')
+    result = Analyzer(str(root), rules=[RULES["serving-route"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_serving_route_flags_unregistered_metric_and_missing_refs(tmp_path):
+    http = (
+        'ROUTE_METRICS = {\n'
+        '    "/newroute": "cooc_bogus_seconds",\n'
+        '}\n')
+    root = _mini_serving_repo(
+        tmp_path, http_body=http,
+        readme_body="nothing here\n",
+        test_body="def test_nothing():\n    pass\n")
+    result = Analyzer(str(root), rules=[RULES["serving-route"]],
+                      baseline=[]).run()
+    msgs = [f.message for f in result.findings]
+    assert any("cooc_bogus_seconds" in m and "CANONICAL_METRICS" in m
+               for m in msgs)
+    assert any("README" in m for m in msgs)
+    assert any("tests/ reference" in m for m in msgs)
+
+
+def test_serving_route_flags_unlisted_route_literal(tmp_path):
+    http = _GOOD_HTTP + (
+        '\n\ndef do_GET(path):\n'
+        '    if path == "/secret":\n'
+        '        return "ok"\n')
+    root = _mini_serving_repo(
+        tmp_path, http_body=http,
+        readme_body="/metrics /healthz /recommend\n",
+        test_body='R = ["/metrics", "/healthz", "/recommend"]\n')
+    result = Analyzer(str(root), rules=[RULES["serving-route"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["serving-route"]
+    assert "/secret" in result.findings[0].message
+
+
+def test_serving_route_flags_vanished_table(tmp_path):
+    root = _mini_serving_repo(
+        tmp_path, http_body="def handler():\n    return 404\n",
+        readme_body="x\n", test_body="y = 1\n")
+    result = Analyzer(str(root), rules=[RULES["serving-route"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["serving-route"]
+    assert "ROUTE_METRICS" in result.findings[0].message

@@ -36,6 +36,10 @@ Layout:
 * :mod:`.rules_fused` — Pallas kernel registry drift (every
   ``pallas_call`` entry point in ``ops/pallas_score.py`` parity-tested
   from ``tests/`` and listed in the ARCHITECTURE kernel table);
+* :mod:`.rules_serving` — HTTP route registry drift (every route in
+  ``observability/http.py``'s ``ROUTE_METRICS`` needs a
+  CANONICAL_METRICS latency metric, a README mention and a tests/
+  reference; unregistered route literals are flagged);
 * ``__main__`` — the runner: ``python -m tpu_cooccurrence.analysis``
   exits 1 on non-baseline findings (``--format json|text``).
 
@@ -63,6 +67,7 @@ from . import rules_jit  # noqa: F401,E402
 from . import rules_lock  # noqa: F401,E402
 from . import rules_native  # noqa: F401,E402
 from . import rules_registry  # noqa: F401,E402
+from . import rules_serving  # noqa: F401,E402
 from . import rules_wire  # noqa: F401,E402
 
 __all__ = [

@@ -1,0 +1,112 @@
+"""HTTP route registry drift (serving plane).
+
+Every route the live HTTP plane answers is operator-facing contract
+three times over: its request latency must be measurable (a route
+without a latency histogram is invisible to the p99 the serving plane
+exists to bound), it must be documented where operators look (README),
+and it must be exercised from ``tests/`` (an unprobed route is exactly
+how ``/recommend`` would rot — the one endpoint nothing scrapes in CI).
+
+``observability/http.py`` therefore keeps a single literal table,
+``ROUTE_METRICS`` (route -> latency-metric name), and this rule holds it
+to all three obligations plus the reverse direction: a route string
+handled in ``do_GET`` (or quoted anywhere in the module) that is not in
+the table is a silent, unmeasured endpoint. AST-checked, baseline-free
+by construction — mirroring ``rules_fused``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Optional
+
+from ..observability.registry import CANONICAL_METRICS
+from .core import (
+    FileContext,
+    Finding,
+    RepoContext,
+    Rule,
+    register,
+    string_constants,
+)
+
+_HTTP_PATH = "tpu_cooccurrence/observability/http.py"
+
+#: A route-shaped string literal: one absolute path segment, lowercase.
+#: (Error bodies, content types and log lines never fully match.)
+_ROUTE_RE = re.compile(r"^/[a-z][a-z0-9_]*$")
+
+
+def _route_table(tree: ast.Module) -> "tuple[Optional[Dict[str, str]], int]":
+    """The ``ROUTE_METRICS`` literal dict and its line, or (None, 0)."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "ROUTE_METRICS"
+                        for t in node.targets)):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None, node.lineno
+        table: Dict[str, str] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                table[k.value] = v.value
+        return table, node.lineno
+    return None, 0
+
+
+@register
+class ServingRouteRule(Rule):
+    name = "serving-route"
+    description = ("every HTTP route in observability/http.py must be in "
+                   "ROUTE_METRICS with a CANONICAL_METRICS latency "
+                   "metric, a README mention and a tests/ reference")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        src: Optional[FileContext] = next(
+            (c for c in repo.files if c.path == _HTTP_PATH), None)
+        if src is None or src.tree is None:
+            return
+        table, lineno = _route_table(src.tree)
+        if table is None:
+            yield Finding(
+                rule=self.name, file=_HTTP_PATH, line=max(lineno, 1),
+                message="ROUTE_METRICS literal dict not found (the route "
+                        "registry this rule guards is gone or no longer "
+                        "a plain literal)")
+            return
+        readme = next((c for c in repo.files if c.path == "README.md"),
+                      None)
+        tests_text = "\n".join(c.source for c in repo.files
+                               if c.path.startswith("tests/"))
+        for route, metric in sorted(table.items()):
+            if metric not in CANONICAL_METRICS:
+                yield Finding(
+                    rule=self.name, file=_HTTP_PATH, line=lineno,
+                    message=(f"route {route!r} maps to latency metric "
+                             f"{metric!r} which is not in "
+                             f"CANONICAL_METRICS — register it (the "
+                             f"route's tail latency must be scrapeable)"))
+            if readme is not None and route not in readme.source:
+                yield Finding(
+                    rule=self.name, file=_HTTP_PATH, line=lineno,
+                    message=(f"route {route!r} is not mentioned in "
+                             f"README.md — document it in the operator "
+                             f"guide"))
+            if route not in tests_text:
+                yield Finding(
+                    rule=self.name, file=_HTTP_PATH, line=lineno,
+                    message=(f"route {route!r} has no tests/ reference — "
+                             f"an unprobed endpoint cannot claim its "
+                             f"latency or schema in CI"))
+        # Reverse direction: any route-shaped literal in the module that
+        # is not registered is an unmeasured endpoint (or a stale doc).
+        for ln, value in string_constants(src.tree):
+            if _ROUTE_RE.match(value) and value not in table:
+                yield Finding(
+                    rule=self.name, file=_HTTP_PATH, line=ln,
+                    message=(f"route-shaped literal {value!r} is not in "
+                             f"ROUTE_METRICS — register it (with a "
+                             f"latency metric) or rename it"))

@@ -118,10 +118,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             LOG.warning("unparseable %s=%r; ignoring",
                         SUPERVISOR_STATE_ENV, raw_state)
     metrics_server = None
-    if config.metrics_port is not None:
-        # Live scrape plane (observability/http.py): a long-running job is
-        # monitorable without attaching to stdout/stderr. Port 0 binds an
-        # ephemeral port; the bound port is in the startup log line.
+    serve_server = None
+    if config.metrics_port is not None or config.serve_port is not None:
+        # Live HTTP plane (observability/http.py): a long-running job is
+        # monitorable (--metrics-port) and queryable (--serve-port)
+        # without attaching to stdout/stderr. Port 0 binds an ephemeral
+        # port; the bound port is in the startup log line.
         from .observability import LEDGER
         from .observability.http import MetricsServer
         from .observability.registry import REGISTRY
@@ -136,11 +138,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 help="restart backoff delay the supervisor applied "
                      "before this attempt").set(
                          supervisor_info.get("backoff_ms", 0))
-        metrics_server = MetricsServer(
-            REGISTRY, counters=job.counters, ledger=LEDGER,
-            port=config.metrics_port,
-            stale_after_s=config.healthz_stale_after_s,
-            supervisor_info=supervisor_info).start()
+        if config.metrics_port is not None:
+            metrics_server = MetricsServer(
+                REGISTRY, counters=job.counters, ledger=LEDGER,
+                port=config.metrics_port,
+                stale_after_s=config.healthz_stale_after_s,
+                supervisor_info=supervisor_info).start()
+        if config.serve_port is not None:
+            # The serving endpoint carries the scrape routes too (one
+            # port to probe behind a load balancer); --metrics-port may
+            # still run its scrape-only twin on a second port.
+            serve_server = MetricsServer(
+                REGISTRY, counters=job.counters, ledger=LEDGER,
+                port=config.serve_port,
+                stale_after_s=config.healthz_stale_after_s,
+                supervisor_info=supervisor_info,
+                serving=job.serving,
+                serve_stale_after_s=config.serve_stale_after_s).start()
     source = FileMonitorSource(
         config.input, job.counters,
         process_continuously=config.process_continuously)
@@ -180,9 +194,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if job.windows_fired:
             # Resumed run: replay the restored state so the stream is
             # complete (rows not re-updated after the checkpoint would
-            # otherwise never appear).
-            for item in sorted(job.latest):
-                print(_render_row(item, job.latest[item]),
+            # otherwise never appear). One consistent snapshot — the
+            # replay must not interleave with concurrent absorption.
+            snap = job.latest.snapshot()
+            for item in sorted(snap):
+                print(_render_row(item, snap[item]),
                       flush=config.process_continuously)
 
     # Poison-input quarantine (robustness/quarantine.py): malformed
@@ -248,13 +264,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # the results consumable instead). With --emit-updates the stream
     # already carried every update; skip the duplicate final dump.
     if not config.emit_updates:
-        for item in sorted(job.latest):
-            print(_render_row(item, job.latest[item]))
-    if metrics_server is not None:
-        # A clean shutdown, not a finally: on a crash the daemon thread
-        # dies with the process and the supervisor's journal-tail read
-        # covers the forensics.
-        metrics_server.stop()
+        # One consistent point-in-time copy (state/results.snapshot):
+        # with --serve-port the query plane may still be reading while
+        # this dump runs, and the dump itself must not lock-step every
+        # row read against it.
+        snap = job.latest.snapshot()
+        for item in sorted(snap):
+            print(_render_row(item, snap[item]))
+    for server in (metrics_server, serve_server):
+        if server is not None:
+            # A clean shutdown, not a finally: on a crash the daemon
+            # thread dies with the process and the supervisor's
+            # journal-tail read covers the forensics.
+            server.stop()
     return 0
 
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import enum
+import sys
 import time
 from typing import List, Optional, Sequence
 
@@ -95,9 +96,9 @@ class Config:
     num_shards: int = 1  # item-axis shards over the device mesh
     window_slide: Optional[int] = None  # sliding windows; None = tumbling
     max_pairs_per_step: int = 1 << 20  # COO padding bucket (recompile guard)
-    sample_workers: int = 1  # RETIRED (round 3): thread-partitioned host
-    # sampling measured ~0.9x serial (GIL-bound); accepted but ignored —
-    # --partition-sampling is the ingest scale-out axis
+    # (--sample-workers was RETIRED in round 3 and fully removed in PR 8:
+    # passing it now raises a clear "retired" error in from_args —
+    # --partition-sampling is the ingest scale-out axis.)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_windows: int = 0  # 0 = disabled
     checkpoint_retain: int = 3  # generation-numbered checkpoints kept
@@ -169,6 +170,21 @@ class Config:
     # startup); None = off
     healthz_stale_after_s: float = 300.0  # /healthz turns 503 once no
     # window has fired for this many wall seconds
+    serve_port: Optional[int] = None  # online serving plane
+    # (serving/): /recommend beside /metrics + /healthz on
+    # 127.0.0.1:PORT, backed by double-buffered zero-lock snapshots of
+    # the per-item top-K table swapped at window boundaries; 0 =
+    # ephemeral port (logged at startup); None = off
+    serve_history: int = 50  # per-user recent-history ring length the
+    # blend multiplies against the co-occurrence rows (bounded memory:
+    # 4 B x users x length)
+    serve_stale_after_s: float = 0.0  # /healthz turns 503 once the
+    # published snapshot is older than this many seconds (load-balancer
+    # drain signal for a wedged job); 0 = off
+    serve_query_slo_s: float = 0.25  # query-latency SLO: a /recommend
+    # slower than this raises the degradation plane's QUERY_PRESSURE
+    # signal, shedding INGEST (tighter cuts, pause) before query latency
+    # degrades — never the reverse; 0 = signal off
     score_ladder: Optional[int] = None  # sparse score-bucket ladder base
     # (power of two >= 2); None = env TPU_COOC_SCORE_LADDER or 4. Coarser
     # = fewer dispatches, more padding — the high-latency-link lever.
@@ -300,6 +316,35 @@ class Config:
                 0 <= self.metrics_port <= 65535):
             raise ValueError(
                 f"--metrics-port must be 0..65535, got {self.metrics_port}")
+        if self.serve_port is not None:
+            if not (0 <= self.serve_port <= 65535):
+                raise ValueError(
+                    f"--serve-port must be 0..65535, got {self.serve_port}")
+            if (self.metrics_port is not None
+                    and self.metrics_port == self.serve_port):
+                raise ValueError(
+                    "--serve-port already serves /metrics and /healthz; "
+                    "binding --metrics-port to the same port would fail "
+                    "at startup — drop one (or use distinct ports)")
+            if self.coordinator is not None or self.partition_sampling:
+                # Each multi-host process materializes only the rows its
+                # chips own; a per-process snapshot would silently serve
+                # a partial catalog as if it were the whole table.
+                raise ValueError(
+                    "--serve-port is single-process only (a multi-host "
+                    "process holds a partial top-K table; front it with "
+                    "a real serving tier instead)")
+        if self.serve_history < 1:
+            raise ValueError(
+                f"--serve-history must be >= 1, got {self.serve_history}")
+        if self.serve_stale_after_s < 0:
+            raise ValueError(
+                f"--serve-stale-after-s must be >= 0, got "
+                f"{self.serve_stale_after_s}")
+        if self.serve_query_slo_s < 0:
+            raise ValueError(
+                f"--serve-query-slo-s must be >= 0, got "
+                f"{self.serve_query_slo_s}")
         if self.healthz_stale_after_s <= 0:
             raise ValueError(
                 f"--healthz-stale-after-s must be positive, got "
@@ -485,11 +530,6 @@ class Config:
                        help="Item-axis shards over the device mesh")
         p.add_argument("--window-slide", type=int, default=None, dest="window_slide",
                        help="Slide (same unit as window) for sliding windows")
-        p.add_argument("--sample-workers", type=int, default=1,
-                       dest="sample_workers",
-                       help="Retired (ignored): host sampling is serial + "
-                            "native; use --partition-sampling for "
-                            "multi-process ingest scale-out")
         p.add_argument("--profile-dir", default=None, dest="profile_dir",
                        help="Write a jax.profiler trace for TensorBoard")
         p.add_argument("--journal", default=None, dest="journal",
@@ -505,6 +545,29 @@ class Config:
                        dest="healthz_stale_after_s",
                        help="/healthz reports 503 once no window has fired "
                             "for this many seconds (default: 300)")
+        p.add_argument("--serve-port", type=int, default=None,
+                       dest="serve_port",
+                       help="Serve /recommend (plus /metrics and /healthz) "
+                            "on 127.0.0.1:PORT from zero-lock double-"
+                            "buffered top-K snapshots swapped at window "
+                            "boundaries (0 = ephemeral, logged at "
+                            "startup; omit to disable)")
+        p.add_argument("--serve-history", type=int, default=50,
+                       dest="serve_history",
+                       help="Per-user recent-history ring length the "
+                            "/recommend blend uses (default: 50)")
+        p.add_argument("--serve-stale-after-s", type=float, default=0.0,
+                       dest="serve_stale_after_s",
+                       help="/healthz reports 503 once the serving "
+                            "snapshot is older than this many seconds, so "
+                            "load balancers can drain a wedged job "
+                            "(default: 0 = off)")
+        p.add_argument("--serve-query-slo-s", type=float, default=0.25,
+                       dest="serve_query_slo_s",
+                       help="Query-latency SLO: a /recommend slower than "
+                            "this raises QUERY_PRESSURE so the "
+                            "degradation plane sheds ingest before query "
+                            "latency degrades (default: 0.25; 0 = off)")
         p.add_argument("--pallas", choices=["auto", "on", "off"],
                        default="auto",
                        help="Fused Pallas score/top-K kernel (auto: on for "
@@ -681,6 +744,18 @@ class Config:
                        dest="num_processes", help="Multi-host: process count")
         p.add_argument("--process-id", type=int, default=None,
                        dest="process_id", help="Multi-host: this process's id")
+        raw = list(argv) if argv is not None else sys.argv[1:]
+        if any(
+                a == "--sample-workers" or a.startswith("--sample-workers=")
+                for a in raw):
+            # Fully retired (PR 8; ignored since round 3): fail with the
+            # reason and the replacement, not argparse's bare
+            # "unrecognized arguments".
+            raise ValueError(
+                "--sample-workers is retired: thread-partitioned host "
+                "sampling measured ~0.9x serial (GIL-bound) and was "
+                "removed; the serial native sampler always runs — use "
+                "--partition-sampling for multi-process ingest scale-out")
         ns = p.parse_args(argv)
         return cls(**vars(ns))
 

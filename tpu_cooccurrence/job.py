@@ -77,16 +77,6 @@ class CooccurrenceJob:
         self.item_vocab = IdMap()
         self.user_vocab = IdMap()
         self.item_cut = ItemInteractionCut(config.item_cut, capacity=1024)
-        if config.sample_workers > 1:
-            # Retired round 3: thread-partitioned sampling measured ~0.9x
-            # serial on this image (GIL-bound small NumPy kernels; the
-            # native serial kernels already took the wins). The flag stays
-            # accepted on every sampler path; ingest scale-out is
-            # --partition-sampling (process-level, multi-host).
-            LOG.warning(
-                "--sample-workers is retired and has no effect; the "
-                "serial native sampler runs (use --partition-sampling "
-                "for multi-process ingest scale-out)")
         if self.sliding:
             if config.partition_sampling:
                 from .parallel.distributed import init_multihost
@@ -146,6 +136,20 @@ class CooccurrenceJob:
         # results: external item id -> [(external other, score) desc];
         # array-backed, lazily materialized (state/results.py)
         self.latest = LatestResults(self.item_vocab)
+        # Online serving plane (--serve-port, serving/): double-buffered
+        # zero-lock top-K snapshots swapped at window boundaries plus the
+        # per-user history blend behind /recommend. Pure observer of the
+        # ingest path: it reads mapped ids and emitted rows, never
+        # touches sampling/scorer state — serving on vs off is
+        # bit-identical on ingest output (parity-tested at depths 0, 2).
+        self.serving = None
+        if config.serve_port is not None:
+            from .serving import ServingPlane
+
+            self.serving = ServingPlane(
+                self.item_vocab, self.user_vocab,
+                history_len=config.serve_history,
+                query_slo_s=config.serve_query_slo_s)
         # Optional streaming-result hook: called with every materialized
         # window output (dense-id rows, post-absorption) — the consumable
         # form of the reference's continuous emission into its sink
@@ -369,6 +373,10 @@ class CooccurrenceJob:
                 f"item vocabulary exceeded --num-items capacity "
                 f"({len(self.item_vocab)} > {self.config.num_items})")
         dense_users = self.user_vocab.map_batch(users)
+        if self.serving is not None:
+            # Feed the per-user history rings on the ingest thread (the
+            # blend's "recent history" side; bounded memory per user).
+            self.serving.feed(dense_users, dense_items)
         n_late = self.engine.add_batch(dense_users, dense_items, ts)
         if n_late:
             # The reference counts late drops at both cut operators
@@ -643,6 +651,15 @@ class CooccurrenceJob:
                     rec["degrade_events"] = degrade_events
             if fused is not None:
                 rec["fused"] = int(fused)
+            if self.serving is not None:
+                # Swap bookkeeping: the snapshot generation and row count
+                # in force when this record was written (this window's
+                # own swap lands just after, in _absorb — the fields
+                # therefore read "serving state the queries saw while
+                # this window computed", identically at every pipeline
+                # depth).
+                rec["snapshot_generation"] = self.serving.generation
+                rec["snapshot_rows"] = self.serving.rows
             breaker_state = getattr(self.scorer, "breaker_state", None)
             if breaker_state is not None:
                 rec["breaker_state"] = breaker_state
@@ -680,6 +697,16 @@ class CooccurrenceJob:
             for dense_item, top in window_out:
                 self.latest.set_row(dense_item, top)
                 self.emissions += 1
+        if self.serving is not None:
+            # Window boundary: fold this window's rows into the build
+            # buffer and swap the next read-optimized snapshot in (one
+            # atomic reference assignment — readers never lock, never
+            # tear). Runs on the absorbing thread (caller serially, the
+            # scorer worker pipelined), same single-writer contract as
+            # `latest` absorption.
+            if len(window_out):
+                self.serving.absorb(window_out)
+            self.serving.publish()
         if self.on_update is not None and len(window_out):
             self.on_update(window_out)
 
@@ -700,6 +727,11 @@ class CooccurrenceJob:
         from .state import checkpoint as ckpt
 
         ckpt.restore(self, self.config.checkpoint_dir, source=source)
+        if self.serving is not None:
+            # Serve the checkpointed rows immediately: a resumed job must
+            # not answer /recommend from an empty table until its first
+            # post-restore window fires.
+            self.serving.seed(self.latest.snapshot())
         # Re-baseline the journal's deltas: the restored counter totals
         # predate this attempt, and the restore itself ships state up
         # (e.g. the sparse slab's restore upload) — neither may be

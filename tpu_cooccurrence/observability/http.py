@@ -1,9 +1,11 @@
-"""Live scrape endpoint: ``/metrics`` (Prometheus text) + ``/healthz``.
+"""Live HTTP plane: ``/metrics``, ``/healthz`` and (serving) ``/recommend``.
 
 The reference outsources live monitoring to the Flink UI; this
 standalone build serves its own, from a stdlib ``http.server`` thread —
 zero dependencies, safe to run inside the job process because every
-handler only *reads* locked registries (no handler can touch job state).
+handler only *reads*: locked registries for the scrape routes, the
+immutable published snapshot for the query route (no handler can touch
+job state).
 
 ``/metrics`` returns Prometheus text-format 0.0.4: every reference-named
 counter (``metrics.Counters``), the TransferLedger wire totals, and all
@@ -16,6 +18,21 @@ fired yet is "starting", not dead), 503 once the age exceeds the
 threshold. A long tail of empty input under ``--process-continuously``
 is indistinguishable from a hang by design — staleness means "no window
 fired", whatever the cause, which is exactly what an operator pages on.
+With the serving plane attached the payload also carries the snapshot
+generation/age, and ``--serve-stale-after-s`` turns a stale snapshot
+into 503 so a load balancer can drain a wedged job.
+
+``/recommend?user=U&n=N`` (``--serve-port`` only) answers from the
+serving plane's current snapshot: zero-lock, one generation per
+response. Its latency lands in the ``cooc_query_seconds`` histogram
+(p50/p95/p99 on ``/metrics``), and a query over the
+``--serve-query-slo-s`` SLO raises the degradation plane's
+QUERY_PRESSURE signal — ingest sheds before query latency degrades,
+never the reverse.
+
+Every route in :data:`ROUTE_METRICS` gets a request-latency histogram;
+the cooclint ``serving-route`` rule holds that table to CANONICAL_METRICS,
+README and tests/ (a route cannot land unmeasured or undocumented).
 
 Port 0 binds an ephemeral port (CI) — the bound port is in ``.port``
 and the startup log line.
@@ -28,6 +45,7 @@ import json
 import logging
 import threading
 import time
+import urllib.parse
 from typing import Optional
 
 from .registry import MetricsRegistry
@@ -42,14 +60,31 @@ LAST_WINDOW_GAUGE = "cooc_last_window_unix_seconds"
 DEGRADATION_GAUGE = "cooc_degradation_level"
 QUARANTINE_GAUGE = "cooc_quarantined_lines_total"
 
+#: Route registry: every HTTP route this server answers, mapped to its
+#: request-latency histogram. The cooclint ``serving-route`` rule
+#: AST-reads this table — each metric must be in CANONICAL_METRICS, each
+#: route must be mentioned in README.md and referenced from tests/, and
+#: no handler may answer a route that is not listed here.
+ROUTE_METRICS = {
+    "/metrics": "cooc_scrape_seconds",
+    "/healthz": "cooc_healthz_seconds",
+    "/recommend": "cooc_query_seconds",
+}
+
 
 class MetricsServer:
-    """Background scrape server over a registry + counters + ledger."""
+    """Background scrape/query server over a registry + counters + ledger.
+
+    ``serving`` (a ``serving.ServingPlane``) arms the ``/recommend``
+    route; without it the route answers 404 with a pointer at
+    ``--serve-port`` — the scrape-only server stays exactly as before.
+    """
 
     def __init__(self, registry: MetricsRegistry, counters=None, ledger=None,
                  port: int = 0, host: str = "127.0.0.1",
                  stale_after_s: float = 300.0,
-                 supervisor_info: Optional[dict] = None) -> None:
+                 supervisor_info: Optional[dict] = None,
+                 serving=None, serve_stale_after_s: float = 0.0) -> None:
         self.registry = registry
         self.counters = counters
         self.ledger = ledger
@@ -58,26 +93,42 @@ class MetricsServer:
         # the env-var payload through): surfaced on /healthz so "is this
         # process a restart, and why" is scrapeable.
         self.supervisor_info = supervisor_info
+        self.serving = serving
+        self.serve_stale_after_s = serve_stale_after_s
         self._started_unix = time.time()
+        # Per-route request-latency histograms, registered up front so
+        # they render on /metrics (at zero) from the first scrape.
+        self._route_hist = {
+            route: registry.histogram(
+                name, help=f"request seconds serving {route}")
+            for route, name in ROUTE_METRICS.items()}
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 - http.server API
-                if self.path.split("?", 1)[0] == "/metrics":
+                path, _, query = self.path.partition("?")
+                t0 = time.perf_counter()
+                if path == "/metrics":
                     body = outer.registry.render_prometheus(
                         outer.counters, outer.ledger).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                     code = 200
-                elif self.path.split("?", 1)[0] == "/healthz":
+                elif path == "/healthz":
                     payload, healthy = outer.health()
                     body = (json.dumps(payload, sort_keys=True)
                             + "\n").encode()
                     ctype = "application/json"
                     code = 200 if healthy else 503
+                elif path == "/recommend":
+                    code, body = outer.recommend(query)
+                    ctype = "application/json"
                 else:
                     body = b"not found\n"
                     ctype = "text/plain"
                     code = 404
+                hist = outer._route_hist.get(path)
+                if hist is not None:
+                    hist.observe(time.perf_counter() - t0)
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -99,12 +150,16 @@ class MetricsServer:
 
     def health(self) -> "tuple[dict, bool]":
         """(payload, healthy): last-window age vs the staleness threshold,
-        plus the degradation plane's level and quarantine count.
+        plus the degradation plane's level and quarantine count, plus —
+        when the serving plane is attached — snapshot generation and age.
 
         ``PAUSE_INGEST`` reports unhealthy even inside the staleness
         window: a paused job is *deliberately* not firing windows, and
         letting the recency of its last pre-pause window read as "ok"
-        would hide exactly the condition an operator pages on.
+        would hide exactly the condition an operator pages on. A serving
+        snapshot older than ``--serve-stale-after-s`` (when set) reports
+        ``snapshot_stale`` and 503 — the load-balancer drain signal for
+        a job whose swap loop wedged while windows still fire.
         """
         now = time.time()
         last = self.registry.gauge(LAST_WINDOW_GAUGE).get()
@@ -128,16 +183,70 @@ class MetricsServer:
                    "degradation_level": level,
                    "quarantined_total": int(
                        self.registry.gauge(QUARANTINE_GAUGE).get())}
+        if self.serving is not None:
+            snap_age = self.serving.snapshot_age_seconds()
+            payload["snapshot_generation"] = self.serving.generation
+            payload["snapshot_rows"] = self.serving.rows
+            payload["snapshot_age_seconds"] = round(snap_age, 3)
+            payload["snapshot_stale_after_seconds"] = self.serve_stale_after_s
+            if (self.serve_stale_after_s > 0
+                    and snap_age > self.serve_stale_after_s
+                    and status not in ("stale", "paused")):
+                status = payload["status"] = "snapshot_stale"
         if self.supervisor_info is not None:
             payload["last_restart"] = self.supervisor_info
-        return payload, status not in ("stale", "paused")
+        return payload, status not in ("stale", "paused", "snapshot_stale")
+
+    def recommend(self, query: str) -> "tuple[int, bytes]":
+        """The ``/recommend`` route body: parse params, run the blend on
+        the current snapshot, JSON the result. Query-side latency SLO
+        enforcement (QUERY_PRESSURE) happens here — the blend itself
+        stays pure."""
+        if self.serving is None:
+            return 404, (json.dumps(
+                {"error": "serving disabled (run with --serve-port)"})
+                + "\n").encode()
+        params = urllib.parse.parse_qs(query)
+        try:
+            user = (int(params["user"][0])
+                    if "user" in params else None)
+            n = int(params.get("n", ["10"])[0])
+        except ValueError:
+            return 400, (json.dumps(
+                {"error": "user and n must be integers"}) + "\n").encode()
+        if n < 1:
+            return 400, (json.dumps(
+                {"error": "n must be >= 1"}) + "\n").encode()
+        t0 = time.perf_counter()
+        items, snap, fallback = self.serving.query(user, n)
+        elapsed = time.perf_counter() - t0
+        slo = self.serving.query_slo_s
+        if slo > 0 and elapsed > slo:
+            from ..robustness import degrade
+
+            if degrade.CONTROLLER is not None:
+                # Shed INGEST before query latency degrades — the
+                # controller has no query-shedding lever by design.
+                degrade.CONTROLLER.note_query_pressure()
+        body = json.dumps({
+            "user": user,
+            "n": n,
+            "generation": snap.generation,
+            "snapshot_age_seconds": round(snap.age_seconds(), 3),
+            "fallback": bool(fallback),
+            "items": [{"item": item, "score": round(score, 6)}
+                      for item, score in items],
+        }, sort_keys=True) + "\n"
+        return 200, body.encode()
 
     def start(self) -> "MetricsServer":
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="cooc-metrics-http",
             daemon=True)
         self._thread.start()
-        LOG.info("serving /metrics and /healthz on http://%s:%d",
+        routes = "/metrics and /healthz" if self.serving is None else \
+            "/metrics, /healthz and /recommend"
+        LOG.info("serving %s on http://%s:%d", routes,
                  self._server.server_address[0], self.port)
         return self
 

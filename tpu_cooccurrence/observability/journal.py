@@ -73,6 +73,11 @@ SCHEMA = {
                                          # one-dispatch path, 0 = chained
                                          # (present for device-backend
                                          # runs only)
+    # Serving plane (serving/, --serve-port): snapshot double-buffer
+    # bookkeeping — the generation and live row count queries saw while
+    # this window computed (the window's own swap lands right after).
+    "snapshot_generation": (False, int),
+    "snapshot_rows": (False, int),
 }
 
 

@@ -109,6 +109,17 @@ CANONICAL_METRICS = frozenset({
     "cooc_host_index_rss_bytes",
     "cooc_slab_device_bytes",
     "cooc_slab_live_cells",
+    # serving plane (serving/, observability/http.py): per-route request
+    # latency histograms plus snapshot double-buffer state
+    "cooc_query_seconds",
+    "cooc_scrape_seconds",
+    "cooc_healthz_seconds",
+    "cooc_snapshot_generation",
+    "cooc_snapshot_swaps_total",
+    "cooc_snapshot_built_unix_seconds",
+    "cooc_snapshot_rows",
+    # degradation plane QUERY_PRESSURE signal (robustness/degrade.py)
+    "cooc_query_pressure_events_total",
 })
 
 #: TransferLedger snapshot key -> exposition series name. Explicit

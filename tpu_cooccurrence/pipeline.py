@@ -17,7 +17,10 @@ equivalent — a bounded-depth producer/consumer pipeline:
   already-jitted, donated-buffer device dispatch — and absorbs the
   previous window's materialized top-K into ``LatestResults`` one step
   behind the device frontier (the scorers' existing one-window result
-  pipeline / deferred table, unchanged).
+  pipeline / deferred table, unchanged). With ``--serve-port`` the same
+  absorption step folds the rows into the serving build buffer and
+  swaps the next read-optimized snapshot in (``serving/snapshot.py`` —
+  single-writer by this thread contract, zero-lock for query readers).
 
 Nothing in the steady state forces ``block_until_ready``: the worker's
 dispatches return as soon as the transfer is enqueued, and synchronization

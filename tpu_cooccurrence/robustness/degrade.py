@@ -6,7 +6,8 @@ killed by the PR-3 watchdog) or die. This module is the third answer:
 *degrade*. A process-global :class:`DegradationController` watches the
 per-window health signals the observability plane already produces
 (window wall time, staging-ring saturation/stall, journal staleness)
-and steps through explicit levels::
+— plus, when the serving plane is up, QUERY_PRESSURE (a ``/recommend``
+over its latency SLO) — and steps through explicit levels::
 
     NORMAL -> SHED_SAMPLING -> SHED_K -> PAUSE_INGEST
 
@@ -131,6 +132,7 @@ class DegradationController:
         self._bad = 0
         self._good = 0
         self._queue_pressure = False
+        self._query_pressure = False
         # Transition event tokens not yet drained into a journal record.
         # Observe-side transitions drain in the same observe_window call;
         # admission-side (stale-ingest) escalations drain through
@@ -200,8 +202,10 @@ class DegradationController:
                 wall_seconds > self.window_wall_s
                 or (ring_capacity > 0 and ring_depth >= ring_capacity)
                 or stall_seconds > self.window_wall_s / 4
-                or self._queue_pressure)
+                or self._queue_pressure
+                or self._query_pressure)
             self._queue_pressure = False
+            self._query_pressure = False
             self._last_window_monotonic = time.monotonic()
             if overloaded:
                 self._bad += 1
@@ -228,6 +232,22 @@ class DegradationController:
         if seconds > self.window_wall_s / 4:
             with self._lock:
                 self._queue_pressure = True
+
+    def note_query_pressure(self) -> None:
+        """QUERY_PRESSURE signal from the serving plane: a /recommend
+        exceeded its latency SLO (``--serve-query-slo-s``), so the next
+        observed window counts as overloaded and the ladder sheds
+        *ingest* (tighter cuts, narrower top-K, admission pause) —
+        queries are never shed; the direction is structural, there is no
+        query-shedding lever in this controller. Called from HTTP
+        handler threads; one flag write under the leaf lock.
+        """
+        with self._lock:
+            self._query_pressure = True
+        REGISTRY.gauge(
+            "cooc_query_pressure_events_total",
+            help="queries that exceeded --serve-query-slo-s and "
+                 "signaled the degradation plane").add(1)
 
     # -- admission control (ingest thread) -------------------------------
 

@@ -1,0 +1,24 @@
+"""Online serving plane: query the job's results while it ingests.
+
+Before this package the computed top-K tables ended at stdout,
+``LatestResults`` and checkpoints — nobody could *query* them. The
+serving plane turns the job into a recommender service:
+
+* :mod:`.snapshot` — immutable, read-optimized snapshots of the per-item
+  top-K table, double-buffered and atomically swapped at window
+  boundaries (zero-lock readers);
+* :mod:`.recommend` — the user-history x co-occurrence blend the
+  reference leaves downstream, with cold-start popularity fallback and
+  already-seen filtering;
+* the ``/recommend`` HTTP endpoint lives beside ``/metrics`` and
+  ``/healthz`` in :mod:`tpu_cooccurrence.observability.http`.
+
+Enabled by ``--serve-port``; see docs/ARCHITECTURE.md "Serving plane".
+"""
+
+from __future__ import annotations
+
+from .recommend import ServingPlane, UserHistory  # noqa: F401
+from .snapshot import SnapshotBuilder, TopKSnapshot  # noqa: F401
+
+__all__ = ["ServingPlane", "UserHistory", "SnapshotBuilder", "TopKSnapshot"]

@@ -1,0 +1,272 @@
+"""The blend step the reference leaves downstream: history x top-K rows.
+
+The paper's lineage ends at the per-item indicator matrix and explicitly
+leaves "multiply the user's recent history against it" to a downstream
+consumer (PAPER.md §0). This module is that consumer, in-process:
+
+* :class:`UserHistory` — a bounded per-user ring buffer of recently seen
+  items, fed from the ingest stream (dense-id space, vectorized per
+  batch; single writer = the ingest thread).
+* :class:`ServingPlane` — composes the history, the snapshot double
+  buffer (:mod:`.snapshot`) and the blend itself. ``query`` scores
+  ``sum over h in history of cooccurrence_row(h)``, filters items the
+  user already saw, and partial-sorts the top N; anonymous or cold-start
+  users fall back to the snapshot's popularity ladder.
+
+**Hot-path contract** (asserted by test instrumentation in
+``tests/test_serving.py``): ``query`` acquires no lock — the snapshot is
+immutable, the history is single-writer with benign-staleness reads —
+and allocates no table-sized scratch: accumulation buffers are
+preallocated per thread (:class:`_Scratch`, ``threading.local``) and
+grown only when the vocabulary grows; the only per-query allocations are
+O(touched-candidates) result arrays (hundreds of elements at most,
+``top_n <= history x K``). ``SCRATCH_ALLOCATIONS`` counts every scratch
+(re)allocation so tests can pin the steady state at zero.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .snapshot import SnapshotBuilder, TopKSnapshot
+
+#: Scratch-buffer (re)allocations across all threads — test
+#: instrumentation for the "no per-query table allocation" contract
+#: (reads/writes are GIL-atomic increments; precision under races is
+#: irrelevant because the pinned steady-state value is *zero deltas*).
+SCRATCH_ALLOCATIONS = 0
+
+#: Cap on ``n`` per query (the partial-sort budget; requests above it
+#: are clamped, not errored — a load balancer probing ?n=1e9 must not
+#: turn into an O(vocab) sort).
+MAX_N = 1000
+
+
+class UserHistory:
+    """Bounded per-user ring of recently seen items (dense-id space).
+
+    Single writer (the ingest thread, via :meth:`extend`); query threads
+    read with :meth:`recent` into caller scratch. Reads are lock-free:
+    growth swaps in new arrays (readers finish on the old ones), and a
+    concurrent write can at worst surface a slightly stale or mixed
+    window of history — acceptable staleness for a recommender, never a
+    torn structure.
+    """
+
+    def __init__(self, length: int = 50, capacity_hint: int = 1024) -> None:
+        if length < 1:
+            raise ValueError(f"history length must be >= 1, got {length}")
+        self.length = length
+        cap = max(int(capacity_hint), 64)
+        self._items = np.zeros((cap, length), dtype=np.int32)
+        self._count = np.zeros(cap, dtype=np.int64)
+
+    def _ensure(self, n: int) -> None:
+        if n <= len(self._count):
+            return
+        cap = len(self._count)
+        while cap < n:
+            cap *= 2
+        grown = np.zeros((cap, self.length), dtype=np.int32)
+        grown[: len(self._items)] = self._items
+        grown_c = np.zeros(cap, dtype=np.int64)
+        grown_c[: len(self._count)] = self._count
+        # Publish rows before counts: a reader pairing a new count with
+        # the old (shorter) item array would index past it.
+        self._items = grown
+        self._count = grown_c
+
+    def extend(self, dense_users: np.ndarray,
+               dense_items: np.ndarray) -> None:
+        """Append one ingest batch (vectorized; stream order per user)."""
+        if not len(dense_users):
+            return
+        u = np.asarray(dense_users, dtype=np.int64)
+        self._ensure(int(u.max()) + 1)
+        order = np.argsort(u, kind="stable")
+        us = u[order]
+        its = np.asarray(dense_items, dtype=np.int64)[order]
+        starts = np.flatnonzero(np.r_[True, us[1:] != us[:-1]])
+        run_len = np.diff(np.r_[starts, len(us)])
+        within = np.arange(len(us)) - np.repeat(starts, run_len)
+        pos = (self._count[us] + within) % self.length
+        self._items[us, pos] = its
+        self._count[us[starts]] += run_len
+
+    def recent(self, dense_user: int, out: np.ndarray) -> int:
+        """Copy the user's ring into ``out`` (caller scratch, length >=
+        ``self.length``); returns the number of valid entries."""
+        count = self._count  # one ref read; rows array read second so a
+        items = self._items  # concurrent grow can only widen coverage
+        if dense_user < 0 or dense_user >= len(count):
+            return 0
+        c = int(count[dense_user])
+        k = min(c, self.length)
+        if k:
+            out[:k] = items[dense_user, :k]
+        return k
+
+
+class _Scratch(threading.local):
+    """Per-thread preallocated query buffers (thread-local: query threads
+    are the HTTP pool — no sharing, no lock)."""
+
+    def __init__(self) -> None:
+        self.acc = np.zeros(0, dtype=np.float32)    # dense score accum
+        self.hist = np.zeros(0, dtype=np.int64)     # history copy
+        self.touched = np.zeros(0, dtype=np.int64)  # candidate ids
+
+    def ensure(self, vocab_cap: int, hist_len: int, touch_cap: int) -> None:
+        global SCRATCH_ALLOCATIONS
+        if len(self.acc) < vocab_cap:
+            self.acc = np.zeros(max(vocab_cap, 1024), dtype=np.float32)
+            SCRATCH_ALLOCATIONS += 1
+        if len(self.hist) < hist_len:
+            self.hist = np.zeros(hist_len, dtype=np.int64)
+            SCRATCH_ALLOCATIONS += 1
+        if len(self.touched) < touch_cap:
+            self.touched = np.zeros(max(touch_cap, 256), dtype=np.int64)
+            SCRATCH_ALLOCATIONS += 1
+
+
+class ServingPlane:
+    """Snapshot double buffer + user history + the blend query.
+
+    Owned by the job when ``--serve-port`` is set. ``feed``/``absorb``/
+    ``publish`` run on the job's threads (ingest / window-absorbing);
+    ``query`` runs on any number of HTTP threads against the immutable
+    published snapshot.
+    """
+
+    def __init__(self, item_vocab, user_vocab, history_len: int = 50,
+                 query_slo_s: float = 0.0) -> None:
+        self.item_vocab = item_vocab
+        self.user_vocab = user_vocab
+        self.builder = SnapshotBuilder(item_vocab)
+        self.history = UserHistory(length=history_len)
+        #: Query-latency SLO feeding the degradation plane's
+        #: QUERY_PRESSURE signal (0 = signal off). The *server* applies
+        #: it (observability/http.py) — the blend itself stays pure.
+        self.query_slo_s = query_slo_s
+        self._scratch = _Scratch()
+
+    # -- job-side hooks --------------------------------------------------
+
+    def feed(self, dense_users: np.ndarray, dense_items: np.ndarray) -> None:
+        """Ingest-thread hook: extend user histories (pre-window, so a
+        user's own interactions are filterable the moment they land)."""
+        self.history.extend(dense_users, dense_items)
+
+    def absorb(self, window_out) -> None:
+        """Window-absorbing-thread hook: fold emitted rows into the
+        build buffer (published at the next :meth:`publish`)."""
+        self.builder.absorb(window_out)
+
+    def publish(self) -> TopKSnapshot:
+        """Swap the next snapshot in (window boundary)."""
+        return self.builder.publish()
+
+    def seed(self, results_snapshot) -> None:
+        """Restore path: serve the checkpointed rows immediately."""
+        self.builder.seed(results_snapshot)
+
+    @property
+    def generation(self) -> int:
+        return self.builder.current.generation
+
+    @property
+    def rows(self) -> int:
+        return self.builder.current.rows
+
+    def snapshot_age_seconds(self) -> float:
+        """Seconds since the last swap *attempt* (quiet boundaries count:
+        a live job over an empty stream is not a wedged job)."""
+        return time.time() - self.builder.last_swap_unix
+
+    # -- the hot query path ----------------------------------------------
+
+    def query(self, user: Optional[int], n: int
+              ) -> "Tuple[List[Tuple[int, float]], TopKSnapshot, bool]":
+        """Top-``n`` recommendations for external user id ``user``
+        (``None`` = anonymous).
+
+        Returns ``(items, snapshot, fallback)`` where ``items`` is
+        ``[(external item, score), ...]`` descending and ``fallback``
+        flags the popularity path. One snapshot reference is taken up
+        front; every read of the call is against that one generation.
+        """
+        snap = self.builder.current  # THE reference: one generation
+        n = max(1, min(int(n), MAX_N))
+        sc = self._scratch
+        hist_len = self.history.length
+        sc.ensure(1, hist_len, 1)  # the history buffer, before reading
+        hist_k = 0
+        if user is not None:
+            dense_user = self.user_vocab.to_dense(user)
+            if dense_user is not None:
+                hist_k = self.history.recent(dense_user, sc.hist)
+        # acc must cover the LIVE vocab AND whatever the history read
+        # just returned — the ingest thread may map a new item (and ring
+        # it) between a vocab-length read and the ring read, so size
+        # from the actual ids about to be indexed.
+        need = max(len(snap.bits) * 64, len(self.item_vocab))
+        if hist_k:
+            need = max(need, int(sc.hist[:hist_k].max()) + 1)
+        sc.ensure(need, hist_len, hist_len * snap.max_k + 16)
+        acc = sc.acc
+        hist = sc.hist[:hist_k]
+        # Exclude already-seen up front: -inf survives any += and is
+        # filtered after the gather.
+        acc[hist] = -np.inf
+        touched_n = 0
+        for i in range(hist_k):
+            row = snap.row(int(hist[i]))
+            if row is None:
+                continue
+            idx, vals = row
+            m = len(idx)
+            if not m:
+                continue
+            sc.touched[touched_n: touched_n + m] = idx
+            acc[idx] += vals  # ids unique within a row: no lost updates
+            touched_n += m
+        items: List[Tuple[int, float]] = []
+        fallback = touched_n == 0
+        if not fallback:
+            t = sc.touched[:touched_n]
+            cand = np.unique(t)  # O(touched log touched), touched <= H*K
+            scores = acc[cand]
+            keep = np.isfinite(scores)
+            cand, scores = cand[keep], scores[keep]
+            if len(cand):
+                take = min(n, len(cand))
+                part = np.argpartition(-scores, take - 1)[:take]
+                part = part[np.argsort(-scores[part], kind="stable")]
+                ext = snap.rev[cand[part]]
+                items = list(zip(ext.tolist(),
+                                 scores[part].astype(float).tolist()))
+            else:
+                fallback = True
+            # Reset the touched accumulator slots for the next query.
+            acc[t] = 0.0
+        acc[hist] = 0.0
+        if fallback and len(snap.popular):
+            items = self._popular(snap, hist, n)
+        return items, snap, fallback
+
+    def _popular(self, snap: TopKSnapshot, hist: np.ndarray, n: int
+                 ) -> List[Tuple[int, float]]:
+        """Cold-start/anonymous fallback: the snapshot's popularity
+        ladder minus already-seen."""
+        pop = snap.popular
+        scores = snap.popular_scores
+        if len(hist):
+            keep = ~np.isin(pop, hist)
+            pop, scores = pop[keep], scores[keep]
+        pop, scores = pop[:n], scores[:n]
+        return list(zip(snap.rev[pop].tolist(),
+                        scores.astype(float).tolist()))

@@ -1,0 +1,419 @@
+"""Read-optimized top-K snapshots: immutable, double-buffered, zero-lock.
+
+The job's result store (:class:`~tpu_cooccurrence.state.results.LatestResults`)
+is write-optimized — absorption is O(window rows) and every *read* takes its
+lock, which is exactly wrong for a query plane fielding millions of
+concurrent reads. This module is the read side: an immutable
+:class:`TopKSnapshot` packs the per-item top-K table into query-ready
+segment arrays (SMASH-style index-friendly layout, PAPERS.md) with an O(1)
+item->row lookup reusing the PR-7 bitmap + rank-directory pattern
+(``state/sparse_scorer.BitmapRowRegistry``), and a :class:`SnapshotBuilder`
+grows it incrementally from each window's emitted rows.
+
+**Double-buffering / swap protocol.** The builder's mutable state (pointer
+arrays, segment list, popularity counts) is the *write buffer*, touched only
+by whichever single thread absorbs windows (the caller thread serially, the
+scorer worker pipelined — the same thread contract as ``LatestResults``
+absorption). At each window boundary :meth:`SnapshotBuilder.publish` packs
+the live pointers into an immutable :class:`TopKSnapshot` and swaps it in
+with one reference assignment (``self.current = snap`` — atomic under the
+GIL). Readers do ``snap = builder.current`` once and hold a plain strong
+reference for the whole query: no lock, no torn table — a snapshot's arrays
+are never written after publication. The retired buffer's arrays are
+recycled for the *next* build only when no reader still holds its snapshot
+(a refcount check — the double-buffer steady state allocates nothing);
+otherwise fresh arrays are allocated and the straggler keeps its intact
+generation.
+
+**Per-window cost.** Absorb is O(window rows) (one ``isfinite`` pass to
+precompute valid lengths — queries never filter); publish is O(live items)
+of vectorized packing (bitmap scatter + popcount rank + two gathers).
+Quiet boundaries (nothing absorbed) keep the published object — its
+generation numbers table *content* — and only advance the swap counter
+and age stamp (O(1)), so an empty-window stream never reads as wedged.
+
+FlashSparse-style redundancy elimination on the query path: rows are
+pre-packed (descending scores, finite prefix, lengths precomputed) so a
+query is pure pointer chasing + vectorized adds into caller scratch.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..observability.registry import REGISTRY
+
+#: Dense item ids kept in the popularity fallback ladder (the cold-start
+#: answer is "top-N of these minus already-seen"; N is capped by it).
+POPULAR_WIDTH = 128
+
+
+if hasattr(np, "bitwise_count"):  # numpy >= 2.0
+    def _popcount(words: np.ndarray) -> np.ndarray:
+        return np.bitwise_count(words)
+else:  # portable fallback: byte-table popcount over the uint8 view
+    _POP8 = np.asarray([bin(i).count("1") for i in range(256)],
+                       dtype=np.uint8)
+
+    def _popcount(words: np.ndarray) -> np.ndarray:
+        return _POP8[words.view(np.uint8).reshape(-1, 8)].sum(
+            axis=1).astype(np.uint64)
+
+
+class _Segment:
+    """One absorbed window's rows, pre-packed for reading.
+
+    ``idx``/``vals`` are the backend's packed ``[S, K]`` arrays as emitted
+    (scores descending, ``-inf`` padding); ``lens[r]`` is the finite prefix
+    length, precomputed once at absorb time so no query ever filters.
+    Immutable after construction — snapshots share segment objects across
+    generations by reference.
+    """
+
+    __slots__ = ("rows", "idx", "vals", "lens")
+
+    def __init__(self, rows: np.ndarray, idx: np.ndarray,
+                 vals: np.ndarray) -> None:
+        self.rows = rows
+        self.idx = idx
+        self.vals = vals
+        self.lens = np.isfinite(vals).sum(axis=1).astype(np.int32)
+
+
+class TopKSnapshot:
+    """Immutable point-in-time view of the per-item top-K table.
+
+    Layout (the operator-facing table lives in docs/ARCHITECTURE.md
+    "Serving plane"):
+
+    * ``bits``/``rank`` — one occupancy bit per dense item plus the
+      per-64-bit-word exclusive popcount prefix (PR-7 pattern): packed
+      position of item *i* is ``rank[i >> 6] + popcount(bits[i >> 6]
+      below bit i)`` — O(1) membership and lookup, no hash, no lock.
+    * ``seg_of``/``row_of`` — per *occupied* item, which segment holds its
+      newest row and where.
+    * ``segments`` — pre-packed window rows (shared by reference with
+      other generations).
+    * ``popular``/``popular_scores`` — the cold-start fallback ladder,
+      descending.
+    * ``rev`` — dense -> external item id array (grow-only; captured at
+      publish so readers never touch the live vocab).
+
+    No method on this class writes any array, and the class holds no lock
+    by construction — reader safety is immutability, not exclusion.
+    """
+
+    __slots__ = ("generation", "built_unix", "rows", "bits", "rank",
+                 "seg_of", "row_of", "segments", "popular",
+                 "popular_scores", "rev", "max_k")
+
+    def __init__(self, generation: int, built_unix: float, rows: int,
+                 bits: np.ndarray, rank: np.ndarray, seg_of: np.ndarray,
+                 row_of: np.ndarray, segments: Tuple[_Segment, ...],
+                 popular: np.ndarray, popular_scores: np.ndarray,
+                 rev: np.ndarray, max_k: int = 1) -> None:
+        self.generation = generation
+        self.built_unix = built_unix
+        self.rows = rows
+        self.bits = bits
+        self.rank = rank
+        self.seg_of = seg_of
+        self.row_of = row_of
+        self.segments = segments
+        self.popular = popular
+        self.popular_scores = popular_scores
+        self.rev = rev
+        # Widest row across segments, precomputed at publish: queries
+        # size their scratch from it — a per-query max() over the
+        # segment list would be O(segments-since-compaction) on exactly
+        # the path whose p99 this plane exists to bound.
+        self.max_k = max_k
+
+    def row(self, dense_item: int):
+        """``(idx_view, vals_view)`` of the item's top-K row, or ``None``.
+
+        Views into the segment's packed arrays — zero copies, zero
+        allocation beyond the two view headers.
+        """
+        if dense_item < 0 or dense_item >= len(self.bits) * 64:
+            return None
+        w = dense_item >> 6
+        b = dense_item & 63
+        word = int(self.bits[w])
+        if not (word >> b) & 1:
+            return None
+        pos = int(self.rank[w]) + bin(word & ((1 << b) - 1)).count("1")
+        seg = self.segments[self.seg_of[pos]]
+        r = int(self.row_of[pos])
+        ln = int(seg.lens[r])
+        return seg.idx[r, :ln], seg.vals[r, :ln]
+
+    def age_seconds(self, now: Optional[float] = None) -> float:
+        return (now if now is not None else time.time()) - self.built_unix
+
+
+class SnapshotBuilder:
+    """Incremental builder + double-buffered publisher of snapshots.
+
+    Thread contract: :meth:`absorb` / :meth:`publish` run on the single
+    window-absorbing thread; :attr:`current` is read by any number of
+    query threads with a plain attribute load. The builder itself holds
+    no lock — single-writer plus immutable-publish needs none.
+    """
+
+    #: Dead (superseded) rows tolerated before a compaction pass; mirrors
+    #: ``LatestResults._COMPACT_MIN_ROWS`` at a serving-friendly scale.
+    _COMPACT_MIN_ROWS = 1 << 18
+
+    def __init__(self, item_vocab) -> None:
+        self._vocab = item_vocab
+        self._segments: List[_Segment] = []
+        self._ptr_seg = np.full(1024, -1, dtype=np.int32)
+        self._ptr_pos = np.zeros(1024, dtype=np.int32)
+        self._pop = np.zeros(1024, dtype=np.float64)
+        self._rows_absorbed = 0
+        self._live = 0
+        self._dirty = False
+        # Retired snapshot whose arrays may be recycled once every reader
+        # released it (the second buffer of the double buffer).
+        self._spare: Optional[TopKSnapshot] = None
+        # Swap bookkeeping (liveness, /healthz staleness): every publish
+        # advances these, whether or not the table content changed.
+        self.swaps = 0
+        self.last_swap_unix = time.time()
+        self._gauge_gen = REGISTRY.gauge(
+            "cooc_snapshot_generation",
+            help="generation of the published serving snapshot")
+        self._gauge_swaps = REGISTRY.gauge(
+            "cooc_snapshot_swaps_total",
+            help="snapshot double-buffer swaps performed")
+        self._gauge_built = REGISTRY.gauge(
+            "cooc_snapshot_built_unix_seconds",
+            help="wall clock of the last snapshot swap (staleness input)")
+        self._gauge_rows = REGISTRY.gauge(
+            "cooc_snapshot_rows",
+            help="live item rows in the published serving snapshot")
+        #: The published snapshot. Plain attribute: assignment is the
+        #: atomic swap; readers take one reference and never look back.
+        self.current: TopKSnapshot = self._empty_snapshot()
+
+    def _empty_snapshot(self) -> TopKSnapshot:
+        snap = TopKSnapshot(
+            generation=0, built_unix=time.time(), rows=0,
+            bits=np.zeros(16, dtype=np.uint64),
+            rank=np.zeros(16, dtype=np.int64),
+            seg_of=np.zeros(0, dtype=np.int32),
+            row_of=np.zeros(0, dtype=np.int32),
+            segments=(), popular=np.zeros(0, dtype=np.int32),
+            popular_scores=np.zeros(0, dtype=np.float64),
+            rev=np.zeros(0, dtype=np.int64))
+        self._gauge_built.set(snap.built_unix)
+        return snap
+
+    # -- absorption (window-absorbing thread) ---------------------------
+
+    def _ensure(self, n: int) -> None:
+        if n <= len(self._ptr_seg):
+            return
+        cap = len(self._ptr_seg)
+        while cap < n:
+            cap *= 2
+        grown = np.full(cap, -1, dtype=np.int32)
+        grown[: len(self._ptr_seg)] = self._ptr_seg
+        self._ptr_seg = grown
+        grown_rows = np.zeros(cap, dtype=np.int32)
+        grown_rows[: len(self._ptr_pos)] = self._ptr_pos
+        self._ptr_pos = grown_rows
+        grown_pop = np.zeros(cap, dtype=np.float64)
+        grown_pop[: len(self._pop)] = self._pop
+        self._pop = grown_pop
+
+    def absorb(self, window_out) -> None:
+        """Fold one window's emitted rows (``TopKBatch`` or host-backend
+        list rows, dense-id space) into the build buffer."""
+        rows, idx, vals = _as_arrays(window_out)
+        if not len(rows):
+            return
+        seg = _Segment(rows, idx, vals)
+        sid = len(self._segments)
+        self._segments.append(seg)
+        r64 = rows.astype(np.int64)
+        self._ensure(int(r64.max()) + 1)
+        fresh = int((self._ptr_seg[r64] < 0).sum())
+        self._ptr_seg[r64] = sid
+        self._ptr_pos[r64] = np.arange(len(r64), dtype=np.int32)
+        self._rows_absorbed += len(r64)
+        self._live += fresh
+        # Popularity: co-occurrence mass per neighbor item across emitted
+        # rows (recency-compounding by construction: an item re-emitted
+        # every window keeps accumulating).
+        finite = np.isfinite(vals)
+        np.add.at(self._pop, idx[finite].astype(np.int64), 1.0)
+        self._dirty = True
+        if (self._rows_absorbed >= self._COMPACT_MIN_ROWS
+                and self._rows_absorbed > 2 * self._live):
+            self._compact()
+
+    def _compact(self) -> None:
+        """Gather live rows into one merged segment; superseded rows (and
+        the segment objects only they referenced) become garbage once the
+        generations still viewing them retire."""
+        live = np.flatnonzero(self._ptr_seg[: len(self._ptr_seg)] >= 0)
+        if not len(live):
+            self._segments = []
+            self._rows_absorbed = 0
+            return
+        sids = self._ptr_seg[live]
+        rows_in = self._ptr_pos[live]
+        parts_idx, parts_vals, parts_rows = [], [], []
+        kmax = max(s.idx.shape[1] for s in self._segments)
+        for sid in np.unique(sids):
+            seg = self._segments[sid]
+            sel = sids == sid
+            r = rows_in[sel]
+            parts_rows.append(live[sel].astype(np.int32))
+            parts_idx.append(_pad_k(seg.idx[r], kmax, 0))
+            parts_vals.append(_pad_k(seg.vals[r], kmax, -np.inf))
+        merged = _Segment(np.concatenate(parts_rows),
+                          np.concatenate(parts_idx),
+                          np.concatenate(parts_vals))
+        self._segments = [merged]
+        self._ptr_seg[live] = 0
+        # Merged row order is per-source-segment, NOT live order: map
+        # each dense id to its actual position in the merged segment.
+        self._ptr_pos[merged.rows.astype(np.int64)] = np.arange(
+            len(merged.rows), dtype=np.int32)
+        self._rows_absorbed = len(live)
+
+    # -- publication (the swap) -----------------------------------------
+
+    def publish(self) -> TopKSnapshot:
+        """Pack the build buffer and swap it in as :attr:`current`.
+
+        Returns the published snapshot. A quiet boundary (nothing
+        absorbed since the last publish) keeps the published *object* —
+        its generation numbers table content, and re-wrapping identical
+        arrays would break the refcount ownership the buffer recycling
+        rests on — while the swap counter and age stamp still advance,
+        so an empty-window stream never reads as a wedged job.
+        """
+        now = time.time()
+        self.swaps += 1
+        self.last_swap_unix = now
+        self._gauge_swaps.add(1)
+        self._gauge_built.set(now)
+        if not self._dirty:
+            return self.current
+        prev = self.current
+        snap = self._pack(prev.generation + 1, now)
+        self._dirty = False
+        self.current = snap  # THE swap: one atomic reference assignment
+        self._spare = prev
+        self._gauge_gen.set(snap.generation)
+        self._gauge_rows.set(snap.rows)
+        return snap
+
+    @staticmethod
+    def _base_cap(a: np.ndarray) -> int:
+        """Allocation capacity behind a (possibly sliced) 1-D array."""
+        return len(a.base) if a.base is not None else len(a)
+
+    def _recycled(self, n_words: int, n_live: int):
+        """Arrays for the next pack: the retired buffer's, when capacity
+        fits and no reader still holds its snapshot (refcount == the
+        builder's own three handles: ``_spare``, the local, and the
+        check argument); fresh pow2-headroom allocations otherwise — a
+        straggling reader keeps its generation intact and only costs
+        one allocation."""
+        spare = self._spare
+        if (spare is not None and sys.getrefcount(spare) == 3
+                and spare.rows > 0
+                and self._base_cap(spare.bits) >= n_words
+                and self._base_cap(spare.seg_of) >= n_live):
+            self._spare = None
+            bits = (spare.bits.base if spare.bits.base is not None
+                    else spare.bits)
+            rank = (spare.rank.base if spare.rank.base is not None
+                    else spare.rank)
+            seg = (spare.seg_of.base if spare.seg_of.base is not None
+                   else spare.seg_of)
+            row = (spare.row_of.base if spare.row_of.base is not None
+                   else spare.row_of)
+            return (bits[:n_words], rank[:n_words],
+                    seg[:n_live], row[:n_live])
+        cap_w = max(16, 1 << max(n_words - 1, 0).bit_length())
+        cap_l = max(64, 1 << max(n_live - 1, 0).bit_length())
+        return (np.zeros(cap_w, dtype=np.uint64)[:n_words],
+                np.zeros(cap_w, dtype=np.int64)[:n_words],
+                np.empty(cap_l, dtype=np.int32)[:n_live],
+                np.empty(cap_l, dtype=np.int32)[:n_live])
+
+    def _pack(self, gen: int, now: float) -> TopKSnapshot:
+        n = min(len(self._ptr_seg), len(self._vocab))
+        live = np.flatnonzero(self._ptr_seg[:n] >= 0).astype(np.int64)
+        n_words = max((n + 63) // 64, 16)
+        bits, rank, seg_of, row_of = self._recycled(n_words, len(live))
+        bits[:] = 0
+        np.bitwise_or.at(bits, live >> 6,
+                         np.uint64(1) << (live & 63).astype(np.uint64))
+        pc = _popcount(bits).astype(np.int64)
+        np.cumsum(pc[:-1], out=rank[1:])
+        rank[0] = 0
+        seg_of[:] = self._ptr_seg[live]
+        row_of[:] = self._ptr_pos[live]
+        pop = self._pop[:n]
+        k = min(POPULAR_WIDTH, n)
+        top = np.argpartition(-pop, k - 1)[:k] if k else np.zeros(
+            0, dtype=np.int64)
+        top = top[pop[top] > 0]
+        top = top[np.argsort(-pop[top], kind="stable")]
+        return TopKSnapshot(
+            gen, now, len(live), bits, rank, seg_of, row_of,
+            tuple(self._segments), top.astype(np.int32),
+            self._pop[top].copy(), self._vocab.external_array(),
+            max_k=max((s.idx.shape[1] for s in self._segments),
+                      default=1))
+
+    # -- seeding (restore path) -----------------------------------------
+
+    def seed(self, results_snapshot) -> None:
+        """Rebuild the buffer from a consistent ``LatestResults``
+        snapshot (``state/results.ResultsSnapshot``) — the restore path:
+        a resumed job must serve its checkpointed rows before the first
+        post-restore window fires."""
+        self._segments = []
+        self._ptr_seg[:] = -1
+        self._pop[:] = 0
+        self._rows_absorbed = 0
+        self._live = 0
+        self.absorb(results_snapshot.packed())
+        self.publish()
+
+
+def _pad_k(a: np.ndarray, k: int, fill) -> np.ndarray:
+    if a.shape[1] == k:
+        return a
+    out = np.full((a.shape[0], k), fill, dtype=a.dtype)
+    out[:, : a.shape[1]] = a
+    return out
+
+
+def _as_arrays(window_out):
+    """Normalize a window output to packed (rows, idx[S,K], vals[S,K]).
+
+    Device backends hand back ``TopKBatch``; host backends hand back
+    ``[(dense_item, [(dense_other, score), ...]), ...]`` lists, padded
+    by the one shared convention (``state/results.pack_rows`` — small by
+    construction, the per-row loop is off the array path).
+    """
+    from ..state.results import TopKBatch, pack_rows
+
+    if isinstance(window_out, TopKBatch):
+        return window_out.rows, window_out.idx, window_out.vals
+    batch = pack_rows(list(window_out))
+    return batch.rows, batch.idx, batch.vals

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Iterator, List, Mapping, Tuple
+from typing import Iterator, List, Mapping, Optional, Tuple
 
 import numpy as np
 
@@ -85,6 +85,30 @@ def materialize_dense(window_out) -> List[Tuple[int, List[Tuple[int, float]]]]:
     return out
 
 
+def pack_rows(rows_list: List[Tuple[int, List[Tuple[int, float]]]],
+              k: Optional[int] = None) -> TopKBatch:
+    """Materialized list rows -> one padded :class:`TopKBatch`.
+
+    Pads to width ``k`` (or the widest row) with idx 0 / ``-inf`` score
+    lanes — the one definition of the list-to-packed convention, shared
+    by :meth:`ResultsSnapshot.packed` and the serving snapshot builder's
+    absorb path (two paddings that drift apart would silently corrupt
+    the restore-seeded serving table).
+    """
+    if not rows_list:
+        return TopKBatch.empty(max(k or 1, 1))
+    if k is None:
+        k = max(1, max(len(top) for _, top in rows_list))
+    rows = np.asarray([item for item, _ in rows_list], dtype=np.int32)
+    idx = np.zeros((len(rows_list), k), dtype=np.int32)
+    vals = np.full((len(rows_list), k), -np.inf, dtype=np.float32)
+    for r, (_, top) in enumerate(rows_list):
+        for c, (j, s) in enumerate(top):
+            idx[r, c] = j
+            vals[r, c] = s
+    return TopKBatch(rows, idx, vals)
+
+
 class _ListBatch:
     """Adapter for host backends that produce per-row Python lists."""
 
@@ -97,6 +121,105 @@ class _ListBatch:
 
     def __len__(self) -> int:
         return len(self.rows)
+
+
+def _materialize_row(b, row: int, vocab) -> List[Tuple[int, float]]:
+    """One stored row -> ``[(external other, score), ...]`` (shared by the
+    live store and its snapshots)."""
+    if isinstance(b, _ListBatch):
+        return [(vocab.to_external(j), s) for j, s in b.rows[row]]
+    vals = b.vals[row]
+    keep = np.isfinite(vals)
+    if not keep.any():
+        return []
+    ext = vocab.to_external_batch(b.idx[row][keep].astype(np.int64))
+    return list(zip(ext.tolist(), vals[keep].astype(float).tolist()))
+
+
+class ResultsSnapshot(Mapping):
+    """Consistent point-in-time view of a :class:`LatestResults`.
+
+    Constructed by :meth:`LatestResults.snapshot` *under the store's
+    lock*: the pointer arrays are copied, the batch list is
+    shallow-copied, and batch contents are immutable once absorbed
+    (compaction builds new batches and a new list; list-batch appends
+    never move existing rows) — so every read here is lock-free and
+    cannot interleave with concurrent absorption. This is what the
+    stdout emitters and the serving snapshot builder consume; iterating
+    the live store mid-run reads a moving target.
+    """
+
+    def __init__(self, vocab, batches: list, ptr_batch: np.ndarray,
+                 ptr_row: np.ndarray) -> None:
+        self._vocab = vocab
+        self.batches = batches
+        self.ptr_batch = ptr_batch
+        self.ptr_row = ptr_row
+        self._n_vocab = len(vocab)  # vocab grows; pin the extent too
+
+    def _live_dense(self) -> np.ndarray:
+        n = min(len(self.ptr_batch), self._n_vocab)
+        return np.nonzero(self.ptr_batch[:n] >= 0)[0]
+
+    def __len__(self) -> int:
+        return int(len(self._live_dense()))
+
+    def __iter__(self) -> Iterator[int]:
+        live = self._live_dense()
+        if len(live) == 0:
+            return iter(())
+        return iter(self._vocab.to_external_batch(live).tolist())
+
+    def __contains__(self, ext_item) -> bool:
+        dense = self._vocab.to_dense(ext_item)
+        return (dense is not None and dense < len(self.ptr_batch)
+                and self.ptr_batch[dense] >= 0)
+
+    def __getitem__(self, ext_item) -> List[Tuple[int, float]]:
+        dense = self._vocab.to_dense(ext_item)
+        if (dense is None or dense >= len(self.ptr_batch)
+                or self.ptr_batch[dense] < 0):
+            raise KeyError(ext_item)
+        return _materialize_row(self.batches[self.ptr_batch[dense]],
+                                int(self.ptr_row[dense]), self._vocab)
+
+    def packed(self) -> TopKBatch:
+        """Live rows as one packed dense-id batch (list-backed rows are
+        padded in) — the serving builder's restore-seed input."""
+        live = self._live_dense()
+        if not len(live):
+            return TopKBatch.empty(1)
+        bids = self.ptr_batch[live]
+        rows = self.ptr_row[live]
+        k = 1
+        for bid in np.unique(bids):
+            b = self.batches[bid]
+            if isinstance(b, _ListBatch):
+                k = max(k, max((len(r) for r in b.rows), default=0))
+            else:
+                k = max(k, b.idx.shape[1])
+        out_rows, out_idx, out_vals = [], [], []
+        for bid in np.unique(bids):
+            b = self.batches[bid]
+            sel = bids == bid
+            r = rows[sel]
+            out_rows.append(live[sel].astype(np.int32))
+            if isinstance(b, _ListBatch):
+                sub = pack_rows(
+                    [(int(d), b.rows[row])
+                     for d, row in zip(live[sel].tolist(), r.tolist())],
+                    k=k)
+                idx, vals = sub.idx, sub.vals
+            else:
+                idx = np.zeros((len(r), k), dtype=np.int32)
+                vals = np.full((len(r), k), -np.inf, dtype=np.float32)
+                idx[:, : b.idx.shape[1]] = b.idx[r]
+                vals[:, : b.vals.shape[1]] = b.vals[r]
+            out_idx.append(idx)
+            out_vals.append(vals)
+        return TopKBatch(np.concatenate(out_rows),
+                         np.concatenate(out_idx),
+                         np.concatenate(out_vals))
 
 
 class LatestResults(Mapping):
@@ -232,15 +355,18 @@ class LatestResults(Mapping):
                 raise KeyError(ext_item)
             b = self._batches[self._ptr_batch[dense]]
             row = int(self._ptr_row[dense])
-        if isinstance(b, _ListBatch):
-            top = b.rows[row]
-            return [(self._vocab.to_external(j), s) for j, s in top]
-        vals = b.vals[row]
-        keep = np.isfinite(vals)
-        if not keep.any():
-            return []
-        ext = self._vocab.to_external_batch(b.idx[row][keep].astype(np.int64))
-        return list(zip(ext.tolist(), vals[keep].astype(float).tolist()))
+        return _materialize_row(b, row, self._vocab)
+
+    def snapshot(self) -> ResultsSnapshot:
+        """Consistent copy for lock-free reading (stdout emitters, the
+        serving seed). Pointer arrays copy under the lock; batches are
+        shared by reference (immutable once absorbed — see
+        :class:`ResultsSnapshot`). O(vocab extent) memcpy, no row data
+        copied."""
+        with self._lock:
+            return ResultsSnapshot(self._vocab, list(self._batches),
+                                   self._ptr_batch.copy(),
+                                   self._ptr_row.copy())
 
     # -- checkpoint helpers ---------------------------------------------
 

@@ -135,19 +135,36 @@ class IdMap:
         return self._rev[dense]
 
     def to_dense(self, ext):
-        """Dense id for an external id, or ``None`` if never seen."""
-        if self._fwd_n != len(self._rev):
-            for dense in range(self._fwd_n, len(self._rev)):
+        """Dense id for an external id, or ``None`` if never seen.
+
+        Safe under concurrent growth (serving query threads call this
+        while the ingest thread appends): the catch-up bound is captured
+        ONCE — re-reading ``len(self._rev)`` after the fill loop could
+        mark ids mapped mid-loop as covered without ever filling them,
+        silently resolving those users/items to ``None`` forever.
+        """
+        n = len(self._rev)
+        if self._fwd_n != n:
+            for dense in range(self._fwd_n, n):
                 self._fwd[self._rev[dense]] = dense
-            self._fwd_n = len(self._rev)
+            self._fwd_n = n
         return self._fwd.get(ext)
 
-    def to_external_batch(self, dense: np.ndarray) -> np.ndarray:
+    def external_array(self) -> np.ndarray:
+        """The dense -> external id array, refreshed if the vocab grew.
+
+        The returned object is never mutated (growth *replaces* the
+        cache), so a caller may hold it across its own reads — the
+        serving snapshot captures it at publish and reads it lock-free.
+        """
         # Rebuilt only when the vocab has grown since the last call (result
         # materialization calls this per row — it must not be O(vocab)).
         if len(self._rev_arr) != len(self._rev):
             self._rev_arr = np.asarray(self._rev, dtype=np.int64)
-        return self._rev_arr[dense]
+        return self._rev_arr
+
+    def to_external_batch(self, dense: np.ndarray) -> np.ndarray:
+        return self.external_array()[dense]
 
     # -- checkpoint ------------------------------------------------------
 
