@@ -39,7 +39,10 @@ from tpu_cooccurrence.bench.grant_watch import (
 def run(backend: str, users, items, ts, num_items: int, window_ms: int,
         pipeline_depth: int = 0, journal: str = None,
         fused_window: str = "off", wire_format: str = "auto",
-        cell_dtype: str = "auto"):
+        cell_dtype: str = "auto", spill_threshold_windows: int = 0,
+        spill_target_hbm_frac: float = 0.5):
+    import hashlib
+
     from tpu_cooccurrence.config import Backend, Config
     from tpu_cooccurrence.job import CooccurrenceJob
     from tpu_cooccurrence.metrics import OBSERVED_COOCCURRENCES
@@ -55,7 +58,9 @@ def run(backend: str, users, items, ts, num_items: int, window_ms: int,
                  user_cut=500, backend=Backend(backend), num_items=num_items,
                  pipeline_depth=pipeline_depth, journal=journal,
                  fused_window=fused_window, wire_format=wire_format,
-                 cell_dtype=cell_dtype)
+                 cell_dtype=cell_dtype,
+                 spill_threshold_windows=spill_threshold_windows,
+                 spill_target_hbm_frac=spill_target_hbm_frac)
     job = CooccurrenceJob(cfg)
     start = time.monotonic()
     job.add_batch(users, items, ts)
@@ -102,8 +107,40 @@ def run(backend: str, users, items, ts, num_items: int, window_ms: int,
         "slab_live_cells": int(
             REGISTRY.gauge("cooc_slab_live_cells").get()),
     }
+    # Tiered-state accounting (PR 9): spill/promote counters, the rows
+    # the run MANAGED (device-resident + spilled to the host arena —
+    # identical across arms on the same stream), and a digest of the
+    # final top-K so the spill A/B arm can assert bit-identity without
+    # holding both result tables.
+    scorer = job.scorer
+    rows_managed = 0
+    if hasattr(scorer, "index"):
+        rows_managed = len(scorer.index.rows.occupied())
+        if getattr(scorer, "index_w", None) is not None:
+            rows_managed += len(scorer.index_w.rows.occupied())
+        store = getattr(scorer, "store", None)
+        if getattr(store, "tiered", False):
+            rows_managed += len(store.arena)
+    digest = hashlib.sha256()
+    snap = job.latest.snapshot()
+    for item in sorted(snap):
+        digest.update(repr((item, snap[item])).encode())
+    spill = {
+        "evictions_total": int(
+            REGISTRY.gauge("cooc_spill_evictions_total").get()),
+        "promotions_total": int(
+            REGISTRY.gauge("cooc_spill_promotions_total").get()),
+        "touches_total": int(
+            REGISTRY.gauge("cooc_spill_row_touches_total").get()),
+        "resident_rows": int(
+            REGISTRY.gauge("cooc_spill_resident_rows").get()),
+        "arena_bytes": int(
+            REGISTRY.gauge("cooc_spill_arena_bytes").get()),
+        "rows_managed": rows_managed,
+        "results_digest": digest.hexdigest(),
+    }
     return pairs, elapsed, job.step_timer.occupancy(elapsed), \
-        REGISTRY.summaries(), degradation, dispatches, wire
+        REGISTRY.summaries(), degradation, dispatches, wire, spill
 
 
 def query_storm(seconds: float = None, threads: int = None,
@@ -220,6 +257,44 @@ def query_storm(seconds: float = None, threads: int = None,
     }
 
 
+def _longtail_churn_stream(windows: int, users_per: int, events_per: int,
+                           n_items: int, alpha: float, drift: int,
+                           seed: int, window_ms: int):
+    """Long-tail stream with genuinely COLD rows, for the spill arm.
+
+    Two production shapes the plain Zipf generator cannot produce
+    (reservoir expansion re-touches every history item's row on every
+    event, so a persistent user base keeps nearly all rows hot):
+
+    * **user cohorts** — each window has its own fresh user cohort;
+      when a cohort leaves, its items stop being re-expanded, and
+    * **catalog drift** — the Zipf head rotates ``drift`` item ids per
+      window (new content replaces old), so even head rows go cold a
+      few windows after the head moves past them.
+
+    Rows touched once and never again are exactly the long-tail items
+    the tiered store exists for.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_items + 1, dtype=np.float64)
+    p = ranks ** -alpha
+    p /= p.sum()
+    us, its, tss = [], [], []
+    for w in range(windows):
+        u = (w * users_per
+             + rng.integers(0, users_per, events_per)).astype(np.int64)
+        i = (rng.choice(n_items, size=events_per, p=p)
+             + w * drift) % n_items
+        t = w * window_ms + np.sort(rng.integers(0, window_ms, events_per))
+        us.append(u)
+        its.append(i.astype(np.int64))
+        tss.append(t.astype(np.int64))
+    return (np.concatenate(us), np.concatenate(its),
+            np.concatenate(tss))
+
+
 def _uplink_per_window(latency: dict) -> float:
     """Mean host->device bytes per fired window, from the run's
     ``cooc_window_uplink_bytes`` histogram summary (TransferLedger-fed:
@@ -241,7 +316,7 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
                    pipeline_depth: int, occupancy: dict,
                    latency: dict = None, degradation: dict = None,
                    fused: dict = None, compression: dict = None,
-                   serving: dict = None) -> None:
+                   serving: dict = None, spill: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -276,6 +351,11 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         # ingesting job — the user-facing metric every later perf PR
         # moves, trajectory-visible like the other arms.
         entry["serving"] = serving
+    if spill:
+        # The PR-9 tiered-state A/B: effective rows per HBM byte off/on,
+        # eviction/promotion counters, hot-row hit rate and the
+        # bit-identity verdict — the elastic-state headline numbers.
+        entry["spill"] = spill
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -342,7 +422,7 @@ def measure() -> None:
     # contention. The occupancy/latency published are the median run's.
     samples = []
     for _ in range(3):
-        pairs, elapsed, occupancy, latency, degradation, _, _ = run(
+        pairs, elapsed, occupancy, latency, degradation, _, _, _ = run(
             "device", users, items, ts, num_items=n_items, window_ms=100,
             pipeline_depth=pipeline_depth, journal=journal)
         samples.append((pairs / max(elapsed, 1e-9), occupancy, latency,
@@ -365,7 +445,7 @@ def measure() -> None:
         pipeline_depth=pipeline_depth, fused_window="auto")
     f_samples = []
     for _ in range(3):
-        f_pairs, f_elapsed, _, f_latency, _, f_dispatches, _ = run(
+        f_pairs, f_elapsed, _, f_latency, _, f_dispatches, _, _ = run(
             "device", users, items, ts, num_items=n_items, window_ms=100,
             pipeline_depth=pipeline_depth, journal=journal,
             fused_window="auto")
@@ -400,7 +480,7 @@ def measure() -> None:
             wire_format=wire, cell_dtype=cell)  # warmup (compiles)
         arm = []
         for _ in range(3):
-            c_pairs, c_elapsed, _, _, _, _, c_wire = run(
+            c_pairs, c_elapsed, _, _, _, _, c_wire, _ = run(
                 "sparse", cu, ci, ct, num_items=n_items, window_ms=100,
                 wire_format=wire, cell_dtype=cell)
             arm.append((c_pairs / max(c_elapsed, 1e-9), c_wire))
@@ -438,6 +518,62 @@ def measure() -> None:
         },
     }
 
+    # Tiered-state (spill) A/B arm (PR 9): the SAME long-tail churn
+    # stream through the sparse backend with tiering off vs on. The
+    # headline pair is deterministic footprint, not timing — effective
+    # rows per HBM byte (rows managed / device slab bytes; rows managed
+    # is identical across arms by construction) and the hot-row hit
+    # rate — so one run per arm suffices; and the results digest pins
+    # the bit-identity claim (spill/promote is exact movement). The
+    # stream mixes user-cohort churn with catalog drift: the two
+    # production shapes that actually create cold rows (see
+    # _longtail_churn_stream).
+    sp_windows = int(os.environ.get("BENCH_SPILL_WINDOWS", 60))
+    sp_u, sp_i, sp_t = _longtail_churn_stream(
+        windows=sp_windows, users_per=150, events_per=2500,
+        n_items=60_000, alpha=1.07, drift=400, seed=11, window_ms=100)
+    sp_thr = int(os.environ.get("BENCH_SPILL_THRESHOLD", 4))
+
+    def _spill_arm(threshold, frac):
+        s_pairs, s_elapsed, _, _, _, _, s_wire, s_spill = run(
+            "sparse", sp_u, sp_i, sp_t, num_items=60_000, window_ms=100,
+            spill_threshold_windows=threshold,
+            spill_target_hbm_frac=frac)
+        return s_pairs / max(s_elapsed, 1e-9), s_wire, s_spill
+
+    off_rate, off_wire, off_spill = _spill_arm(0, 0.5)
+    on_rate, on_wire, on_spill = _spill_arm(sp_thr, 0.0)
+
+    def _rows_per_byte(sp, w):
+        return sp["rows_managed"] / max(w["slab_device_bytes"], 1)
+
+    spill_info = {
+        "events": len(sp_u),
+        "threshold_windows": sp_thr,
+        "rows_managed": on_spill["rows_managed"],
+        "slab_device_bytes_off": off_wire["slab_device_bytes"],
+        "slab_device_bytes_on": on_wire["slab_device_bytes"],
+        "effective_rows_per_hbm_byte": {
+            "off": round(_rows_per_byte(off_spill, off_wire), 8),
+            "on": round(_rows_per_byte(on_spill, on_wire), 8),
+        },
+        "rows_per_hbm_byte_gain": round(
+            _rows_per_byte(on_spill, on_wire)
+            / max(_rows_per_byte(off_spill, off_wire), 1e-12), 3),
+        "spill_evictions_total": on_spill["evictions_total"],
+        "promotions_total": on_spill["promotions_total"],
+        "hot_row_hit_rate": round(
+            1.0 - on_spill["promotions_total"]
+            / max(on_spill["touches_total"], 1), 4),
+        "arena_bytes": on_spill["arena_bytes"],
+        "resident_rows": on_spill["resident_rows"],
+        "pairs_per_sec_off": round(off_rate, 1),
+        "pairs_per_sec_on": round(on_rate, 1),
+        # The whole point: exact movement, never approximation.
+        "identical_topk": (on_spill["results_digest"]
+                           == off_spill["results_digest"]),
+    }
+
     # Query-storm arm (PR-8 serving plane): closed-loop qps + query
     # latency tails from a keep-alive HTTP pool against a live ingesting
     # job (million-user id space). Host-side plane, so the arm runs
@@ -455,7 +591,7 @@ def measure() -> None:
         with open(baseline_path) as f:
             baseline = json.load(f)["pairs_per_sec"]
     else:
-        b_pairs, b_elapsed, _, _, _, _, _ = run("oracle", users, items, ts,
+        b_pairs, b_elapsed, _, _, _, _, _, _ = run("oracle", users, items, ts,
                                              num_items=n_items,
                                              window_ms=100)
         baseline = b_pairs / max(b_elapsed, 1e-9)
@@ -476,6 +612,7 @@ def measure() -> None:
         "degradation": degradation,
         "fused": fused_info,
         "compression": compression,
+        "spill": spill_info,
         "serving": serving_storm,
     }
     if journal:
@@ -497,7 +634,7 @@ def measure() -> None:
     else:
         _record_onchip(out["value"], out["vs_baseline"], backend,
                        pipeline_depth, occupancy, latency, degradation,
-                       fused_info, compression, serving_storm)
+                       fused_info, compression, serving_storm, spill_info)
     print(json.dumps(out))
 
 

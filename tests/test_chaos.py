@@ -79,7 +79,13 @@ def test_chaos_quick_crash_parity(tmp_path):
     write_stream(f, n=600)
     base = ["-i", str(f), "-ws", "40", "-ic", "8", "-uc", "5",
             "-s", "0xC0FFEE", "--backend", "oracle",
-            "--checkpoint-every-windows", "3"]
+            "--checkpoint-every-windows", "3",
+            # Wide retain window: the PR-9 sweep ages out *.corrupt
+            # files whose generation leaves the window, and this test's
+            # final assertion wants the torn generation's forensics
+            # still on disk (the sweep itself is pinned by
+            # tests/test_state_store.py).
+            "--checkpoint-retain", "10"]
     clean = _clean_run(tmp_path, base)
     assert clean, "reference run produced no output"
 
@@ -161,6 +167,80 @@ def test_chaos_scorer_breaker_trips_and_run_completes_on_fallback(tmp_path):
     assert states[-1] == "closed", states    # half-open probe recovered
 
 
+def _run_cli(args, timeout=600, expect_rc=0):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cooccurrence.cli"] + args,
+        capture_output=True, text=True, env=ENV, cwd=REPO, timeout=timeout)
+    if expect_rc is not None:
+        assert proc.returncode == expect_rc, proc.stderr[-800:]
+    return proc
+
+
+@pytest.mark.parametrize("n_from,n_to,depth", [(2, 4, 0), (4, 2, 2)])
+def test_chaos_rescale_kill_and_resume_other_topology(tmp_path, n_from,
+                                                      n_to, depth):
+    """Elastic-state capstone (ISSUE 9): kill a sharded-sparse run at
+    ``--num-shards N`` mid-stream, resume at M — stdout bit-identical
+    to resuming at N (the same-topology resume is the canonical
+    reference: any restore rebuilds rows in key order, so rescale must
+    change NOTHING beyond topology), both directions, depths 0 and 2.
+    """
+    f = tmp_path / "in.csv"
+    write_stream(f, n=500)
+    ck = tmp_path / "ck"
+
+    def args(shards, extra=()):
+        return ["-i", str(f), "-ws", "40", "-ic", "8", "-uc", "5",
+                "-s", "0xC0FFEE", "--backend", "sparse",
+                "--num-shards", str(shards),
+                "--pipeline-depth", str(depth),
+                "--checkpoint-every-windows", "3",
+                "--checkpoint-dir", str(ck)] + list(extra)
+
+    # Kill at N: the injected crash leaves a committed checkpoint behind
+    # (rc != 0 — the crash is a SIGKILL-style exit, not a clean run).
+    proc = _run_cli(args(n_from, ["--inject-fault", "window_fire:7:crash",
+                                  "--fault-state-dir",
+                                  str(tmp_path / "fault-state")]),
+                    expect_rc=None)
+    assert proc.returncode != 0
+    assert not proc.stdout, "final dump must not have run before the kill"
+    assert any(p.startswith("state") for p in os.listdir(ck)), \
+        "no checkpoint to rescale from"
+    import shutil
+
+    shutil.copytree(ck, tmp_path / "ck-same")
+    same_args = args(n_from)
+    same_args[same_args.index(str(ck))] = str(tmp_path / "ck-same")
+
+    # Resume at N (reference) and at M (rescaled) from the same kill.
+    same = _run_cli(same_args)
+    rescaled = _run_cli(args(n_to))
+    assert same.stdout, "resumed run emitted nothing"
+    assert "restored checkpoint" in rescaled.stderr
+    assert rescaled.stdout == same.stdout
+    _assert_all_fired(tmp_path, 1)
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_chaos_spill_enabled_stdout_identical_to_off(tmp_path, depth):
+    """Tiered-state transparency through the real CLI: a spill-enabled
+    sparse run's total stdout is bit-identical to spill-off on the same
+    stream (spill/promote is exact movement, tie order included), at
+    pipeline depths 0 and 2."""
+    f = tmp_path / "in.csv"
+    write_stream(f, n=450)
+    base = ["-i", str(f), "-ws", "40", "-ic", "8", "-uc", "5",
+            "-s", "0xC0FFEE", "--backend", "sparse",
+            "--pipeline-depth", str(depth)]
+    off = _run_cli(base)
+    on = _run_cli(base + ["--spill-threshold-windows", "2",
+                          "--spill-target-hbm-frac", "0.0"])
+    assert off.stdout, "spill-off run emitted nothing"
+    assert on.stdout == off.stdout
+    assert "tiered state armed" in on.stderr
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("depth", [0, 2])
 def test_chaos_soak_multi_site_parity(tmp_path, depth):
@@ -175,7 +255,9 @@ def test_chaos_soak_multi_site_parity(tmp_path, depth):
             "-s", "0xC0FFEE", "--backend", "oracle",
             "--pipeline-depth", str(depth),
             "--checkpoint-every-windows", "3",
-            "--checkpoint-retain", "4"]
+            # Wide enough that the torn generation's *.corrupt survives
+            # the PR-9 aged-quarantine sweep until the final assertion.
+            "--checkpoint-retain", "12"]
     clean = _clean_run(tmp_path, base)
     faults = [
         "source_read:crash",                    # before any progress

@@ -789,3 +789,96 @@ def test_serving_route_flags_vanished_table(tmp_path):
                       baseline=[]).run()
     assert [f.rule for f in result.findings] == ["serving-route"]
     assert "ROUTE_METRICS" in result.findings[0].message
+
+
+# -- rule pack 9: state-store registry ----------------------------------
+
+
+def _mini_store_repo(tmp_path, *, test_body, arch_body):
+    """A minimal repo for the state-store-registry rule: the base class
+    plus one direct subclass and one transitive subclass."""
+    root = tmp_path / "repo"
+    state = root / "tpu_cooccurrence" / "state"
+    state.mkdir(parents=True)
+    (state / "store.py").write_text(
+        "class StateStore:\n"
+        "    def checkpoint_state(self):\n"
+        "        raise NotImplementedError\n\n\n"
+        "class MyDirectStore(StateStore):\n"
+        "    pass\n\n\n"
+        "class MyTieredStore(MyDirectStore):\n"
+        "    pass\n")
+    (root / "tests").mkdir()
+    (root / "tests" / "test_store_fixture.py").write_text(test_body)
+    (root / "docs").mkdir()
+    (root / "docs" / "ARCHITECTURE.md").write_text(arch_body)
+    return root
+
+
+def test_state_store_registry_clean_fixture_passes(tmp_path):
+    """Both stores referenced from tests/ (the transitive subclass
+    counts as an implementation too) and in the ARCHITECTURE table."""
+    root = _mini_store_repo(
+        tmp_path,
+        test_body=("def test_round_trip():\n"
+                   "    assert MyDirectStore and MyTieredStore\n"),
+        arch_body=("| `MyDirectStore` | direct |\n"
+                   "| `MyTieredStore` | tiered |\n"))
+    result = Analyzer(str(root), rules=[RULES["state-store-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_state_store_registry_flags_untested_store(tmp_path):
+    root = _mini_store_repo(
+        tmp_path,
+        test_body="def test_round_trip():\n    assert MyDirectStore\n",
+        arch_body=("| `MyDirectStore` | direct |\n"
+                   "| `MyTieredStore` | tiered |\n"))
+    result = Analyzer(str(root), rules=[RULES["state-store-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["state-store-registry"]
+    assert "MyTieredStore" in result.findings[0].message
+    assert "round-trip" in result.findings[0].message
+
+
+def test_state_store_registry_flags_missing_arch_row(tmp_path):
+    root = _mini_store_repo(
+        tmp_path,
+        test_body=("def test_round_trip():\n"
+                   "    assert MyDirectStore and MyTieredStore\n"),
+        arch_body="# arch\n\nno state-store table here\n")
+    result = Analyzer(str(root), rules=[RULES["state-store-registry"]],
+                      baseline=[]).run()
+    assert sorted(f.rule for f in result.findings) == [
+        "state-store-registry", "state-store-registry"]
+    assert all("state-store table" in f.message for f in result.findings)
+
+
+def test_state_store_registry_flags_vanished_arch_doc(tmp_path):
+    """A missing docs/ARCHITECTURE.md is a finding in its own right,
+    not a silent waiver of the doc requirement for every store (same
+    posture as the serving rule's vanished ROUTE_METRICS table)."""
+    root = _mini_store_repo(
+        tmp_path,
+        test_body=("def test_round_trip():\n"
+                   "    assert MyDirectStore and MyTieredStore\n"),
+        arch_body="x\n")
+    os.remove(root / "docs" / "ARCHITECTURE.md")
+    result = Analyzer(str(root), rules=[RULES["state-store-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["state-store-registry"]
+    assert "ARCHITECTURE.md not found" in result.findings[0].message
+
+
+def test_state_store_registry_flags_empty_registry(tmp_path):
+    """state/store.py with every implementation gone = the registry this
+    rule guards no longer exists; that is a finding, not silence."""
+    root = _mini_store_repo(
+        tmp_path, test_body="x = 1\n", arch_body="# arch\n")
+    (root / "tpu_cooccurrence" / "state" / "store.py").write_text(
+        "class StateStore:\n    pass\n")
+    result = Analyzer(str(root), rules=[RULES["state-store-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["state-store-registry"]
+    assert "registry" in result.findings[0].message

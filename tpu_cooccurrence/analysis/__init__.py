@@ -40,6 +40,10 @@ Layout:
   ``observability/http.py``'s ``ROUTE_METRICS`` needs a
   CANONICAL_METRICS latency metric, a README mention and a tests/
   reference; unregistered route literals are flagged);
+* :mod:`.rules_state` — state-store registry drift (every
+  ``StateStore`` implementation in ``state/store.py`` needs a
+  checkpoint round-trip test reference under ``tests/`` and a row in
+  the ARCHITECTURE state-store table);
 * ``__main__`` — the runner: ``python -m tpu_cooccurrence.analysis``
   exits 1 on non-baseline findings (``--format json|text``).
 
@@ -68,6 +72,7 @@ from . import rules_lock  # noqa: F401,E402
 from . import rules_native  # noqa: F401,E402
 from . import rules_registry  # noqa: F401,E402
 from . import rules_serving  # noqa: F401,E402
+from . import rules_state  # noqa: F401,E402
 from . import rules_wire  # noqa: F401,E402
 
 __all__ = [

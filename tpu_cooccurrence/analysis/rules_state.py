@@ -1,0 +1,116 @@
+"""State-store registry drift (baseline-free).
+
+Every :class:`~tpu_cooccurrence.state.store.StateStore` implementation
+in ``state/store.py`` is a placement policy whose correctness claim is
+"the canonical checkpoint blob round-trips bit-identically through me"
+— a claim only a checkpoint round-trip test can back, and an
+operator-facing contract the ARCHITECTURE "State-store table" names
+with its placement semantics. A store added without both is exactly how
+the elastic-state plane would rot: a policy nothing ever round-trips
+against the canonical blob, documented nowhere an operator looks —
+the silent-restores-garbage failure class the checkpoint digests exist
+to prevent, reintroduced one layer up.
+
+Evidence model mirrors ``pallas-kernel-registry`` / ``wire-codec-
+roundtrip``: AST-only (nothing imported), a class counts as covered
+when its NAME is referenced anywhere under ``tests/`` and appears in
+``docs/ARCHITECTURE.md``. Fixture-tested in ``tests/test_cooclint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from .core import FileContext, Finding, RepoContext, Rule, register
+
+_STORE_PATH = "tpu_cooccurrence/state/store.py"
+_ARCH_PATH = "docs/ARCHITECTURE.md"
+_BASE = "StateStore"
+
+
+def _store_subclasses(tree: ast.Module) -> Dict[str, ast.ClassDef]:
+    """Module-level classes deriving (directly or through another class
+    in the module) from ``StateStore``."""
+    classes = {n.name: n for n in tree.body if isinstance(n, ast.ClassDef)}
+    derived: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, node in classes.items():
+            if name in derived or name == _BASE:
+                continue
+            for b in node.bases:
+                base = (b.id if isinstance(b, ast.Name)
+                        else b.attr if isinstance(b, ast.Attribute)
+                        else None)
+                if base == _BASE or base in derived:
+                    derived.add(name)
+                    changed = True
+    return {name: classes[name] for name in derived}
+
+
+def _test_referenced_names(repo: RepoContext) -> Set[str]:
+    refs: Set[str] = set()
+    for ctx in repo.python_files():
+        if not ctx.path.startswith("tests/") or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name):
+                refs.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                refs.add(node.attr)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    refs.add(alias.name.rsplit(".", 1)[-1])
+    return refs
+
+
+@register
+class StateStoreRegistryRule(Rule):
+    name = "state-store-registry"
+    description = ("every StateStore implementation in state/store.py "
+                   "needs a checkpoint round-trip test reference under "
+                   "tests/ and a row in the ARCHITECTURE state-store "
+                   "table")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        src: Optional[FileContext] = next(
+            (c for c in repo.files if c.path == _STORE_PATH), None)
+        if src is None or src.tree is None:
+            return
+        stores = _store_subclasses(src.tree)
+        if not stores:
+            yield Finding(
+                rule=self.name, file=_STORE_PATH, line=1,
+                message="no StateStore implementations found (the "
+                        "state-store registry this rule guards is gone)")
+            return
+        refs = _test_referenced_names(repo)
+        arch = next((c for c in repo.files if c.path == _ARCH_PATH), None)
+        if arch is None:
+            # A vanished anchor doc must be a finding, not a silent
+            # waiver of the doc requirement for every store (same
+            # posture as the vanished ROUTE_METRICS table in
+            # rules_serving).
+            yield Finding(
+                rule=self.name, file=_STORE_PATH, line=1,
+                message=(f"{_ARCH_PATH} not found — the state-store "
+                         f"table this rule checks implementations "
+                         f"against is gone"))
+        for name, node in sorted(stores.items()):
+            if name not in refs:
+                yield Finding(
+                    rule=self.name, file=_STORE_PATH, line=node.lineno,
+                    message=(f"StateStore implementation {name!r} has no "
+                             f"checkpoint round-trip evidence: nothing "
+                             f"under tests/ references it — a placement "
+                             f"policy nothing round-trips against the "
+                             f"canonical blob is a silent-restore-"
+                             f"garbage risk"))
+            if arch is not None and name not in arch.source:
+                yield Finding(
+                    rule=self.name, file=_STORE_PATH, line=node.lineno,
+                    message=(f"StateStore implementation {name!r} is not "
+                             f"in {_ARCH_PATH} — add it to the "
+                             f"state-store table"))

@@ -95,6 +95,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                  config.degrade_window_wall_s, config.degrade_trip_windows,
                  config.degrade_clear_windows, config.degrade_shed_factor,
                  config.degrade_pause_ms)
+    if config.spill_threshold_windows > 0:
+        # Make the tiering unmissable in the run log: cold rows leave
+        # HBM, so slab-footprint numbers in the same log read
+        # differently from an untiered run (results do not).
+        LOG.info("tiered state armed: rows idle for %d windows spill to "
+                 "the host arena (target HBM frac %.2f); output stays "
+                 "bit-identical to spill-off",
+                 config.spill_threshold_windows,
+                 config.spill_target_hbm_frac)
     if config.pipeline_depth > 0:
         # Make the execution mode unmissable in the run log: with
         # --emit-updates the result stream is produced by the pipeline's

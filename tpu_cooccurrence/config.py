@@ -209,6 +209,17 @@ class Config:
     # saturate — unlike the dense --count-dtype, which wraps like the
     # reference's Java shorts. auto = int16 on the single-process sparse
     # backend, int32 elsewhere.
+    spill_threshold_windows: int = 0  # tiered elastic state
+    # (state/store.TieredSlabStore): rows untouched for this many fired
+    # windows spill from the HBM slab to a host-side packed arena
+    # (index keys really freed, capacity reused by hot rows) and
+    # re-promote exactly on next touch, batched into the window's
+    # existing uplink. 0 = tiering off (every row device-resident for
+    # the whole run). Bit-identical output and checkpoints either way.
+    spill_target_hbm_frac: float = 0.5  # spilling engages only while
+    # live slab cells exceed this fraction of the allocated device slab
+    # capacity (0.0 = spill every eligible cold row unconditionally;
+    # 1.0 = only under a full slab)
     wire_format: str = "auto"  # sparse per-window uplink encoding:
     # auto|raw|packed. packed = per-section sorted delta + zigzag +
     # bit-pack of the update buffer, decoded on device by a jit prologue
@@ -428,6 +439,24 @@ class Config:
                 "--wire-format packed applies to the single-process "
                 "sparse backend's update uplink (other backends ship "
                 "raw COO or basket formats)")
+        if self.spill_threshold_windows < 0:
+            raise ValueError(
+                f"--spill-threshold-windows must be >= 0, got "
+                f"{self.spill_threshold_windows}")
+        if not (0.0 <= self.spill_target_hbm_frac <= 1.0):
+            raise ValueError(
+                f"--spill-target-hbm-frac must be in [0, 1], got "
+                f"{self.spill_target_hbm_frac}")
+        if self.spill_threshold_windows > 0 and not sparse_single:
+            # Same single-process-sparse scoping rule as --cell-dtype:
+            # the spill arena and promotion extras are per-process slab
+            # state (the sharded backend's elastic axis is
+            # rescale-on-restore instead).
+            raise ValueError(
+                "--spill-threshold-windows is single-process --backend "
+                "sparse only (the spill arena is per-process slab "
+                "state; sharded runs rescale via --num-shards at "
+                "restore instead)")
         if self.fused_window not in ("auto", "on", "off"):
             raise ValueError(
                 f"--fused-window must be auto|on|off, got "
@@ -591,6 +620,19 @@ class Config:
                             "counts: rows promote to a wide int32 "
                             "side-table before saturation (auto: int16 "
                             "on the single-process sparse backend)")
+        p.add_argument("--spill-threshold-windows", type=int, default=0,
+                       dest="spill_threshold_windows",
+                       help="Tiered elastic state (sparse backend): "
+                            "spill rows untouched for this many windows "
+                            "from the HBM slab to a host-side arena, "
+                            "re-promoting exactly on touch (0 = off; "
+                            "output and checkpoints stay bit-identical)")
+        p.add_argument("--spill-target-hbm-frac", type=float, default=0.5,
+                       dest="spill_target_hbm_frac",
+                       help="Spill cold rows only while live slab cells "
+                            "exceed this fraction of the allocated "
+                            "device slab capacity (0.0 = spill every "
+                            "eligible row; default: 0.5)")
         p.add_argument("--wire-format", choices=["auto", "raw", "packed"],
                        default="auto", dest="wire_format",
                        help="Sparse per-window uplink + checkpoint blob "

@@ -341,7 +341,9 @@ class CooccurrenceJob:
                 cell_dtype=resolve_cell_dtype(
                     self.config.cell_dtype, sparse_single_device=True),
                 wire_format=resolve_wire_format(
-                    self.config.wire_format, sparse_single_device=True)))
+                    self.config.wire_format, sparse_single_device=True),
+                spill_threshold_windows=self.config.spill_threshold_windows,
+                spill_target_hbm_frac=self.config.spill_target_hbm_frac))
         if backend == Backend.SHARDED:
             from .parallel.distributed import maybe_multihost_mesh
 

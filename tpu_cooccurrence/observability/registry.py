@@ -109,6 +109,18 @@ CANONICAL_METRICS = frozenset({
     "cooc_host_index_rss_bytes",
     "cooc_slab_device_bytes",
     "cooc_slab_live_cells",
+    # per-shard breakdown of the two series above (sharded-sparse,
+    # parallel/sharded_sparse.py): emitted as <name><shard-id> — the
+    # entries here are the f-string prefixes the emission sites use
+    "cooc_host_index_rss_bytes_shard",
+    "cooc_slab_live_cells_shard",
+    # tiered elastic state (state/store.TieredSlabStore): spill/promote
+    # counters and the host arena footprint, refreshed per window
+    "cooc_spill_evictions_total",
+    "cooc_spill_promotions_total",
+    "cooc_spill_resident_rows",
+    "cooc_spill_arena_bytes",
+    "cooc_spill_row_touches_total",
     # serving plane (serving/, observability/http.py): per-route request
     # latency histograms plus snapshot double-buffer state
     "cooc_query_seconds",

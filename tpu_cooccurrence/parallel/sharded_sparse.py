@@ -155,6 +155,13 @@ class ShardedSparseScorer:
         self.row_sums = put_global(
             np.zeros((self.items_cap,), np.int32), self.mesh, P())
         self._build_update()
+        # Elastic-state interface (state/store.py): single-process
+        # checkpoints are global-key-space blobs, so restore re-buckets
+        # onto THIS run's shard count — a checkpoint taken at
+        # --num-shards N restores onto M (Flink savepoint semantics).
+        from ..state.store import ShardedRescaleStore
+
+        self.store = ShardedRescaleStore(self)
 
     # -- mesh kernels -----------------------------------------------------
 
@@ -527,9 +534,49 @@ class ShardedSparseScorer:
         self.last_dispatched_rows = len(rows)
         _record_shard_metrics(len(rows), owner_counts)
         chunks = self._dispatch_scoring(rows, row_owner)
+        self._record_state_gauges()
         prev, self._pending = self._pending, chunks
         return (self._materialize(prev) if prev is not None
                 else TopKBatch.empty(self.top_k))
+
+    def _record_state_gauges(self) -> None:
+        """Per-window state-footprint gauges, per shard AND summed.
+
+        The summed series reuse the single-process sparse backend's
+        canonical names (``cooc_host_index_rss_bytes`` /
+        ``cooc_slab_live_cells`` / ``cooc_slab_device_bytes``) so
+        dashboards read one process-level number regardless of backend;
+        the per-shard breakdown rides suffixed series
+        (``cooc_host_index_rss_bytes_shard*``) for imbalance debugging.
+        """
+        from ..observability.registry import REGISTRY
+
+        rss_total = 0
+        cells_total = 0
+        for d, ix in enumerate(self.indexes):
+            rss = ix.nbytes
+            cells = len(ix)
+            REGISTRY.gauge(
+                f"cooc_host_index_rss_bytes_shard{d}",
+                help="host-side slab index footprint of one shard"
+            ).set(rss)
+            REGISTRY.gauge(
+                f"cooc_slab_live_cells_shard{d}",
+                help="live matrix cells of one shard's slab").set(cells)
+            rss_total += rss
+            cells_total += cells
+        REGISTRY.gauge(
+            "cooc_host_index_rss_bytes",
+            help="host-side slab index footprint (registry + cell "
+                 "index), refreshed per window").set(rss_total)
+        REGISTRY.gauge(
+            "cooc_slab_live_cells",
+            help="live matrix cells across narrow and wide slabs"
+        ).set(cells_total)
+        REGISTRY.gauge(
+            "cooc_slab_device_bytes",
+            help="device slab allocation (cnt + dst, narrow and wide)"
+        ).set(self.cnt.nbytes + self.dst.nbytes)
 
     def _dispatch_scoring(self, rows: np.ndarray,
                           row_owner: np.ndarray) -> List[Tuple]:
@@ -746,6 +793,16 @@ class ShardedSparseScorer:
             local_key & 0xFFFFFFFF)
 
     def checkpoint_state(self) -> dict:
+        """Canonical snapshot via the state store (state/store.py) —
+        single-process blobs are global-key-space, shard-count-free."""
+        return self.store.checkpoint_state()
+
+    def restore_state(self, st: dict) -> None:
+        """Restore via the state store: re-buckets a global blob onto
+        THIS run's shard count (N->M rescale-on-restore)."""
+        self.store.restore_state(st)
+
+    def _device_checkpoint_state(self) -> dict:
         local = self._local_slabs()
         if jax.process_count() > 1:
             # Per-process snapshot. The *index* (cell keys, placement) is
@@ -794,7 +851,9 @@ class ShardedSparseScorer:
             "observed": np.asarray([self.observed], dtype=np.int64),
         }
 
-    def restore_state(self, st: dict) -> None:
+    def _device_restore_state(self, st: dict) -> None:
+        from ..state.store import rebucket_cells
+
         if "mh_rows_key" in st:
             return self._restore_multihost(st)
         D = self.n_shards
@@ -808,14 +867,14 @@ class ShardedSparseScorer:
             self.row_sums_host = np.zeros(new_cap, dtype=np.int64)
             self.items_cap = new_cap
             self._build_update()
-        owner = (src % D).astype(np.int64)
+        # Rescale-on-restore: re-bucket the global key space onto THIS
+        # run's shard count — the checkpoint's --num-shards does not
+        # constrain the restoring mesh (state/store.rebucket_cells).
         need = 0
         per_shard = []
-        for d in range(D):
-            sel = owner == d
-            lk = self._local_key(src[sel], dst[sel])
+        for d, (lk, cv, dv) in enumerate(rebucket_cells(key, cnt_vals, D)):
             slots = self.indexes[d].rebuild_from_keys(lk)
-            per_shard.append((slots, cnt_vals[sel], dst[sel]))
+            per_shard.append((slots, cv, dv))
             need = max(need, self.indexes[d].heap_end)
         while self.capacity < need:
             self.capacity *= 2
@@ -868,14 +927,12 @@ class ShardedSparseScorer:
             self.row_sums_host = np.zeros(new_cap, dtype=np.int64)
             self.items_cap = new_cap
             self._build_update()
-        owner = (src % D).astype(np.int64)
+        from ..state.store import rebucket_cells
+
         need = 0
         slots_by_shard = {}
-        for d in range(D):
-            sel = owner == d
-            lk = self._local_key(src[sel], dst[sel])
-            slots_by_shard[d] = (self.indexes[d].rebuild_from_keys(lk),
-                                 dst[sel])
+        for d, (lk, _cv, dv) in enumerate(rebucket_cells(key, None, D)):
+            slots_by_shard[d] = (self.indexes[d].rebuild_from_keys(lk), dv)
             need = max(need, self.indexes[d].heap_end)
         while self.capacity < need:
             self.capacity *= 2

@@ -220,6 +220,33 @@ def step_back(directory: str, suffix: str = "") -> "int | None":
     return gen
 
 
+def _sweep_aged_quarantine(directory: str, suffix: str,
+                           oldest_kept: int) -> None:
+    """Delete ``*.corrupt`` quarantine files whose generation has aged
+    out of the retain window (generation < ``oldest_kept``). The legacy
+    un-numbered ``state<suffix>.npz.corrupt`` counts as generation 0.
+    Called by :func:`save` alongside generation retention so the two
+    windows can never drift apart."""
+    pat = re.compile(
+        rf"^state{re.escape(suffix)}\.(\d+)\.npz\.corrupt$")
+    legacy = os.path.basename(_legacy_path(directory, suffix)) + ".corrupt"
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for name in names:
+        m = pat.match(name)
+        gen = int(m.group(1)) if m else (0 if name == legacy else None)
+        if gen is None or gen >= oldest_kept:
+            continue
+        try:
+            os.remove(os.path.join(directory, name))
+            LOG.info("aged out quarantined checkpoint %s (retain window "
+                     "starts at generation %d)", name, oldest_kept)
+        except OSError:
+            continue
+
+
 def _sweep_orphan_tmps(directory: str) -> None:
     """Delete ``*.tmp`` snapshots abandoned by a crash between
     ``mkstemp`` and ``os.replace``. Age-gated: a fresh tmp may be a
@@ -387,11 +414,20 @@ def save(job, directory: str, source=None) -> str:
     # Retention: keep the newest N generations (quarantined/rolled-back
     # files keep their renamed forms and are not counted).
     retain = max(1, getattr(job.config, "checkpoint_retain", 3))
-    for _old_gen, old_path in generations(directory, suffix)[retain:]:
+    survivors = generations(directory, suffix)
+    for _old_gen, old_path in survivors[retain:]:
         try:
             os.remove(old_path)
         except OSError:
             pass
+    # Quarantined *.corrupt files beyond the retain window age out too:
+    # they exist for operator forensics on RECENT generations, and
+    # without a sweep a long-running crashy job accumulates them
+    # forever. A corrupt generation still inside the window is kept —
+    # its forensics are still current.
+    _sweep_aged_quarantine(directory, suffix,
+                           oldest_kept=(survivors[: retain][-1][0]
+                                        if survivors else 0))
     REGISTRY.gauge(
         GENERATION_GAUGE,
         help="checkpoint generation last written or restored").set(gen)

@@ -872,6 +872,67 @@ class SlabIndex:
         # row vs live cap C), so a 1/2 threshold would never fire.
         return self.garbage * 3 > self.heap_end and self.heap_end > min_heap
 
+    def _adopt_alloc(self, rows: np.ndarray, lens: np.ndarray) -> np.ndarray:
+        """Allocate fresh contiguous regions for currently-absent ``rows``
+        (sorted unique) and register them; returns the cell slots in the
+        caller's per-row cell order. Shared by both index layouts'
+        :meth:`adopt_rows`."""
+        rows = np.asarray(rows, dtype=np.int64)
+        lens32 = np.asarray(lens, dtype=np.int32)
+        self.ensure_rows(int(rows.max()))
+        caps = _pow2ceil(lens32, minimum=4)
+        new_end = self.heap_end + int(caps.astype(np.int64).sum())
+        if new_end >= 2**31:
+            raise SlabCapacityError(
+                f"slab heap growth to {new_end} cells crosses the int32 "
+                f"slot space (2^31); shard the stream (--num-shards) "
+                f"instead")
+        starts = (self.heap_end
+                  + np.concatenate([[0], np.cumsum(caps)[:-1]])
+                  ).astype(np.int32)
+        self.heap_end = new_end
+        self.rows.update(rows, start=starts, length=lens32, cap=caps)
+        return (np.repeat(starts, lens32)
+                + _ragged_arange(lens32)).astype(np.int32)
+
+    def adopt_rows(self, rows: np.ndarray, keys: np.ndarray,
+                   lens: np.ndarray) -> np.ndarray:
+        """Re-insert absent rows' cells with their given per-row order
+        PRESERVED (``keys`` concatenated per row in within-row slab
+        order, ``lens`` per row). The tiered store's promotion path: the
+        re-promoted row must reproduce its pre-spill slab layout because
+        top-K tie-breaking among equal scores is slot-ordered — a
+        key-ordered re-insert (what :meth:`apply` would do) could flip
+        ties against the spill-off run. Returns the slots, keys-aligned
+        — valid until the next :meth:`apply` (which may relocate an
+        adopted row that outgrows its capacity; re-resolve through
+        :meth:`lookup` afterwards).
+        """
+        slots = self._adopt_alloc(rows, lens)
+        if len(keys):
+            order = np.argsort(keys, kind="stable")
+            sk = keys[order]
+            ss = slots[order]
+            pos = np.searchsorted(self.g_key, sk)
+            self.g_key, self.g_slot = merge_sorted_insert(
+                self.g_key, self.g_slot, pos, sk, ss)
+        return slots
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Current slots of keys KNOWN to be present. The promotion
+        path resolves its cells' slots through this AFTER the window's
+        :meth:`apply` — apply may have relocated an adopted row (a new
+        cell outgrowing the fresh capacity), and a slot captured at
+        adopt time would then point into the freed region."""
+        pos = np.searchsorted(self.g_key, keys)
+        if len(keys):
+            safe = np.minimum(pos, max(len(self.g_key) - 1, 0))
+            if (len(self.g_key) == 0 or (pos >= len(self.g_key)).any()
+                    or not np.array_equal(self.g_key[safe], keys)):
+                raise KeyError("lookup of absent cell keys — promotion "
+                               "contract violated")
+        return self.g_slot[pos].astype(np.int32)
+
     def row_cells(self, rows: np.ndarray):
         """Live cells of ``rows`` as ``(keys, slots)``, rows concatenated
         in order (keys sorted within each row — the sorted layout's
@@ -1162,6 +1223,39 @@ class HashSlabIndex(SlabIndex):
         idx = np.repeat(starts, lens) + _ragged_arange(lens)
         return self.slot_key[idx].copy(), idx.astype(np.int32)
 
+    def adopt_rows(self, rows: np.ndarray, keys: np.ndarray,
+                   lens: np.ndarray) -> np.ndarray:
+        """Hash-layout override: same preserved-order contract as the
+        sorted base (see its docstring); the table and reverse map take
+        the place of the sorted merge."""
+        slots = self._adopt_alloc(rows, lens)
+        if not len(keys):
+            return slots
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        self._ensure_slot_key(self.heap_end)
+        self.slot_key[slots] = keys
+        self._grow_table(self._n + len(keys))
+        slots_c = np.ascontiguousarray(slots)
+        self._check_probe(self._lib.slab_hash_insert(
+            self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
+            self._p64(keys), self._p32(slots_c), len(keys)))
+        self._n += len(keys)
+        return slots
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Hash-layout override of the present-keys slot resolve."""
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        slots = np.empty(len(keys), dtype=np.int32)
+        missing = np.empty(len(keys), dtype=np.uint8)
+        self._check_probe(self._lib.slab_hash_lookup(
+            self._p64(self._tkeys), self._p32(self._tvals), self._cap - 1,
+            self._p64(keys), len(keys), self._p32(slots),
+            self._p8(missing)))
+        if missing.view(bool).any():
+            raise KeyError("lookup of absent cell keys — promotion "
+                           "contract violated")
+        return slots
+
     def free_rows(self, rows: np.ndarray) -> None:
         """Hash-layout override: the open-addressing table has no
         tombstones, so deletion rebuilds it minus the dead keys —
@@ -1229,7 +1323,9 @@ class SparseDeviceScorer:
                  fixed_shapes: Optional[bool] = None,
                  use_pallas: str = "auto",
                  cell_dtype: str = "int32",
-                 wire_format: str = "raw") -> None:
+                 wire_format: str = "raw",
+                 spill_threshold_windows: int = 0,
+                 spill_target_hbm_frac: float = 0.5) -> None:
         from ..xla_cache import enable_compilation_cache
         from .wire import CELL_DTYPES, cell_promote_threshold
 
@@ -1324,6 +1420,14 @@ class SparseDeviceScorer:
 
         self.use_pallas = resolve_sparse_pallas_flag(use_pallas)
         self._pallas_interpret = jax.default_backend() != "tpu"
+        # Elastic-state placement policy (state/store.py): tiered
+        # cold-row spill when --spill-threshold-windows is set, direct
+        # (everything device-resident) otherwise. The store owns the
+        # checkpoint-blob round trip either way.
+        from .store import make_store
+
+        self.store = make_store(self, spill_threshold_windows,
+                                spill_target_hbm_frac)
 
     def _rect_pallas(self, R: int) -> bool:
         """Whether bucket width ``R`` routes through the fused kernel
@@ -1399,6 +1503,11 @@ class SparseDeviceScorer:
                 return TopKBatch.empty(self.top_k)
             # No new dispatch — drain any completed in-flight results now.
             return self.flush()
+        # Tiered-state spill step (state/store.py; no-op for the direct
+        # store): advance the window clock and move rows that went cold
+        # to the host arena, BEFORE any index op — the freed regions
+        # become garbage the compaction below can reclaim this window.
+        self.store.tick()
         # Reclaim freed slab regions once they dominate the heap. Runs
         # between windows only: mid-window the move/update instructions
         # already carry concrete slab addresses.
@@ -1444,6 +1553,12 @@ class SparseDeviceScorer:
         self.observed += window_sum
         self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
 
+        # Spill-tier re-promotion FIRST (before the narrow->wide check
+        # and before any delta applies): touched rows resident in the
+        # host arena re-enter the slab index with their within-row order
+        # preserved; their cell values ride this window's update upload
+        # as extra new-cell + delta entries — no extra dispatch.
+        promo_n, promo_w = self.store.promote_touched(rows)
         # Narrow-cell promotion, then the per-slab split: a cell routes by
         # its row's residency, decided BEFORE this window's deltas apply.
         if self.index_w is not None:
@@ -1451,13 +1566,16 @@ class SparseDeviceScorer:
             cell_wide = self.wide_rows[src_d]
         else:
             cell_wide = None
-        if cell_wide is not None and cell_wide.any():
+        if cell_wide is not None and (cell_wide.any()
+                                      or promo_w is not None):
             self._window_update(d_key[~cell_wide], d_val32[~cell_wide],
-                                rows, rs_delta, wide=False)
+                                rows, rs_delta, wide=False, promo=promo_n)
             self._window_update(d_key[cell_wide], d_val32[cell_wide],
-                                rows[:0], rs_delta[:0], wide=True)
+                                rows[:0], rs_delta[:0], wide=True,
+                                promo=promo_w)
         else:
-            self._window_update(d_key, d_val32, rows, rs_delta, wide=False)
+            self._window_update(d_key, d_val32, rows, rs_delta,
+                                wide=False, promo=promo_n)
 
         if self.development_mode:
             self._check_row_sums(rows)
@@ -1508,10 +1626,20 @@ class SparseDeviceScorer:
 
     def _window_update(self, d_key: np.ndarray, d_val32: np.ndarray,
                        rows: np.ndarray, rs_delta: np.ndarray,
-                       wide: bool = False) -> None:
+                       wide: bool = False, promo=None) -> None:
         """Allocate slots and dispatch one slab's window update. The
         narrow dispatch also carries the shared row-sum section (row
-        sums are slab-independent); the wide dispatch's is empty."""
+        sums are slab-independent); the wide dispatch's is empty.
+
+        ``promo`` — tiered-store re-promotion extras ``(cell_keys,
+        dst_vals, cnt_vals)``: each promoted cell rides the SAME upload
+        as one new-cell entry (sets its partner id, zeroes the slot)
+        plus one delta entry (adds its spilled count back) — exact
+        movement with no extra dispatch. Slots are resolved AFTER
+        ``apply`` (a promoted row gaining a new cell this window may be
+        relocated by it); they are disjoint from apply's new-cell slots,
+        and a promoted slot also receiving a window delta is fine: the
+        delta section's scatter-adds commute."""
         index = self.index_w if wide else self.index
         plan = index.apply(d_key)
         if wide:
@@ -1523,18 +1651,30 @@ class SparseDeviceScorer:
         self.live_cells += plan.n_new
 
         # One packed update upload: new cells | deltas | row sums.
-        n_new = plan.n_new
-        n_d, n_rs = len(d_key), len(rows)
+        if promo is not None:
+            p_keys, p_dst, p_vals = promo
+            p_slots = index.lookup(p_keys)
+        else:
+            p_slots = p_dst = p_vals = np.zeros(0, dtype=np.int32)
+        n_pn = plan.n_new
+        n_promo = len(p_slots)
+        n_new = n_pn + n_promo
+        n_d, n_rs = len(d_key) + n_promo, len(rows)
         n = n_new + n_d + n_rs
         n_pad = pad_pow4(n, minimum=1 << 12)
         upd = np.full((2, n_pad), _SENT, dtype=np.int32)
         upd[1] = 0
-        if n_new:
-            upd[0, :n_new] = plan.slots[plan.new_sel]
-            upd[1, :n_new] = (d_key[plan.new_sel]
-                              & 0xFFFFFFFF).astype(np.int32)
-        upd[0, n_new: n_new + n_d] = plan.slots
-        upd[1, n_new: n_new + n_d] = d_val32
+        if n_pn:
+            upd[0, :n_pn] = plan.slots[plan.new_sel]
+            upd[1, :n_pn] = (d_key[plan.new_sel]
+                             & 0xFFFFFFFF).astype(np.int32)
+        if n_promo:
+            upd[0, n_pn: n_new] = p_slots
+            upd[1, n_pn: n_new] = p_dst
+            upd[0, n_new: n_new + n_promo] = p_slots
+            upd[1, n_new: n_new + n_promo] = p_vals
+        upd[0, n_new + n_promo: n_new + n_d] = plan.slots
+        upd[1, n_new + n_promo: n_new + n_d] = d_val32
         upd[0, n_new + n_d: n] = rows
         upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
         bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
@@ -1617,6 +1757,7 @@ class SparseDeviceScorer:
             "cooc_slab_live_cells",
             help="live matrix cells across narrow and wide slabs"
         ).set(self.live_cells)
+        self.store.record_gauges()
 
     def _dispatch_scoring(self, rows: np.ndarray,
                           wide: bool = False) -> List[Tuple]:
@@ -1779,10 +1920,21 @@ class SparseDeviceScorer:
     # -- checkpoint -------------------------------------------------------
 
     def checkpoint_state(self) -> dict:
-        """Canonical sparse-matrix snapshot — same keys as the hybrid
-        backend, so checkpoints are interchangeable between the two (and
-        between cell dtypes: narrow/wide residency is an in-memory
-        layout, not a checkpoint concern)."""
+        """Canonical snapshot via the state store (state/store.py): the
+        tiered store merges spilled arena cells back into the blob, the
+        direct store passes through — either way the format is the
+        canonical one and files are interchangeable across stores."""
+        return self.store.checkpoint_state()
+
+    def restore_state(self, st: dict) -> None:
+        self.store.restore_state(st)
+
+    def _device_checkpoint_state(self) -> dict:
+        """Canonical sparse-matrix snapshot of the DEVICE-resident rows —
+        same keys as the hybrid backend, so checkpoints are
+        interchangeable between the two (and between cell dtypes:
+        narrow/wide residency is an in-memory layout, not a checkpoint
+        concern)."""
         keys, slots = self.index.keys_and_slots()
         if self.index_w is not None:
             # free_rows deletes promoted rows' narrow entries; the mask
@@ -1819,7 +1971,7 @@ class SparseDeviceScorer:
             "observed": np.asarray([self.observed], dtype=np.int64),
         }
 
-    def restore_state(self, st: dict) -> None:
+    def _device_restore_state(self, st: dict) -> None:
         from .wire import checked_narrow
 
         key = st["rows_key"]
