@@ -11,8 +11,21 @@ use, so doing this in conftest (before any test touches jax) is safe.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent XLA compilation cache, shared by the pytest process AND
+# every subprocess a test spawns (supervisor children, gang workers,
+# CLI chaos runs all re-jit the same small programs). Set via env vars
+# rather than jax.config.update so children inherit it; setdefault so
+# an operator's own cache dir wins. The zero thresholds matter on CPU:
+# this suite's programs are tiny and would otherwise all fall under the
+# default min-compile-time cutoff.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "tpu-cooc-xla-cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 # JAX_PLATFORMS=cpu alone is NOT enough to keep jax off the network:
 # the sitecustomize-registered accelerator plugin still contacts its
 # pool at import, and a half-dead tunnel (TCP accepts, never answers)

@@ -35,6 +35,11 @@ def main() -> None:
         else 1,
         checkpoint_dir=spec.get("checkpoint_dir"),
         partition_sampling=spec.get("partition_sampling", False),
+        # Gang-robustness knobs (ISSUE 10): pipelined multi-host
+        # execution and lockstep degradation are exercisable here too.
+        pipeline_depth=spec.get("pipeline_depth", 0),
+        degrade=spec.get("degrade", False),
+        journal=spec.get("journal"),
         coordinator=spec["coordinator"],
         num_processes=spec["num_processes"],
         process_id=spec["process_id"])

@@ -289,3 +289,23 @@ def test_chaos_soak_multi_site_parity(tmp_path, depth):
         logical = (r["ts"], r["events"], r["pairs"])
         assert by_seq.setdefault(r["seq"], logical) == logical
     assert max(by_seq) == len(by_seq), "window ordinals must be gapless"
+
+
+def test_chaos_ckpt_commit_crash_in_torn_pointer_window(tmp_path):
+    """ISSUE-10 durability satellite: crash INSIDE the torn-pointer
+    window — generation file renamed into place but the directory
+    entry not yet fsynced (the ckpt_commit site sits exactly between
+    the rename and the directory fsync). The supervised restart must
+    restore and converge to bit-identical output; the site's seq is
+    the GENERATION number, so the spec pins the generation-2 commit."""
+    f = tmp_path / "in.csv"
+    write_stream(f, n=600)
+    base = ["-i", str(f), "-ws", "40", "-ic", "8", "-uc", "5",
+            "-s", "0xD1CE", "--backend", "oracle",
+            "--checkpoint-every-windows", "3"]
+    clean = _clean_run(tmp_path, base)
+    rc, out = _supervised_run(
+        tmp_path, base, ["ckpt_commit:2:crash"], attempts=2)
+    assert rc == 0
+    assert out == clean
+    _assert_all_fired(tmp_path, 1)

@@ -454,3 +454,115 @@ def test_legacy_unnumbered_checkpoint_still_restores(tmp_path):
     b = CooccurrenceJob(make_cfg(tmp_path))
     b.restore()
     assert b.windows_fired == a.windows_fired
+
+
+# -- epoch-commit plane (multi-host gang contract, ISSUE 10) -----------
+
+
+def _fake_gen(d, suffix, gen, marker):
+    import os
+
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"state{suffix}.{gen}.npz"), "wb") as f:
+        f.write(b"x")
+    if marker:
+        open(os.path.join(d, f"EPOCH{suffix}.{gen}"), "w").close()
+
+
+def test_epoch_markers_and_committed_generations(tmp_path):
+    from tpu_cooccurrence.state import checkpoint as ckpt
+
+    d = str(tmp_path / "ck")
+    _fake_gen(d, ".p0", 1, marker=True)
+    _fake_gen(d, ".p0", 2, marker=True)
+    _fake_gen(d, ".p0", 3, marker=False)  # crashed pre-commit
+    _fake_gen(d, ".p1", 1, marker=True)   # other suffix: independent
+    assert ckpt.epoch_markers(d, ".p0") == [2, 1]
+    committed = ckpt.committed_generations(d, ".p0")
+    assert [g for g, _ in committed] == [2, 1]
+    assert ckpt.newest_committed(d, ".p0") == 2
+    assert ckpt.newest_committed(d, ".p1") == 1
+    assert ckpt.newest_committed(d, ".p9") == -1
+
+
+def test_committed_generations_legacy_no_markers(tmp_path, caplog):
+    """A pre-epoch directory (generations, zero markers) keeps
+    restoring — with a warning, not a veto."""
+    import logging
+
+    from tpu_cooccurrence.state import checkpoint as ckpt
+
+    d = str(tmp_path / "ck")
+    _fake_gen(d, ".p0", 1, marker=False)
+    _fake_gen(d, ".p0", 2, marker=False)
+    with caplog.at_level(logging.WARNING,
+                         logger="tpu_cooccurrence.checkpoint"):
+        committed = ckpt.committed_generations(d, ".p0")
+    assert [g for g, _ in committed] == [2, 1]
+    assert any("no EPOCH markers" in r.message for r in caplog.records)
+
+
+def test_quarantine_uncommitted_moves_files_and_markers(tmp_path):
+    import os
+
+    from tpu_cooccurrence.state import checkpoint as ckpt
+
+    d = str(tmp_path / "ck")
+    _fake_gen(d, ".p0", 1, marker=True)
+    _fake_gen(d, ".p0", 2, marker=True)   # committed here, not gang-wide
+    _fake_gen(d, ".p0", 3, marker=False)
+    assert ckpt.quarantine_uncommitted(d, ".p0", above_gen=1) == [3, 2]
+    assert sorted(p for p in os.listdir(d) if p.endswith(".partial")) \
+        == ["state.p0.2.npz.partial", "state.p0.3.npz.partial"]
+    # Markers of quarantined generations are dropped too.
+    assert ckpt.epoch_markers(d, ".p0") == [1]
+    # Idempotent: a second vote on the same state moves nothing.
+    assert ckpt.quarantine_uncommitted(d, ".p0", above_gen=1) == []
+
+
+def test_save_writes_no_epoch_markers_single_process(tmp_path):
+    """Single-process saves (empty suffix) write no epoch plane at all:
+    restore semantics are exactly the pre-gang ones."""
+    users, items, ts = random_stream(33, n=200)
+    job = CooccurrenceJob(make_cfg(tmp_path))
+    job.add_batch(users, items, ts)
+    job.checkpoint()
+    assert not [p for p in (tmp_path / "ckpt").iterdir()
+                if p.name.startswith("EPOCH")]
+
+
+def test_partial_quarantine_ages_out_with_retention(tmp_path):
+    """*.partial fallout ages out of the retain window exactly like
+    *.corrupt (the PR-9 sweep, extended)."""
+    users, items, ts = random_stream(34, n=400)
+    cfg = make_cfg(tmp_path, checkpoint_retain=2)
+    job = CooccurrenceJob(cfg)
+    ck = tmp_path / "ckpt"
+    ck.mkdir(exist_ok=True)
+    # A quarantined partial from a long-dead generation.
+    (ck / "state.1.npz.partial").write_bytes(b"x")
+    step = len(users) // 4
+    for i in range(4):
+        job.add_batch(users[i * step:(i + 1) * step],
+                      items[i * step:(i + 1) * step],
+                      ts[i * step:(i + 1) * step])
+        job.checkpoint()
+    # Retention window is generations {3, 4}: the gen-1 partial aged out.
+    assert not (ck / "state.1.npz.partial").exists()
+
+
+def test_ckpt_commit_site_fires_with_generation_seq():
+    """The ckpt_commit chaos site addresses the torn-pointer window by
+    GENERATION (not window ordinal): a spec for generation 2 must not
+    fire at the generation-1 commit."""
+    from tpu_cooccurrence.robustness.faults import FaultPlan
+
+    plan = FaultPlan.parse(["ckpt_commit:2:exception"])
+    plan.fire("ckpt_commit", seq=1)
+    assert not plan.specs[0].fired
+    import pytest as _pytest
+
+    from tpu_cooccurrence.robustness.faults import InjectedFault
+
+    with _pytest.raises(InjectedFault):
+        plan.fire("ckpt_commit", seq=2)

@@ -164,3 +164,69 @@ def test_fixed_score_honored_under_hybrid_alias():
     job = CooccurrenceJob(cfg)
     assert isinstance(job.scorer, SparseDeviceScorer)
     assert job.scorer.fixed_shapes is False
+
+
+# -- gang supervision flags (ISSUE 10) ---------------------------------
+
+
+def test_gang_workers_validation():
+    ok = Config(window_size=10, seed=1, backend=Backend.SHARDED,
+                num_shards=2, gang_workers=2)
+    assert ok.gang_workers == 2
+    with pytest.raises(ValueError, match="gang of one"):
+        Config(window_size=10, seed=1, backend=Backend.SHARDED,
+               gang_workers=1)
+    with pytest.raises(ValueError, match="assigns"):
+        Config(window_size=10, seed=1, backend=Backend.SHARDED,
+               gang_workers=2, coordinator="h:1", num_processes=2,
+               process_id=0)
+    with pytest.raises(ValueError, match="process-continuously"):
+        Config(window_size=10, seed=1, backend=Backend.SHARDED,
+               gang_workers=2, process_continuously=True)
+    with pytest.raises(ValueError, match="serving tier"):
+        Config(window_size=10, seed=1, backend=Backend.SHARDED,
+               gang_workers=2, serve_port=0)
+
+
+def test_gang_workers_needs_multihost_backend():
+    with pytest.raises(ValueError, match="multi-controller"):
+        Config(window_size=10, seed=1, gang_workers=2)  # device backend
+    with pytest.raises(ValueError, match="multi-controller"):
+        Config(window_size=10, seed=1, backend=Backend.SPARSE,
+               gang_workers=2)  # sparse needs num_shards > 1
+    Config(window_size=10, seed=1, backend=Backend.SPARSE, num_shards=4,
+           gang_workers=2)
+
+
+def test_gang_timing_flags_validation():
+    with pytest.raises(ValueError, match="gang-heartbeat-s"):
+        Config(window_size=10, seed=1, gang_heartbeat_s=0)
+    with pytest.raises(ValueError, match="gang-stale-after-s"):
+        Config(window_size=10, seed=1, gang_stale_after_s=-1)
+    with pytest.raises(ValueError, match="collective-timeout-s"):
+        Config(window_size=10, seed=1, collective_timeout_s=-1)
+
+
+def test_gang_workers_with_restart_budget_and_watchdog():
+    # The gang reuses --restart-on-failure as its attempt budget and
+    # may run the journal-staleness watchdog without a single-process
+    # supervisor.
+    Config(window_size=10, seed=1, backend=Backend.SHARDED,
+           num_shards=2, gang_workers=2, restart_on_failure=3,
+           watchdog_stale_after_s=5.0, journal="/tmp/j.jsonl")
+    with pytest.raises(ValueError, match="restart-on-failure"):
+        Config(window_size=10, seed=1, watchdog_stale_after_s=5.0,
+               journal="/tmp/j.jsonl")
+
+
+def test_multihost_pipeline_now_accepted_partition_sampling_not():
+    # ISSUE 10 relaxed the blanket multi-host pipeline rejection: the
+    # scorer worker issues collectives serially in window order. The
+    # partitioned sampler's sampling-thread allgather still conflicts.
+    Config(window_size=10, seed=1, backend=Backend.SHARDED,
+           coordinator="h:1", num_processes=2, process_id=0,
+           pipeline_depth=2)
+    with pytest.raises(ValueError, match="partition-sampling"):
+        Config(window_size=10, seed=1, backend=Backend.SHARDED,
+               coordinator="h:1", num_processes=2, process_id=0,
+               pipeline_depth=2, partition_sampling=True)

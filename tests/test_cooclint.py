@@ -882,3 +882,74 @@ def test_state_store_registry_flags_empty_registry(tmp_path):
                       baseline=[]).run()
     assert [f.rule for f in result.findings] == ["state-store-registry"]
     assert "registry" in result.findings[0].message
+
+
+# -- collective-watchdog / gang-fault-sites (rules_gang) ----------------
+
+
+def test_collective_watchdog_flags_raw_collectives():
+    bad = '''
+from jax.experimental import multihost_utils
+
+def exchange(vec):
+    lens = multihost_utils.process_allgather(vec)
+    multihost_utils.sync_global_devices("x")
+    return lens
+'''
+    findings = analyze_source(
+        bad, path="tpu_cooccurrence/sampling/multihost.py",
+        rules=["collective-watchdog"])
+    assert _rules(findings) == ["collective-watchdog"]
+    assert {f.line for f in findings} == {5, 6}
+
+
+def test_collective_watchdog_flags_bare_imported_call():
+    bad = ('from jax.experimental.multihost_utils import '
+           'process_allgather\n'
+           'def f(v):\n'
+           '    return process_allgather(v)\n')
+    findings = analyze_source(
+        bad, path="tpu_cooccurrence/parallel/sharded.py",
+        rules=["collective-watchdog"])
+    assert _rules(findings) == ["collective-watchdog"]
+
+
+def test_collective_watchdog_allows_wrappers_and_wrapper_module():
+    good = '''
+from tpu_cooccurrence.parallel.distributed import (
+    gang_barrier, guarded_allgather)
+
+def exchange(vec):
+    gang_barrier("x")
+    return guarded_allgather(vec)
+'''
+    assert analyze_source(
+        good, path="tpu_cooccurrence/sampling/multihost.py",
+        rules=["collective-watchdog"]) == []
+    # The wrapper module itself is the one allowed caller.
+    raw = ('from jax.experimental import multihost_utils\n'
+           'def g(a):\n'
+           '    return multihost_utils.process_allgather(a)\n')
+    assert analyze_source(
+        raw, path="tpu_cooccurrence/parallel/distributed.py",
+        rules=["collective-watchdog"]) == []
+
+
+def test_gang_fault_sites_rule_clean_on_repo():
+    result = Analyzer(REPO, rules=[RULES["gang-fault-sites"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_gang_fault_sites_flags_unfired_site(tmp_path):
+    """A faults.py present but no package code firing a GANG_SITES
+    member = a finding (the chaos specs can no longer trigger)."""
+    root = tmp_path / "repo"
+    pkg = root / "tpu_cooccurrence" / "robustness"
+    pkg.mkdir(parents=True)
+    (pkg / "faults.py").write_text("SITES = {}\n")
+    result = Analyzer(str(root), rules=[RULES["gang-fault-sites"]],
+                      baseline=[]).run()
+    # All three gang sites are unplugged in this mini-repo.
+    assert len(result.findings) == 3
+    assert all(f.rule == "gang-fault-sites" for f in result.findings)

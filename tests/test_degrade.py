@@ -490,10 +490,10 @@ def test_breaker_delegates_to_primary_attributes():
     assert b.accepts_aggregated is True
 
 
-def test_degrade_rejected_on_multihost():
-    with pytest.raises(ValueError, match="single-process only"):
-        Config(window_size=10, degrade=True, backend=Backend.SHARDED,
-               coordinator="h:1234", num_processes=2, process_id=0)
+# (test_degrade_rejected_on_multihost was retired by ISSUE 10: the
+# blanket multi-host rejection became the per-window worst-signal
+# allgather — see test_multihost_degrade_config_now_accepted below and
+# the gang chaos lockstep test in test_gang_chaos.py.)
 
 
 def test_breaker_config_validation():
@@ -631,3 +631,135 @@ def test_config_degrade_validation():
         Config(window_size=10, max_quarantine_rate=0.0)
     with pytest.raises(ValueError, match="trip-windows"):
         Config(window_size=10, degrade_trip_windows=0)
+
+
+# -- dead-letter rotation (--max-quarantine-bytes, ISSUE-10 satellite) --
+
+
+def test_quarantine_rotation_caps_active_file(tmp_path):
+    import json as _json
+
+    from tpu_cooccurrence.robustness.quarantine import (
+        QUARANTINE_BACKUPS, Quarantine)
+
+    path = str(tmp_path / "dead.jsonl")
+    q = Quarantine(path, max_rate=1.0, max_bytes=400)
+    q.note_lines(10_000)
+    for i in range(40):
+        q.quarantine("in.csv", i + 1, "x" * 40, "bad line")
+    q.close()
+    assert q.rotations > 0
+    # Active file stays under the cap; numbered backups exist and are
+    # bounded by the keep window.
+    assert os.path.getsize(path) <= 400
+    backups = sorted(p.name for p in tmp_path.iterdir()
+                     if p.name.startswith("dead.jsonl."))
+    assert backups and len(backups) <= QUARANTINE_BACKUPS
+    # Every surviving line is still intact JSONL (rotation never tears
+    # a record), and the run-total counter survived the rotations.
+    kept = 0
+    for p in [path] + [str(tmp_path / b) for b in backups]:
+        with open(p) as f:
+            for line in f:
+                _json.loads(line)
+                kept += 1
+    assert q.quarantined == 40 and kept <= 40
+
+
+def test_quarantine_rotation_shifts_backups_and_drops_oldest(tmp_path):
+    from tpu_cooccurrence.robustness.quarantine import (
+        QUARANTINE_BACKUPS, Quarantine)
+
+    path = str(tmp_path / "dead.jsonl")
+    q = Quarantine(path, max_rate=1.0, max_bytes=150)
+    q.note_lines(100_000)
+    for i in range(60):
+        q.quarantine("in.csv", i + 1, "y" * 30, "bad")
+    q.close()
+    assert q.rotations > QUARANTINE_BACKUPS  # oldest really dropped
+    assert not os.path.exists(f"{path}.{QUARANTINE_BACKUPS + 1}")
+
+
+def test_quarantine_unbounded_without_cap(tmp_path):
+    from tpu_cooccurrence.robustness.quarantine import Quarantine
+
+    path = str(tmp_path / "dead.jsonl")
+    q = Quarantine(path, max_rate=1.0)
+    q.note_lines(10_000)
+    for i in range(50):
+        q.quarantine("in.csv", i + 1, "z" * 40, "bad")
+    q.close()
+    assert q.rotations == 0
+    assert not os.path.exists(path + ".1")
+
+
+def test_max_quarantine_bytes_validation():
+    from tpu_cooccurrence.config import Config
+    from tpu_cooccurrence.robustness.quarantine import Quarantine
+
+    with pytest.raises(ValueError, match="max-quarantine-bytes"):
+        Config(window_size=10, max_quarantine_bytes=-1)
+    with pytest.raises(ValueError, match="max_bytes"):
+        Quarantine("/tmp/x.jsonl", max_bytes=-5)
+
+
+# -- multi-host worst-signal exchange (ISSUE-10 degrade plane) ---------
+
+
+def test_exchange_vote_drives_ladder_from_peer_signal():
+    """A host whose OWN windows are healthy must still escalate when a
+    peer votes overloaded — the exchange returns the gang max."""
+    c = DegradationController(window_wall_s=1.0, trip_windows=2,
+                              clear_windows=2)
+    votes = []
+
+    def exchange(local):
+        votes.append(local)
+        return 1  # a peer is drowning
+
+    c.exchange = exchange
+    for _ in range(2):
+        level, _ = c.observe_window(wall_seconds=0.001)
+    assert level == int(DegradationLevel.SHED_SAMPLING)
+    assert votes == [0, 0]  # this host's local signal stayed healthy
+
+
+def test_exchange_vote_clears_when_gang_healthy():
+    c = DegradationController(window_wall_s=1.0, trip_windows=1,
+                              clear_windows=2)
+    c.exchange = lambda local: local  # single-host gang: identity
+    c.observe_window(wall_seconds=9.0)  # trip
+    assert c.level == DegradationLevel.SHED_SAMPLING
+    c.observe_window(wall_seconds=0.001)
+    level, _ = c.observe_window(wall_seconds=0.001)
+    assert level == int(DegradationLevel.NORMAL)
+
+
+def test_exchange_disables_admission_side_stale_escalation():
+    """Wall-clock staleness is per-host-nondeterministic: with an
+    exchange attached the admit() gate must never move the ladder."""
+    c = DegradationController(window_wall_s=1.0, trip_windows=3,
+                              stale_after_s=0.001)
+    c.exchange = lambda local: local
+    c._started_monotonic -= 100.0  # way past stale
+    c.admit()
+    assert c.level == DegradationLevel.NORMAL
+    # Control: without the exchange the same state escalates.
+    c2 = DegradationController(window_wall_s=1.0, trip_windows=3,
+                               stale_after_s=0.001)
+    c2._started_monotonic -= 100.0
+    c2.admit()
+    assert c2.level == DegradationLevel.SHED_SAMPLING
+
+
+def test_multihost_degrade_config_now_accepted():
+    """The PR-5 blanket rejection is gone: --degrade rides multi-host
+    at depth 0; pipelined multi-host degrade is still rejected (the
+    vote would race the sampling thread)."""
+    from tpu_cooccurrence.config import Config
+
+    Config(window_size=10, degrade=True, coordinator="h:1",
+           num_processes=2, process_id=0)
+    with pytest.raises(ValueError, match="pipeline-depth 0"):
+        Config(window_size=10, degrade=True, coordinator="h:1",
+               num_processes=2, process_id=0, pipeline_depth=1)

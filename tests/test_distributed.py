@@ -73,3 +73,110 @@ def test_restore_clears_pending(scorer_cls):
     scorer.restore_state(snap)
     # rolled-back results must not surface
     assert materialize_dense(scorer.flush()) == []
+
+
+# -- init semantics (ISSUE-10 satellite: previously untested) ----------
+
+
+def test_init_multihost_idempotent_and_probe_does_not_latch(monkeypatch):
+    from tpu_cooccurrence.parallel import distributed
+
+    calls = []
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    # Argument-free probe: a no-op that must NOT latch _initialized —
+    # a later real initialize must still go through.
+    init_multihost()
+    assert calls == [] and not distributed._initialized
+    init_multihost("127.0.0.1:1234", 2, 0)
+    assert len(calls) == 1 and distributed._initialized
+    assert calls[0] == {"coordinator_address": "127.0.0.1:1234",
+                        "num_processes": 2, "process_id": 0}
+    # Idempotent: a second real call is swallowed (the runtime is up).
+    init_multihost("127.0.0.1:1234", 2, 0)
+    assert len(calls) == 1
+
+
+def test_hosts_major_device_ordering():
+    """The mesh ordering contract: all of host 0's chips, then host
+    1's, ... (ties broken by device id) — contiguous row shards stay
+    within a host so the item-axis psum decomposes ICI-first."""
+    from tpu_cooccurrence.parallel.distributed import hosts_major
+
+    class Dev:
+        def __init__(self, process_index, id):
+            self.process_index = process_index
+            self.id = id
+
+    devs = [Dev(1, 0), Dev(0, 3), Dev(1, 2), Dev(0, 1)]
+    ordered = [(d.process_index, d.id) for d in hosts_major(devs)]
+    assert ordered == [(0, 1), (0, 3), (1, 0), (1, 2)]
+
+
+def test_make_multihost_mesh_single_process_keeps_given_order():
+    """Single-process (no multi-controller runtime): the caller's
+    device order is preserved verbatim — hosts-major reordering only
+    engages when process_count > 1."""
+    devs = list(jax.devices())[::-1]
+    mesh = make_multihost_mesh(devs)
+    assert list(mesh.devices.flat) == devs
+
+
+# -- collective-entry watchdog -----------------------------------------
+
+
+def test_collective_watchdog_disarmed_without_env(monkeypatch):
+    from tpu_cooccurrence.parallel import distributed
+
+    monkeypatch.delenv(distributed.COLLECTIVE_TIMEOUT_ENV, raising=False)
+    exits = []
+    monkeypatch.setattr(distributed, "_peer_lost_exit",
+                        lambda *a: exits.append(a))
+    import threading
+
+    before = threading.active_count()
+    with distributed.collective_watchdog("test"):
+        assert threading.active_count() == before  # no timer thread
+    assert exits == []
+
+
+def test_collective_watchdog_fires_on_blocked_entry(monkeypatch):
+    import time
+
+    from tpu_cooccurrence.parallel import distributed
+
+    monkeypatch.setenv(distributed.COLLECTIVE_TIMEOUT_ENV, "0.05")
+    fired = []
+    monkeypatch.setattr(distributed, "_peer_lost_exit",
+                        lambda label, t: fired.append(label))
+    with distributed.collective_watchdog("wedged-collective"):
+        deadline = time.time() + 5.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.01)
+    assert fired == ["wedged-collective"]
+    # A fast collective cancels its timer: no late fire.
+    fired.clear()
+    with distributed.collective_watchdog("fast"):
+        pass
+    time.sleep(0.15)
+    assert fired == []
+
+
+def test_collective_watchdog_fires_barrier_enter_site(monkeypatch):
+    from tpu_cooccurrence.parallel import distributed
+    from tpu_cooccurrence.robustness import faults
+
+    monkeypatch.delenv(distributed.COLLECTIVE_TIMEOUT_ENV, raising=False)
+    # The per-process collective ordinal is process-global state; pin it
+    # so the armed seq-2 spec means "the second entry below".
+    monkeypatch.setattr(distributed, "_collective_seq", 0)
+    faults.arm(["barrier_enter:2:exception"])
+    try:
+        with distributed.collective_watchdog("one"):
+            pass
+        with pytest.raises(faults.InjectedFault):
+            with distributed.collective_watchdog("two"):
+                pass
+    finally:
+        faults.disarm()

@@ -176,3 +176,63 @@ def test_supervised_injection_requires_state_dir():
            inject_fault=["window_fire:3:crash"], fault_state_dir="/tmp/fs")
     Config(input="x", window_size=10, seed=1,
            inject_fault=["window_fire:3:crash"])
+
+
+# -- process-qualified specs (site@proc, the gang chaos grammar) -------
+
+
+def test_parse_process_qualifier():
+    s = FaultSpec.parse("ckpt_commit@1:5:crash", 0)
+    assert (s.site, s.proc, s.window_seq, s.kind) == (
+        "ckpt_commit", 1, 5, "crash")
+    # Unqualified spec: proc stays None (fires in any process).
+    assert FaultSpec.parse("ckpt_commit:5:crash", 0).proc is None
+
+
+@pytest.mark.parametrize("bad", ["ckpt_commit@:5", "ckpt_commit@x:5",
+                                 "ckpt_commit@-1:5"])
+def test_parse_rejects_bad_process_qualifier(bad):
+    with pytest.raises(ValueError, match="process qualifier"):
+        FaultSpec.parse(bad, 0)
+
+
+def test_qualified_spec_fires_only_in_matching_process():
+    plan = FaultPlan.parse(["barrier_enter@1:exception"], process_id=0)
+    plan.fire("barrier_enter", seq=1)  # wrong process: no trigger
+    assert not plan.specs[0].fired
+    plan = FaultPlan.parse(["barrier_enter@1:exception"], process_id=1)
+    with pytest.raises(InjectedFault):
+        plan.fire("barrier_enter", seq=1)
+
+
+def test_unqualified_plan_arms_as_process_zero():
+    # A plan armed without a process id is process 0: @0 fires, @1 not.
+    plan = FaultPlan.parse(["peer_heartbeat@0:exception"])
+    with pytest.raises(InjectedFault):
+        plan.fire("peer_heartbeat", seq=1)
+    plan = FaultPlan.parse(["peer_heartbeat@1:exception"])
+    plan.fire("peer_heartbeat", seq=1)
+    assert not plan.specs[0].fired
+
+
+def test_fired_markers_are_per_process_in_shared_state_dir(tmp_path):
+    """Gang workers share one --fault-state-dir: each process's
+    exactly-once is tracked independently (fault<i>.p<pid>.fired)."""
+    d = str(tmp_path / "fs")
+    p0 = FaultPlan.parse(["window_fire:exception"], state_dir=d,
+                         process_id=0)
+    with pytest.raises(InjectedFault):
+        p0.fire("window_fire", seq=1)
+    assert os.path.exists(os.path.join(d, "fault0.p0.fired"))
+    # Process 1 arming from the same dir is NOT pre-fired by p0's
+    # marker, and records its own on firing.
+    p1 = FaultPlan.parse(["window_fire:exception"], state_dir=d,
+                         process_id=1)
+    assert not p1.specs[0].fired
+    with pytest.raises(InjectedFault):
+        p1.fire("window_fire", seq=1)
+    assert os.path.exists(os.path.join(d, "fault0.p1.fired"))
+    # A restarted process 0 sees only its own marker: spent.
+    p0b = FaultPlan.parse(["window_fire:exception"], state_dir=d,
+                          process_id=0)
+    assert p0b.specs[0].fired

@@ -1,0 +1,367 @@
+"""Gang-supervision unit layer: argv derivation, heartbeats, the peer
+table, the restore vote, and the GangSupervisor restart loop over fake
+workers (the real-CLI chaos capstone lives in ``test_gang_chaos.py``).
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from tpu_cooccurrence.observability.http import MetricsServer
+from tpu_cooccurrence.observability.registry import MetricsRegistry
+from tpu_cooccurrence.robustness import faults
+from tpu_cooccurrence.robustness.gang import (
+    GANG_SITES,
+    GangSupervisor,
+    HeartbeatWriter,
+    PeerTable,
+    agree_restore_generation,
+    gang_child_argv,
+    heartbeat_path,
+)
+from tpu_cooccurrence.state import checkpoint as ckpt
+
+
+# -- argv derivation ----------------------------------------------------
+
+
+def test_gang_child_argv_strips_supervision_and_appends_identity():
+    argv = ["-i", "in.csv", "-ws", "10", "--gang-workers", "2",
+            "--restart-on-failure", "3", "--restart-delay-ms", "0",
+            "--backend", "sharded"]
+    out = gang_child_argv(argv, 1, 2, "127.0.0.1:5000")
+    assert "--gang-workers" not in out
+    assert "--restart-on-failure" not in out
+    assert out[-6:] == ["--coordinator", "127.0.0.1:5000",
+                        "--num-processes", "2", "--process-id", "1"]
+    assert out[:4] == ["-i", "in.csv", "-ws", "10"]
+
+
+def test_gang_child_argv_suffixes_per_process_outputs():
+    argv = ["--journal", "/tmp/j.jsonl", "--quarantine-file=/tmp/q.jsonl"]
+    out0 = gang_child_argv(argv, 0, 2, "c:1")
+    out1 = gang_child_argv(argv, 1, 2, "c:1")
+    assert "/tmp/j.jsonl.p0" in out0 and "/tmp/j.jsonl.p1" in out1
+    assert "--quarantine-file=/tmp/q.jsonl.p0" in out0
+    assert "--quarantine-file=/tmp/q.jsonl.p1" in out1
+
+
+# -- heartbeats ---------------------------------------------------------
+
+
+def test_heartbeat_writer_touches_file_and_fires_site(tmp_path):
+    gang_dir = str(tmp_path / "gang")
+    plan = faults.arm(["peer_heartbeat:2:exception"])
+    try:
+        hb = HeartbeatWriter(gang_dir, 1, interval_s=60.0)
+        hb.beat()
+        path = heartbeat_path(gang_dir, 1)
+        assert os.path.exists(path)
+        payload = json.loads(open(path).read())
+        assert payload["beat"] == 1
+        # Second beat crosses the armed spec's seq and must fire it.
+        with pytest.raises(faults.InjectedFault):
+            hb.beat()
+        assert plan.specs[0].fired
+    finally:
+        faults.disarm()
+
+
+def test_heartbeat_thread_beats_periodically(tmp_path):
+    gang_dir = str(tmp_path / "gang")
+    hb = HeartbeatWriter(gang_dir, 0, interval_s=0.05).start()
+    try:
+        deadline = time.time() + 5.0
+        while hb.beats < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        assert hb.beats >= 3
+        assert os.path.exists(heartbeat_path(gang_dir, 0))
+    finally:
+        hb.stop()
+
+
+# -- the peer table + /healthz ------------------------------------------
+
+
+def _touch_heartbeat(gang_dir, pid, age_s=0.0):
+    os.makedirs(gang_dir, exist_ok=True)
+    p = heartbeat_path(gang_dir, pid)
+    with open(p, "w") as f:
+        f.write("{}")
+    past = time.time() - age_s
+    os.utime(p, (past, past))
+
+
+def test_peer_table_reports_ages_epochs_and_staleness(tmp_path):
+    gang_dir = str(tmp_path / "gang")
+    ck_dir = str(tmp_path / "ck")
+    os.makedirs(ck_dir)
+    _touch_heartbeat(gang_dir, 0, age_s=0.0)
+    _touch_heartbeat(gang_dir, 1, age_s=99.0)
+    for gen in (1, 2):
+        open(os.path.join(ck_dir, f"EPOCH.p0.{gen}"), "w").close()
+    open(os.path.join(ck_dir, "EPOCH.p1.1"), "w").close()
+    table = PeerTable(gang_dir, 2, stale_after_s=10.0,
+                      checkpoint_dir=ck_dir)
+    rows, any_stale = table.snapshot()
+    assert any_stale
+    assert rows[0]["stale"] is False and rows[1]["stale"] is True
+    assert rows[0]["committed_epoch"] == 2
+    assert rows[1]["committed_epoch"] == 1
+    assert rows[1]["heartbeat_age_seconds"] >= 99.0
+
+
+def test_peer_table_missing_heartbeat_grace_then_stale(tmp_path):
+    gang_dir = str(tmp_path / "gang")
+    os.makedirs(gang_dir)
+    table = PeerTable(gang_dir, 1, stale_after_s=10.0)
+    rows, any_stale = table.snapshot()
+    # No beat yet, but inside the startup grace: not stale.
+    assert not any_stale
+    assert rows[0]["heartbeat_age_seconds"] is None
+    table._started_unix -= 120.0  # age the table past the grace
+    rows, any_stale = table.snapshot()
+    assert any_stale and rows[0]["stale"]
+
+
+def test_peer_table_stale_after_zero_disables_staleness(tmp_path):
+    """--gang-stale-after-s 0 means staleness handling OFF (matching
+    the supervisor's _stale_worker): /healthz must not drain a healthy
+    gang on heartbeat age."""
+    gang_dir = str(tmp_path / "gang")
+    _touch_heartbeat(gang_dir, 0, age_s=9999.0)
+    table = PeerTable(gang_dir, 1, stale_after_s=0.0)
+    rows, any_stale = table.snapshot()
+    assert not any_stale
+    assert rows[0]["stale"] is False
+
+
+def test_healthz_carries_peers_and_503s_on_stale(tmp_path):
+    import urllib.request
+
+    gang_dir = str(tmp_path / "gang")
+    _touch_heartbeat(gang_dir, 0, age_s=0.0)
+    _touch_heartbeat(gang_dir, 1, age_s=500.0)
+    reg = MetricsRegistry()
+    server = MetricsServer(
+        reg, port=0,
+        peers=PeerTable(gang_dir, 2, stale_after_s=60.0)).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/healthz"
+        try:
+            urllib.request.urlopen(url)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            payload = json.loads(exc.read().decode())
+        assert payload["status"] == "peer_stale"
+        peers = payload["peers"]
+        assert [p["process"] for p in peers] == [0, 1]
+        assert peers[1]["stale"] is True
+    finally:
+        server.stop()
+
+
+def test_healthz_peers_all_fresh_is_healthy(tmp_path):
+    import urllib.request
+
+    gang_dir = str(tmp_path / "gang")
+    _touch_heartbeat(gang_dir, 0)
+    _touch_heartbeat(gang_dir, 1)
+    reg = MetricsRegistry()
+    server = MetricsServer(
+        reg, port=0,
+        peers=PeerTable(gang_dir, 2, stale_after_s=60.0)).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz") as resp:
+            payload = json.loads(resp.read().decode())
+        assert len(payload["peers"]) == 2
+        assert not any(p["stale"] for p in payload["peers"])
+    finally:
+        server.stop()
+
+
+# -- the restore vote ---------------------------------------------------
+
+
+def _write_gen(directory, suffix, gen, marker=True):
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory,
+                           f"state{suffix}.{gen}.npz"), "wb") as f:
+        f.write(b"x")
+    if marker:
+        open(os.path.join(directory, f"EPOCH{suffix}.{gen}"), "w").close()
+
+
+def test_vote_quarantines_uncommitted_above_agreed(tmp_path):
+    d = str(tmp_path / "ck")
+    _write_gen(d, ".p0", 1)
+    _write_gen(d, ".p0", 2, marker=False)  # crashed pre-commit
+    # This host committed 1; the (fake) gang agreed on 1 too.
+    agreed = agree_restore_generation(d, ".p0", exchange=lambda v: v)
+    assert agreed == 1
+    assert os.path.exists(os.path.join(d, "state.p0.2.npz.partial"))
+    assert not os.path.exists(os.path.join(d, "state.p0.2.npz"))
+    assert ckpt.generations(d, ".p0")[0][0] == 1
+
+
+def test_vote_peer_missing_commit_drags_this_host_back(tmp_path):
+    d = str(tmp_path / "ck")
+    _write_gen(d, ".p0", 1)
+    _write_gen(d, ".p0", 2, marker=True)  # committed HERE...
+    # ...but the peer's vote says its newest committed is 1.
+    agreed = agree_restore_generation(d, ".p0",
+                                      exchange=lambda v: min(v, 1))
+    assert agreed == 1
+    assert os.path.exists(os.path.join(d, "state.p0.2.npz.partial"))
+    # The stale marker is dropped with the quarantined generation.
+    assert not os.path.exists(os.path.join(d, "EPOCH.p0.2"))
+
+
+def test_vote_fresh_directory_is_noop(tmp_path):
+    d = str(tmp_path / "ck")
+    seen = []
+
+    def exch(v):
+        seen.append(v)
+        return v
+
+    assert agree_restore_generation(d, ".p0", exchange=exch) == -1
+    assert seen == [-1]
+
+
+def test_vote_legacy_directory_without_markers_uses_newest_gen(tmp_path):
+    # Pre-epoch checkpoints (no markers at all) keep restoring: the
+    # per-host vote falls back to the newest generation file.
+    d = str(tmp_path / "ck")
+    _write_gen(d, ".p0", 3, marker=False)
+    _write_gen(d, ".p0", 2, marker=False)
+    assert agree_restore_generation(d, ".p0", exchange=lambda v: v) == 3
+    assert os.path.exists(os.path.join(d, "state.p0.3.npz"))
+
+
+# -- the gang supervisor over fake workers ------------------------------
+
+
+FAKE_WORKER = r"""
+import json, os, sys, time
+args = sys.argv[1:]
+def val(flag):
+    return args[args.index(flag) + 1]
+pid = int(val("--process-id"))
+state_dir = val("-i")  # the test smuggles its scratch dir as the input
+mode = val("-ws")      # and the scenario name as the window size slot
+gang_dir = os.environ["TPU_COOC_GANG_DIR"]
+hb = os.path.join(gang_dir, f"heartbeat.p{pid}")
+open(hb, "w").write("{}")
+if mode == "clean":
+    print(f"row-from-p{pid}")
+    sys.exit(0)
+if mode == "fail-once":
+    marker = os.path.join(state_dir, f"failed.p{pid}")
+    if pid == 1 and not os.path.exists(marker):
+        open(marker, "w").close()
+        sys.exit(9)
+    print(f"row-from-p{pid}")
+    sys.exit(0)
+if mode == "permanent":
+    sys.exit(78 if pid == 0 else 0)
+if mode == "wedge":
+    # One beat, then silence: the stale-heartbeat monitor must kill us.
+    time.sleep(600)
+if mode == "skew":
+    # Worker 0 finishes immediately (its heartbeat legitimately
+    # freezes); worker 1 keeps working well past stale_after_s.
+    if pid == 0:
+        print(f"row-from-p{pid}")
+        sys.exit(0)
+    t0 = time.time()
+    while time.time() - t0 < 3.0:
+        open(hb, "w").write("{}")
+        time.sleep(0.2)
+    print(f"row-from-p{pid}")
+    sys.exit(0)
+sys.exit(3)
+"""
+
+
+def _fake_gang(tmp_path, mode, attempts=1, stale_after_s=0.0,
+               timeout_s=60.0):
+    script = tmp_path / "fake_worker.py"
+    script.write_text(FAKE_WORKER)
+
+    class Sink:
+        def __init__(self):
+            self.text = ""
+
+        def write(self, s):
+            self.text += s
+
+    sink = Sink()
+    sup = GangSupervisor(
+        ["-i", str(tmp_path), "-ws", mode], num_workers=2,
+        attempts=attempts, gang_dir=str(tmp_path / "gang"),
+        stale_after_s=stale_after_s, delay_s=0.0, timeout_s=timeout_s,
+        stdout=sink, python=[sys.executable, str(script)])
+    return sup, sink
+
+
+def test_gang_supervisor_forwards_clean_output_in_process_order(tmp_path):
+    sup, sink = _fake_gang(tmp_path, "clean")
+    assert sup.run() == 0
+    assert sink.text == "row-from-p0\nrow-from-p1\n"
+
+
+def test_gang_supervisor_restarts_whole_gang_on_one_death(tmp_path):
+    sup, sink = _fake_gang(tmp_path, "fail-once", attempts=2)
+    assert sup.run() == 0
+    # Attempt 1's partial output (worker 0 printed before the gang was
+    # killed) is discarded; only the clean attempt's spools forward.
+    assert sink.text == "row-from-p0\nrow-from-p1\n"
+
+
+def test_gang_supervisor_exhausts_attempts(tmp_path):
+    script = tmp_path / "fake_worker.py"
+    script.write_text(FAKE_WORKER)
+    sup, _ = _fake_gang(tmp_path, "fail-once", attempts=0)
+    assert sup.run() == 9
+
+
+def test_gang_supervisor_permanent_code_never_retries(tmp_path):
+    sup, _ = _fake_gang(tmp_path, "permanent", attempts=5)
+    t0 = time.monotonic()
+    assert sup.run() == 78
+    assert time.monotonic() - t0 < 30  # no backoff-retry loop
+
+
+def test_gang_supervisor_ignores_exited_workers_staleness(tmp_path):
+    """A worker that exited cleanly freezes its heartbeat by design;
+    while peers finish a skewed tail past stale_after_s the monitor
+    must not read that as peer death and kill a completing gang."""
+    sup, sink = _fake_gang(tmp_path, "skew", stale_after_s=1.0)
+    assert sup.run() == 0
+    assert sink.text == "row-from-p0\nrow-from-p1\n"
+
+
+def test_gang_supervisor_kills_gang_on_stale_heartbeat(tmp_path):
+    sup, _ = _fake_gang(tmp_path, "wedge", attempts=0,
+                        stale_after_s=1.0)
+    t0 = time.monotonic()
+    assert sup.run() == 124
+    # Killed by staleness (~1s + poll), nowhere near the 600s sleep.
+    assert time.monotonic() - t0 < 30
+
+
+def test_gang_supervisor_rejects_gang_of_one(tmp_path):
+    with pytest.raises(ValueError):
+        GangSupervisor([], num_workers=1, attempts=0,
+                       gang_dir=str(tmp_path / "g"))
+
+
+def test_gang_sites_are_registered():
+    for site in GANG_SITES:
+        assert site in faults.SITES

@@ -47,7 +47,7 @@ def _spawn_procs(tmp_path, phase: str, half: int, stream_path: str,
                  checkpoint_dir: str, backend: str = "sharded",
                  partition_sampling: bool = False,
                  window_slide: int = None, nproc: int = 2,
-                 expect_failure: bool = False):
+                 expect_failure: bool = False, pipeline_depth: int = 0):
     """Launch all ``nproc`` processes of one phase; return parsed outputs
     (or, with ``expect_failure``, the list of (rc, stderr) per process).
 
@@ -71,9 +71,12 @@ def _spawn_procs(tmp_path, phase: str, half: int, stream_path: str,
                     half=half, checkpoint_dir=checkpoint_dir,
                     backend=backend, num_shards=8,
                     partition_sampling=partition_sampling,
-                    window_slide=window_slide)
+                    window_slide=window_slide,
+                    pipeline_depth=pipeline_depth)
         tag = (f"{backend}{'-ps' if partition_sampling else ''}"
-               f"{'-sl' if window_slide else ''}-n{nproc}")
+               f"{'-sl' if window_slide else ''}"
+               f"{f'-d{pipeline_depth}' if pipeline_depth else ''}"
+               f"-n{nproc}")
         spec_path = tmp_path / f"spec-{tag}-{phase}-{pid}.json"
         out_path = tmp_path / f"out-{tag}-{phase}-{pid}.json"
         spec_path.write_text(json.dumps(spec))
@@ -306,3 +309,16 @@ def test_multihost_partitioned_sliding_matches_replicated(tmp_path, stream):
                           checkpoint_dir=None, partition_sampling=True,
                           window_slide=5)
     _assert_matches_reference(results, users, items, ts, window_slide=5)
+
+
+def test_multihost_pipelined_depth2_matches_single_process(tmp_path,
+                                                           stream):
+    """ISSUE 10 relaxed the blanket multi-host pipeline rejection:
+    without --partition-sampling every collective issues from the
+    scorer worker in window order, so a depth-2 two-process run must
+    reproduce the single-process serial reference exactly."""
+    stream_path, users, items, ts = stream
+    results = _spawn_procs(tmp_path, "full", len(users), stream_path,
+                           checkpoint_dir=None, nproc=2,
+                           pipeline_depth=2)
+    _assert_matches_reference(results, users, items, ts)

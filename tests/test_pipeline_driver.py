@@ -215,10 +215,23 @@ def test_depth_validation():
     # Config validates in __post_init__ — construction itself raises.
     with pytest.raises(ValueError, match="pipeline-depth"):
         Config(window_size=100, pipeline_depth=3)
-    with pytest.raises(ValueError, match="single-process"):
-        Config(window_size=100, pipeline_depth=1, coordinator="h:1234",
-               num_processes=2, process_id=0, backend=Backend.SHARDED,
-               num_shards=2, num_items=64)
+    # Multi-host pipelining is supported: every collective issues from one
+    # thread in window order, so a coordinator plus depth > 0 is valid.
+    Config(window_size=100, pipeline_depth=1, coordinator="h:1234",
+           num_processes=2, process_id=0, backend=Backend.SHARDED,
+           num_shards=2, num_items=64)
+    # ... except with the partitioned sampler, whose sampling-thread
+    # allgather would race the scorer worker's collectives.
+    with pytest.raises(ValueError, match="partition-sampling"):
+        Config(window_size=100, pipeline_depth=1, partition_sampling=True,
+               coordinator="h:1234", num_processes=2, process_id=0,
+               backend=Backend.SHARDED, num_shards=2, num_items=64)
+    # Multi-host --degrade needs the serial path: the per-window shed vote
+    # is only in lockstep with sampling at depth 0.
+    with pytest.raises(ValueError, match="pipeline-depth 0"):
+        Config(window_size=100, pipeline_depth=1, degrade=True,
+               coordinator="h:1234", num_processes=2, process_id=0,
+               backend=Backend.SHARDED, num_shards=2, num_items=64)
     with pytest.raises(ValueError):
         PipelineDriver(job=None, depth=0)
 

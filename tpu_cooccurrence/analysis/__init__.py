@@ -33,6 +33,10 @@ Layout:
   narrow-dtype cast guards (every encoder needs its decoder + a test
   referencing both; every int16/int8 cast needs a visible overflow
   guard);
+* :mod:`.rules_gang` — gang-robustness invariants (host-level
+  collectives must ride the watchdog wrappers in
+  ``parallel/distributed.py``; the gang chaos sites must stay
+  registered and fired);
 * :mod:`.rules_fused` — Pallas kernel registry drift (every
   ``pallas_call`` entry point in ``ops/pallas_score.py`` parity-tested
   from ``tests/`` and listed in the ARCHITECTURE kernel table);
@@ -67,6 +71,7 @@ from .core import (  # noqa: F401
 # Importing the rule modules registers their rules in RULES.
 from . import rules_degrade  # noqa: F401,E402
 from . import rules_fused  # noqa: F401,E402
+from . import rules_gang  # noqa: F401,E402
 from . import rules_jit  # noqa: F401,E402
 from . import rules_lock  # noqa: F401,E402
 from . import rules_native  # noqa: F401,E402

@@ -1,0 +1,123 @@
+"""Gang-robustness invariants: watchdog-wrapped collectives + live sites.
+
+Two rules guard the distributed robustness plane (ISSUE 10):
+
+* **collective-watchdog** — a JAX multi-controller collective whose
+  peer died does not fail, it *hangs forever*. Every host-level
+  collective the framework issues must therefore go through the
+  watchdog wrappers in ``parallel/distributed.py``
+  (``guarded_allgather`` / ``gang_barrier`` / the ``allgather_min``/
+  ``allgather_max`` votes), which convert the silent wedge into a
+  supervised exit the gang supervisor can restart. A raw
+  ``multihost_utils.process_allgather`` / ``sync_global_devices`` call
+  anywhere else in the package is an unguarded hang waiting for its
+  first dead peer.
+
+* **gang-fault-sites** — the gang's process-qualified chaos sites
+  (``robustness/gang.GANG_SITES``: ``barrier_enter``, ``ckpt_commit``,
+  ``peer_heartbeat``) must stay registered in ``faults.SITES`` *and*
+  fired by real package code — the whole-gang recovery tests address
+  workers by these names, so a renamed or unplugged site silently
+  removes chaos coverage while the tests keep passing on stale specs.
+
+Both are AST-checked and baseline-free by construction: the repo ships
+clean and there is nothing to grandfather.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from ..robustness.faults import SITES
+from ..robustness.gang import GANG_SITES
+from .core import FileContext, Finding, RepoContext, Rule, register
+
+#: The one module allowed to touch the raw collective entry points —
+#: it owns the watchdog that wraps them.
+_WRAPPER_PATH = "tpu_cooccurrence/parallel/distributed.py"
+
+#: Raw multi-controller collective entry points that hang (not fail) on
+#: peer loss.
+_RAW_COLLECTIVES = ("process_allgather", "sync_global_devices")
+
+_FAULTS_PATH = "tpu_cooccurrence/robustness/faults.py"
+
+
+@register
+class CollectiveWatchdogRule(Rule):
+    name = "collective-watchdog"
+    description = ("host-level collectives must go through the watchdog "
+                   "wrappers in parallel/distributed.py (raw "
+                   "multihost_utils calls hang forever on peer loss)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if (not ctx.path.startswith("tpu_cooccurrence/")
+                or not ctx.is_python or ctx.path == _WRAPPER_PATH):
+            return
+        tree = ctx.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            callee = None
+            if isinstance(func, ast.Attribute):
+                callee = func.attr
+            elif isinstance(func, ast.Name):
+                callee = func.id
+            if callee in _RAW_COLLECTIVES:
+                yield Finding(
+                    rule=self.name, file=ctx.path, line=node.lineno,
+                    message=(f"raw collective {callee}() bypasses the "
+                             f"collective-entry watchdog — call the "
+                             f"wrapper in parallel/distributed.py "
+                             f"(guarded_allgather / gang_barrier) so a "
+                             f"dead peer becomes a supervised exit, "
+                             f"not a silent hang"))
+
+
+@register
+class GangFaultSiteRule(Rule):
+    name = "gang-fault-sites"
+    description = ("every gang chaos site (gang.GANG_SITES) must be "
+                   "registered in faults.SITES and fired by package "
+                   "code")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        # Full-repo passes only: a single-fixture run has no business
+        # declaring sites unplugged (same scoping as the fault-site
+        # rule's reverse check).
+        if not any(c.path == _FAULTS_PATH for c in repo.files):
+            return
+        fired: Set[str] = set()
+        for ctx in repo.package_files():
+            tree = ctx.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and ((isinstance(node.func, ast.Attribute)
+                              and node.func.attr == "fire")
+                             or (isinstance(node.func, ast.Name)
+                                 and node.func.id == "fire"))
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    fired.add(node.args[0].value)
+        for site in GANG_SITES:
+            if site not in SITES:
+                yield Finding(
+                    rule=self.name, file=_FAULTS_PATH, line=1,
+                    message=(f"gang chaos site {site!r} "
+                             f"(gang.GANG_SITES) is not registered in "
+                             f"faults.SITES — the whole-gang recovery "
+                             f"tests address workers by this name"))
+            elif site not in fired:
+                yield Finding(
+                    rule=self.name, file=_FAULTS_PATH, line=1,
+                    message=(f"gang chaos site {site!r} is registered "
+                             f"but never fired by package code — the "
+                             f"chaos specs that target it can no "
+                             f"longer trigger"))

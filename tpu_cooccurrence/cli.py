@@ -51,6 +51,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         LOG.error("configuration error: %s", exc)
         return EX_CONFIG
 
+    if config.gang_workers:
+        # Gang-supervisor mode (robustness/gang.py — the JobManager
+        # analogue): launch/monitor one multi-controller worker per
+        # gang slot and gang-restart the WHOLE set from the last
+        # committed epoch on any failure. Workers run the job path
+        # below with the coordinator flags filled in; their stdouts are
+        # spooled and forwarded in process order only on clean exit.
+        from .robustness.gang import GangSupervisor
+
+        import tempfile
+
+        raw = list(argv) if argv is not None else sys.argv[1:]
+        gang_dir = (os.path.join(config.checkpoint_dir, "gang")
+                    if config.checkpoint_dir
+                    else tempfile.mkdtemp(prefix="cooc-gang-"))
+        LOG.info("gang supervising %d workers (up to %d restart(s); "
+                 "heartbeats in %s)", config.gang_workers,
+                 config.restart_on_failure, gang_dir)
+        return GangSupervisor(
+            raw, config.gang_workers,
+            attempts=config.restart_on_failure,
+            gang_dir=gang_dir,
+            stale_after_s=config.gang_stale_after_s,
+            delay_s=config.restart_delay_ms / 1000.0,
+            backoff_base_s=(config.restart_backoff_base_ms / 1000.0
+                            if config.restart_backoff_base_ms > 0
+                            else None),
+            backoff_max_s=config.restart_backoff_max_ms / 1000.0,
+            journal_path=config.journal,
+            watchdog_stale_after_s=(config.watchdog_stale_after_s
+                                    if config.watchdog_stale_after_s > 0
+                                    else None)).run()
+
     if config.restart_on_failure > 0:
         # Supervisor mode (Flink restart-strategy analogue, SURVEY §5):
         # respawn the job as a child process on abnormal exit; the child
@@ -80,13 +113,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                     else None),
             checkpoint_dir=config.checkpoint_dir)
 
+    if config.collective_timeout_s > 0:
+        # The watchdog reads the env at every collective entry; setting
+        # it here (before any backend init) arms the whole process —
+        # including collectives issued during scorer construction.
+        from .parallel.distributed import COLLECTIVE_TIMEOUT_ENV
+
+        os.environ[COLLECTIVE_TIMEOUT_ENV] = str(
+            config.collective_timeout_s)
+
     if config.inject_fault:
         # Armed only on the job path: a supervising parent passes the
         # specs through to its child instead of firing them itself.
+        # process_id resolves site@proc qualifiers (gang chaos: kill
+        # exactly worker 1) and namespaces the fired markers so gang
+        # workers sharing one --fault-state-dir stay independent.
         from .robustness import faults
 
-        faults.arm(config.inject_fault, config.fault_state_dir)
+        faults.arm(config.inject_fault, config.fault_state_dir,
+                   process_id=config.process_id)
         LOG.warning("fault injection armed: %s", config.inject_fault)
+
+    # Gang worker: the supervising parent hands down the gang state dir;
+    # start the heartbeat beacon BEFORE job construction so liveness
+    # covers jax.distributed startup (a hang there must read as a stale
+    # peer, not silence).
+    heartbeat = None
+    from .robustness.gang import GANG_DIR_ENV, HeartbeatWriter
+
+    gang_dir = os.environ.get(GANG_DIR_ENV)
+    if gang_dir and config.process_id is not None:
+        heartbeat = HeartbeatWriter(
+            gang_dir, config.process_id,
+            interval_s=config.gang_heartbeat_s).start()
 
     config.log_configuration(LOG)
     if config.degrade:
@@ -147,12 +206,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 help="restart backoff delay the supervisor applied "
                      "before this attempt").set(
                          supervisor_info.get("backoff_ms", 0))
+        peers = None
+        if gang_dir and config.num_processes:
+            # /healthz peers table: heartbeat ages + committed epochs
+            # for every gang slot, 503 ("peer_stale") when any peer is
+            # stale — the load-balancer drain signal ahead of the gang
+            # restart.
+            from .robustness.gang import PeerTable
+
+            peers = PeerTable(gang_dir, config.num_processes,
+                              stale_after_s=config.gang_stale_after_s,
+                              checkpoint_dir=config.checkpoint_dir)
         if config.metrics_port is not None:
             metrics_server = MetricsServer(
                 REGISTRY, counters=job.counters, ledger=LEDGER,
                 port=config.metrics_port,
                 stale_after_s=config.healthz_stale_after_s,
-                supervisor_info=supervisor_info).start()
+                supervisor_info=supervisor_info, peers=peers).start()
         if config.serve_port is not None:
             # The serving endpoint carries the scrape routes too (one
             # port to probe behind a load balancer); --metrics-port may
@@ -176,6 +246,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .state import checkpoint as ckpt
 
         job.source = source
+        if config.coordinator is not None:
+            # Gang restore vote (robustness/gang.py): agree on the
+            # newest generation committed on EVERY host and quarantine
+            # anything newer as *.partial — a crash mid-epoch-commit
+            # falls back one generation everywhere instead of
+            # restoring a torn global state. Runs after job
+            # construction (the scorer's init joined the
+            # multi-controller runtime the vote's allgather needs).
+            from .robustness.gang import agree_restore_generation
+
+            agreed = agree_restore_generation(
+                config.checkpoint_dir,
+                getattr(job.scorer, "process_suffix", ""))
+            LOG.info("gang restore vote: committed epoch %d", agreed)
         if ckpt.exists(job, config.checkpoint_dir):
             job.restore(source=source)
             LOG.info("restored checkpoint from %s (windows_fired=%d)",
@@ -218,7 +302,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .robustness.quarantine import Quarantine
 
         quarantine = Quarantine(config.quarantine_file,
-                                max_rate=config.max_quarantine_rate)
+                                max_rate=config.max_quarantine_rate,
+                                max_bytes=config.max_quarantine_bytes)
         LOG.info("quarantine armed: dead-letter %s, max rate %.2f%%",
                  config.quarantine_file, config.max_quarantine_rate * 100)
 
@@ -286,6 +371,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # thread dies with the process and the supervisor's
             # journal-tail read covers the forensics.
             server.stop()
+    if heartbeat is not None:
+        # Same rationale: stop only on the clean path — on a crash the
+        # daemon beacon dies with the process and the resulting stale
+        # heartbeat is exactly the gang supervisor's death signal.
+        heartbeat.stop()
     return 0
 
 

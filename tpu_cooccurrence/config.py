@@ -146,6 +146,10 @@ class Config:
     # 2, permanent) once more than this fraction of input lines has
     # been quarantined — a systematically wrong input must not
     # "succeed" on its crumbs
+    max_quarantine_bytes: int = 0  # dead-letter size cap: the active
+    # file rolls over to .1/.2/... at this size, oldest backup beyond
+    # the keep window deleted — a week-long stream cannot grow the
+    # dead-letter JSONL unboundedly. 0 = unbounded (today's behavior)
     scorer_breaker_threshold: int = 0  # scorer circuit breaker
     # (robustness/degrade.py): N consecutive process_window failures
     # open the breaker onto the exact host-oracle fallback scorer, so a
@@ -244,6 +248,21 @@ class Config:
     coordinator: Optional[str] = None  # host:port of process 0
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    gang_workers: int = 0  # gang supervision (robustness/gang.py): this
+    # process becomes the gang supervisor — it launches N workers with
+    # the multi-controller identity flags filled in (fresh local
+    # coordinator port per attempt), monitors exits + heartbeat files,
+    # and gang-kills + gang-restarts the WHOLE set on any failure (JAX
+    # collectives cannot survive peer loss); --restart-on-failure is
+    # the gang's restart budget. 0 = off
+    gang_heartbeat_s: float = 5.0  # worker heartbeat write interval
+    gang_stale_after_s: float = 60.0  # heartbeat age past which a peer
+    # counts as dead: the gang supervisor restarts the gang, /healthz
+    # 503s ("peer_stale") so a load balancer drains first; 0 = off
+    collective_timeout_s: float = 0.0  # collective-entry watchdog
+    # (parallel/distributed.py): a guarded collective blocked this long
+    # means a peer is gone — exit 75 for the gang supervisor to restart
+    # the whole gang, instead of hanging forever; 0 = off
     partition_sampling: bool = False  # split host-side sampling across
     # processes by user (u % P) — the reservoir in tumbling mode, basket
     # expansion in sliding mode (cuts stay replicated) — and allgather
@@ -268,8 +287,9 @@ class Config:
                 "--restart-on-failure supervises one process; in a "
                 "multi-host run a respawned child would re-join the "
                 "coordinator while surviving peers are blocked "
-                "mid-collective — supervise multi-host jobs externally "
-                "(restart all processes together) instead")
+                "mid-collective — use --gang-workers (the gang "
+                "supervisor restarts all processes together) or "
+                "supervise externally")
         multihost = (self.coordinator, self.num_processes, self.process_id)
         if any(v is not None for v in multihost):
             if any(v is None for v in multihost):
@@ -281,10 +301,55 @@ class Config:
                     f"--process-id {self.process_id} out of range for "
                     f"--num-processes {self.num_processes}")
         if self.partition_sampling:
-            if self.coordinator is None:
+            if self.coordinator is None and not self.gang_workers:
                 raise ValueError(
                     "--partition-sampling is a multi-host mode — it needs "
-                    "--coordinator/--num-processes/--process-id")
+                    "--coordinator/--num-processes/--process-id (or "
+                    "--gang-workers, which assigns them)")
+        if self.gang_workers:
+            if self.gang_workers < 2:
+                raise ValueError(
+                    f"--gang-workers needs >= 2 workers (a gang of one "
+                    f"is --restart-on-failure), got {self.gang_workers}")
+            if self.coordinator is not None or self.process_id is not None \
+                    or self.num_processes is not None:
+                raise ValueError(
+                    "--gang-workers assigns --coordinator/--num-processes"
+                    "/--process-id to its workers itself — do not pass "
+                    "them to the supervisor")
+            if self.process_continuously:
+                raise ValueError(
+                    "--gang-workers buffers each worker's stdout until "
+                    "the gang exits cleanly; a --process-continuously "
+                    "job never exits — supervise continuous gangs "
+                    "externally (restart all processes together)")
+            if self.serve_port is not None:
+                raise ValueError(
+                    "--serve-port is single-process only; gang workers "
+                    "hold partial top-K tables (front them with a real "
+                    "serving tier instead)")
+            backend_multihost = (
+                self.backend == Backend.SHARDED
+                or (self.backend in (Backend.SPARSE, Backend.HYBRID)
+                    and self.num_shards > 1))
+            if not backend_multihost:
+                raise ValueError(
+                    "--gang-workers runs a multi-controller job: use "
+                    "--backend sharded, or sparse with --num-shards > 1 "
+                    "(other backends would run one full independent job "
+                    "per worker and clobber the shared checkpoint dir)")
+        if self.gang_heartbeat_s <= 0:
+            raise ValueError(
+                f"--gang-heartbeat-s must be positive, got "
+                f"{self.gang_heartbeat_s}")
+        if self.gang_stale_after_s < 0:
+            raise ValueError(
+                f"--gang-stale-after-s must be >= 0, got "
+                f"{self.gang_stale_after_s}")
+        if self.collective_timeout_s < 0:
+            raise ValueError(
+                f"--collective-timeout-s must be >= 0, got "
+                f"{self.collective_timeout_s}")
         if self.inject_fault is None:
             self.inject_fault = []
         if self.inject_fault:
@@ -315,10 +380,10 @@ class Config:
                 f"--watchdog-stale-after-s must be >= 0, got "
                 f"{self.watchdog_stale_after_s}")
         if self.watchdog_stale_after_s > 0:
-            if self.restart_on_failure <= 0:
+            if self.restart_on_failure <= 0 and not self.gang_workers:
                 raise ValueError(
                     "--watchdog-stale-after-s is supervisor machinery — "
-                    "it needs --restart-on-failure")
+                    "it needs --restart-on-failure (or --gang-workers)")
             if not self.journal:
                 raise ValueError(
                     "--watchdog-stale-after-s watches the run journal "
@@ -380,21 +445,28 @@ class Config:
             raise ValueError(
                 f"--degrade-stale-after-s must be positive, got "
                 f"{self.degrade_stale_after_s}")
-        if self.degrade and (self.partition_sampling
-                             or self.coordinator is not None):
-            # Shedding decisions are per-process, keyed on local wall
-            # times; multi-host runs need every process's sampling state
-            # identical (replicated, or partition-allgathered) — one
-            # host tripping to SHED_SAMPLING alone would diverge the
-            # pair streams feeding the mesh collectives.
+        if (self.degrade and self.pipeline_depth > 0
+                and (self.coordinator is not None or self.gang_workers)):
+            # Multi-host --degrade stays in lockstep through a
+            # per-window worst-signal allgather on the window-record
+            # thread (robustness/degrade.py exchange); at depth 0 that
+            # thread IS the sampling thread, so the level every host
+            # samples under is deterministic. Pipelined, the sampling
+            # thread would read the level mid-flight while the scorer
+            # worker votes — hosts could sample the same window under
+            # different cuts and diverge the pair streams.
             raise ValueError(
-                "--degrade is single-process only (per-process shedding "
-                "would diverge the replicated/partitioned sampling "
-                "state across hosts)")
+                "--degrade on multi-host runs needs --pipeline-depth 0 "
+                "(the per-window shed vote is only in lockstep with "
+                "sampling on the serial path)")
         if not (0.0 < self.max_quarantine_rate <= 1.0):
             raise ValueError(
                 f"--max-quarantine-rate must be in (0, 1], got "
                 f"{self.max_quarantine_rate}")
+        if self.max_quarantine_bytes < 0:
+            raise ValueError(
+                f"--max-quarantine-bytes must be >= 0, got "
+                f"{self.max_quarantine_bytes}")
         if self.scorer_breaker_threshold < 0:
             raise ValueError(
                 f"--scorer-breaker-threshold must be >= 0, got "
@@ -482,14 +554,20 @@ class Config:
             raise ValueError(
                 f"--pipeline-depth must be 0, 1 or 2, got "
                 f"{self.pipeline_depth}")
-        if self.pipeline_depth > 0 and self.coordinator is not None:
+        if self.pipeline_depth > 0 and self.partition_sampling:
             # Multi-controller collectives must be issued in the same
-            # order on every process; a per-process scorer thread racing
-            # a sampling thread (which also collects under
-            # --partition-sampling) cannot guarantee that lockstep.
+            # order on every process; the partitioned sampler's
+            # per-window allgather runs on the sampling thread, which
+            # would race the scorer worker's dispatches. Plain
+            # multi-host pipelining is fine: every collective (scorer
+            # dispatch, degrade-off, epoch barrier behind
+            # pipeline.barrier()) issues from one thread in window
+            # order.
             raise ValueError(
-                "--pipeline-depth > 0 is single-process only (multi-host "
-                "runs issue collectives from the job thread in lockstep)")
+                "--pipeline-depth > 0 is incompatible with "
+                "--partition-sampling (the partitioned sampler's "
+                "allgather on the sampling thread would race the "
+                "scorer worker's collectives)")
 
     @property
     def window_millis(self) -> int:
@@ -730,6 +808,31 @@ class Config:
                        help="Escalate one level when no window has "
                             "completed for this long while ingest "
                             "continues (default: 30)")
+        p.add_argument("--gang-workers", type=int, default=0,
+                       dest="gang_workers",
+                       help="Gang supervision: launch N multi-controller "
+                            "workers (coordinator flags assigned per "
+                            "attempt), monitor heartbeats, and gang-kill "
+                            "+ gang-restart the whole set from the last "
+                            "committed epoch on any failure "
+                            "(--restart-on-failure = restart budget)")
+        p.add_argument("--gang-heartbeat-s", type=float, default=5.0,
+                       dest="gang_heartbeat_s",
+                       help="Worker heartbeat-file write interval "
+                            "(default: 5)")
+        p.add_argument("--gang-stale-after-s", type=float, default=60.0,
+                       dest="gang_stale_after_s",
+                       help="Heartbeat age past which a gang peer counts "
+                            "as dead: the supervisor restarts the gang, "
+                            "/healthz 503s 'peer_stale' (default: 60; "
+                            "0 = off)")
+        p.add_argument("--collective-timeout-s", type=float, default=0.0,
+                       dest="collective_timeout_s",
+                       help="Collective-entry watchdog: a guarded "
+                            "collective blocked this long exits 75 (a "
+                            "gang peer is gone; the gang supervisor "
+                            "restarts the whole set) instead of hanging "
+                            "forever (default: 0 = off)")
         p.add_argument("--quarantine-file", default=None,
                        dest="quarantine_file",
                        help="Divert malformed input lines to this "
@@ -740,6 +843,13 @@ class Config:
                        help="Abort (exit 2, permanent) once more than "
                             "this fraction of input lines has been "
                             "quarantined (default: 0.01)")
+        p.add_argument("--max-quarantine-bytes", type=int, default=0,
+                       dest="max_quarantine_bytes",
+                       help="Roll the dead-letter file over to .1/.2/... "
+                            "at this size (oldest backup beyond the keep "
+                            "window deleted) so a long stream cannot "
+                            "grow it unboundedly (default: 0 = "
+                            "unbounded)")
         p.add_argument("--scorer-breaker-threshold", type=int, default=0,
                        dest="scorer_breaker_threshold",
                        help="Scorer circuit breaker: consecutive dispatch "
@@ -752,13 +862,17 @@ class Config:
                             "a half-open probe retries the primary "
                             "(default: 8)")
         p.add_argument("--inject-fault", action="append", default=None,
-                       dest="inject_fault", metavar="SITE[:SEQ][:KIND[:ARG]]",
+                       dest="inject_fault",
+                       metavar="SITE[@PROC][:SEQ][:KIND[:ARG]]",
                        help="Fault injection (repeatable): fire KIND "
                             "(crash|exception|delay_ms|torn_write; default "
                             "crash) once at the named site, optionally at "
-                            "window ordinal SEQ — e.g. "
+                            "window ordinal SEQ and only in process PROC "
+                            "(multi-host chaos) — e.g. "
                             "--inject-fault checkpoint_post_write:3:"
-                            "torn_write (sites: robustness/faults.py)")
+                            "torn_write, or ckpt_commit@1:5:crash to kill "
+                            "exactly worker 1 at the generation-5 commit "
+                            "(sites: robustness/faults.py)")
         p.add_argument("--fault-state-dir", default=None,
                        dest="fault_state_dir",
                        help="Directory persisting fired-fault markers so "

@@ -108,6 +108,17 @@ class CooccurrenceJob:
                 config.user_cut, config.seed, config.skip_cuts,
                 counters=self.counters)
         self.scorer = scorer if scorer is not None else self._make_scorer()
+        if self.degrade is not None and config.coordinator is not None:
+            # Multi-host degradation (robustness/gang.py plane): every
+            # observed window exchanges each host's worst signal
+            # (gang-wide max over the overloaded bit, one tiny guarded
+            # allgather per window) so all hosts step the ladder
+            # identically and sampling stays in lockstep. Wired after
+            # scorer construction — its init joined the
+            # multi-controller runtime the exchange rides on.
+            from .parallel.distributed import allgather_max
+
+            self.degrade.exchange = allgather_max
         if (getattr(self.scorer, "use_fused", False)
                 and isinstance(self.sampler, UserReservoirSampler)):
             # Fused-window uplink (--fused-window, ops/device_scorer):
@@ -665,6 +676,14 @@ class CooccurrenceJob:
             breaker_state = getattr(self.scorer, "breaker_state", None)
             if breaker_state is not None:
                 rec["breaker_state"] = breaker_state
+            if self.config.coordinator is not None:
+                # Gang forensics: the newest epoch this process has
+                # committed when the record was written — a restart's
+                # journal shows exactly which epoch the gang resumed
+                # from.
+                from .state.checkpoint import EPOCH_GAUGE
+
+                rec["epoch"] = int(REGISTRY.gauge(EPOCH_GAUGE).get())
             self.journal.record(rec)
 
     def _journal_degrade_event(self, event: str) -> None:

@@ -84,7 +84,8 @@ class MetricsServer:
                  port: int = 0, host: str = "127.0.0.1",
                  stale_after_s: float = 300.0,
                  supervisor_info: Optional[dict] = None,
-                 serving=None, serve_stale_after_s: float = 0.0) -> None:
+                 serving=None, serve_stale_after_s: float = 0.0,
+                 peers=None) -> None:
         self.registry = registry
         self.counters = counters
         self.ledger = ledger
@@ -95,6 +96,11 @@ class MetricsServer:
         self.supervisor_info = supervisor_info
         self.serving = serving
         self.serve_stale_after_s = serve_stale_after_s
+        # Gang peer table (robustness/gang.PeerTable, multi-host runs):
+        # /healthz carries per-peer heartbeat age + committed epoch and
+        # 503s ("peer_stale") when any peer is stale — the
+        # load-balancer drain signal ahead of the gang restart.
+        self.peers = peers
         self._started_unix = time.time()
         # Per-route request-latency histograms, registered up front so
         # they render on /metrics (at zero) from the first scrape.
@@ -193,9 +199,19 @@ class MetricsServer:
                     and snap_age > self.serve_stale_after_s
                     and status not in ("stale", "paused")):
                 status = payload["status"] = "snapshot_stale"
+        if self.peers is not None:
+            rows, any_stale = self.peers.snapshot()
+            payload["peers"] = rows
+            if any_stale and status not in ("stale", "paused",
+                                            "snapshot_stale"):
+                # A stale peer means the gang is about to be restarted
+                # (its collectives cannot complete); drain this process
+                # even though ITS windows may still look fresh.
+                status = payload["status"] = "peer_stale"
         if self.supervisor_info is not None:
             payload["last_restart"] = self.supervisor_info
-        return payload, status not in ("stale", "paused", "snapshot_stale")
+        return payload, status not in ("stale", "paused", "snapshot_stale",
+                                       "peer_stale")
 
     def recommend(self, query: str) -> "tuple[int, bytes]":
         """The ``/recommend`` route body: parse params, run the blend on

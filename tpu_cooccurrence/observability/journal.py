@@ -78,6 +78,11 @@ SCHEMA = {
     # this window computed (the window's own swap lands right after).
     "snapshot_generation": (False, int),
     "snapshot_rows": (False, int),
+    # Gang plane (robustness/gang.py, multi-host runs only): the newest
+    # checkpoint epoch this process had committed when the record was
+    # written — restart forensics show which epoch the gang resumed
+    # from.
+    "epoch": (False, int),
 }
 
 

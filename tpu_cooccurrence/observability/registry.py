@@ -81,6 +81,11 @@ CANONICAL_METRICS = frozenset({
     # checkpoint plane (state/checkpoint.py)
     "cooc_checkpoint_quarantined_total",
     "cooc_checkpoint_generation",
+    # gang / epoch-commit plane (state/checkpoint.py epoch markers,
+    # robustness/gang.py peer table)
+    "cooc_epoch_committed",
+    "cooc_checkpoint_partial_total",
+    "cooc_gang_stale_peers",
     # sharded scorers (parallel/sharded.py)
     "cooc_scorer_dispatch_rows",
     "cooc_shard_row_imbalance",

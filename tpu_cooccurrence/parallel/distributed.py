@@ -21,11 +21,27 @@ DCN across slices. This module owns that boundary:
 Result extraction stays process-local: each host materializes only the
 top-K blocks of rows its chips own (``Array.addressable_shards``), exactly
 like a Flink subtask emitting only its key partition.
+
+**Collective-entry watchdog** (robustness plane, ISSUE 10): a JAX
+multi-controller collective whose peer has died does not fail — it
+*hangs*, silently, forever (the runtime cannot distinguish "peer slow"
+from "peer gone"). Every host-level collective this framework issues
+goes through :func:`guarded_allgather` / :func:`gang_barrier`, which arm
+a timer (:func:`collective_watchdog`, ``TPU_COOC_COLLECTIVE_TIMEOUT_S``
+env, 0/unset = off) that converts the silent wedge into a supervised
+exit with :data:`PEER_LOST_EXIT` — a code the gang supervisor treats as
+"restart the whole gang", which is the only recovery JAX's
+multi-controller model permits (a lost peer invalidates every surviving
+process's collectives). The cooclint ``collective-watchdog`` rule keeps
+raw ``multihost_utils`` calls from bypassing the wrappers.
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import os
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -33,11 +49,127 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..robustness import faults
 from .mesh import ITEM_AXIS
 
 LOG = logging.getLogger("tpu_cooccurrence")
 
+#: Exit code for a collective-entry watchdog trip: EX_TEMPFAIL from
+#: sysexits(3) — transient by definition (the peer died; a gang restart
+#: fixes it), so deliberately NOT in the supervisor's permanent set.
+PEER_LOST_EXIT = 75
+
+#: Env var holding the collective-entry timeout in seconds; 0/unset
+#: disables the watchdog (single-process runs, or externally-supervised
+#: pods that prefer the runtime's own coordinator heartbeats). The gang
+#: supervisor sets it for its children.
+COLLECTIVE_TIMEOUT_ENV = "TPU_COOC_COLLECTIVE_TIMEOUT_S"
+
 _initialized = False
+
+#: 1-based ordinal of guarded collective entries in this process — the
+#: ``barrier_enter`` fault site's seq, so chaos tests can kill a worker
+#: at exactly the Nth collective.
+_collective_seq = 0
+_collective_seq_lock = threading.Lock()
+
+
+def _peer_lost_exit(label: str, timeout_s: float) -> None:
+    """Watchdog expiry: the collective has been blocked past the
+    timeout, which in a multi-controller gang means a peer is gone and
+    this process can never make progress again. ``os._exit`` (not
+    ``sys.exit``): the main thread is wedged inside a C++ collective
+    and an exception raised here would never unwind it. A module
+    function so tests can monkeypatch the exit away."""
+    LOG.error(
+        "collective watchdog: %s blocked for more than %.1fs — a gang "
+        "peer is unreachable; exiting %d for the gang supervisor to "
+        "restart the whole gang", label, timeout_s, PEER_LOST_EXIT)
+    os._exit(PEER_LOST_EXIT)
+
+
+@contextlib.contextmanager
+def collective_watchdog(label: str):
+    """Arm a peer-loss timer around one collective entry.
+
+    Fires the ``barrier_enter`` fault site (chaos hook), then runs the
+    body under a daemon timer that calls :func:`_peer_lost_exit` if the
+    collective is still blocked after ``TPU_COOC_COLLECTIVE_TIMEOUT_S``
+    seconds. With the env unset the site still fires but no timer is
+    armed (zero threads on the hot path).
+    """
+    global _collective_seq
+    with _collective_seq_lock:
+        _collective_seq += 1
+        seq = _collective_seq
+    if faults.PLAN is not None:
+        faults.PLAN.fire("barrier_enter", seq=seq)
+    timeout_s = float(os.environ.get(COLLECTIVE_TIMEOUT_ENV, "0") or 0)
+    if timeout_s <= 0:
+        yield
+        return
+    timer = threading.Timer(timeout_s, _peer_lost_exit,
+                            args=(label, timeout_s))
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()
+
+
+def guarded_allgather(arr: np.ndarray):
+    """``multihost_utils.process_allgather`` behind the collective-entry
+    watchdog — the only allgather entry point the framework uses (the
+    cooclint ``collective-watchdog`` rule enforces it)."""
+    from jax.experimental import multihost_utils
+
+    with collective_watchdog("process_allgather"):
+        return multihost_utils.process_allgather(arr)
+
+
+def allgather_max(value: int) -> int:
+    """Worst-signal exchange: every process contributes one int, every
+    process receives the gang-wide max. The multi-host degradation
+    plane's per-window vote (robustness/degrade.py ``exchange``)."""
+    return int(guarded_allgather(
+        np.asarray([int(value)], dtype=np.int64)).max())
+
+
+def allgather_min(value: int) -> int:
+    """Gang-wide minimum of one int per process — the checkpoint
+    restore vote (robustness/gang.py ``agree_restore_generation``): the
+    newest generation committed on EVERY host is the min of the
+    per-host newest-committed values."""
+    return int(guarded_allgather(
+        np.asarray([int(value)], dtype=np.int64)).min())
+
+
+def gang_barrier(name: str) -> None:
+    """All-process rendezvous behind the watchdog (checkpoint epoch
+    commits and other whole-gang sync points)."""
+    from jax.experimental import multihost_utils
+
+    with collective_watchdog(f"barrier:{name}"):
+        multihost_utils.sync_global_devices(name)
+
+
+def _enable_cpu_collectives() -> None:
+    """Select gloo as the CPU backend's cross-process collective fabric.
+
+    Without an implementation selected, every multi-process computation
+    on the CPU backend fails with "Multiprocess computations aren't
+    implemented on the CPU backend" — which is exactly what a 2-process
+    CPU gang (the chaos tests, or a laptop rehearsal of a pod run) hits
+    on its first ``psum``. TPU fabrics ignore the setting (collectives
+    ride ICI/DCN); older jaxlibs without the option are left alone.
+    Must run before the backend client is created, hence its place
+    inside :func:`init_multihost` ahead of ``initialize``.
+    """
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # option absent: TPU-only jaxlib, nothing to do
+        pass
 
 
 def init_multihost(coordinator_address: Optional[str] = None,
@@ -58,6 +190,7 @@ def init_multihost(coordinator_address: Optional[str] = None,
         return
     if _initialized:
         return
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -72,6 +205,14 @@ def is_multihost() -> bool:
     return jax.process_count() > 1
 
 
+def hosts_major(devices: Sequence) -> "list":
+    """Hosts-major device order: all of host 0's chips, then host 1's, …
+    (ties broken by device id). The ordering contract behind
+    :func:`make_multihost_mesh`, split out so tests can pin it without a
+    real multi-process runtime."""
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
 def make_multihost_mesh(devices: Optional[Sequence] = None) -> Mesh:
     """1-D ``items`` mesh over all chips of all hosts, DCN-aware.
 
@@ -83,7 +224,7 @@ def make_multihost_mesh(devices: Optional[Sequence] = None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     if jax.process_count() > 1:
-        devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+        devices = hosts_major(devices)
     return Mesh(np.asarray(devices), (ITEM_AXIS,))
 
 

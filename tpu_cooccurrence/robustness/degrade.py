@@ -31,6 +31,16 @@ flapping (``tests/test_degrade.py`` pins this).
 bit-identical to a run without the controller (parity-tested at
 pipeline depths 0 and 2).
 
+**Multi-host lockstep** (ISSUE 10): on multi-controller runs the job
+wires :attr:`DegradationController.exchange` to the watchdog-guarded
+``allgather_max`` — every observed window exchanges each host's local
+overloaded bit and the gang-wide max drives the ladder, so all hosts
+apply the identical transition sequence at the identical window
+ordinal and the replicated/partitioned sampling state never diverges.
+The admission-side wall-clock staleness escalation is disabled in this
+mode (it is per-host-nondeterministic); chaos-proven in
+``tests/test_gang_chaos.py``.
+
 Zero-cost-when-off contract (same as :mod:`.faults`): hot paths guard
 with ``if degrade.CONTROLLER is not None`` — one module-attribute load
 and a pointer compare. Arming is explicit (:func:`install`, done by
@@ -145,6 +155,16 @@ class DegradationController:
         # Optional durable event sink (job wires its journal here):
         # called with each transition token outside the controller lock.
         self.journal_event: Optional[Callable[[str], None]] = None
+        # Multi-host worst-signal vote (job wires
+        # parallel/distributed.allgather_max here): every observed
+        # window's local overloaded bit is exchanged and the gang-wide
+        # MAX drives the ladder, so every host applies the identical
+        # transition sequence at the identical window ordinal and
+        # sampling stays in lockstep. None = single-process (local
+        # signals only). With an exchange attached the admission-side
+        # wall-clock staleness escalation is disabled — it is
+        # per-host-nondeterministic and would desynchronize the vote.
+        self.exchange: Optional[Callable[[int], int]] = None
         self._transitions = 0
         # Staleness baseline before any window completes: controller
         # construction time — a scorer that wedges on its very FIRST
@@ -207,6 +227,14 @@ class DegradationController:
             self._queue_pressure = False
             self._query_pressure = False
             self._last_window_monotonic = time.monotonic()
+        if self.exchange is not None:
+            # Outside the lock: the vote is a collective and must not
+            # hold the leaf lock against the ingest thread's admit()
+            # while peers rendezvous. Called once per observed window —
+            # windows are deterministic, so the collective order is in
+            # lockstep across hosts.
+            overloaded = bool(self.exchange(int(overloaded)))
+        with self._lock:
             if overloaded:
                 self._bad += 1
                 self._good = 0
@@ -268,6 +296,11 @@ class DegradationController:
             if self.pause_s > 0:
                 time.sleep(self.pause_s)
             return self.pause_s
+        if self.exchange is not None:
+            # Multi-host: the wall-clock staleness escalation is
+            # per-host-nondeterministic; only the exchanged per-window
+            # vote may move the ladder, or hosts would desynchronize.
+            return 0.0
         pending: List[str] = []
         with self._lock:
             # Before the first window completes, staleness is measured

@@ -12,9 +12,16 @@ there (``tests/test_chaos.py``).
 
 Spec grammar (CLI ``--inject-fault``, repeatable)::
 
-    site[:window_seq][:kind[:arg]]
+    site[@proc][:window_seq][:kind[:arg]]
 
 * ``site`` — a key of :data:`SITES` (the registered injection points).
+* ``proc`` — optional process qualifier (multi-host chaos): the spec
+  arms only in the process whose ``--process-id`` matches (a plan armed
+  without a process id is process 0). ``ckpt_commit@1:5:crash`` kills
+  exactly worker 1, at exactly the gang's generation-5 commit — the
+  deterministic peer-death injection the gang-recovery tests are built
+  on. Omitted = fires in whichever process hits the site first (every
+  process, for replicated sites — each keeps its own fired marker).
 * ``window_seq`` — optional integer: trigger on the first hit whose
   sequence number is >= this (sites inside the window loop pass the
   fired-window ordinal; ``source_read`` passes the file-open ordinal).
@@ -81,6 +88,17 @@ SITES = {
                       "inside process_window before device dispatch "
                       "(seq = 1-based scorer-window ordinal; the "
                       "exception kind is the breaker's trip input)",
+    "barrier_enter": "parallel/distributed.py — entering a guarded "
+                     "collective/barrier (seq = 1-based per-process "
+                     "collective ordinal)",
+    "ckpt_commit": "state/checkpoint.py — generation file renamed into "
+                   "place, before the directory fsync / gang epoch "
+                   "commit (seq = generation number); a crash here "
+                   "leaves a durable per-host file with no EPOCH marker",
+    "peer_heartbeat": "robustness/gang.py — the heartbeat writer is "
+                      "about to touch this process's liveness file "
+                      "(seq = 1-based beat ordinal; delay_ms simulates "
+                      "a silently wedged peer)",
 }
 
 KINDS = ("crash", "exception", "delay_ms", "torn_write")
@@ -116,12 +134,21 @@ class FaultSpec:
     kind: str
     arg: Optional[int]
     index: int  # position in the plan (the persistence-marker key)
+    proc: Optional[int] = None  # process qualifier (site@proc); None =
+    # unqualified, fires in any process
     fired: bool = False
 
     @classmethod
     def parse(cls, raw: str, index: int) -> "FaultSpec":
         parts = raw.split(":")
-        site = parts[0]
+        site, sep, proc_s = parts[0].partition("@")
+        proc: Optional[int] = None
+        if sep:
+            if not _is_int(proc_s) or int(proc_s) < 0:
+                raise ValueError(
+                    f"process qualifier must be a non-negative integer "
+                    f"in --inject-fault {raw!r}")
+            proc = int(proc_s)
         if site not in SITES:
             raise UnknownFaultSiteError(
                 f"unknown fault site {site!r} in --inject-fault {raw!r}; "
@@ -160,7 +187,7 @@ class FaultSpec:
                 f"delay_ms needs an argument, e.g. "
                 f"{site}:delay_ms:5000 (--inject-fault {raw!r})")
         return cls(site=site, window_seq=window_seq, kind=kind, arg=arg,
-                   index=index)
+                   index=index, proc=proc)
 
 
 def _is_int(s: str) -> bool:
@@ -173,12 +200,19 @@ def _is_int(s: str) -> bool:
 
 class FaultPlan:
     """The armed set of fault specs. Sites call :meth:`fire`; each spec
-    triggers at most once (persisted across restarts via ``state_dir``)."""
+    triggers at most once (persisted across restarts via ``state_dir``).
+
+    ``process_id`` qualifies ``site@proc`` specs: a spec whose ``proc``
+    does not match this plan's process never fires here (the gang's
+    shared ``--fault-state-dir`` keys markers per process, so two
+    processes firing the same unqualified spec stay independent)."""
 
     def __init__(self, specs: List[FaultSpec],
-                 state_dir: Optional[str] = None) -> None:
+                 state_dir: Optional[str] = None,
+                 process_id: Optional[int] = None) -> None:
         self.specs = specs
         self.state_dir = state_dir
+        self.process_id = process_id
         self._lock = threading.Lock()
         if state_dir:
             os.makedirs(state_dir, exist_ok=True)
@@ -188,12 +222,19 @@ class FaultPlan:
 
     @classmethod
     def parse(cls, raw_specs: Sequence[str],
-              state_dir: Optional[str] = None) -> "FaultPlan":
+              state_dir: Optional[str] = None,
+              process_id: Optional[int] = None) -> "FaultPlan":
         return cls([FaultSpec.parse(raw, i)
-                    for i, raw in enumerate(raw_specs)], state_dir)
+                    for i, raw in enumerate(raw_specs)], state_dir,
+                   process_id)
 
     def _marker(self, spec: FaultSpec) -> str:
-        return os.path.join(self.state_dir, f"fault{spec.index}.fired")
+        # Gang runs share one state dir: markers are per (spec, process)
+        # so each process's exactly-once is tracked independently.
+        part = (f".p{self.process_id}" if self.process_id is not None
+                else "")
+        return os.path.join(self.state_dir,
+                            f"fault{spec.index}{part}.fired")
 
     def fire(self, site: str, seq: int = 0, path: Optional[str] = None,
              rename_to: Optional[str] = None) -> None:
@@ -205,6 +246,9 @@ class FaultPlan:
         """
         for spec in self.specs:
             if spec.fired or spec.site != site:
+                continue
+            if (spec.proc is not None
+                    and spec.proc != (self.process_id or 0)):
                 continue
             if spec.window_seq is not None and seq < spec.window_seq:
                 continue
@@ -256,10 +300,14 @@ PLAN: Optional[FaultPlan] = None
 
 
 def arm(raw_specs: Sequence[str],
-        state_dir: Optional[str] = None) -> FaultPlan:
-    """Parse and arm ``raw_specs`` as the process-wide plan."""
+        state_dir: Optional[str] = None,
+        process_id: Optional[int] = None) -> FaultPlan:
+    """Parse and arm ``raw_specs`` as the process-wide plan.
+
+    ``process_id`` (a multi-host run's ``--process-id``) resolves
+    ``site@proc`` qualifiers; ``None`` arms as process 0."""
     global PLAN
-    PLAN = FaultPlan.parse(raw_specs, state_dir)
+    PLAN = FaultPlan.parse(raw_specs, state_dir, process_id)
     return PLAN
 
 

@@ -1,0 +1,577 @@
+"""Gang supervision for multi-controller runs.
+
+The reference gets globally-consistent failure recovery for free from
+Flink's JobManager/TaskManager runtime: the JobManager detects a dead
+TaskManager by heartbeat, cancels the whole job graph, and restarts it
+from the last completed (barrier-aligned) checkpoint (SURVEY §0, §2.6).
+A JAX multi-controller gang has the same failure shape with none of the
+machinery: collectives cannot survive peer loss — a surviving process
+does not fail, it *hangs* — so the only sound restart unit is the whole
+gang, restored from a checkpoint *every* host committed. This module is
+the JobManager analogue, three pieces:
+
+* :class:`GangSupervisor` (CLI ``--gang-workers N``) launches one
+  worker process per gang slot on this machine (coordinator on a fresh
+  local port per attempt), spools each worker's stdout, and monitors
+  all of them: any abnormal exit — or a heartbeat file stale past
+  ``--gang-stale-after-s`` — gang-kills the survivors and relaunches
+  the whole set after backoff. Workers resume from the last *committed*
+  epoch on their own (the restore vote below). Output discipline is the
+  single-process supervisor's, per worker: spools are forwarded in
+  process order only when the whole gang exits cleanly, so a chaotic
+  run's total stdout is bit-identical to an uninterrupted one.
+
+* :class:`HeartbeatWriter` runs inside each worker (armed by the
+  ``TPU_COOC_GANG_DIR`` env the supervisor sets): a daemon thread
+  touching ``heartbeat.p<i>`` every ``--gang-heartbeat-s`` seconds —
+  the liveness signal that catches a worker wedged *outside* a
+  collective (the collective-entry watchdog in
+  ``parallel/distributed.py`` catches the wedged-``psum`` case and
+  exits :data:`~tpu_cooccurrence.parallel.distributed.PEER_LOST_EXIT`).
+  Each beat fires the ``peer_heartbeat`` fault site, so chaos tests can
+  freeze exactly one process's liveness signal.
+
+* :func:`agree_restore_generation` — the restore vote. Each process
+  computes its newest *committed* checkpoint generation (one with an
+  ``EPOCH`` marker; see ``state/checkpoint.py``), the gang allgathers
+  the minimum, and every process quarantines anything newer as
+  ``*.partial``. A crash anywhere between the first per-host generation
+  rename and the last epoch marker therefore drags every host back to
+  the same previous epoch — never a torn global restore.
+
+The ``peers`` table on ``/healthz`` (:class:`PeerTable`) reads the same
+heartbeat files plus each suffix's committed-epoch markers, and turns a
+stale peer into a 503 so a load balancer drains the process before the
+gang restart lands.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from ..observability.registry import REGISTRY
+from . import faults
+
+LOG = logging.getLogger("tpu_cooccurrence.gang")
+
+#: Env var carrying the gang state directory (heartbeat files) into the
+#: workers; its presence is what arms the worker-side heartbeat thread.
+GANG_DIR_ENV = "TPU_COOC_GANG_DIR"
+
+#: The robustness plane's process-qualified fault sites (registered in
+#: ``faults.SITES``; the cooclint ``gang-fault-sites`` rule holds this
+#: tuple to the registry and to live fire() call sites).
+GANG_SITES = ("barrier_enter", "ckpt_commit", "peer_heartbeat")
+
+#: Stale-peer gauge refreshed by :meth:`PeerTable.snapshot` (the
+#: /healthz scrape): peers whose heartbeat age exceeded the threshold.
+STALE_PEERS_GAUGE = "cooc_gang_stale_peers"
+
+#: Grace before a worker's FIRST heartbeat counts toward staleness:
+#: interpreter + jax.distributed startup must not read as peer death.
+HEARTBEAT_START_GRACE_S = 30.0
+
+#: Supervisor poll period while the gang runs.
+_POLL_S = 0.2
+
+
+def heartbeat_path(gang_dir: str, process_id: int) -> str:
+    return os.path.join(gang_dir, f"heartbeat.p{process_id}")
+
+
+class HeartbeatWriter:
+    """Worker-side liveness beacon: touch ``heartbeat.p<i>`` every
+    ``interval_s`` seconds from a daemon thread.
+
+    The write is a whole-file rewrite (tiny payload: beat ordinal +
+    wall clock), not an ``os.utime``, so a reader can also see *what*
+    the worker last reported; the mtime is the liveness signal. Each
+    beat fires the ``peer_heartbeat`` fault site (seq = beat ordinal) —
+    ``peer_heartbeat@1:3:delay_ms:600000`` freezes worker 1's beacon at
+    beat 3, the deterministic "silently wedged peer" injection.
+    """
+
+    def __init__(self, gang_dir: str, process_id: int,
+                 interval_s: float = 5.0) -> None:
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be positive, got "
+                             f"{interval_s}")
+        self.gang_dir = gang_dir
+        self.process_id = process_id
+        self.interval_s = interval_s
+        self.beats = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(gang_dir, exist_ok=True)
+
+    def beat(self) -> None:
+        """One heartbeat write (also the unit-test entry point)."""
+        self.beats += 1
+        if faults.PLAN is not None:
+            faults.PLAN.fire("peer_heartbeat", seq=self.beats)
+        path = heartbeat_path(self.gang_dir, self.process_id)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps({"beat": self.beats,
+                                    "wall_unix": round(time.time(), 3)}))
+            os.replace(tmp, path)
+        except OSError as exc:
+            # Liveness reporting must never kill the worker it reports
+            # on; a missed beat reads as staleness, which is the truth.
+            LOG.warning("heartbeat write failed: %s", exc)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.beat()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HeartbeatWriter":
+        self._thread = threading.Thread(
+            target=self._run, name="cooc-gang-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None
+
+
+class PeerTable:
+    """Read-only view of the gang for ``/healthz``: per-process
+    heartbeat age and committed epoch.
+
+    Reads only the filesystem (heartbeat files + ``EPOCH.p<i>.<gen>``
+    markers), so it is safe inside the HTTP handler thread and needs no
+    cross-process plumbing. A peer with no heartbeat file yet reports
+    ``age_seconds: null`` and counts as stale only after a startup
+    grace from table construction.
+    """
+
+    def __init__(self, gang_dir: str, num_processes: int,
+                 stale_after_s: float,
+                 checkpoint_dir: Optional[str] = None) -> None:
+        self.gang_dir = gang_dir
+        self.num_processes = num_processes
+        self.stale_after_s = stale_after_s
+        self.checkpoint_dir = checkpoint_dir
+        self._started_unix = time.time()
+
+    def snapshot(self) -> "tuple[list, bool]":
+        """``(rows, any_stale)`` — one row per gang slot."""
+        import re
+
+        now = time.time()
+        in_grace = (now - self._started_unix
+                    <= max(self.stale_after_s, HEARTBEAT_START_GRACE_S))
+        # One checkpoint-dir listing serves every gang slot: a load
+        # balancer probes /healthz every few seconds, and N listdir
+        # scans of a generation-filled directory per probe adds up.
+        epochs_by_pid: "dict[int, int]" = {}
+        if self.checkpoint_dir:
+            pat = re.compile(r"^EPOCH\.p(\d+)\.(\d+)$")
+            try:
+                names = os.listdir(self.checkpoint_dir)
+            except OSError:
+                names = []
+            for m in filter(None, map(pat.match, names)):
+                pid, gen = int(m.group(1)), int(m.group(2))
+                epochs_by_pid[pid] = max(epochs_by_pid.get(pid, -1), gen)
+        rows, any_stale = [], False
+        for pid in range(self.num_processes):
+            try:
+                age = now - os.path.getmtime(
+                    heartbeat_path(self.gang_dir, pid))
+            except OSError:
+                age = None
+            epoch = epochs_by_pid.get(pid, -1)
+            if self.stale_after_s <= 0:
+                # 0 = staleness handling off (matches the gang
+                # supervisor's _stale_worker): never drain on age.
+                stale = False
+            else:
+                stale = (age > self.stale_after_s if age is not None
+                         else not in_grace)
+            any_stale = any_stale or stale
+            rows.append({
+                "process": pid,
+                "heartbeat_age_seconds": (round(age, 3)
+                                          if age is not None else None),
+                "committed_epoch": epoch,
+                "stale": stale,
+            })
+        REGISTRY.gauge(
+            STALE_PEERS_GAUGE,
+            help="gang peers whose heartbeat age exceeds "
+                 "--gang-stale-after-s (healthz drain signal)").set(
+                     sum(r["stale"] for r in rows))
+        return rows, any_stale
+
+
+def agree_restore_generation(directory: str, suffix: str,
+                             exchange=None) -> int:
+    """The gang's restore vote; returns the agreed generation (-1 =
+    fresh start) after quarantining anything newer on this host.
+
+    Each process contributes its newest committed generation
+    (``checkpoint.newest_committed`` — the newest ``EPOCH``-marked one,
+    or, for a pre-epoch legacy directory with no markers at all, the
+    newest generation file); the gang-wide MINIMUM wins, because a
+    generation missing a marker on *any* host may be a torn global
+    commit. Generations above the agreed one are moved aside as
+    ``*.partial`` so no later walk can restore them.
+
+    ``exchange`` is the min-vote collective (injectable for tests);
+    default is the watchdog-guarded
+    :func:`~tpu_cooccurrence.parallel.distributed.allgather_min`.
+    """
+    from ..state import checkpoint as ckpt
+
+    local = ckpt.newest_committed(directory, suffix)
+    if exchange is None:
+        from ..parallel.distributed import allgather_min
+
+        exchange = allgather_min
+    agreed = int(exchange(local))
+    if agreed < local:
+        LOG.warning(
+            "gang restore vote: this host committed generation %d but "
+            "the gang agreed on %d (a peer's commit is missing) — "
+            "quarantining the newer generation(s)", local, agreed)
+    quarantined = ckpt.quarantine_uncommitted(directory, suffix, agreed)
+    if quarantined:
+        LOG.warning("gang restore vote: quarantined generation(s) %s "
+                    "for suffix %r", quarantined, suffix)
+    return agreed
+
+
+# -- the gang supervisor (parent side) ---------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+#: Per-process output files a gang must split by worker: a shared
+#: append-mode file would interleave two processes' records.
+_PER_PROCESS_FLAGS = ("--journal", "--quarantine-file")
+
+
+def gang_child_argv(argv: Sequence[str], process_id: int,
+                    num_processes: int, coordinator: str) -> List[str]:
+    """One worker's argv: the supervisor's flags stripped (including the
+    gang's own — a worker must not recurse into supervision), the
+    multi-controller identity appended, and per-process output paths
+    (``--journal``, ``--quarantine-file``) suffixed ``.p<i>``."""
+    from ..supervisor import child_argv
+
+    out: List[str] = []
+    suffix_next = False
+    for a in child_argv(argv):
+        if suffix_next:
+            a = f"{a}.p{process_id}"
+            suffix_next = False
+        elif a in _PER_PROCESS_FLAGS:
+            suffix_next = True
+        else:
+            for flag in _PER_PROCESS_FLAGS:
+                if a.startswith(flag + "="):
+                    a = f"{a}.p{process_id}"
+                    break
+        out.append(a)
+    out += ["--coordinator", coordinator,
+            "--num-processes", str(num_processes),
+            "--process-id", str(process_id)]
+    return out
+
+
+class _Worker:
+    """One gang slot's live state: process, spool, liveness baselines."""
+
+    def __init__(self, proc: "subprocess.Popen", spool,
+                 spawned_monotonic: float,
+                 journal_path: Optional[str] = None) -> None:
+        self.proc = proc
+        self.spool = spool
+        self.spawned = spawned_monotonic
+        # Journal-staleness watchdog state (same liveness signal as the
+        # single-process supervisor's): size at spawn, growth marks
+        # activity.
+        from ..supervisor import _journal_size
+
+        self.journal_path = journal_path
+        self.journal_size = _journal_size(journal_path)
+        self.journal_activity = spawned_monotonic
+        self.journal_grew = False
+
+
+class GangSupervisor:
+    """Launch, monitor, gang-kill and gang-restart a multi-controller
+    worker set (see the module docstring for the contract).
+
+    ``argv`` is the operator's full CLI argv; each attempt derives the
+    per-worker argv via :func:`gang_child_argv` with a fresh local
+    coordinator port (a dead gang's port may linger in TIME_WAIT).
+    ``attempts`` is the restart budget (``--restart-on-failure``);
+    permanent exit codes (usage/config) are never retried.
+    """
+
+    def __init__(self, argv: Sequence[str], num_workers: int,
+                 attempts: int, gang_dir: str,
+                 stale_after_s: float = 60.0,
+                 delay_s: float = 1.0,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_max_s: float = 30.0,
+                 timeout_s: Optional[float] = None,
+                 stdout=None,
+                 journal_path: Optional[str] = None,
+                 watchdog_stale_after_s: Optional[float] = None,
+                 python: Optional[Sequence[str]] = None) -> None:
+        if num_workers < 2:
+            raise ValueError(
+                f"a gang needs >= 2 workers, got {num_workers}")
+        self.argv = list(argv)
+        self.num_workers = num_workers
+        self.attempts = attempts
+        self.gang_dir = gang_dir
+        self.stale_after_s = stale_after_s
+        self.delay_s = delay_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.timeout_s = timeout_s
+        self.stdout = stdout
+        # Per-worker journal staleness (the hang watchdog's liveness
+        # signal, ``--watchdog-stale-after-s``): heartbeat files prove a
+        # worker process is ALIVE; journal growth proves it is making
+        # WINDOW PROGRESS. A worker wedged outside a guarded collective
+        # (alive, beating, not firing windows) is only caught here.
+        self.journal_path = journal_path
+        self.watchdog_stale_after_s = watchdog_stale_after_s
+        #: Command prefix for one worker (overridable in tests).
+        self.python = list(python) if python is not None else [
+            sys.executable, "-m", "tpu_cooccurrence.cli"]
+        os.makedirs(gang_dir, exist_ok=True)
+
+    # -- one attempt ---------------------------------------------------
+
+    def _spawn(self, restarts: int, last_rc: int,
+               backoff_s: float) -> List[_Worker]:
+        from ..supervisor import SUPERVISOR_STATE_ENV
+
+        # Clear the previous attempt's heartbeat files: a dead gang's
+        # recent mtimes must not vouch for the new gang's liveness.
+        for pid in range(self.num_workers):
+            try:
+                os.remove(heartbeat_path(self.gang_dir, pid))
+            except OSError:
+                pass
+        coordinator = f"127.0.0.1:{_free_port()}"
+        env = dict(os.environ)
+        env[GANG_DIR_ENV] = self.gang_dir
+        env[SUPERVISOR_STATE_ENV] = json.dumps({
+            "restarts": restarts,
+            "last_rc": last_rc,
+            "backoff_ms": int(backoff_s * 1000) if restarts else 0,
+            "last_restart_unix": round(time.time(), 3) if restarts else 0,
+            "stepped_back": False,
+        })
+        workers = []
+        now = time.monotonic()
+        for pid in range(self.num_workers):
+            cmd = self.python + gang_child_argv(
+                self.argv, pid, self.num_workers, coordinator)
+            spool = tempfile.TemporaryFile()
+            proc = subprocess.Popen(cmd, stdout=spool, env=env)
+            workers.append(_Worker(
+                proc, spool, now,
+                journal_path=(f"{self.journal_path}.p{pid}"
+                              if self.journal_path else None)))
+        LOG.info("gang attempt spawned: %d workers, coordinator %s",
+                 self.num_workers, coordinator)
+        return workers
+
+    def _kill_gang(self, workers: List[_Worker]) -> None:
+        from ..supervisor import _kill_child
+
+        for w in workers:
+            if w.proc.poll() is None:
+                _kill_child(w.proc)
+
+    def _stale_worker(self, workers: List[_Worker]) -> Optional[int]:
+        """Process id of a worker whose heartbeat went stale, or None.
+
+        Before a worker's first beat, staleness is measured from its
+        spawn against ``max(stale_after_s, startup grace)`` — jax
+        startup is not peer death.
+        """
+        if self.stale_after_s <= 0:
+            return None
+        now_mono = time.monotonic()
+        now_wall = time.time()
+        for pid, w in enumerate(workers):
+            if w.proc.poll() is not None:
+                # Exited workers have no liveness to report: a clean
+                # exit froze its heartbeat legitimately (peers may
+                # still be finishing a skewed tail), and an abnormal
+                # one is _watch's failed-check's business, not ours.
+                continue
+            try:
+                age = now_wall - os.path.getmtime(
+                    heartbeat_path(self.gang_dir, pid))
+                threshold = self.stale_after_s
+            except OSError:
+                age = now_mono - w.spawned
+                threshold = max(self.stale_after_s,
+                                HEARTBEAT_START_GRACE_S)
+            if age > threshold:
+                return pid
+        return None
+
+    def _watch(self, workers: List[_Worker]) -> int:
+        """Wait for a gang verdict: 0 = every worker exited cleanly;
+        nonzero = the first failure's exit code (the survivors are
+        gang-killed — their collectives can never complete without the
+        dead peer); 124 = overall timeout or stale heartbeat."""
+        start = time.monotonic()
+        while True:
+            codes = [w.proc.poll() for w in workers]
+            failed = next((rc for rc in codes
+                           if rc is not None and rc != 0), None)
+            if failed is not None:
+                LOG.error("gang worker died with rc=%d; gang-killing "
+                          "the survivors (a lost peer invalidates every "
+                          "surviving process's collectives)", failed)
+                self._kill_gang(workers)
+                return failed
+            if all(rc == 0 for rc in codes):
+                return 0
+            if (self.timeout_s is not None
+                    and time.monotonic() - start > self.timeout_s):
+                LOG.error("gang exceeded timeout_s=%.1f; gang-killing",
+                          self.timeout_s)
+                self._kill_gang(workers)
+                return 124
+            stale = self._stale_worker(workers)
+            if stale is not None:
+                LOG.error("gang worker %d heartbeat stale past %.1fs; "
+                          "gang-killing for a whole-gang restart",
+                          stale, self.stale_after_s)
+                self._kill_gang(workers)
+                return 124
+            wedged = self._stale_journal(workers)
+            if wedged is not None:
+                LOG.error("gang worker %d journal stale past %.1fs "
+                          "(alive but not firing windows — a silently "
+                          "wedged peer); gang-killing for a whole-gang "
+                          "restart", wedged, self.watchdog_stale_after_s)
+                self._kill_gang(workers)
+                return 124
+            time.sleep(_POLL_S)
+
+    def _stale_journal(self, workers: List[_Worker]) -> Optional[int]:
+        """Process id of a worker whose journal stopped growing past
+        ``watchdog_stale_after_s``, or None. Same semantics as the
+        single-process supervisor's hang watchdog: the first growth
+        must exceed the 1-byte torn-tail seal, and a startup grace
+        covers imports + jax.distributed rendezvous + restore."""
+        if not self.watchdog_stale_after_s or not self.journal_path:
+            return None
+        now = time.monotonic()
+        from ..supervisor import WATCHDOG_START_GRACE_S, _journal_size
+
+        for pid, w in enumerate(workers):
+            if w.proc.poll() is not None:
+                continue  # exited: no window progress to demand
+            size = _journal_size(w.journal_path)
+            if size > w.journal_size + (0 if w.journal_grew else 1):
+                w.journal_size = size
+                w.journal_activity = now
+                w.journal_grew = True
+            threshold = (self.watchdog_stale_after_s if w.journal_grew
+                         else max(self.watchdog_stale_after_s,
+                                  WATCHDOG_START_GRACE_S))
+            if now - w.journal_activity > threshold:
+                return pid
+        return None
+
+    def _forward(self, workers: List[_Worker]) -> None:
+        """Forward every worker's spooled stdout in process order — the
+        deterministic concatenation the parity tests compare."""
+        sink = self.stdout if self.stdout is not None else sys.stdout
+        for w in workers:
+            w.spool.seek(0)
+            if hasattr(sink, "buffer"):
+                shutil.copyfileobj(w.spool, sink.buffer)
+                sink.flush()
+            else:
+                import io
+
+                reader = io.TextIOWrapper(w.spool, encoding="utf-8",
+                                          errors="replace", newline="")
+                try:
+                    shutil.copyfileobj(reader, sink)
+                finally:
+                    reader.detach()
+
+    # -- the restart loop ----------------------------------------------
+
+    def run(self) -> int:
+        from ..supervisor import PERMANENT_EXIT_CODES
+
+        restarts = 0
+        last_rc = 0
+        prev_delay = (self.backoff_base_s
+                      if self.backoff_base_s is not None else self.delay_s)
+        while True:
+            workers = self._spawn(restarts, last_rc,
+                                  prev_delay if restarts else 0.0)
+            try:
+                rc = self._watch(workers)
+                if rc == 0:
+                    self._forward(workers)
+                    if restarts:
+                        LOG.info("gang completed after %d restart(s)",
+                                 restarts)
+                    return 0
+            finally:
+                for w in workers:
+                    w.spool.close()
+            last_rc = rc
+            if rc in PERMANENT_EXIT_CODES:
+                LOG.error("gang worker failed with rc=%d (usage/config "
+                          "error — permanent); not restarting", rc)
+                return rc
+            restarts += 1
+            if restarts > self.attempts:
+                LOG.error("gang failed with rc=%d; restart attempts "
+                          "exhausted (%d)", rc, self.attempts)
+                return rc
+            if self.backoff_base_s is not None:
+                prev_delay = min(self.backoff_max_s,
+                                 random.uniform(self.backoff_base_s,
+                                                max(self.backoff_base_s,
+                                                    prev_delay * 3)))
+            else:
+                prev_delay = self.delay_s
+            LOG.warning(
+                "gang attempt %d failed with rc=%d; gang-restarting all "
+                "%d workers from the last committed epoch in %.1fs "
+                "(%d attempt(s) left)", restarts, rc, self.num_workers,
+                prev_delay, self.attempts - restarts)
+            if prev_delay > 0:
+                time.sleep(prev_delay)

@@ -45,32 +45,79 @@ LOG = logging.getLogger("tpu_cooccurrence.quarantine")
 #: dataset, and one constant so the two can never disagree.
 RAW_TRUNCATE = 160
 
+#: Rotated dead-letter backups kept (``path.1`` newest … ``path.N``
+#: oldest); with ``max_bytes`` set, total disk for the dead-letter
+#: plane is bounded by ``(QUARANTINE_BACKUPS + 1) * max_bytes``.
+QUARANTINE_BACKUPS = 3
+
 
 class QuarantineRateExceeded(RuntimeError):
     """The quarantine breaker: too large a fraction of input rejected."""
 
 
 class Quarantine:
-    """Dead-letter writer with a quarantine-rate circuit breaker."""
+    """Dead-letter writer with a quarantine-rate circuit breaker.
+
+    ``max_bytes`` (CLI ``--max-quarantine-bytes``) caps the active
+    file: once a record would push it past the cap, the file rotates
+    logrotate-style (``path`` -> ``path.1``, shifting existing backups
+    up and deleting beyond :data:`QUARANTINE_BACKUPS`) and a fresh
+    active file opens — a week-long stream with a steady trickle of
+    poison lines keeps bounded disk instead of an unbounded JSONL.
+    Rate-breaker counters are run totals and survive rotation.
+    """
 
     def __init__(self, path: str, max_rate: float = 0.01,
-                 min_lines: int = 1000) -> None:
+                 min_lines: int = 1000, max_bytes: int = 0) -> None:
         if not (0.0 < max_rate <= 1.0):
             raise ValueError(
                 f"max_rate must be in (0, 1], got {max_rate}")
         if min_lines < 1:
             raise ValueError(f"min_lines must be >= 1, got {min_lines}")
+        if max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
         self.path = path
         self.max_rate = max_rate
         self.min_lines = min_lines
+        self.max_bytes = max_bytes
+        self.rotations = 0
         self.quarantined = 0
         self.seen = 0
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")  # noqa: SIM115 - long-lived
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
         self._gauge = REGISTRY.gauge(
             "cooc_quarantined_lines_total",
             help="malformed input lines diverted to the dead-letter file")
+
+    def _rotate(self) -> None:
+        """Roll the active file to ``path.1`` (shifting older backups
+        up, deleting past the keep window) and reopen fresh."""
+        self._f.close()
+        try:
+            os.remove(f"{self.path}.{QUARANTINE_BACKUPS}")
+        except OSError:
+            pass
+        for i in range(QUARANTINE_BACKUPS - 1, 0, -1):
+            try:
+                os.replace(f"{self.path}.{i}", f"{self.path}.{i + 1}")
+            except OSError:
+                continue
+        try:
+            os.replace(self.path, f"{self.path}.1")
+        except OSError as exc:
+            LOG.warning("dead-letter rotation failed (%s); continuing "
+                        "in the oversized active file", exc)
+        self._f = open(self.path, "a", encoding="utf-8")  # noqa: SIM115
+        self._bytes = os.path.getsize(self.path)
+        self.rotations += 1
+        LOG.info("dead-letter file rotated (%d rotation(s) this run; "
+                 "keeping %d backup(s))", self.rotations,
+                 QUARANTINE_BACKUPS)
 
     def note_lines(self, n: int) -> None:
         """Count ``n`` lines entering the parser (the rate denominator)."""
@@ -86,8 +133,13 @@ class Quarantine:
             "reason": str(reason)[:200],
             "wall_unix": round(time.time(), 3),
         }
-        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        if (self.max_bytes > 0 and self._bytes > 0
+                and self._bytes + len(line.encode()) > self.max_bytes):
+            self._rotate()
+        self._f.write(line)
         self._f.flush()
+        self._bytes += len(line.encode())
         self.quarantined += 1
         self._gauge.add(1)
         LOG.warning("quarantined %s:%d (%d so far): %s",

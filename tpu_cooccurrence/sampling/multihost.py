@@ -93,18 +93,21 @@ def _counter_names() -> List[str]:
 def _allgather_ragged(vec: np.ndarray) -> List[np.ndarray]:
     """Gather a per-process int64 vector from every process.
 
-    ``process_allgather`` needs equal shapes, so lengths go first and the
+    The allgather needs equal shapes, so lengths go first and the
     payload is padded to the global max — two collective rounds total,
     which is why callers pack everything they exchange into ONE vector.
+    Rides :func:`~tpu_cooccurrence.parallel.distributed
+    .guarded_allgather` so a dead peer trips the collective-entry
+    watchdog (supervised exit) instead of wedging the sampler forever.
     """
-    from jax.experimental import multihost_utils
+    from ..parallel.distributed import guarded_allgather
 
-    lens = multihost_utils.process_allgather(
+    lens = guarded_allgather(
         np.asarray([len(vec)], dtype=np.int64))  # [P, 1]
     m = max(int(lens.max()), 1)
     padded = np.zeros(m, vec.dtype)
     padded[: len(vec)] = vec
-    gathered = multihost_utils.process_allgather(padded)  # [P, m]
+    gathered = guarded_allgather(padded)  # [P, m]
     return [gathered[p][: int(lens[p, 0])]
             for p in range(gathered.shape[0])]
 

@@ -33,6 +33,30 @@ Durability (the recovery-loop contract, ``tests/test_chaos.py``):
 * Orphaned ``*.tmp`` files (a crash between ``mkstemp`` and
   ``os.replace``) are swept by the next :func:`save` once they are old
   enough to be provably dead.
+* **Directory durability** — after every atomic rename (generation file,
+  ``LATEST`` pointer, epoch marker) the *directory* is fsynced too:
+  rename alone only orders the pointer change in the page cache, and a
+  power loss could resurrect the old directory entry under a new
+  ``LATEST`` — a torn-pointer window the digest cannot see because both
+  files verify.
+
+Multi-host epoch commit (the gang contract, ``robustness/gang.py``):
+each process of a multi-controller run checkpoints its own row block as
+``state.p<i>.<gen>.npz``, which makes "the checkpoint" a *set* of files
+whose partial existence is a torn global state. :func:`save` therefore
+commits per-host files in two phases: after its own rename + directory
+fsync every process enters a window-aligned ``gang_barrier`` (all
+processes checkpoint at the same fired-window ordinal, so the barrier
+is deterministic), and only once every host's file is durable does each
+process write its own ``EPOCH.p<i>.<gen>`` marker. A generation is
+*committed* on a host iff its marker exists; restore walks committed
+generations only, and the gang supervisor's restore vote
+(:func:`gang.agree_restore_generation`) allgathers each host's newest
+committed generation and quarantines anything newer as ``*.partial`` —
+so a crash anywhere between the first per-host rename and the last
+marker write falls back exactly one generation on every host instead of
+restoring a torn mix. Single-process runs write no markers and restore
+exactly as before.
 """
 
 from __future__ import annotations
@@ -66,6 +90,16 @@ QUARANTINE_GAUGE = "cooc_checkpoint_quarantined_total"
 #: :func:`restore` (generation restored).
 GENERATION_GAUGE = "cooc_checkpoint_generation"
 
+#: Multi-host epoch gauge: the newest generation whose ``EPOCH`` marker
+#: this process has written (save) or restored from. Stays 0 on
+#: single-process runs (no epoch plane).
+EPOCH_GAUGE = "cooc_epoch_committed"
+
+#: Partial-generation quarantine counter: per-host generation files
+#: newer than the gang's agreed committed epoch, moved aside as
+#: ``*.partial`` before restore.
+PARTIAL_GAUGE = "cooc_checkpoint_partial_total"
+
 
 class CheckpointCorrupt(ValueError):
     """A checkpoint file failed to load or verify its digest."""
@@ -84,6 +118,107 @@ def _gen_path(directory: str, suffix: str, gen: int) -> str:
 
 def _latest_path(directory: str, suffix: str) -> str:
     return os.path.join(directory, f"LATEST{suffix}")
+
+
+def _epoch_path(directory: str, suffix: str, gen: int) -> str:
+    return os.path.join(directory, f"EPOCH{suffix}.{gen}")
+
+
+def _fsync_dir(directory: str) -> None:
+    """fsync the directory itself so a just-committed rename survives
+    power loss — ``os.replace`` alone only updates the in-cache
+    directory entry; the journal flush that makes it durable needs an
+    explicit fsync on the directory fd. Best-effort: a filesystem
+    without directory fds (or a permission quirk) must not fail the
+    checkpoint it is trying to harden."""
+    try:
+        fd = os.open(directory, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def epoch_markers(directory: str, suffix: str) -> "list[int]":
+    """Committed-epoch markers for this process suffix, newest first."""
+    pat = re.compile(rf"^EPOCH{re.escape(suffix)}\.(\d+)$")
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted((int(m.group(1)) for m in map(pat.match, names) if m),
+                  reverse=True)
+
+
+def committed_generations(directory: str,
+                          suffix: str) -> "list[tuple[int, str]]":
+    """Restorable generations *committed* for this process suffix,
+    newest first.
+
+    Multi-host only (``suffix`` non-empty callers): a generation counts
+    as committed iff its ``EPOCH<suffix>.<gen>`` marker exists — the
+    marker is written only after the whole gang's files were durable,
+    so a generation without one may be a torn global state. Directories
+    with generation files but NO markers at all are legacy (pre-epoch)
+    checkpoints and restore as before, with a warning.
+    """
+    gens = generations(directory, suffix)
+    marked = set(epoch_markers(directory, suffix))
+    if not marked:
+        if gens:
+            LOG.warning(
+                "checkpoint dir %s has generations for suffix %r but no "
+                "EPOCH markers (written by a pre-epoch-commit version); "
+                "restoring without global-commit protection", directory,
+                suffix)
+        return gens
+    return [(g, p) for g, p in gens if g in marked]
+
+
+def newest_committed(directory: str, suffix: str) -> int:
+    """Newest committed generation for this suffix, or -1 when none —
+    the per-process input to the gang's restore vote."""
+    gens = committed_generations(directory, suffix)
+    return gens[0][0] if gens else -1
+
+
+def quarantine_uncommitted(directory: str, suffix: str,
+                           above_gen: int) -> "list[int]":
+    """Move this suffix's generation files newer than ``above_gen``
+    aside as ``*.partial`` (and drop their markers, if any): the gang's
+    restore vote agreed on ``above_gen``, so anything newer on this
+    host is part of a torn global commit no host may restore. Returns
+    the quarantined generation numbers."""
+    out = []
+    for gen, path in generations(directory, suffix):
+        if gen <= above_gen:
+            continue
+        try:
+            os.replace(path, path + ".partial")
+        except OSError as exc:
+            LOG.error("could not quarantine uncommitted generation %d "
+                      "(%s): %s", gen, path, exc)
+            continue
+        try:
+            os.remove(_epoch_path(directory, suffix, gen))
+        except OSError:
+            pass
+        out.append(gen)
+        REGISTRY.gauge(
+            PARTIAL_GAUGE,
+            help="per-host checkpoint generations newer than the gang's "
+                 "agreed epoch, moved aside as *.partial").add(1)
+        LOG.warning("quarantined uncommitted checkpoint generation %d "
+                    "(%s -> *.partial): the gang's committed epoch is %d",
+                    gen, path, above_gen)
+    if out:
+        _update_latest(directory, suffix)
+        _fsync_dir(directory)
+    return out
 
 
 def generations(directory: str, suffix: str) -> "list[tuple[int, str]]":
@@ -222,13 +357,14 @@ def step_back(directory: str, suffix: str = "") -> "int | None":
 
 def _sweep_aged_quarantine(directory: str, suffix: str,
                            oldest_kept: int) -> None:
-    """Delete ``*.corrupt`` quarantine files whose generation has aged
+    """Delete quarantine files (``*.corrupt`` digest failures and
+    ``*.partial`` uncommitted-epoch fallout) whose generation has aged
     out of the retain window (generation < ``oldest_kept``). The legacy
     un-numbered ``state<suffix>.npz.corrupt`` counts as generation 0.
     Called by :func:`save` alongside generation retention so the two
     windows can never drift apart."""
     pat = re.compile(
-        rf"^state{re.escape(suffix)}\.(\d+)\.npz\.corrupt$")
+        rf"^state{re.escape(suffix)}\.(\d+)\.npz\.(?:corrupt|partial)$")
     legacy = os.path.basename(_legacy_path(directory, suffix)) + ".corrupt"
     try:
         names = os.listdir(directory)
@@ -411,15 +547,52 @@ def save(job, directory: str, source=None) -> str:
     # pointer is advisory, never load-bearing. Quarantine and step-back
     # refresh it so it never names a gone file.
     _update_latest(directory, suffix)
+    # The ckpt_commit site sits exactly inside the torn-pointer window:
+    # the generation file is renamed into place but neither the
+    # directory entry nor the gang's epoch marker is durable yet — a
+    # crash here is the power-loss shape the directory fsync below (and,
+    # multi-host, the epoch commit) exists to contain. seq = generation,
+    # so chaos specs address "the generation-N commit", not a window.
+    if faults.PLAN is not None:
+        faults.PLAN.fire("ckpt_commit", seq=gen)
+    _fsync_dir(directory)
+    if suffix:
+        # Multi-host epoch commit: my generation file is durable; wait
+        # until EVERY host's is (all processes checkpoint at the same
+        # fired-window ordinal, so this barrier is deterministic), then
+        # mark the generation committed on this host. A crash anywhere
+        # before the marker rename leaves the generation uncommitted
+        # here — the gang's restore vote then drags every host back to
+        # the previous epoch (gang.agree_restore_generation).
+        from ..parallel.distributed import gang_barrier
+
+        gang_barrier(f"ckpt/{gen}")
+        epoch_tmp = _epoch_path(directory, suffix, gen) + ".tmp"
+        with open(epoch_tmp, "w") as f:
+            f.write(f"{gen}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(epoch_tmp, _epoch_path(directory, suffix, gen))
+        _fsync_dir(directory)
+        REGISTRY.gauge(
+            EPOCH_GAUGE,
+            help="newest checkpoint generation whose gang epoch marker "
+                 "this process committed (multi-host only)").set(gen)
     # Retention: keep the newest N generations (quarantined/rolled-back
-    # files keep their renamed forms and are not counted).
+    # files keep their renamed forms and are not counted). Epoch markers
+    # age out with their generation files.
     retain = max(1, getattr(job.config, "checkpoint_retain", 3))
     survivors = generations(directory, suffix)
-    for _old_gen, old_path in survivors[retain:]:
+    for old_gen, old_path in survivors[retain:]:
         try:
             os.remove(old_path)
         except OSError:
             pass
+        if suffix:
+            try:
+                os.remove(_epoch_path(directory, suffix, old_gen))
+            except OSError:
+                pass
     # Quarantined *.corrupt files beyond the retain window age out too:
     # they exist for operator forensics on RECENT generations, and
     # without a sweep a long-running crashy job accumulates them

@@ -67,7 +67,11 @@ LOG = logging.getLogger("tpu_cooccurrence.supervisor")
 _SUPERVISOR_FLAGS = ("--restart-on-failure", "--restart-delay-ms",
                      "--restart-backoff-base-ms", "--restart-backoff-max-ms",
                      "--crash-loop-threshold", "--crash-loop-window-s",
-                     "--watchdog-stale-after-s")
+                     "--watchdog-stale-after-s",
+                     # Gang-supervisor policy (robustness/gang.py): a
+                     # gang worker must run the job directly, not spawn
+                     # a nested gang.
+                     "--gang-workers")
 
 #: ``EX_CONFIG`` from sysexits(3): the CLI exits with it on a
 #: configuration ValueError, and argparse exits 2 on usage errors.
