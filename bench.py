@@ -89,6 +89,10 @@ def run(backend: str, users, items, ts, num_items: int, window_ms: int,
             REGISTRY.gauge("cooc_fused_dispatches_total").get()),
         "chained_dispatches": int(
             REGISTRY.gauge("cooc_chained_dispatches_total").get()),
+        # Fused-sparse shape specialization: distinct fused-program
+        # shapes compiled (per-bucket churn; 0 on the chained path).
+        "fused_bucket_compilations": int(
+            REGISTRY.gauge("cooc_fused_bucket_compilations_total").get()),
     }
     # Compressed-state accounting (sparse backend; zeros elsewhere): the
     # raw-vs-encoded uplink pair from the ledger, plus the host index /
@@ -316,7 +320,8 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
                    pipeline_depth: int, occupancy: dict,
                    latency: dict = None, degradation: dict = None,
                    fused: dict = None, compression: dict = None,
-                   serving: dict = None, spill: dict = None) -> None:
+                   serving: dict = None, spill: dict = None,
+                   fused_sparse: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -356,6 +361,12 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         # eviction/promotion counters, hot-row hit rate and the
         # bit-identity verdict — the elastic-state headline numbers.
         entry["spill"] = spill
+    if fused_sparse:
+        # The PR-11 fused-SPARSE A/B: one-dispatch sparse window vs the
+        # chained sparse path (pairs/s ratio, per-window uplink bytes,
+        # bucket compile counts) — trajectory-visible like the dense
+        # fused arm, CPU-neutrality included.
+        entry["fused_sparse"] = fused_sparse
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -518,6 +529,47 @@ def measure() -> None:
         },
     }
 
+    # Fused-SPARSE A/B arm (--fused-window auto on the sparse backend):
+    # chained vs fused over the same truncated stream as the compression
+    # arm, compressed defaults on BOTH arms (int16 cells + packed wire —
+    # the fused program decodes the packed uplink in its prologue, so
+    # the two levers compose under measurement). On a real chip this is
+    # the one-dispatch sparse window; on CPU auto resolves OFF and the
+    # arm re-measures the chained path — the CPU-neutrality check
+    # (vs_chained ~ 1.0, zero fused dispatches), exactly like the dense
+    # fused arm. Per-arm untimed warmup, median of three; per-window
+    # uplink bytes ride the ledger-fed histogram, bucket compile counts
+    # ride the shape-specialization gauge.
+    def _sparse_fused_arm(fused):
+        run("sparse", cu, ci, ct, num_items=n_items, window_ms=100,
+            wire_format="packed", cell_dtype="int16",
+            fused_window=fused)  # warmup (compiles)
+        arm = []
+        for _ in range(3):
+            s_pairs, s_elapsed, _, s_lat, _, s_disp, s_wire, _ = run(
+                "sparse", cu, ci, ct, num_items=n_items, window_ms=100,
+                wire_format="packed", cell_dtype="int16",
+                fused_window=fused)
+            arm.append((s_pairs / max(s_elapsed, 1e-9), s_lat, s_disp,
+                        s_wire))
+        arm.sort(key=lambda s: s[0])
+        return arm[1]
+
+    sc_rate, sc_lat, _sc_disp, sc_wire = _sparse_fused_arm("off")
+    sf_rate, sf_lat, sf_disp, sf_wire = _sparse_fused_arm("auto")
+    sf_windows = max(sf_wire["windows"], 1)
+    fused_sparse = {
+        "mode": "auto",
+        "pairs_per_sec_chained": round(sc_rate, 1),
+        "pairs_per_sec_fused": round(sf_rate, 1),
+        "vs_chained": round(sf_rate / max(sc_rate, 1e-9), 3),
+        "uplink_bytes_per_window": _uplink_per_window(sf_lat),
+        "chained_uplink_bytes_per_window": _uplink_per_window(sc_lat),
+        "uplink_bytes_encoded_per_window": round(
+            sf_wire["uplink_bytes_encoded"] / sf_windows, 1),
+        **sf_disp,
+    }
+
     # Tiered-state (spill) A/B arm (PR 9): the SAME long-tail churn
     # stream through the sparse backend with tiering off vs on. The
     # headline pair is deterministic footprint, not timing — effective
@@ -611,6 +663,7 @@ def measure() -> None:
         "latency": latency,
         "degradation": degradation,
         "fused": fused_info,
+        "fused_sparse": fused_sparse,
         "compression": compression,
         "spill": spill_info,
         "serving": serving_storm,
@@ -634,7 +687,8 @@ def measure() -> None:
     else:
         _record_onchip(out["value"], out["vs_baseline"], backend,
                        pipeline_depth, occupancy, latency, degradation,
-                       fused_info, compression, serving_storm, spill_info)
+                       fused_info, compression, serving_storm, spill_info,
+                       fused_sparse)
     print(json.dumps(out))
 
 

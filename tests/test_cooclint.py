@@ -714,6 +714,80 @@ def test_pallas_kernel_registry_flags_empty_registry(tmp_path):
     assert "no pallas_call entry points" in result.findings[0].message
 
 
+def test_pallas_kernel_registry_scans_beyond_pallas_score(tmp_path):
+    """The rule's scope is the whole package: a fused-sparse kernel that
+    grew inside state/ (not ops/pallas_score.py) needs the same parity
+    surface + ARCHITECTURE row — uncovered, it is two findings anchored
+    at ITS file."""
+    root = _mini_pallas_repo(
+        tmp_path,
+        test_body="def test_parity():\n    assert my_kernel_wrapper\n",
+        arch_body="| `_my_kernel_core` | streaming thing |\n")
+    state = root / "tpu_cooccurrence" / "state"
+    state.mkdir()
+    (state / "fused_sparse.py").write_text(
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def _slab_decode_kernel(x):\n"
+        "    return pl.pallas_call(None)(x)\n")
+    result = Analyzer(str(root), rules=[RULES["pallas-kernel-registry"]],
+                      baseline=[]).run()
+    assert sorted(f.message.split("'")[1] for f in result.findings) == \
+        ["_slab_decode_kernel", "_slab_decode_kernel"]
+    assert all(f.file.endswith("state/fused_sparse.py")
+               for f in result.findings)
+
+
+def test_pallas_kernel_registry_survives_missing_anchor_file(tmp_path):
+    """A vanished ops/pallas_score.py must not silently waive the rule:
+    kernels elsewhere in the package are still checked, and a repo with
+    no kernels at all yields the registry-gone finding."""
+    root = _mini_pallas_repo(
+        tmp_path,
+        test_body="def test_nothing():\n    pass\n",
+        arch_body="# arch\n")
+    (root / "tpu_cooccurrence" / "ops" / "pallas_score.py").unlink()
+    state = root / "tpu_cooccurrence" / "state"
+    state.mkdir()
+    (state / "fused_sparse.py").write_text(
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def _slab_decode_kernel(x):\n"
+        "    return pl.pallas_call(None)(x)\n")
+    result = Analyzer(str(root), rules=[RULES["pallas-kernel-registry"]],
+                      baseline=[]).run()
+    assert len(result.findings) == 2  # untested + un-documented
+    assert all("_slab_decode_kernel" in f.message for f in result.findings)
+    # With that kernel gone too there is nothing to guard — and no
+    # anchor file, so fixture repos for OTHER rules stay silent here
+    # (the registry-gone finding needs ops/pallas_score.py to exist).
+    (state / "fused_sparse.py").write_text("def plain(x):\n    return x\n")
+    result = Analyzer(str(root), rules=[RULES["pallas-kernel-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_pallas_kernel_registry_covers_out_of_tree_kernel_via_wrapper(
+        tmp_path):
+    """Same out-of-ops kernel, but with a same-module wrapper referenced
+    from tests/ and an ARCHITECTURE row: clean — the one-hop wrapper
+    contract applies uniformly across the package."""
+    root = _mini_pallas_repo(
+        tmp_path,
+        test_body="def test_parity():\n    assert my_kernel_wrapper\n"
+                  "def test_slab():\n    assert slab_decode\n",
+        arch_body="| `_my_kernel_core` | x |\n| `_slab_decode_kernel` |\n")
+    state = root / "tpu_cooccurrence" / "state"
+    state.mkdir()
+    (state / "fused_sparse.py").write_text(
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def _slab_decode_kernel(x):\n"
+        "    return pl.pallas_call(None)(x)\n\n\n"
+        "def slab_decode(x):\n"
+        "    return _slab_decode_kernel(x)\n")
+    result = Analyzer(str(root), rules=[RULES["pallas-kernel-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
 # -- rule pack 8: serving route registry --------------------------------
 
 

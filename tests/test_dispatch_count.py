@@ -23,8 +23,10 @@ class DispatchCounter:
 
     TRACKED = ("_apply_update", "_apply_moves_update",
                "_apply_update_chunked", "_apply_moves_update_chunked",
+               "_apply_update_packed", "_apply_moves_update_packed",
                "_score_slab", "_score_into_table",
-               "_score_window_into_table", "_grow", "_compact_gather")
+               "_score_window_into_table", "_grow", "_compact_gather",
+               "_fused_sparse_window_packed", "_fused_sparse_window_raw")
 
     def __init__(self, monkeypatch):
         self.counts = {name: 0 for name in self.TRACKED}
@@ -49,7 +51,14 @@ class DispatchCounter:
         return (self.counts["_apply_update"]
                 + self.counts["_apply_moves_update"]
                 + self.counts["_apply_update_chunked"]
-                + self.counts["_apply_moves_update_chunked"])
+                + self.counts["_apply_moves_update_chunked"]
+                + self.counts["_apply_update_packed"]
+                + self.counts["_apply_moves_update_packed"])
+
+    @property
+    def fused(self):
+        return (self.counts["_fused_sparse_window_packed"]
+                + self.counts["_fused_sparse_window_raw"])
 
     @property
     def window_scores(self):
@@ -158,6 +167,94 @@ def test_variable_mode_defer_still_one_update(monkeypatch):
         assert counter.window_scores == 0
         assert counter.counts["_score_slab"] == 0  # defer: no downlink
         assert counter.counts["_score_into_table"] >= 1
+
+
+def _clique_window(n_items: int = 40):
+    """All ordered pairs of an n-item clique: the first window allocates
+    every cell, every later identical window touches ONLY existing cells
+    — the zero-relocation steady state the fused path owns."""
+    items = np.arange(n_items)
+    src, dst = np.meshgrid(items, items)
+    sel = src != dst
+    return PairDeltaBatch(src[sel].ravel().astype(np.int64),
+                          dst[sel].ravel().astype(np.int64),
+                          np.ones(int(sel.sum()), dtype=np.int32))
+
+
+@pytest.mark.parametrize("wire", ["packed", "raw"])
+def test_fused_sparse_steady_state_is_one_dispatch(monkeypatch, wire):
+    """--fused-window on, sparse backend: a steady-state window (no
+    relocation, no promotion, no growth) is exactly ONE device dispatch
+    — the fused program; no separate update or score dispatch leaks."""
+    from tpu_cooccurrence.observability.registry import REGISTRY
+
+    counter = DispatchCounter(monkeypatch)
+    scorer = ss.SparseDeviceScorer(
+        top_k=5, defer_results=True, fused_window="on", wire_format=wire,
+        cell_dtype="int16" if wire == "packed" else "int32",
+        capacity=1 << 16, items_capacity=1 << 10)
+    pairs = _clique_window()
+    fused_gauge = REGISTRY.gauge("cooc_fused_dispatches_total")
+    chained_gauge = REGISTRY.gauge("cooc_chained_dispatches_total")
+    for w in range(3):  # warmup: allocation, growth, first compiles
+        scorer.process_window(w * 10, pairs)
+    f0, c0 = fused_gauge.get(), chained_gauge.get()
+    for w in range(3, 10):
+        counter.reset()
+        scorer.process_window(w * 10, pairs)
+        assert counter.fused == 1, (
+            f"window {w}: {counter.fused} fused dispatches "
+            f"({counter.counts})")
+        assert counter.updates == 0, (
+            f"window {w}: update dispatch leaked out of the fused "
+            f"program ({counter.counts})")
+        assert counter.window_scores == 0 and counter.bucket_scores == 0, (
+            f"window {w}: score dispatch leaked out of the fused "
+            f"program ({counter.counts})")
+        assert counter.counts["_grow"] == 0
+        assert counter.counts["_compact_gather"] == 0
+    # The routing gauges split accordingly: 7 fused, 0 chained.
+    assert fused_gauge.get() - f0 == 7
+    assert chained_gauge.get() - c0 == 0
+    # Shape specialization is bounded: the identical windows compiled
+    # exactly one fused program shape.
+    assert REGISTRY.gauge("cooc_fused_bucket_compilations_total").get() >= 1
+
+
+def test_fused_sparse_relocation_window_falls_back_chained(monkeypatch):
+    """A window that relocates rows (new cells outgrow pow2 caps) routes
+    chained — plan.mv rides the chained moves+update dispatch — and the
+    very next steady window is fused again; the gauges split per
+    window."""
+    from tpu_cooccurrence.observability.registry import REGISTRY
+
+    counter = DispatchCounter(monkeypatch)
+    scorer = ss.SparseDeviceScorer(
+        top_k=5, defer_results=True, fused_window="on",
+        wire_format="packed", capacity=1 << 16, items_capacity=1 << 10)
+    pairs = _clique_window(24)
+    for w in range(3):
+        scorer.process_window(w * 10, pairs)
+    fused_gauge = REGISTRY.gauge("cooc_fused_dispatches_total")
+    chained_gauge = REGISTRY.gauge("cooc_chained_dispatches_total")
+    f0, c0 = fused_gauge.get(), chained_gauge.get()
+    # Every row gains 40 new partners: caps (pow2 of 23) outgrow, rows
+    # relocate, the window MUST route chained.
+    counter.reset()
+    grow = _clique_window(64)
+    scorer.process_window(100, grow)
+    assert counter.fused == 0, counter.counts
+    assert counter.updates == 1, counter.counts
+    assert scorer.last_dispatch_fused is False
+    assert chained_gauge.get() - c0 == 1
+    # Steady again: the relocated layout syncs through the registry
+    # delta and the next window is back to one fused dispatch.
+    counter.reset()
+    scorer.process_window(110, grow)
+    assert counter.fused == 1, counter.counts
+    assert counter.updates == 0, counter.counts
+    assert scorer.last_dispatch_fused is True
+    assert fused_gauge.get() - f0 == 1
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")

@@ -314,14 +314,17 @@ def test_fused_registry_counters_and_journal(tmp_path):
 
 
 def test_fused_window_config_validation():
-    with pytest.raises(ValueError, match="device only"):
-        Config(window_size=10, backend=Backend.SPARSE, fused_window="on")
+    with pytest.raises(ValueError, match="device or sparse"):
+        Config(window_size=10, backend=Backend.ORACLE, fused_window="on")
     with pytest.raises(ValueError, match="tumbling"):
         Config(window_size=10, window_slide=5, fused_window="on")
     with pytest.raises(ValueError, match="auto"):
         Config(window_size=10, fused_window="sometimes")
-    # auto rides along anywhere (it only engages on the device backend).
-    Config(window_size=10, backend=Backend.SPARSE, fused_window="auto")
+    # Single-process sparse accepts a forced 'on' since the fused sparse
+    # window landed (its own validation lives in test_fused_sparse.py);
+    # auto still rides along anywhere.
+    Config(window_size=10, backend=Backend.SPARSE, fused_window="on")
+    Config(window_size=10, backend=Backend.SHARDED, fused_window="auto")
 
 
 # -- satellite: COO chunk pad-slot guard --------------------------------

@@ -10,10 +10,22 @@ bars either way.
 import json
 
 import numpy as np
+import pytest
 
 from tpu_cooccurrence.bench import ml25m, tpu_round2
 from tpu_cooccurrence.bench.ml25m import (PSUM_LATENCY_DEFAULT_S,
                                           measured_psum_latency)
+
+
+@pytest.fixture(scope="module")
+def measured_20k():
+    """ONE 20k-event measured run shared by every projection test: the
+    monkeypatched capture file only changes :func:`ml25m.project_v5e8`'s
+    constants (arithmetic), never the measured stream numbers — so the
+    expensive measurement half runs once per module, not per test."""
+    with pytest.MonkeyPatch.context() as mp:
+        mp.delenv("MOVIELENS_25M", raising=False)  # stand-in stream
+        return ml25m.measure_full(20_000, host_only=False)
 
 
 def test_psum_default_when_no_capture(tmp_path, monkeypatch):
@@ -72,7 +84,8 @@ def test_projection_constants_reject_cpu_tagged_rows(tmp_path,
     assert s is None
 
 
-def test_projection_point_uses_measured_overhead(tmp_path, monkeypatch):
+def test_projection_point_uses_measured_overhead(tmp_path, monkeypatch,
+                                                 measured_20k):
     """VERDICT r4 Next #7: once a sharded-pallas-1chip capture exists,
     the projection's per-window collective term is the measured
     shard_map+psum overhead — zero assumed constants — and the source
@@ -86,8 +99,7 @@ def test_projection_point_uses_measured_overhead(tmp_path, monkeypatch):
                             "sharded_overhead_ms_per_window": 1.25,
                             "ts": "2026-03-04 00:00:00"}) + "\n")
     monkeypatch.setattr(tpu_round2, "OUT", str(out_file))
-    monkeypatch.delenv("MOVIELENS_25M", raising=False)
-    out = ml25m.run_full(20_000, host_only=False)
+    out = ml25m.project_v5e8(measured_20k)
     assert out["psum_latency_s"] == 1.25e-3
     assert "measured 1-chip shard_map+psum" in out["psum_latency_source"]
     assert "2026-03-04" in out["psum_latency_source"]
@@ -105,7 +117,8 @@ def test_projection_point_uses_measured_overhead(tmp_path, monkeypatch):
         round(host + dev / 8 + w * 8.0e-3, 2), atol=0.011)
 
 
-def test_projection_carries_error_bars(tmp_path, monkeypatch):
+def test_projection_carries_error_bars(tmp_path, monkeypatch,
+                                       measured_20k):
     """run_full's projection reports point, range, and both constants'
     provenance; a measured tunnel RTT bounds the range from above but
     must NOT inflate the point estimate (tunnel transport is not an
@@ -116,8 +129,7 @@ def test_projection_carries_error_bars(tmp_path, monkeypatch):
                             "sync_ms_per_dispatch": 8.0,
                             "ts": "2026-03-03 00:00:00"}) + "\n")
     monkeypatch.setattr(tpu_round2, "OUT", str(out_file))
-    monkeypatch.delenv("MOVIELENS_25M", raising=False)
-    out = ml25m.run_full(20_000, host_only=False)
+    out = ml25m.project_v5e8(measured_20k)
     assert out["synthetic_standin"] is True
     low, high = out["v5e8_projected_range"]
     assert low <= out["v5e8_projected_seconds"] <= high
@@ -135,13 +147,13 @@ def test_projection_carries_error_bars(tmp_path, monkeypatch):
         high, round(host + dev / 8 + w * 8.0e-3, 2), atol=0.011)
 
 
-def test_partitioned_projection_labeled(tmp_path, monkeypatch):
+def test_partitioned_projection_labeled(tmp_path, monkeypatch,
+                                        measured_20k):
     """The secondary host-partitioned projection must be present,
     follow host/8 + device/8 + windows*psum, and carry the
     assumed-linear-scaling label (it is arithmetic, not measurement)."""
     monkeypatch.setattr(tpu_round2, "OUT", str(tmp_path / "none.jsonl"))
-    monkeypatch.delenv("MOVIELENS_25M", raising=False)
-    out = ml25m.run_full(20_000, host_only=False)
+    out = ml25m.project_v5e8(measured_20k)
     host = out["host_sample_seconds"]
     dev = out["device_score_seconds"]
     w = out["windows"]

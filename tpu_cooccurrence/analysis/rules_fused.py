@@ -17,16 +17,24 @@ Coverage is one call hop wide: a private kernel core (e.g.
 wrapper that calls it is referenced from ``tests/`` — the wrappers are
 the public surface the tests drive. AST-checked (nothing imported) and
 baseline-free by construction, mirroring the ``degrade-registry`` rule.
+
+Scope (extended for the fused-sparse plane): EVERY module under
+``tpu_cooccurrence/`` is scanned for ``pallas_call`` entry points, not
+just ``ops/pallas_score.py`` — a fused-sparse program that grew its own
+kernel in ``state/`` must register a parity surface and an ARCHITECTURE
+kernel-table row exactly like the ops-layer kernels (wrapper coverage
+stays one hop wide *within the defining module*).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, Optional, Set
+from typing import Dict, Iterable, Set
 
-from .core import FileContext, Finding, RepoContext, Rule, register
+from .core import Finding, RepoContext, Rule, register
 
 _PALLAS_PATH = "tpu_cooccurrence/ops/pallas_score.py"
+_PKG_PREFIX = "tpu_cooccurrence/"
 _ARCH_PATH = "docs/ARCHITECTURE.md"
 
 
@@ -80,47 +88,59 @@ def _test_referenced_names(repo: RepoContext) -> Set[str]:
 @register
 class FusedKernelRegistryRule(Rule):
     name = "pallas-kernel-registry"
-    description = ("every Pallas kernel entry point in ops/pallas_score.py "
-                   "needs a registered parity test (referenced from tests/, "
-                   "directly or via a calling wrapper) and a row in the "
+    description = ("every Pallas kernel entry point under "
+                   "tpu_cooccurrence/ needs a registered parity test "
+                   "(referenced from tests/, directly or via a calling "
+                   "wrapper in the same module) and a row in the "
                    "ARCHITECTURE Pallas kernel table")
 
     def finalize(self, repo: RepoContext) -> Iterable[Finding]:
-        src: Optional[FileContext] = next(
-            (c for c in repo.files if c.path == _PALLAS_PATH), None)
-        if src is None or src.tree is None:
+        # No anchor-file gate: a vanished/unparseable ops/pallas_score.py
+        # must not silently waive the rule for kernels elsewhere in the
+        # package (the state-store-registry rule's vanished-ARCHITECTURE
+        # precedent) — the package-wide scan below is the whole gate.
+        sources = [c for c in repo.python_files()
+                   if c.path.startswith(_PKG_PREFIX) and c.tree is not None]
+        per_file = [(ctx, _kernel_entry_points(ctx.tree))
+                    for ctx in sources]
+        if not any(kernels for _ctx, kernels in per_file):
+            # The registry-gone finding is anchored on the kernel home
+            # module existing at all — fixture repos for OTHER rules
+            # carry no ops/pallas_score.py and are not kernel registries.
+            if any(c.path == _PALLAS_PATH for c in repo.files):
+                yield Finding(
+                    rule=self.name, file=_PALLAS_PATH, line=1,
+                    message="no pallas_call entry points found (the "
+                            "kernel registry this rule guards is gone)")
             return
-        kernels = _kernel_entry_points(src.tree)
-        if not kernels:
-            yield Finding(
-                rule=self.name, file=_PALLAS_PATH, line=1,
-                message="no pallas_call entry points found (the kernel "
-                        "registry this rule guards is gone)")
-            return
-        functions = _module_functions(src.tree)
-        # Wrappers: module-level functions that call a kernel entry point
-        # (one hop — the public surface parity tests drive).
-        callers: Dict[str, Set[str]] = {k: set() for k in kernels}
-        for name, fn in functions.items():
-            for callee in _called_names(fn) & set(kernels):
-                if name != callee:
-                    callers[callee].add(name)
         refs = _test_referenced_names(repo)
         arch = next((c for c in repo.files if c.path == _ARCH_PATH), None)
-        for kernel, fn in sorted(kernels.items()):
-            covered = kernel in refs or bool(callers[kernel] & refs)
-            if not covered:
-                yield Finding(
-                    rule=self.name, file=_PALLAS_PATH, line=fn.lineno,
-                    message=(f"Pallas kernel entry point {kernel!r} has no "
-                             f"registered parity test: nothing under "
-                             f"tests/ references it (or a wrapper that "
-                             f"calls it) — a kernel nothing compares "
-                             f"against a reference is a silent-miscompile "
-                             f"risk"))
-            if arch is not None and kernel not in arch.source:
-                yield Finding(
-                    rule=self.name, file=_PALLAS_PATH, line=fn.lineno,
-                    message=(f"Pallas kernel entry point {kernel!r} is not "
-                             f"in {_ARCH_PATH} — add it to the Pallas "
-                             f"kernel table"))
+        for ctx, kernels in per_file:
+            if not kernels:
+                continue
+            functions = _module_functions(ctx.tree)
+            # Wrappers: module-level functions that call a kernel entry
+            # point (one hop within the defining module — the public
+            # surface parity tests drive).
+            callers: Dict[str, Set[str]] = {k: set() for k in kernels}
+            for name, fn in functions.items():
+                for callee in _called_names(fn) & set(kernels):
+                    if name != callee:
+                        callers[callee].add(name)
+            for kernel, fn in sorted(kernels.items()):
+                covered = kernel in refs or bool(callers[kernel] & refs)
+                if not covered:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=fn.lineno,
+                        message=(f"Pallas kernel entry point {kernel!r} "
+                                 f"has no registered parity test: nothing "
+                                 f"under tests/ references it (or a "
+                                 f"wrapper that calls it) — a kernel "
+                                 f"nothing compares against a reference "
+                                 f"is a silent-miscompile risk"))
+                if arch is not None and kernel not in arch.source:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=fn.lineno,
+                        message=(f"Pallas kernel entry point {kernel!r} "
+                                 f"is not in {_ARCH_PATH} — add it to "
+                                 f"the Pallas kernel table"))

@@ -198,12 +198,20 @@ class Config:
     pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = on
     # for int16 counts on a real TPU where it wins 247x, off otherwise —
     # measured, see ops/device_scorer.pallas_auto)
-    fused_window: str = "off"  # one-dispatch fused window path (device
-    # backend, tumbling mode): the sampler uplinks baskets (star ops)
-    # and expansion + count scatter + row sums + LLR + top-K run as ONE
-    # program per shape bucket (ops/pallas_score.pallas_expand_baskets
-    # + ops/device_scorer._fused_window_*); auto = on-chip only — the
-    # CPU fallback stays on the chained scatter+score path
+    fused_window: str = "off"  # one-dispatch fused window path.
+    # device backend (tumbling mode): the sampler uplinks baskets (star
+    # ops) and expansion + count scatter + row sums + LLR + top-K run
+    # as ONE program per shape bucket
+    # (ops/pallas_score.pallas_expand_baskets +
+    # ops/device_scorer._fused_window_*). sparse backend
+    # (single-process, deferred results): packed-wire decode + slab
+    # update scatter + device registry sync + rescore + results-table
+    # scatter run as ONE program per shape bucket
+    # (state/sparse_scorer._fused_sparse_window_*); relocation /
+    # promotion / spill-re-promotion windows route chained per window.
+    # auto = on-chip only — the CPU fallback stays on the chained
+    # scatter+score path
+
     count_dtype: str = "int32"  # dense C cell dtype; int16 halves HBM
     # (reference-style short counts incl. its wraparound, doubles the
     # dense/sharded vocab ceiling)
@@ -534,22 +542,37 @@ class Config:
                 f"--fused-window must be auto|on|off, got "
                 f"{self.fused_window!r}")
         if self.fused_window == "on":
-            # 'auto' may ride along anywhere (it only engages where the
-            # device backend resolves it); a forced 'on' that cannot
-            # engage must fail loudly, not silently run chained.
-            if self.backend not in (Backend.DEVICE,):
+            # 'auto' may ride along anywhere (it only engages where a
+            # fused-capable backend resolves it); a forced 'on' that
+            # cannot engage must fail loudly, not silently run chained.
+            if self.backend == Backend.DEVICE:
+                if self.window_slide is not None:
+                    raise ValueError(
+                        "--fused-window on with --backend device applies "
+                        "to tumbling reservoir sampling; sliding windows "
+                        "stay on the chained path")
+                if self.partition_sampling or self.coordinator is not None:
+                    raise ValueError(
+                        "--fused-window on is single-process only (the "
+                        "partitioned sampler allgathers expanded COO)")
+            elif self.backend in (Backend.SPARSE, Backend.HYBRID):
+                if not sparse_single:
+                    raise ValueError(
+                        "--fused-window on with --backend sparse is "
+                        "single-process only (the sharded-sparse mesh "
+                        "stays on the chained path)")
+                if self.emit_updates:
+                    raise ValueError(
+                        "--fused-window on with --backend sparse needs "
+                        "deferred results (drop --emit-updates): the "
+                        "fused program scatters top-K into the "
+                        "device-resident table, never downlinks per "
+                        "window")
+            else:
                 raise ValueError(
-                    f"--fused-window on is --backend device only (got "
-                    f"{self.backend.value}); other backends stay on the "
-                    f"chained path")
-            if self.window_slide is not None:
-                raise ValueError(
-                    "--fused-window on applies to tumbling reservoir "
-                    "sampling; sliding windows stay on the chained path")
-            if self.partition_sampling or self.coordinator is not None:
-                raise ValueError(
-                    "--fused-window on is single-process only (the "
-                    "partitioned sampler allgathers expanded COO)")
+                    f"--fused-window on is --backend device or sparse "
+                    f"only (got {self.backend.value}); other backends "
+                    f"stay on the chained path")
         if self.pipeline_depth not in (0, 1, 2):
             raise ValueError(
                 f"--pipeline-depth must be 0, 1 or 2, got "
@@ -681,11 +704,15 @@ class Config:
                             "int16 counts on TPU, off otherwise — measured)")
         p.add_argument("--fused-window", choices=["auto", "on", "off"],
                        default="off", dest="fused_window",
-                       help="One-dispatch fused window path (device "
-                            "backend): ship baskets, run expansion + "
-                            "count update + LLR + top-K as one program "
-                            "per shape bucket (auto: on-chip only — the "
-                            "CPU fallback stays on the chained path)")
+                       help="One-dispatch fused window path. device: ship "
+                            "baskets, run expansion + count update + LLR "
+                            "+ top-K as one program per shape bucket. "
+                            "sparse (single-process, deferred results): "
+                            "packed-wire decode + slab update + registry "
+                            "sync + rescore as one program; relocation/"
+                            "promotion/spill windows route chained. "
+                            "(auto: on-chip only — the CPU fallback "
+                            "stays on the chained path)")
         p.add_argument("--count-dtype", choices=["int32", "int16"],
                        default="int32", dest="count_dtype",
                        help="Dense count-matrix cell dtype (int16 halves "

@@ -119,7 +119,7 @@ class CooccurrenceJob:
             from .parallel.distributed import allgather_max
 
             self.degrade.exchange = allgather_max
-        if (getattr(self.scorer, "use_fused", False)
+        if (getattr(self.scorer, "wants_baskets", False)
                 and isinstance(self.sampler, UserReservoirSampler)):
             # Fused-window uplink (--fused-window, ops/device_scorer):
             # the sampler hands the scorer un-expanded baskets — host
@@ -127,7 +127,9 @@ class CooccurrenceJob:
             # fused-routable windows; non-routable ones expand host-side
             # inside the scorer (bit-identical either way). Gated on the
             # tumbling reservoir sampler: sliding/partitioned samplers
-            # stay on the expanded-COO contract.
+            # stay on the expanded-COO contract. Dense backend only
+            # (wants_baskets): the sparse fused path keeps the host
+            # fold — slot allocation needs the aggregated cells anyway.
             self.sampler.emit_baskets = True
         if config.partition_sampling and not self.sliding:
             # Sliding mode is exempt: its partitioned sampler is stateless
@@ -354,7 +356,8 @@ class CooccurrenceJob:
                 wire_format=resolve_wire_format(
                     self.config.wire_format, sparse_single_device=True),
                 spill_threshold_windows=self.config.spill_threshold_windows,
-                spill_target_hbm_frac=self.config.spill_target_hbm_frac))
+                spill_target_hbm_frac=self.config.spill_target_hbm_frac,
+                fused_window=self.config.fused_window))
         if backend == Backend.SHARDED:
             from .parallel.distributed import maybe_multihost_mesh
 

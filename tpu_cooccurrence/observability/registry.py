@@ -78,6 +78,10 @@ CANONICAL_METRICS = frozenset({
     "cooc_chained_dispatches_total",
     "cooc_window_score_seconds_fused",
     "cooc_window_score_seconds_chained",
+    # fused-sparse shape specialization (state/sparse_scorer.py): how
+    # many distinct fused-program shapes (= XLA compiles) the pow2
+    # (ops, touched-rows, registry-delta) bucketing produced
+    "cooc_fused_bucket_compilations_total",
     # checkpoint plane (state/checkpoint.py)
     "cooc_checkpoint_quarantined_total",
     "cooc_checkpoint_generation",

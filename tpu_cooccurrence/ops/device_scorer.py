@@ -596,6 +596,10 @@ class DeviceScorer:
         # update + rescore + top-K run as one program per shape triple.
         # The job enables basket emission iff this resolved True.
         self.use_fused = resolve_fused_flag(fused_window)
+        # Basket uplinks are the DENSE fused path's wire format (the
+        # kernel expands them on chip); the sparse fused path consumes
+        # aggregated deltas instead and leaves this False.
+        self.wants_baskets = self.use_fused
         # Which path the LAST process_window dispatch took — the job's
         # fused-vs-chained wall-time split and journal field read it.
         self.last_dispatch_fused = False

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..observability import LEDGER
+from ..observability.registry import REGISTRY
 from ..robustness import faults
 from ..ops.aggregate import (AggregatedPairs, aggregate_window_coo,
                              distinct_sorted, merge_sorted_insert,
@@ -326,6 +327,83 @@ def _score_window_into_table(tbl, cnt, dst, row_sums, meta_all, observed, *,
     return tbl
 
 
+def _fused_sparse_body(cnt, dst, row_sums, tbl, reg_start, reg_len, upd,
+                       bounds, reg_upd, rows_all, observed, top_k: int,
+                       plan, interpret: bool):
+    """ONE-dispatch fused sparse window (trace body shared by the packed
+    and raw wire forms).
+
+    Stages, in order, all inside one program:
+
+      1. ``_update_body``   — the window's new-cell / delta / row-sum
+                              scatter (Insum-style indirect addressing
+                              into slab cells; pad lanes carry the
+                              sentinel no-op scatter, exactly like the
+                              chained upload).
+      2. registry sync      — ``reg_upd`` ([3, Rp]: row, start, len;
+                              sentinel-padded) scatters the host
+                              registry's dirty rows into the
+                              device-resident (start, len) mirror, so
+                              stage 3 resolves rows to slab rectangles
+                              without a per-window meta upload.
+      3. bucketed rescore   — for each static ``plan`` rectangle, the
+                              touched rows' (start, len) are GATHERED
+                              from the device mirror (the on-device
+                              registry probe) and the SHARED score body
+                              (``_score_rect`` / ``pallas_score_rect``)
+                              scatters packed top-K into the results
+                              table. Pad slots carry ``_SENT`` row ids:
+                              their gathers clamp harmlessly and their
+                              scatter drops, mirroring the chained
+                              path's len==0 padding.
+
+    Sharing ``_update_body`` and ``_rect_into_table`` with the chained
+    dispatches is the bit-parity argument: the fused window cannot
+    drift numerically because there is no second implementation.
+    """
+    cnt, dst, row_sums = _update_body(cnt, dst, row_sums, upd, bounds)
+    reg_start = reg_start.at[reg_upd[0]].set(reg_upd[1], mode="drop")
+    reg_len = reg_len.at[reg_upd[0]].set(reg_upd[2], mode="drop")
+    for R, S, off, use_pl in plan:
+        rowids = jax.lax.slice(rows_all, (off,), (off + S,))
+        meta = jnp.stack([rowids, reg_start[rowids], reg_len[rowids]])
+        tbl = _rect_into_table(tbl, cnt, dst, row_sums, meta, observed,
+                               top_k, R, use_pl, interpret)
+    return cnt, dst, row_sums, tbl, reg_start, reg_len
+
+
+@functools.partial(jax.jit,
+                   donate_argnums=donate_argnums(0, 1, 2, 3, 4, 5),
+                   static_argnames=("n_pad", "top_k", "plan", "interpret"))
+def _fused_sparse_window_packed(cnt, dst, row_sums, tbl, reg_start, reg_len,
+                                words_i, words_v, header, reg_upd, rows_all,
+                                observed, *, n_pad: int, top_k: int, plan,
+                                interpret: bool = False):
+    """Packed-wire form: the PR-7 bit-packed uplink is decoded by the
+    ``decode_update`` prologue (gathers/shifts/uint32-wraparound cumsums)
+    INSIDE the fused program, feeding the same scatter — wire compression
+    and fusion compose instead of excluding each other."""
+    from .wire import decode_update
+
+    upd, bounds = decode_update(words_i, words_v, header, n_pad)
+    return _fused_sparse_body(cnt, dst, row_sums, tbl, reg_start, reg_len,
+                              upd, bounds, reg_upd, rows_all, observed,
+                              top_k, plan, interpret)
+
+
+@functools.partial(jax.jit,
+                   donate_argnums=donate_argnums(0, 1, 2, 3, 4, 5),
+                   static_argnames=("top_k", "plan", "interpret"))
+def _fused_sparse_window_raw(cnt, dst, row_sums, tbl, reg_start, reg_len,
+                             upd, bounds, reg_upd, rows_all, observed, *,
+                             top_k: int, plan, interpret: bool = False):
+    """Raw-wire form (``--wire-format raw``): the update buffer ships
+    uncompressed, the rest of the program is identical."""
+    return _fused_sparse_body(cnt, dst, row_sums, tbl, reg_start, reg_len,
+                              upd, bounds, reg_upd, rows_all, observed,
+                              top_k, plan, interpret)
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def _grow(arr, n: int):
     # No donation: the output is a different buffer size, so XLA could
@@ -479,12 +557,74 @@ else:  # portable fallback: byte-table popcount over the uint8 view
             axis=1).astype(np.uint64)
 
 
-class DenseRowRegistry:
+class _RegistryDirtyLog:
+    """Dirty-row tracking shared by both registry layouts.
+
+    The fused sparse window keeps a DEVICE-resident mirror of the
+    (start, len) columns (``SparseDeviceScorer`` reg views) so the
+    scoring half of the one-dispatch program can resolve rows to slab
+    rectangles without a per-window meta upload. The mirror syncs by
+    delta: every host-side registry mutation logs its rows here, and
+    the next fused dispatch uplinks exactly those rows' (start, len).
+    Off (``None``) unless the fused path enables it — the steady-state
+    chained path pays nothing.
+    """
+
+    #: Logged-entry bound: past this the log collapses to the all-dirty
+    #: flag (next fused window does one full occupied-rows resync).
+    #: Bounds memory when the fused path is enabled but windows route
+    #: chained indefinitely (e.g. every touched row went wide) — the
+    #: log would otherwise grow by one array per window forever.
+    DIRTY_CAP = 1 << 20
+
+    def __init__(self) -> None:
+        self._dirty_log = None  # None = tracking off
+        self._dirty_count = 0
+        self._all_dirty = False
+
+    def enable_dirty_log(self) -> None:
+        if self._dirty_log is None:
+            self._dirty_log = []
+
+    def _mark_dirty(self, rows) -> None:
+        if self._dirty_log is None or self._all_dirty or not len(rows):
+            return
+        self._dirty_log.append(np.asarray(rows, dtype=np.int64))
+        self._dirty_count += len(rows)
+        if self._dirty_count > self.DIRTY_CAP:
+            self._mark_all_dirty()
+
+    def _mark_all_dirty(self) -> None:
+        if self._dirty_log is not None:
+            self._all_dirty = True
+            self._dirty_log.clear()
+            self._dirty_count = 0
+
+    def drain_dirty(self):
+        """``(rows, all_dirty)`` accumulated since the last drain. With
+        ``all_dirty`` the caller must resync every occupied row (the
+        wholesale-rebuild paths — restore, reset — and a capped log)."""
+        all_d = self._all_dirty
+        if all_d or self._dirty_log is None or not self._dirty_log:
+            rows = np.zeros(0, dtype=np.int64)
+        elif len(self._dirty_log) == 1:
+            rows = np.unique(self._dirty_log[0])
+        else:
+            rows = np.unique(np.concatenate(self._dirty_log))
+        if self._dirty_log is not None:
+            self._dirty_log.clear()
+        self._dirty_count = 0
+        self._all_dirty = False
+        return rows, all_d
+
+
+class DenseRowRegistry(_RegistryDirtyLog):
     """Original dense triple: three int32 arrays over the row space."""
 
     kind = "dense"
 
     def __init__(self, rows_capacity: int) -> None:
+        super().__init__()
         cap = max(int(rows_capacity), 64)
         self.start = np.zeros(cap, dtype=np.int32)
         self.length = np.zeros(cap, dtype=np.int32)
@@ -524,6 +664,7 @@ class DenseRowRegistry:
         rows = np.asarray(rows, dtype=np.int64)
         if len(rows):
             self.ensure(int(rows.max()))
+        self._mark_dirty(rows)
         if start is not None:
             self.start[rows] = start
         if length is not None:
@@ -534,6 +675,7 @@ class DenseRowRegistry:
     def clear(self, rows: np.ndarray) -> None:
         rows = np.asarray(rows, dtype=np.int64)
         rows = rows[rows < self.rows_cap]
+        self._mark_dirty(rows)
         self.start[rows] = 0
         self.length[rows] = 0
         self.cap[rows] = 0
@@ -542,12 +684,13 @@ class DenseRowRegistry:
         return np.flatnonzero(self.cap > 0).astype(np.int32)
 
     def reset(self) -> None:
+        self._mark_all_dirty()
         self.start[:] = 0
         self.length[:] = 0
         self.cap[:] = 0
 
 
-class BitmapRowRegistry:
+class BitmapRowRegistry(_RegistryDirtyLog):
     """Bitmap + rank directory + packed per-occupied-row fields.
 
     ``bits`` holds one occupancy bit per possible row; ``rank`` holds the
@@ -564,6 +707,7 @@ class BitmapRowRegistry:
     kind = "bitmap"
 
     def __init__(self, rows_capacity: int) -> None:
+        super().__init__()
         cap = max(int(rows_capacity), 64)
         cap = int(_pow2ceil(np.asarray([cap]), 64)[0])
         self.bits = np.zeros(cap // 64, dtype=np.uint64)
@@ -629,6 +773,7 @@ class BitmapRowRegistry:
         if not len(rows):
             return
         self.ensure(int(rows.max()))
+        self._mark_dirty(rows)
         pos, occ = self._pos(rows)
         new = rows[~occ]
         if len(new):
@@ -649,6 +794,7 @@ class BitmapRowRegistry:
 
     def clear(self, rows: np.ndarray) -> None:
         rows = np.asarray(rows, dtype=np.int64)
+        self._mark_dirty(rows)
         pos, occ = self._pos(rows)
         p = pos[occ]
         self.start[p] = 0
@@ -661,6 +807,7 @@ class BitmapRowRegistry:
         return ids[self.cap > 0].astype(np.int32)
 
     def reset(self) -> None:
+        self._mark_all_dirty()
         self.bits[:] = 0
         self.rank[:] = 0
         self.start = np.zeros(0, dtype=np.int32)
@@ -1325,7 +1472,8 @@ class SparseDeviceScorer:
                  cell_dtype: str = "int32",
                  wire_format: str = "raw",
                  spill_threshold_windows: int = 0,
-                 spill_target_hbm_frac: float = 0.5) -> None:
+                 spill_target_hbm_frac: float = 0.5,
+                 fused_window: str = "off") -> None:
         from ..xla_cache import enable_compilation_cache
         from .wire import CELL_DTYPES, cell_promote_threshold
 
@@ -1420,6 +1568,47 @@ class SparseDeviceScorer:
 
         self.use_pallas = resolve_sparse_pallas_flag(use_pallas)
         self._pallas_interpret = jax.default_backend() != "tpu"
+        # Fused one-dispatch window (--fused-window on the SPARSE
+        # backend): steady-state windows run wire decode + update
+        # scatter + registry sync + rescore + results scatter as ONE
+        # program (_fused_sparse_window_*). Deferred results only — the
+        # whole point is that nothing returns per window; config rejects
+        # an explicit 'on' with --emit-updates, 'auto' degrades to
+        # chained. Relocation / promotion / spill-re-promotion windows
+        # route chained per window (same bit-identical results: the
+        # fused body IS the chained body, fused).
+        from ..ops.device_scorer import resolve_fused_flag
+
+        self.use_fused = self.defer_results and resolve_fused_flag(
+            fused_window)
+        # The sparse fused path consumes aggregated deltas (the host
+        # fold owns slot allocation); it never wants basket uplinks.
+        self.wants_baskets = False
+        # Which path the LAST process_window dispatch took — the job's
+        # fused-vs-chained wall-time split and journal field read it.
+        self.last_dispatch_fused = False
+        self._fused_dispatches = REGISTRY.gauge(
+            "cooc_fused_dispatches_total",
+            help="windows dispatched through the fused one-dispatch "
+                 "window program")
+        self._chained_dispatches = REGISTRY.gauge(
+            "cooc_chained_dispatches_total",
+            help="windows dispatched through the chained "
+                 "scatter+score path")
+        self._bucket_compiles = REGISTRY.gauge(
+            "cooc_fused_bucket_compilations_total",
+            help="distinct fused-window program shapes dispatched "
+                 "(per-bucket shape-specialization compile churn)")
+        # Static-shape keys the fused path has dispatched: each is one
+        # XLA compile (pow2/pow4 ladders bound the set).
+        self._fused_shapes = set()
+        if self.use_fused:
+            # Host side of the device registry mirror: every registry
+            # mutation logs its rows; each fused dispatch uplinks the
+            # dirty rows' (start, len) as a delta sync.
+            self.index.rows.enable_dirty_log()
+            self.reg_start = jnp.zeros(self.items_cap, dtype=jnp.int32)
+            self.reg_len = jnp.zeros(self.items_cap, dtype=jnp.int32)
         # Elastic-state placement policy (state/store.py): tiered
         # cold-row spill when --spill-threshold-windows is set, direct
         # (everything device-resident) otherwise. The store owns the
@@ -1461,6 +1650,11 @@ class SparseDeviceScorer:
             wide = np.zeros(new_cap, dtype=bool)
             wide[: len(self.wide_rows)] = self.wide_rows
             self.wide_rows = wide
+        if self.use_fused:
+            # Zero-extension preserves the synced (start, len) entries;
+            # new rows read len 0 until their first registry sync.
+            self.reg_start = _grow(self.reg_start, n=new_cap)
+            self.reg_len = _grow(self.reg_len, n=new_cap)
         self.items_cap = new_cap
         if self._results is not None:
             self._results.resize(new_cap)
@@ -1493,6 +1687,7 @@ class SparseDeviceScorer:
             # The breaker's trip input (see ops/device_scorer.py).
             faults.PLAN.fire("scorer_breaker", seq=self._breaker_seq)
         self.last_dispatched_rows = 0
+        self.last_dispatch_fused = False
         if len(pairs) == 0:
             if self.defer_results:
                 # Idle window: results are intentionally held on device for
@@ -1566,6 +1761,30 @@ class SparseDeviceScorer:
             cell_wide = self.wide_rows[src_d]
         else:
             cell_wide = None
+        # Fused routing gate: steady-state all-narrow windows with no
+        # spill re-promotion take the one-dispatch program; promotion /
+        # wide-touching / re-promotion windows (and, inside
+        # _fused_window, relocation windows and explicit upload-split
+        # requests) route chained — per window, bit-identically.
+        pre_plan = None
+        fused_done = False
+        if (self.use_fused and promo_n is None and promo_w is None
+                and (cell_wide is None or not cell_wide.any())):
+            fused_done, pre_plan = self._fused_window(d_key, d_val32,
+                                                      rows, rs_delta)
+        if fused_done:
+            if self.development_mode:
+                self._check_row_sums(rows)
+            self.counters.add(RESCORED_ITEMS, len(rows))
+            self.last_dispatched_rows = len(rows)
+            self.last_dispatch_fused = True
+            self._fused_dispatches.add(1)
+            self._record_state_gauges()
+            # Deferred results only: this window's top-K was scattered
+            # into the device table inside the fused program.
+            return TopKBatch.empty(self.top_k)
+
+        self._chained_dispatches.add(1)
         if cell_wide is not None and (cell_wide.any()
                                       or promo_w is not None):
             self._window_update(d_key[~cell_wide], d_val32[~cell_wide],
@@ -1575,7 +1794,7 @@ class SparseDeviceScorer:
                                 promo=promo_w)
         else:
             self._window_update(d_key, d_val32, rows, rs_delta,
-                                wide=False, promo=promo_n)
+                                wide=False, promo=promo_n, plan=pre_plan)
 
         if self.development_mode:
             self._check_row_sums(rows)
@@ -1626,7 +1845,8 @@ class SparseDeviceScorer:
 
     def _window_update(self, d_key: np.ndarray, d_val32: np.ndarray,
                        rows: np.ndarray, rs_delta: np.ndarray,
-                       wide: bool = False, promo=None) -> None:
+                       wide: bool = False, promo=None,
+                       plan: Optional[AllocPlan] = None) -> None:
         """Allocate slots and dispatch one slab's window update. The
         narrow dispatch also carries the shared row-sum section (row
         sums are slab-independent); the wide dispatch's is empty.
@@ -1641,7 +1861,12 @@ class SparseDeviceScorer:
         and a promoted slot also receiving a window delta is fine: the
         delta section's scatter-adds commute."""
         index = self.index_w if wide else self.index
-        plan = index.apply(d_key)
+        if plan is None:
+            # A non-None plan comes from a fused-window attempt that
+            # bailed AFTER allocation (relocation window / explicit
+            # upload-split request): apply already ran, re-running it
+            # would double-insert.
+            plan = index.apply(d_key)
         if wide:
             self._ensure_heap_w(index.heap_end)
             cnt_t, dst_t = self.cnt_w, self.dst_w
@@ -1650,34 +1875,9 @@ class SparseDeviceScorer:
             cnt_t, dst_t = self.cnt, self.dst
         self.live_cells += plan.n_new
 
-        # One packed update upload: new cells | deltas | row sums.
-        if promo is not None:
-            p_keys, p_dst, p_vals = promo
-            p_slots = index.lookup(p_keys)
-        else:
-            p_slots = p_dst = p_vals = np.zeros(0, dtype=np.int32)
-        n_pn = plan.n_new
-        n_promo = len(p_slots)
-        n_new = n_pn + n_promo
-        n_d, n_rs = len(d_key) + n_promo, len(rows)
-        n = n_new + n_d + n_rs
-        n_pad = pad_pow4(n, minimum=1 << 12)
-        upd = np.full((2, n_pad), _SENT, dtype=np.int32)
-        upd[1] = 0
-        if n_pn:
-            upd[0, :n_pn] = plan.slots[plan.new_sel]
-            upd[1, :n_pn] = (d_key[plan.new_sel]
-                             & 0xFFFFFFFF).astype(np.int32)
-        if n_promo:
-            upd[0, n_pn: n_new] = p_slots
-            upd[1, n_pn: n_new] = p_dst
-            upd[0, n_new: n_new + n_promo] = p_slots
-            upd[1, n_new: n_new + n_promo] = p_vals
-        upd[0, n_new + n_promo: n_new + n_d] = plan.slots
-        upd[1, n_new + n_promo: n_new + n_d] = d_val32
-        upd[0, n_new + n_d: n] = rows
-        upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
-        bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
+        upd, bounds, n = self._pack_update(index, plan, d_key, d_val32,
+                                           rows, rs_delta, promo)
+        n_pad = upd.shape[1]
         lbl = "update-wide" if wide else "update"
 
         # An explicit upload-split request (TPU_COOC_UPLOAD_CHUNKS /
@@ -1735,11 +1935,174 @@ class SparseDeviceScorer:
         else:
             self.cnt, self.dst = cnt_t, dst_t
 
+    def _pack_update(self, index, plan: AllocPlan, d_key: np.ndarray,
+                     d_val32: np.ndarray, rows: np.ndarray,
+                     rs_delta: np.ndarray, promo):
+        """THE window update-buffer layout (new cells | deltas | row
+        sums, sentinel padding, pow4 transfer bucket) — single owner,
+        shared by the chained and fused dispatch forms so the wire
+        layout cannot drift between them. Returns ``(upd, bounds, n)``.
+
+        ``promo`` as in :meth:`_window_update` (the fused path always
+        passes ``None`` — re-promotion windows route chained)."""
+        if promo is not None:
+            p_keys, p_dst, p_vals = promo
+            p_slots = index.lookup(p_keys)
+        else:
+            p_slots = p_dst = p_vals = np.zeros(0, dtype=np.int32)
+        n_pn = plan.n_new
+        n_promo = len(p_slots)
+        n_new = n_pn + n_promo
+        n_d, n_rs = len(d_key) + n_promo, len(rows)
+        n = n_new + n_d + n_rs
+        n_pad = pad_pow4(n, minimum=1 << 12)
+        upd = np.full((2, n_pad), _SENT, dtype=np.int32)
+        upd[1] = 0
+        if n_pn:
+            upd[0, :n_pn] = plan.slots[plan.new_sel]
+            upd[1, :n_pn] = (d_key[plan.new_sel]
+                             & 0xFFFFFFFF).astype(np.int32)
+        if n_promo:
+            upd[0, n_pn: n_new] = p_slots
+            upd[1, n_pn: n_new] = p_dst
+            upd[0, n_new: n_new + n_promo] = p_slots
+            upd[1, n_new: n_new + n_promo] = p_vals
+        upd[0, n_new + n_promo: n_new + n_d] = plan.slots
+        upd[1, n_new + n_promo: n_new + n_d] = d_val32
+        upd[0, n_new + n_d: n] = rows
+        upd[1, n_new + n_d: n] = rs_delta.astype(np.int32)
+        bounds = np.asarray([n_new, n_new + n_d], dtype=np.int32)
+        return upd, bounds, n
+
+    def _bump_fixed_plan(self, plan_buckets: dict, bucket: np.ndarray,
+                         min_r: int) -> None:
+        """Raise the monotone (bucket -> chunk-count) high-water plan to
+        cover this window's bucket occupancy — single owner of the
+        fixed-shape plan rule, shared by the chained fixed-mode dispatch
+        and the fused window so their plans cannot drift."""
+        for b, n_rows in zip(*[u.tolist() for u in
+                               np.unique(bucket, return_counts=True)]):
+            R = bucket_r(b, min_r, self.score_ladder)
+            S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
+            plan_buckets[b] = max(plan_buckets.get(b, 0), -(-n_rows // S))
+
+    def _note_fused_shape(self, key) -> None:
+        """Track distinct fused-program static shapes (= XLA compiles):
+        the per-bucket shape-specialization churn gauge."""
+        if key not in self._fused_shapes:
+            self._fused_shapes.add(key)
+            self._bucket_compiles.set(len(self._fused_shapes))
+
+    def _fused_window(self, d_key: np.ndarray, d_val32: np.ndarray,
+                      rows: np.ndarray, rs_delta: np.ndarray):
+        """Dispatch one steady-state window through the fused
+        one-dispatch program. Returns ``(handled, pre_plan)``:
+        ``(True, None)`` when the window ran fused, ``(False, plan)``
+        when it must route chained — the allocation already happened, so
+        the chained ``_window_update`` receives the plan instead of
+        re-applying it.
+
+        Not fused-routable (decided here, after allocation): relocation
+        windows (``plan.mv`` — the fused program carries no move
+        kernel; moves stay fused with the CHAINED update instead) and
+        windows under an explicit upload-split request
+        (TPU_COOC_UPLOAD_CHUNKS/_CHUNK_KB pins the raw chunked path —
+        an operator A/B-ing chunk sizes must not silently measure the
+        fused program). The caller gates promotion / wide-row / spill
+        re-promotion windows before allocation.
+        """
+        plan = self.index.apply(d_key)
+        if plan.mv is not None:
+            return False, plan
+        self._ensure_heap(self.index.heap_end)
+
+        upd, bounds, n = self._pack_update(self.index, plan, d_key,
+                                           d_val32, rows, rs_delta, None)
+        n_pad = upd.shape[1]
+        if split_upload_auto(upd) is not None:
+            return False, plan
+        self.live_cells += plan.n_new
+
+        # Registry delta sync: rows whose host (start, len) changed
+        # since the device mirror last synced — this window's new-cell
+        # rows plus anything a chained window / compaction / spill
+        # touched in between. Sentinel-padded, scatter-dropped.
+        dirty, all_dirty = self.index.rows.drain_dirty()
+        if all_dirty:
+            dirty = self.index.rows.occupied().astype(np.int64)
+        n_reg = len(dirty)
+        reg_pad = pad_pow2(n_reg, minimum=256)
+        reg_upd = np.full((3, reg_pad), _SENT, dtype=np.int32)
+        if n_reg:
+            r_start, r_len, _c = self.index.rows.get(dirty)
+            reg_upd[0, :n_reg] = dirty
+            reg_upd[1, :n_reg] = r_start
+            reg_upd[2, :n_reg] = r_len
+
+        # Monotone scoring plan (the fixed-shape mode's rule, shared
+        # _plan_buckets): every (bucket, chunk-rank) ever occupied
+        # dispatches — absent ones as all-padding rectangles — so the
+        # static plan only grows and compile count stays bounded by the
+        # final plan's rectangle count. Per-row independence of
+        # _score_rect makes chunking/padding parity-neutral.
+        _s, lens_h, _c = self.index.rows.get(rows)
+        min_r = max(16, self.top_k)
+        bucket, order = score_buckets(lens_h, min_r, self.score_ladder)
+        self._bump_fixed_plan(self._plan_buckets, bucket, min_r)
+        b_sorted = bucket[order]
+        plan_t = []
+        segs = []
+        off = 0
+        for b in sorted(self._plan_buckets):
+            R = bucket_r(b, min_r, self.score_ladder)
+            S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
+            lo = int(np.searchsorted(b_sorted, b))
+            hi = int(np.searchsorted(b_sorted, b, side="right"))
+            rows_b = rows[order[lo:hi]]
+            for c in range(self._plan_buckets[b]):
+                chunk = rows_b[c * S: (c + 1) * S]
+                seg = np.full(S, _SENT, dtype=np.int32)
+                seg[: len(chunk)] = chunk
+                segs.append(seg)
+                plan_t.append((R, S, off, self._rect_pallas(R)))
+                off += S
+        rows_all = np.concatenate(segs)
+        plan_t = tuple(plan_t)
+
+        self._results.ensure()
+        observed = np.float32(self.observed)
+        if self.wire_packed:
+            from .wire import encode_update
+
+            words_i, words_v, header = encode_update(upd, bounds, n)
+            wi = _pad_words(words_i)
+            wv = _pad_words(words_v)
+            LEDGER.up_encoded("fused-window-packed",
+                              upd.nbytes + bounds.nbytes, wi, wv, header)
+            LEDGER.up("fused-window-meta", reg_upd, rows_all)
+            self._note_fused_shape(
+                ("packed", n_pad, len(wi), len(wv), reg_pad, plan_t))
+            (self.cnt, self.dst, self.row_sums, self._results.tbl,
+             self.reg_start, self.reg_len) = _fused_sparse_window_packed(
+                self.cnt, self.dst, self.row_sums, self._results.tbl,
+                self.reg_start, self.reg_len, wi, wv, header, reg_upd,
+                rows_all, observed, n_pad=n_pad, top_k=self.top_k,
+                plan=plan_t, interpret=self._pallas_interpret)
+        else:
+            LEDGER.up("fused-window", upd, bounds, reg_upd, rows_all)
+            self._note_fused_shape(("raw", n_pad, reg_pad, plan_t))
+            (self.cnt, self.dst, self.row_sums, self._results.tbl,
+             self.reg_start, self.reg_len) = _fused_sparse_window_raw(
+                self.cnt, self.dst, self.row_sums, self._results.tbl,
+                self.reg_start, self.reg_len, upd, bounds, reg_upd,
+                rows_all, observed, top_k=self.top_k, plan=plan_t,
+                interpret=self._pallas_interpret)
+        self._results.mark(rows)
+        return True, None
+
     def _record_state_gauges(self) -> None:
         """Per-window state-footprint gauges (the compression layer's
         headline numbers: host index RSS, device slab bytes, live cells)."""
-        from ..observability.registry import REGISTRY
-
         rss = self.index.nbytes
         slab = self.cnt.nbytes + self.dst.nbytes
         if self.index_w is not None:
@@ -1788,12 +2151,7 @@ class SparseDeviceScorer:
             # fused program's static plan only grows — no churn from
             # per-window bucket subsets OR from a bucket occasionally
             # overflowing its per-dispatch row cap.
-            occupied, occ_counts = np.unique(bucket, return_counts=True)
-            for b, n_rows in zip(occupied.tolist(), occ_counts.tolist()):
-                R = bucket_r(b, min_r, self.score_ladder)
-                S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
-                n_chunks = -(-n_rows // S)
-                plan_buckets[b] = max(plan_buckets.get(b, 0), n_chunks)
+            self._bump_fixed_plan(plan_buckets, bucket, min_r)
         pos = 0
         while pos < len(order):
             b = int(b_sorted[pos])
@@ -2032,3 +2390,9 @@ class SparseDeviceScorer:
             self._results.reset(self.items_cap)
         self._plan_buckets = {}
         self._plan_buckets_w = {}
+        if self.use_fused:
+            # Fresh device registry mirror for the rebuilt index; the
+            # registry reset above marked everything dirty, so the next
+            # fused window resyncs every occupied row.
+            self.reg_start = jnp.zeros(self.items_cap, dtype=jnp.int32)
+            self.reg_len = jnp.zeros(self.items_cap, dtype=jnp.int32)
