@@ -299,6 +299,189 @@ def _longtail_churn_stream(windows: int, users_per: int, events_per: int,
             np.concatenate(tss))
 
 
+def _checkpoint_arm(sp_u, sp_i, sp_t, window_ms: int = 100) -> dict:
+    """Full-vs-incremental checkpoint A/B on the churn stream (PR 12).
+
+    Three ingest runs feed window-aligned slices and poll
+    ``state/checkpoint.LAST_COMMIT`` after each, so every generation's
+    committed bytes/seconds land in the arm (not just the last):
+
+    * ``full@fine`` vs ``incr@fine`` — same cadence, so the
+      commit-bytes ratio is apples-to-apples (the acceptance headline:
+      median incremental generation ≪ the full rewrite);
+    * ``full@coarse`` — the cadence expensive full commits force in
+      practice; its crash-replay tail is what the incremental run's
+      fine cadence eliminates.
+
+    Restore-to-first-window is measured for real: restore from the
+    newest generation, replay the events ingested after that commit,
+    stop at the first fired window.
+    """
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.observability import LEDGER
+    from tpu_cooccurrence.observability.registry import REGISTRY
+    from tpu_cooccurrence.state import checkpoint as ckpt
+
+    # The coarse cadence models what expensive full rewrites force in
+    # practice: a rational interval scales with commit cost, and the
+    # measured full-vs-delta gap is ~10x bytes / ~2x seconds (plus
+    # whatever the durable-storage link multiplies it by).
+    fine = int(os.environ.get("BENCH_CKPT_EVERY_FINE", 2))
+    coarse = int(os.environ.get("BENCH_CKPT_EVERY_COARSE", 16))
+    bounds = np.searchsorted(
+        sp_t, np.arange(window_ms, int(sp_t[-1]) + 2 * window_ms,
+                        window_ms))
+
+    def cfg_kw(d, incremental, every):
+        return dict(window_size=window_ms, seed=0xC0FFEE, item_cut=500,
+                    user_cut=500, backend=Backend.SPARSE,
+                    checkpoint_dir=d, checkpoint_every_windows=every,
+                    checkpoint_retain=10_000,
+                    checkpoint_incremental=incremental,
+                    checkpoint_compact_ratio=0.5)
+
+    # Both arms "crash" at the SAME mid-stream point — deliberately LATE
+    # in a coarse checkpoint cycle (the expected-case crash position:
+    # uniformly random arrival lands ~coarse/2 windows past the last
+    # coarse commit; we pin coarse-2 for determinism): each arm restores
+    # from ITS newest commit and replays the input ingested after it —
+    # the replay-tail difference IS the cadence difference cheap
+    # commits buy.
+    crash_at = max((len(bounds) // coarse) * coarse - 2, coarse)
+
+    def ingest(incremental, every):
+        import shutil
+
+        REGISTRY.reset()
+        LEDGER.reset()
+        ckpt.LAST_COMMIT = None
+        d = tempfile.mkdtemp(prefix="bench-ckpt-")
+        job = CooccurrenceJob(Config(**cfg_kw(d, incremental, every)))
+        commits, idx_at = [], []
+        crash = None
+        last_gen = 0
+        lo = 0
+        for w, hi in enumerate(bounds):
+            if hi > lo:
+                job.add_batch(sp_u[lo:hi], sp_i[lo:hi], sp_t[lo:hi])
+                lo = hi
+            c = ckpt.LAST_COMMIT
+            if c is not None and c["gen"] != last_gen:
+                last_gen = c["gen"]
+                commits.append(dict(c))
+                idx_at.append(hi)
+            if w == crash_at and crash is None:
+                # Snapshot the checkpoint dir as of the crash point.
+                shutil.copytree(d, d + "-crash")
+                crash = (d + "-crash", idx_at[-1] if idx_at else 0,
+                         job.windows_fired)
+        job.finish()
+        c = ckpt.LAST_COMMIT
+        if c is not None and c["gen"] != last_gen:
+            commits.append(dict(c))
+            idx_at.append(len(sp_u))
+        return d, job, commits, crash
+
+    def restore_to_first_window(crash, incremental, every):
+        """(first-window seconds, catch-up seconds, replayed windows):
+        restore from the crash snapshot, replay the input ingested
+        after its newest commit until (a) the first window fires and
+        (b) the run is back AT the crash point — (b) is where the fine
+        cadence cheap commits buy pays off (shorter replay tail)."""
+        snap_dir, resume_idx, fired_at_crash = crash
+        REGISTRY.reset()
+        LEDGER.reset()
+        t0 = time.monotonic()
+        job = CooccurrenceJob(Config(**cfg_kw(snap_dir, incremental,
+                                              every)))
+        job.restore()
+        w0 = job.windows_fired
+        first_window_s = None
+        replayed = 0
+        lo = resume_idx
+        for hi in bounds:
+            if hi <= lo:
+                continue
+            job.add_batch(sp_u[lo:hi], sp_i[lo:hi], sp_t[lo:hi])
+            replayed += 1
+            lo = hi
+            if first_window_s is None and job.windows_fired > w0:
+                first_window_s = time.monotonic() - t0
+            if job.windows_fired >= fired_at_crash:
+                break
+        catch_up_s = time.monotonic() - t0
+        job.abort()
+        return first_window_s or catch_up_s, catch_up_s, replayed
+
+    d_full, j_full, commits_full, crash_full = ingest(False, fine)
+    d_incr, _j_incr, commits_incr, crash_incr = ingest(True, fine)
+    d_coarse, _j_coarse, _commits_coarse, crash_coarse = ingest(
+        False, coarse)
+
+    full_bytes = [c["bytes"] for c in commits_full]
+    delta_bytes = [c["bytes"] for c in commits_incr
+                   if c["kind"] == "delta"]
+    coarse_restore, coarse_catch, coarse_replay = \
+        restore_to_first_window(crash_coarse, False, coarse)
+    incr_restore, incr_catch, incr_replay = restore_to_first_window(
+        crash_incr, True, fine)
+    import shutil
+
+    for path in (d_full, d_incr, d_coarse, crash_full[0],
+                 crash_incr[0], crash_coarse[0]):
+        shutil.rmtree(path, ignore_errors=True)
+    med = statistics.median
+    return {
+        "events": len(sp_u),
+        "windows": j_full.windows_fired,
+        "every_fine": fine,
+        "every_coarse": coarse,
+        "generations_full": len(commits_full),
+        "generations_incremental": len(commits_incr),
+        "delta_generations": len(delta_bytes),
+        "compactions": sum(
+            1 for i, c in enumerate(commits_incr[1:], 1)
+            if c["kind"] == "full"
+            and commits_incr[i - 1]["kind"] == "delta"),
+        "chain_len_max": max(
+            (c["chain_len"] for c in commits_incr), default=0),
+        "full_commit_bytes_median": med(full_bytes) if full_bytes else 0,
+        "incr_commit_bytes_median": (med(delta_bytes)
+                                     if delta_bytes else 0),
+        # The acceptance headline: median incremental generation vs the
+        # median full rewrite at the SAME cadence.
+        "commit_bytes_ratio": round(
+            med(delta_bytes) / max(med(full_bytes), 1), 4)
+        if delta_bytes and full_bytes else None,
+        "full_commit_seconds_median": round(
+            med([c["seconds"] for c in commits_full]), 4)
+        if commits_full else 0,
+        "incr_commit_seconds_median": round(
+            med([c["seconds"] for c in commits_incr
+                 if c["kind"] == "delta"]), 4) if delta_bytes else 0,
+        # Crash-replay comparison: full checkpoints at the coarse
+        # cadence their cost forces vs incremental at the fine one.
+        "restore_to_first_window_seconds": {
+            "full_coarse": round(coarse_restore, 3),
+            "incremental": round(incr_restore, 3),
+        },
+        "restore_catch_up_seconds": {
+            "full_coarse": round(coarse_catch, 3),
+            "incremental": round(incr_catch, 3),
+        },
+        "replay_windows": {
+            "full_coarse": coarse_replay,
+            "incremental": incr_replay,
+        },
+    }
+
+
 def _uplink_per_window(latency: dict) -> float:
     """Mean host->device bytes per fired window, from the run's
     ``cooc_window_uplink_bytes`` histogram summary (TransferLedger-fed:
@@ -321,7 +504,8 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
                    latency: dict = None, degradation: dict = None,
                    fused: dict = None, compression: dict = None,
                    serving: dict = None, spill: dict = None,
-                   fused_sparse: dict = None) -> None:
+                   fused_sparse: dict = None,
+                   checkpoint: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -367,6 +551,12 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         # bucket compile counts) — trajectory-visible like the dense
         # fused arm, CPU-neutrality included.
         entry["fused_sparse"] = fused_sparse
+    if checkpoint:
+        # The PR-12 incremental-checkpoint A/B: full-vs-delta commit
+        # bytes + seconds per generation on the churn stream, and the
+        # restore-to-first-window comparison — the commit-bandwidth and
+        # restart-replay headline numbers.
+        entry["checkpoint"] = checkpoint
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -626,6 +816,19 @@ def measure() -> None:
                            == off_spill["results_digest"]),
     }
 
+    # Incremental-checkpoint arm (PR 12): the SAME long-tail churn
+    # stream (cold rows = churn a fraction of accumulated state — the
+    # regime incremental commits exist for), full-vs-incremental at the
+    # same fine cadence for the commit-bytes ratio, plus the
+    # restore-to-first-window comparison: a full-checkpoint run is
+    # forced onto a COARSE cadence by its commit cost, so a crash
+    # replays more input; the incremental run checkpoints every other
+    # window and resumes almost immediately.
+    try:
+        ckpt_info = _checkpoint_arm(sp_u, sp_i, sp_t, window_ms=100)
+    except Exception as exc:
+        ckpt_info = {"error": f"{type(exc).__name__}: {exc}"}
+
     # Query-storm arm (PR-8 serving plane): closed-loop qps + query
     # latency tails from a keep-alive HTTP pool against a live ingesting
     # job (million-user id space). Host-side plane, so the arm runs
@@ -666,6 +869,7 @@ def measure() -> None:
         "fused_sparse": fused_sparse,
         "compression": compression,
         "spill": spill_info,
+        "checkpoint": ckpt_info,
         "serving": serving_storm,
     }
     if journal:
@@ -688,7 +892,7 @@ def measure() -> None:
         _record_onchip(out["value"], out["vs_baseline"], backend,
                        pipeline_depth, occupancy, latency, degradation,
                        fused_info, compression, serving_storm, spill_info,
-                       fused_sparse)
+                       fused_sparse, ckpt_info)
     print(json.dumps(out))
 
 

@@ -958,6 +958,116 @@ def test_state_store_registry_flags_empty_registry(tmp_path):
     assert "registry" in result.findings[0].message
 
 
+# -- rule pack 10: checkpoint-format round trip -------------------------
+
+
+_OK_DELTA_BODY = ("def encode():\n"
+                  "    header = {\"gen\": 1}\n\n\n"
+                  "def decode(header):\n"
+                  "    return header[\"gen\"]\n")
+
+
+def _mini_ckpt_repo(tmp_path, *, ckpt_body, delta_body=_OK_DELTA_BODY,
+                    test_body="x = 1\n"):
+    root = tmp_path / "repo"
+    state = root / "tpu_cooccurrence" / "state"
+    state.mkdir(parents=True)
+    (state / "checkpoint.py").write_text(ckpt_body)
+    (state / "delta.py").write_text(delta_body)
+    (root / "tests").mkdir()
+    (root / "tests" / "test_fmt_fixture.py").write_text(test_body)
+    return root
+
+
+def test_ckpt_format_clean_fixture_passes(tmp_path):
+    root = _mini_ckpt_repo(
+        tmp_path,
+        ckpt_body=("def save():\n"
+                   "    meta = {\"windows\": 1}\n"
+                   "    meta[\"extra\"] = 2\n\n\n"
+                   "def restore(meta):\n"
+                   "    return meta[\"windows\"], meta.get(\"extra\")\n"),
+        delta_body=("def encode():\n"
+                    "    header = {\"gen\": 1}\n\n\n"
+                    "def decode(header):\n"
+                    "    return header[\"gen\"]\n"),
+        test_body=("KEYS = {\"windows\", \"extra\", \"gen\"}\n"))
+    result = Analyzer(str(root), rules=[RULES["ckpt-format-roundtrip"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_ckpt_format_flags_writer_only_field(tmp_path):
+    """A meta key with no restore-side read is silent format drift."""
+    root = _mini_ckpt_repo(
+        tmp_path,
+        ckpt_body=("def save():\n"
+                   "    meta = {\"windows\": 1, \"orphan\": 2}\n\n\n"
+                   "def restore(meta):\n"
+                   "    return meta[\"windows\"]\n"),
+        test_body="KEYS = {\"windows\", \"orphan\", \"gen\"}\n")
+    result = Analyzer(str(root), rules=[RULES["ckpt-format-roundtrip"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["ckpt-format-roundtrip"]
+    assert "'orphan'" in result.findings[0].message
+    assert "never read back" in result.findings[0].message
+
+
+def test_ckpt_format_flags_untested_field(tmp_path):
+    root = _mini_ckpt_repo(
+        tmp_path,
+        ckpt_body=("def save():\n"
+                   "    meta = {\"windows\": 1}\n\n\n"
+                   "def restore(meta):\n"
+                   "    return meta[\"windows\"]\n"),
+        test_body="KEYS = {\"gen\"}\n")
+    result = Analyzer(str(root), rules=[RULES["ckpt-format-roundtrip"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["ckpt-format-roundtrip"]
+    assert "round-trip reference" in result.findings[0].message
+
+
+def test_ckpt_format_flags_vanished_module(tmp_path):
+    """A format module going missing is a finding in its own right, not
+    a silent waiver (same posture as the other registry rules)."""
+    root = _mini_ckpt_repo(
+        tmp_path,
+        ckpt_body=("def save():\n"
+                   "    meta = {\"windows\": 1}\n\n\n"
+                   "def restore(meta):\n"
+                   "    return meta[\"windows\"]\n"),
+        test_body="KEYS = {\"windows\"}\n")
+    os.remove(root / "tpu_cooccurrence" / "state" / "delta.py")
+    result = Analyzer(str(root), rules=[RULES["ckpt-format-roundtrip"]],
+                      baseline=[]).run()
+    msgs = [f.message for f in result.findings
+            if f.rule == "ckpt-format-roundtrip"]
+    assert any("missing" in m for m in msgs)
+
+
+def test_ckpt_format_flags_empty_key_registry(tmp_path):
+    """A checkpoint.py that no longer builds a meta dict means the
+    registry this rule guards moved — finding, not silence."""
+    root = _mini_ckpt_repo(
+        tmp_path, ckpt_body="def save():\n    pass\n",
+        delta_body=("def encode():\n"
+                    "    header = {\"gen\": 1}\n\n\n"
+                    "def decode(header):\n"
+                    "    return header[\"gen\"]\n"),
+        test_body="KEYS = {\"gen\"}\n")
+    result = Analyzer(str(root), rules=[RULES["ckpt-format-roundtrip"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["ckpt-format-roundtrip"]
+    assert "no format keys" in result.findings[0].message
+
+
+def test_ckpt_format_rule_clean_on_repo():
+    """The real repo is clean under the rule (baseline-free contract)."""
+    result = Analyzer(REPO, rules=[RULES["ckpt-format-roundtrip"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
 # -- collective-watchdog / gang-fault-sites (rules_gang) ----------------
 
 

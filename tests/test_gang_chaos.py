@@ -105,6 +105,77 @@ def test_gang_ckpt_commit_crash_recovers_bit_identical(
     assert "gang-restarting" in proc.stderr
 
 
+def _sparse_gang_args(stream, ck_dir, incremental, extra):
+    """Sparse-backend gang (the sharded-sparse mh checkpoint format —
+    the topology the incremental delta chain must survive)."""
+    return [sys.executable, "-m", "tpu_cooccurrence.cli",
+            "-i", stream, "-ws", "500", "-ic", "8", "-uc", "5",
+            "-s", "0xC0FFEE", "--backend", "sparse",
+            "--num-shards", "2",
+            "--checkpoint-dir", ck_dir,
+            "--checkpoint-every-windows", "2",
+            "--checkpoint-retain", "10",
+            "--checkpoint-compact-ratio", "10",
+            "--gang-workers", "2", "--gang-heartbeat-s", "1",
+            "--collective-timeout-s", "15",
+            "--restart-delay-ms", "0"] \
+        + (["--checkpoint-incremental"] if incremental else []) + extra
+
+
+def _run_sparse(stream, ck_dir, incremental, extra, timeout=420):
+    return subprocess.run(
+        _sparse_gang_args(stream, ck_dir, incremental, extra),
+        capture_output=True, text=True, env=ENV, cwd=REPO,
+        timeout=timeout)
+
+
+def test_gang_incremental_ckpt_mid_delta_crash_bit_identical(
+        tmp_path, stream):
+    """ISSUE 12 acceptance: a 2-process sparse gang running INCREMENTAL
+    checkpoints, killed inside a DELTA generation's epoch-commit window
+    (worker 1 at the generation-2 commit — its npz and delta file are
+    renamed into place but no EPOCH marker exists). The restore vote
+    counts only fully-committed chains, drags both hosts back to
+    generation 1, quarantines the torn generation's npz AND delta as
+    *.partial on both, and total stdout is bit-identical to the SAME
+    crash recovered from full checkpoints (restore canonicalizes
+    within-row slab order, so the full-checkpoint recovery — not an
+    uninterrupted run — is the bit-exact comparator, same as every
+    sparse resume test): the delta-chain restore is byte-equivalent to
+    the full-checkpoint restore in the gang topology."""
+    chaos = ["--restart-on-failure", "2",
+             "--inject-fault", "ckpt_commit@1:2:crash"]
+    ref_ck = str(tmp_path / "ck-full")
+    ref = _run_sparse(stream, ref_ck, False,
+                      chaos + ["--fault-state-dir",
+                               str(tmp_path / "faults-full")])
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    assert ref.stdout, "full-checkpoint chaos run produced no output"
+    assert "gang restore vote" in ref.stderr
+
+    ck = str(tmp_path / "ck")
+    proc = _run_sparse(stream, ck, True,
+                       chaos + ["--fault-state-dir",
+                                str(tmp_path / "faults")])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout == ref.stdout
+    assert "gang restore vote" in proc.stderr
+    assert sorted(os.listdir(tmp_path / "faults")) == ["fault0.p1.fired"]
+    # The torn DELTA generation was quarantined with its npz on both
+    # hosts; the recovered run then rebuilt generation 2 (files exist
+    # again) and kept chaining deltas.
+    partials = sorted(p for p in os.listdir(ck)
+                      if p.endswith(".partial"))
+    assert partials == ["delta.p0.2.bin.partial",
+                        "delta.p1.2.bin.partial",
+                        "state.p0.2.npz.partial",
+                        "state.p1.2.npz.partial"]
+    for pid in (0, 1):
+        assert any(n.startswith(f"delta.p{pid}.")
+                   and n.endswith(".bin") for n in os.listdir(ck)), \
+            f"no live delta generation for p{pid} after recovery"
+
+
 def test_gang_degrade_lockstep_journals(tmp_path, stream):
     """--degrade on a multi-host run: the per-window worst-signal
     allgather steps both hosts' ladders identically — the journals

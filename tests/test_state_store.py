@@ -92,15 +92,25 @@ def test_spill_bit_identical_to_off(depth):
 
 
 def test_spill_checkpoint_blob_byte_identical():
+    """The CANONICAL blob arrays stay byte-identical to spill-off;
+    the tiered store adds only the supplemental ``tier_*`` recency
+    arrays (ISSUE 12 satellite — restore resumes the same residency
+    trajectory), which every other store ignores."""
     users, items, ts = random_stream(78, n=700, n_items=60, n_users=25)
     off = run_job(sparse_cfg(), users, items, ts)
     on = run_job(sparse_cfg(**SPILL), users, items, ts)
     assert len(on.scorer.store.arena) > 0, "nothing left spilled at end"
     a = off.scorer.checkpoint_state()
     b = on.scorer.checkpoint_state()
-    assert set(a) == set(b)
+    extra = set(b) - set(a)
+    assert extra == {"tier_clock", "tier_rows", "tier_ages"}
     for key in a:
         assert np.array_equal(a[key], b[key]), key
+    # The persisted clock matches the run's fired-window count and the
+    # stamp arrays are consistent.
+    assert int(b["tier_clock"][0]) == on.scorer.store.clock
+    assert len(b["tier_rows"]) == len(b["tier_ages"])
+    assert (b["tier_ages"] >= 0).all()
 
 
 def test_spill_resume_bit_identical(tmp_path):
@@ -128,6 +138,47 @@ def test_spill_resume_bit_identical(tmp_path):
         c.finish()
         assert_latest_identical(c.latest, b.latest)
     assert set(ref.latest.snapshot()) == set(b.latest.snapshot())
+
+
+def test_spill_parity_across_restore(tmp_path):
+    """Recency is checkpointed (ISSUE 12 satellite): a restored tiered
+    run resumes the writer's spill clock, so residency converges to the
+    uninterrupted run's at the first post-restore tick instead of every
+    row sitting hot for ``threshold`` more windows."""
+    users, items, ts = random_stream(83, n=900, n_items=70, n_users=26)
+    half = 430
+    a = CooccurrenceJob(sparse_cfg(tmp_path, **SPILL))
+    a.add_batch(users[:half], items[:half], ts[:half])
+    a.checkpoint()
+    store_a = a.scorer.store
+    assert store_a.clock > 0 and len(store_a.arena), "vacuous setup"
+    b = CooccurrenceJob(sparse_cfg(tmp_path, **SPILL))
+    b.restore()
+    store_b = b.scorer.store
+
+    def eligibility(store):
+        # Ages are persisted clipped at the threshold (the same
+        # collapse the tick's bucket consolidation applies), so the
+        # restored trajectory is compared in eligibility space.
+        lt = store.last_touch
+        return np.where(lt >= 0,
+                        np.minimum(store.clock - lt, store.threshold),
+                        -1)
+
+    # The clock resumed (not reset to 0) and the stamps match the
+    # writer's exactly up to the documented eligible-age collapse.
+    assert store_b.clock == store_a.clock
+    np.testing.assert_array_equal(eligibility(store_b),
+                                  eligibility(store_a))
+    # Continue both; the arena's resident set re-converges and stays in
+    # lockstep (with frac=0.0 every eligible row spills each tick).
+    a.add_batch(users[half:], items[half:], ts[half:])
+    a.finish()
+    b.add_batch(users[half:], items[half:], ts[half:])
+    b.finish()
+    assert sorted(store_b.arena.dir) == sorted(store_a.arena.dir)
+    assert store_b.clock == store_a.clock
+    assert set(a.latest.snapshot()) == set(b.latest.snapshot())
 
 
 def _phased_stream():

@@ -48,6 +48,10 @@ Layout:
   ``StateStore`` implementation in ``state/store.py`` needs a
   checkpoint round-trip test reference under ``tests/`` and a row in
   the ARCHITECTURE state-store table);
+* :mod:`.rules_ckpt` — checkpoint-format drift (every field written
+  into generation meta or delta headers needs a restore-side reader in
+  its module and a ``tests/`` round-trip reference — the two ends of
+  the incremental-checkpoint format cannot drift silently);
 * ``__main__`` — the runner: ``python -m tpu_cooccurrence.analysis``
   exits 1 on non-baseline findings (``--format json|text``).
 
@@ -69,6 +73,7 @@ from .core import (  # noqa: F401
 )
 
 # Importing the rule modules registers their rules in RULES.
+from . import rules_ckpt  # noqa: F401,E402
 from . import rules_degrade  # noqa: F401,E402
 from . import rules_fused  # noqa: F401,E402
 from . import rules_gang  # noqa: F401,E402

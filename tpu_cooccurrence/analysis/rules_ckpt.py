@@ -1,0 +1,147 @@
+"""Checkpoint-format drift guard (baseline-free).
+
+``ckpt-format-roundtrip`` — the two ends of the checkpoint format live
+in different functions (``save`` vs ``restore`` in
+``state/checkpoint.py``; ``encode_delta`` vs ``decode_delta`` in
+``state/delta.py``) and nothing structural stops a writer-side field
+from landing with no reader: the file still round-trips, the digest
+still verifies, and the field silently never influences restore — until
+a replica or a future restore path needs it and finds garbage semantics.
+
+The rule makes the registry explicit: every string key written into the
+generation meta (the dict literal assigned to ``meta`` / subscript
+stores on it) or into the delta header (the ``header`` dict in
+``state/delta.py``) must
+
+* have a matching restore-side READ of the same key string somewhere in
+  its module (a read-position constant — ``meta["k"]`` load,
+  ``meta.get("k")``, membership test), and
+* appear as a string constant somewhere under ``tests/`` — the
+  round-trip fixture reference that pins the field's semantics
+  (``tests/test_incremental_checkpoint.py`` keeps the canonical list).
+
+Baseline-free: a new meta/header field lands in the same PR as its
+reader and its test, or tier-1 fails.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set, Tuple
+
+from .core import FileContext, Finding, RepoContext, Rule, register
+
+#: Module -> dict-variable names whose string keys form the format.
+_FORMAT_FILES = {
+    "tpu_cooccurrence/state/checkpoint.py": ("meta",),
+    "tpu_cooccurrence/state/delta.py": ("header",),
+}
+
+
+def _written_keys(ctx: FileContext,
+                  names) -> "Tuple[Dict[str, int], Set[int]]":
+    """``{key: first write line}`` plus the AST node ids of the write-
+    position key constants (so the read scan can exclude them)."""
+    written: Dict[str, int] = {}
+    write_nodes: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                # meta = {"k": ...} / header = {"k": ...}
+                if (isinstance(tgt, ast.Name) and tgt.id in names
+                        and isinstance(node.value, ast.Dict)):
+                    for k in node.value.keys:
+                        if (isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)):
+                            written.setdefault(k.value, k.lineno)
+                            write_nodes.add(id(k))
+                # meta["k"] = ...
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in names
+                        and isinstance(tgt.slice, ast.Constant)
+                        and isinstance(tgt.slice.value, str)):
+                    written.setdefault(tgt.slice.value, tgt.lineno)
+                    write_nodes.add(id(tgt.slice))
+    return written, write_nodes
+
+
+def _read_constants(ctx: FileContext, write_nodes: Set[int]) -> Set[str]:
+    """Every string constant in the module that is NOT one of the
+    write-position keys — the reader-evidence pool (subscript loads,
+    ``.get`` arguments, membership tests all surface here)."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and id(node) not in write_nodes):
+            out.add(node.value)
+    return out
+
+
+def _tests_constants(repo: RepoContext) -> Set[str]:
+    out: Set[str] = set()
+    for ctx in repo.python_files():
+        if not ctx.path.startswith("tests/") or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                out.add(node.value)
+    return out
+
+
+@register
+class CkptFormatRoundtripRule(Rule):
+    name = "ckpt-format-roundtrip"
+    description = ("every field written into checkpoint generation meta "
+                   "or delta headers needs a restore-side reader in its "
+                   "module and a tests/ round-trip reference")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        # Scope guard (the rules_fused posture): the missing-module
+        # finding stays anchored on the format SUBSYSTEM existing — a
+        # scan root with neither module (other rules' fixture repos,
+        # partial trees) is silent, while a repo where one end of the
+        # format vanished out from under the other is flagged.
+        present = {path: next((c for c in repo.files if c.path == path),
+                              None)
+                   for path in _FORMAT_FILES}
+        if not any(c is not None for c in present.values()):
+            return
+        tests = None
+        for path, names in sorted(_FORMAT_FILES.items()):
+            src = present[path]
+            if src is None or src.tree is None:
+                yield Finding(
+                    rule=self.name, file=path, line=1,
+                    message=(f"format module {path} is missing or "
+                             f"unparseable — the checkpoint-format "
+                             f"registry this rule guards is gone"))
+                continue
+            written, write_nodes = _written_keys(src, names)
+            if not written:
+                yield Finding(
+                    rule=self.name, file=path, line=1,
+                    message=(f"no format keys found on {names} in "
+                             f"{path} (writer moved? update "
+                             f"rules_ckpt._FORMAT_FILES)"))
+                continue
+            reads = _read_constants(src, write_nodes)
+            if tests is None:
+                tests = _tests_constants(repo)
+            for key, line in sorted(written.items()):
+                if key not in reads:
+                    yield Finding(
+                        rule=self.name, file=path, line=line,
+                        message=(f"format key {key!r} is written but "
+                                 f"never read back in {path} — a "
+                                 f"writer-only field is silent format "
+                                 f"drift; add the restore-side reader "
+                                 f"(or drop the field)"))
+                if key not in tests:
+                    yield Finding(
+                        rule=self.name, file=path, line=line,
+                        message=(f"format key {key!r} has no tests/ "
+                                 f"round-trip reference — pin it in "
+                                 f"tests/test_incremental_checkpoint.py"
+                                 f"'s format-key registry"))

@@ -103,7 +103,22 @@ class Config:
     checkpoint_every_windows: int = 0  # 0 = disabled
     checkpoint_retain: int = 3  # generation-numbered checkpoints kept
     # (state.<gen>.npz; restore falls back to the newest generation that
-    # verifies its digest, quarantining corrupt ones as *.corrupt)
+    # verifies its digest, quarantining corrupt ones as *.corrupt).
+    # Chain-aware under --checkpoint-incremental: a base or intermediate
+    # delta a retained generation still chains through is never deleted.
+    checkpoint_incremental: bool = False  # dirty-row incremental
+    # generations (state/delta.py): a full base plus per-generation
+    # delta.<gen>.bin files holding only rows touched since the previous
+    # committed generation, coded with the PR-7 delta+zigzag+varint
+    # primitives — commit bytes scale with per-generation churn, not
+    # vocab. Restore replays base + deltas into byte-identical state.
+    # Sparse backends only (the canonical rows_key/rows_cnt blob is the
+    # delta's domain); the same files are the consumable delta log
+    # (state/delta.read_delta_stream) future read replicas tail.
+    checkpoint_compact_ratio: float = 0.5  # ratio trigger: once the
+    # delta chain's bytes exceed this fraction of the base's, the next
+    # checkpoint rewrites a fresh full base (bounds restore replay) and
+    # the old chain ages out under --checkpoint-retain
     restart_on_failure: int = 0  # supervisor: respawn the job up to N
     # times on abnormal exit, resuming from --checkpoint-dir when set
     # (the reference delegates this to Flink's restart strategies,
@@ -376,6 +391,27 @@ class Config:
             raise ValueError(
                 f"--checkpoint-retain must be >= 1, got "
                 f"{self.checkpoint_retain}")
+        if self.checkpoint_compact_ratio <= 0:
+            raise ValueError(
+                f"--checkpoint-compact-ratio must be > 0, got "
+                f"{self.checkpoint_compact_ratio}")
+        if self.checkpoint_incremental:
+            if self.backend not in (Backend.SPARSE, Backend.HYBRID):
+                # The delta records' domain is the canonical sparse
+                # rows_key/rows_cnt blob; dense C matrices have no
+                # dirty-row representation to replay.
+                raise ValueError(
+                    "--checkpoint-incremental needs a sparse-family "
+                    "backend (--backend sparse, any shard count); got "
+                    f"--backend {self.backend.value}")
+            if self.scorer_breaker_threshold > 0:
+                # A tripped breaker scores on the host fallback: rows it
+                # rescored never reach the store's dirty log, so a delta
+                # written mid-trip would silently miss them.
+                raise ValueError(
+                    "--checkpoint-incremental cannot run with "
+                    "--scorer-breaker-threshold: fallback-scored rows "
+                    "bypass the dirty-row log — disable one of the two")
         if self.restart_backoff_base_ms < 0 or self.restart_backoff_max_ms < 0:
             raise ValueError("restart backoff values must be >= 0")
         if (self.restart_backoff_base_ms
@@ -770,7 +806,22 @@ class Config:
                        dest="checkpoint_retain",
                        help="Generation-numbered checkpoints to keep "
                             "(restore falls back to the newest one that "
-                            "verifies; default: 3)")
+                            "verifies; chain-aware: a base or delta some "
+                            "retained generation chains through is never "
+                            "deleted; default: 3)")
+        p.add_argument("--checkpoint-incremental", action="store_true",
+                       dest="checkpoint_incremental",
+                       help="Dirty-row incremental checkpoint generations "
+                            "(sparse backends): a full base plus per-"
+                            "generation delta.<gen>.bin files holding only "
+                            "rows touched since the previous generation — "
+                            "commit bytes scale with churn, not vocab; "
+                            "restore replays base + deltas bit-identically")
+        p.add_argument("--checkpoint-compact-ratio", type=float,
+                       default=0.5, dest="checkpoint_compact_ratio",
+                       help="Rewrite a fresh full base once the delta "
+                            "chain's bytes exceed this fraction of the "
+                            "base's (bounds restore replay; default: 0.5)")
         p.add_argument("--restart-on-failure", type=int, default=0,
                        dest="restart_on_failure",
                        help="Supervise the run: respawn the job up to N "

@@ -108,6 +108,26 @@ class CooccurrenceJob:
                 config.user_cut, config.seed, config.skip_cuts,
                 counters=self.counters)
         self.scorer = scorer if scorer is not None else self._make_scorer()
+        # Incremental-checkpoint job-side dirty tracker (state/delta.py):
+        # users touched per fired window + vocab-length cursors. None =
+        # incremental off (zero hot-path cost).
+        self._ckpt_dirty = None
+        if config.checkpoint_incremental:
+            # Incremental checkpoints (state/delta.py): arm the store's
+            # dirty-row log — the scorer feeds it the same per-window
+            # touched-rows set the tiered store's recency clock stamps,
+            # and checkpoint.save drains it per generation. Config
+            # validation restricted the flag to sparse-family backends,
+            # all of which expose a StateStore.
+            store = getattr(self.scorer, "store", None)
+            if store is None:
+                raise ValueError(
+                    "--checkpoint-incremental needs a StateStore-backed "
+                    "scorer (sparse backends)")
+            store.enable_ckpt_dirty()
+            from .state.delta import JobDirtyTracker
+
+            self._ckpt_dirty = JobDirtyTracker()
         if self.degrade is not None and config.coordinator is not None:
             # Multi-host degradation (robustness/gang.py plane): every
             # observed window exchanges each host's worst signal
@@ -513,6 +533,11 @@ class CooccurrenceJob:
             self.windows_fired += 1
             if faults.PLAN is not None:
                 faults.PLAN.fire("window_fire", seq=self.windows_fired)
+            if self._ckpt_dirty is not None:
+                # Incremental-checkpoint user feed: the reservoir only
+                # mutates for this window's users, so they are exactly
+                # the sampler-state dirty set (state/delta.py).
+                self._ckpt_dirty.users.note(np.unique(users))
             if self.degrade is not None:
                 # Apply the level in force to this window's cuts BEFORE
                 # sampling (sampling-thread-only writes; identity at
@@ -746,6 +771,21 @@ class CooccurrenceJob:
         # processed windows; land them in `latest` before snapshotting.
         self._absorb(self._flush_scorer())
         ckpt.save(self, self.config.checkpoint_dir, source=source)
+        if self.journal is not None and ckpt.LAST_COMMIT is not None:
+            # One out-of-band checkpoint record per commit (journal
+            # CKPT_SCHEMA): the commit-cost trajectory — bytes, wall
+            # seconds, full-vs-delta and the chain depth — is flight-
+            # recorder data, not just a gauge snapshot.
+            from .observability.journal import VERSION
+
+            c = ckpt.LAST_COMMIT
+            self.journal.record({
+                "v": VERSION, "checkpoint": c["gen"], "kind": c["kind"],
+                "bytes": int(c["bytes"]),
+                "seconds": round(c["seconds"], 6),
+                "chain_len": int(c["chain_len"]),
+                "wall_unix": round(time.time(), 3),
+            })
 
     def restore(self, source=None) -> None:
         from .state import checkpoint as ckpt

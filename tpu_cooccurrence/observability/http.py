@@ -189,6 +189,26 @@ class MetricsServer:
                    "degradation_level": level,
                    "quarantined_total": int(
                        self.registry.gauge(QUARANTINE_GAUGE).get())}
+        from ..state.checkpoint import (CHAIN_LEN_GAUGE,
+                                        COMMIT_BYTES_GAUGE,
+                                        COMMIT_SECONDS_GAUGE,
+                                        GENERATION_GAUGE)
+
+        ckpt_gen = int(self.registry.gauge(GENERATION_GAUGE).get())
+        if ckpt_gen:
+            # Checkpoint plane (present once a generation was written or
+            # restored): the last commit's cost and the delta-chain
+            # depth — an operator watching restore-replay budgets reads
+            # these beside the staleness fields.
+            payload["checkpoint"] = {
+                "generation": ckpt_gen,
+                "commit_bytes": int(self.registry.gauge(
+                    COMMIT_BYTES_GAUGE).get()),
+                "commit_seconds": round(self.registry.gauge(
+                    COMMIT_SECONDS_GAUGE).get(), 6),
+                "delta_chain_len": int(self.registry.gauge(
+                    CHAIN_LEN_GAUGE).get()),
+            }
         if self.serving is not None:
             snap_age = self.serving.snapshot_age_seconds()
             payload["snapshot_generation"] = self.serving.generation

@@ -27,7 +27,11 @@ line — the expected shape of a file whose writer was SIGKILLed mid-
 Besides window records, the file may carry out-of-band **event
 records** (:data:`EVENT_SCHEMA`, distinguished by an ``"event"`` key):
 today the degradation plane's admission-side level transitions, which
-must reach disk even when no window ever completes again.
+must reach disk even when no window ever completes again. Checkpoint
+commits append **checkpoint records** (:data:`CKPT_SCHEMA`,
+distinguished by a ``"checkpoint"`` key): per-generation commit bytes /
+seconds / full-vs-delta kind / chain depth — the incremental plane's
+cost trajectory.
 """
 
 from __future__ import annotations
@@ -97,11 +101,48 @@ EVENT_SCHEMA = {
 }
 
 
+#: Out-of-band checkpoint record (distinguished by the ``"checkpoint"``
+#: key = generation number): one per commit, written by
+#: ``job.checkpoint`` from ``state/checkpoint.LAST_COMMIT``. The
+#: commit-cost trajectory (``bytes``, ``seconds``, full-vs-delta
+#: ``kind``, delta ``chain_len``) is the operator's view of what
+#: ``--checkpoint-incremental`` is buying per generation.
+CKPT_SCHEMA = {
+    "v": (True, int),
+    "checkpoint": (True, int),   # generation number committed
+    "kind": (True, str),         # "full" | "delta"
+    "bytes": (True, int),        # npz + delta file bytes committed
+    "seconds": (True, float),    # commit wall seconds
+    "chain_len": (True, int),    # delta generations behind this one
+    "wall_unix": (True, float),
+}
+
+
 def validate_record(rec: dict) -> None:
     """Raise ``ValueError`` unless ``rec`` matches :data:`SCHEMA` (window
     records) or :data:`EVENT_SCHEMA` (out-of-band event records)."""
     if not isinstance(rec, dict):
         raise ValueError(f"journal record is not an object: {rec!r}")
+    if "checkpoint" in rec:
+        for field, (required, typ) in CKPT_SCHEMA.items():
+            v = rec.get(field)
+            ok = (isinstance(v, (int, float)) if typ is float
+                  else isinstance(v, typ)) and not isinstance(v, bool)
+            if required and not ok:
+                raise ValueError(
+                    f"journal checkpoint record field {field!r} bad: {rec}")
+        unknown = set(rec) - set(CKPT_SCHEMA)
+        if unknown:
+            raise ValueError(
+                f"journal checkpoint record has unknown fields "
+                f"{unknown}: {rec}")
+        if rec["v"] != VERSION:
+            raise ValueError(f"journal version {rec['v']} != {VERSION}")
+        if rec["kind"] not in ("full", "delta"):
+            raise ValueError(
+                f"journal checkpoint record kind {rec['kind']!r} "
+                f"must be full|delta")
+        return
     if "event" in rec:
         for field, (required, typ) in EVENT_SCHEMA.items():
             v = rec.get(field)

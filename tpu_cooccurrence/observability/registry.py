@@ -85,6 +85,13 @@ CANONICAL_METRICS = frozenset({
     # checkpoint plane (state/checkpoint.py)
     "cooc_checkpoint_quarantined_total",
     "cooc_checkpoint_generation",
+    # incremental checkpoints + delta log (--checkpoint-incremental,
+    # state/checkpoint.py + state/delta.py): per-commit cost and the
+    # chain depth behind the newest generation
+    "cooc_checkpoint_commit_bytes",
+    "cooc_checkpoint_commit_seconds",
+    "cooc_checkpoint_delta_chain_len",
+    "cooc_checkpoint_compactions_total",
     # gang / epoch-commit plane (state/checkpoint.py epoch markers,
     # robustness/gang.py peer table)
     "cooc_epoch_committed",

@@ -456,6 +456,10 @@ class ShardedSparseScorer:
         window_sum = int(delta64.sum())
         self.observed += window_sum
         self.counters.add(ROW_SUM_PROCESS_WINDOW, window_sum)
+        # Incremental-checkpoint dirty feed (state/delta.py): global
+        # rows touched this window. No-op unless
+        # --checkpoint-incremental armed the store's log.
+        self.store.note_touched(rows)
 
         # Per-shard placement: cells by owner, local keys stay sorted
         # because src // D is monotone within a fixed residue class.

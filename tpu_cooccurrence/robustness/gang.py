@@ -33,11 +33,15 @@ the JobManager analogue, three pieces:
 
 * :func:`agree_restore_generation` — the restore vote. Each process
   computes its newest *committed* checkpoint generation (one with an
-  ``EPOCH`` marker; see ``state/checkpoint.py``), the gang allgathers
-  the minimum, and every process quarantines anything newer as
-  ``*.partial``. A crash anywhere between the first per-host generation
-  rename and the last epoch marker therefore drags every host back to
-  the same previous epoch — never a torn global restore.
+  ``EPOCH`` marker; see ``state/checkpoint.py`` — under
+  ``--checkpoint-incremental`` a generation counts only when its FULL
+  delta chain is present and committed, so a torn delta commit can
+  never be voted restorable), the gang allgathers the minimum, and
+  every process quarantines anything newer as ``*.partial`` (delta
+  files included). A crash anywhere between the first per-host
+  generation rename and the last epoch marker therefore drags every
+  host back to the same previous epoch — never a torn global restore
+  (``test_gang_incremental_ckpt_mid_delta_crash_bit_identical``).
 
 The ``peers`` table on ``/healthz`` (:class:`PeerTable`) reads the same
 heartbeat files plus each suffix's committed-epoch markers, and turns a
@@ -227,12 +231,13 @@ def agree_restore_generation(directory: str, suffix: str,
     fresh start) after quarantining anything newer on this host.
 
     Each process contributes its newest committed generation
-    (``checkpoint.newest_committed`` — the newest ``EPOCH``-marked one,
-    or, for a pre-epoch legacy directory with no markers at all, the
-    newest generation file); the gang-wide MINIMUM wins, because a
-    generation missing a marker on *any* host may be a torn global
-    commit. Generations above the agreed one are moved aside as
-    ``*.partial`` so no later walk can restore them.
+    (``checkpoint.newest_committed`` — the newest ``EPOCH``-marked one
+    whose delta chain, if any, is fully present and committed; or, for
+    a pre-epoch legacy directory with no markers at all, the newest
+    generation file); the gang-wide MINIMUM wins, because a generation
+    missing a marker on *any* host may be a torn global commit.
+    Generations above the agreed one are moved aside as ``*.partial``
+    (their delta files too) so no later walk can restore them.
 
     ``exchange`` is the min-vote collective (injectable for tests);
     default is the watchdog-guarded

@@ -40,6 +40,37 @@ Durability (the recovery-loop contract, ``tests/test_chaos.py``):
   ``LATEST`` — a torn-pointer window the digest cannot see because both
   files verify.
 
+Incremental generations (``--checkpoint-incremental``, ISSUE 12): a
+full *base* generation plus per-generation *row-delta* files
+(``delta<suffix>.<gen>.bin``, ``state/delta.py``) holding only the rows
+touched since the previous committed generation — commit bytes scale
+with churn, not vocab, so checkpoint intervals can shrink and
+restart replay with them. The chain rules:
+
+* A generation is incremental iff its delta file exists (chain
+  structure is derivable from a directory listing alone — the gang
+  restore vote never opens an npz); a delta generation's predecessor is
+  always ``gen - 1``, its *base* is the newest generation at or below
+  it without a delta file.
+* The delta file is renamed into place BEFORE the generation's npz: the
+  npz rename commits the generation (its embedded meta records the
+  delta file's sha256, so a swapped or torn delta cannot restore), and
+  a delta file without its npz is an orphan the next save sweeps.
+* Restore reconstructs ``base + delta[B+1..G]`` into exactly the arrays
+  a full generation-``G`` checkpoint would hold — byte-identical in
+  every StateStore / cell-dtype / wire-format / topology combination
+  (``tests/test_incremental_checkpoint.py``). A corrupt delta is
+  quarantined ``*.corrupt`` and the walk falls back exactly like the
+  torn-npz path.
+* A ratio trigger (``--checkpoint-compact-ratio``: delta-chain bytes vs
+  base bytes) rewrites a fresh base at the next window boundary and the
+  old chain ages out under ``--checkpoint-retain``; retention never
+  deletes a base or intermediate delta some retained generation still
+  chains through.
+* The same delta files are a consumable, documented **delta log**
+  (``state/delta.read_delta_stream``) — ROADMAP #2's read replicas tail
+  it for catch-up instead of re-syncing full snapshots.
+
 Multi-host epoch commit (the gang contract, ``robustness/gang.py``):
 each process of a multi-controller run checkpoints its own row block as
 ``state.p<i>.<gen>.npz``, which makes "the checkpoint" a *set* of files
@@ -74,6 +105,7 @@ import numpy as np
 from ..metrics import RESCORED_ITEMS
 from ..observability.registry import REGISTRY
 from ..robustness import faults
+from . import delta as deltalog
 
 LOG = logging.getLogger("tpu_cooccurrence.checkpoint")
 
@@ -99,6 +131,25 @@ EPOCH_GAUGE = "cooc_epoch_committed"
 #: newer than the gang's agreed committed epoch, moved aside as
 #: ``*.partial`` before restore.
 PARTIAL_GAUGE = "cooc_checkpoint_partial_total"
+
+#: Last commit's total bytes (npz + delta file) — the headline the
+#: incremental plane exists to shrink.
+COMMIT_BYTES_GAUGE = "cooc_checkpoint_commit_bytes"
+
+#: Last commit's wall seconds (arrays snapshot to durable rename).
+COMMIT_SECONDS_GAUGE = "cooc_checkpoint_commit_seconds"
+
+#: Delta generations between the last written generation and its base
+#: (0 = the last commit was a full base).
+CHAIN_LEN_GAUGE = "cooc_checkpoint_delta_chain_len"
+
+#: Ratio-triggered base rewrites (--checkpoint-compact-ratio).
+COMPACTIONS_GAUGE = "cooc_checkpoint_compactions_total"
+
+#: Stats of this process's most recent :func:`save` — the journal
+#: checkpoint record's source (read by ``job.checkpoint`` right after
+#: the save returns; single writer thread per process).
+LAST_COMMIT: "dict | None" = None
 
 
 class CheckpointCorrupt(ValueError):
@@ -143,6 +194,43 @@ def _fsync_dir(directory: str) -> None:
         os.close(fd)
 
 
+def chain_of(directory: str, suffix: str,
+             gen: int) -> "tuple[int, list[int]]":
+    """``(base_gen, delta_gens_ascending)`` for ``gen``, derived purely
+    from the directory listing: a generation is incremental iff its
+    ``delta<suffix>.<gen>.bin`` exists, and a delta generation's
+    predecessor is always ``gen - 1`` (save only extends the chain when
+    the newest on-disk generation is the dirty log's anchor)."""
+    dset = set(deltalog.delta_generations(directory, suffix))
+    chain = []
+    g = gen
+    while g in dset:
+        chain.append(g)
+        g -= 1
+    chain.reverse()
+    return g, chain
+
+
+def chain_bytes(directory: str, suffix: str, base: int,
+                chain: "list[int]") -> "tuple[int, int]":
+    """``(base_bytes, delta_chain_bytes)`` for an already-derived chain
+    (:func:`chain_of` — passed in so the caller's directory listing is
+    not walked twice). Missing files count as 0 (the ratio then errs
+    toward compaction, which is the safe direction)."""
+    try:
+        base_b = os.path.getsize(_gen_path(directory, suffix, base))
+    except OSError:
+        base_b = 0
+    total = 0
+    for g in chain:
+        try:
+            total += os.path.getsize(
+                deltalog.delta_path(directory, suffix, g))
+        except OSError:
+            continue
+    return base_b, total
+
+
 def epoch_markers(directory: str, suffix: str) -> "list[int]":
     """Committed-epoch markers for this process suffix, newest first."""
     pat = re.compile(rf"^EPOCH{re.escape(suffix)}\.(\d+)$")
@@ -181,9 +269,29 @@ def committed_generations(directory: str,
 
 def newest_committed(directory: str, suffix: str) -> int:
     """Newest committed generation for this suffix, or -1 when none —
-    the per-process input to the gang's restore vote."""
+    the per-process input to the gang's restore vote.
+
+    Chain-aware (ISSUE 12): an incremental generation only counts when
+    its FULL delta chain is committed here — every generation from its
+    base up must be present and epoch-marked, because a delta whose
+    predecessor is a torn global state is itself unrestorable. Derived
+    from directory listings alone (the vote must not open npz files)."""
     gens = committed_generations(directory, suffix)
-    return gens[0][0] if gens else -1
+    if not gens:
+        return -1
+    present = {g for g, _p in gens}
+    dset = set(deltalog.delta_generations(directory, suffix))
+    for g, _path in gens:
+        cur = g
+        while cur in dset and (cur - 1) in present:
+            cur -= 1
+        if cur not in dset:
+            return g
+        LOG.warning(
+            "committed generation %d (suffix %r) has an incomplete "
+            "delta chain (broken at %d) — not counting it for the "
+            "restore vote", g, suffix, cur)
+    return -1
 
 
 def quarantine_uncommitted(directory: str, suffix: str,
@@ -203,6 +311,15 @@ def quarantine_uncommitted(directory: str, suffix: str,
             LOG.error("could not quarantine uncommitted generation %d "
                       "(%s): %s", gen, path, exc)
             continue
+        dpath = deltalog.delta_path(directory, suffix, gen)
+        if os.path.exists(dpath):
+            # The generation's delta file is part of the same torn
+            # global commit; quarantining it also detaches it from any
+            # chain a directory listing would derive.
+            try:
+                os.replace(dpath, dpath + ".partial")
+            except OSError:
+                pass
         try:
             os.remove(_epoch_path(directory, suffix, gen))
         except OSError:
@@ -337,6 +454,154 @@ def _quarantine(path: str, directory: str, suffix: str) -> None:
     LOG.error("quarantined corrupt checkpoint %s -> %s", path, target)
 
 
+def _quarantine_delta(dpath: str) -> None:
+    """Move a failed-verification delta file aside as ``*.corrupt`` —
+    same contract as the torn-npz path: the crash-restart loop cannot
+    hit it again, the walk falls back one committed generation, and the
+    quarantine is counted."""
+    try:
+        os.replace(dpath, dpath + ".corrupt")
+    except OSError as exc:
+        LOG.error("could not quarantine corrupt delta %s: %s", dpath, exc)
+        return
+    REGISTRY.gauge(
+        QUARANTINE_GAUGE,
+        help="checkpoint files that failed verification, moved aside "
+             "as *.corrupt").add(1)
+    LOG.error("quarantined corrupt checkpoint delta %s -> *.corrupt",
+              dpath)
+
+
+def _decode_codec(data: "dict[str, np.ndarray]", meta: dict) -> None:
+    """Decode ``ckpt_codec``-packed blobs back to canonical arrays in
+    place (state/wire.py delta+varint generation format). Absent record
+    = pre-codec file, restored through the raw path unchanged."""
+    codec = meta.get("ckpt_codec")
+    if not codec:
+        return
+    from .wire import decode_sorted_u64, decode_varint
+
+    if codec.get("v") != 1:
+        raise ValueError(
+            f"unknown checkpoint codec version {codec.get('v')!r} "
+            f"(written by a newer framework?)")
+    for name, (spec, count) in codec["arrays"].items():
+        blob = data.pop(name + "__packed")
+        if spec == "sdv":
+            data[name] = decode_sorted_u64(blob, count)
+        elif spec == "v":
+            data[name] = decode_varint(blob, count).astype(np.int64)
+        else:
+            raise ValueError(
+                f"unknown checkpoint array codec {spec!r} for {name}")
+
+
+#: Canonical big-blob keys an incremental generation omits from its npz
+#: (reconstructed from base + delta replay instead).
+_BLOB_KEYS = ("rows_key", "rows_cnt", "mh_rows_key", "mh_local_cnt",
+              "row_sums", "observed", "mh_local_shards")
+_LATEST_KEYS = ("latest_items", "latest_offsets", "latest_others",
+                "latest_scores")
+
+
+def _resolve_chain(directory: str, suffix: str, top_gen: int,
+                   top_meta: dict) -> "tuple[dict, tuple, dict]":
+    """Reconstruct an incremental generation's big arrays: walk the
+    delta files down to the full base, then replay them oldest-first
+    over the base blob.
+
+    Verification chain: the top npz's digest was already checked and
+    its meta commits the top delta's sha256; every delta file carries
+    its own sha256 trailer plus ``gen``/``prev``/``base`` cross-links
+    (a delta generation's predecessor is always ``gen - 1`` and every
+    chain member records the same base), and the base npz verifies its
+    own digest. Intermediate npzs are deliberately NOT opened — their
+    arrays are superseded by the top generation's, and under the
+    commit protocol a delta file at a chain position can only be the
+    one its generation's npz committed (orphans are overwritten or
+    removed by the next save, quarantine/step-back move npz and delta
+    together), so re-reading each one's meta would cost a full
+    inflate+digest per generation for no additional integrity.
+
+    Raises :class:`CheckpointCorrupt` on any broken link; provably
+    corrupt files are quarantined (``*.corrupt``) so the restart loop
+    cannot hit them again, while MISSING links quarantine nothing (the
+    walk simply falls back past the gap).
+    """
+    deltas = []
+    rec = top_meta["ckpt_delta"]
+    base_gen = int(rec["base"])
+    top_sha = rec.get("sha256")
+    cur_gen = top_gen
+    while cur_gen > base_gen:
+        dpath = deltalog.delta_path(directory, suffix, cur_gen)
+        try:
+            with open(dpath, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError as exc:
+            # Missing = broken link (fall back past the gap); any other
+            # OSError is environmental and propagates for the
+            # supervisor's restart to retry (same policy as
+            # _load_verified).
+            raise CheckpointCorrupt(
+                f"chain broken at generation {cur_gen}: missing delta "
+                f"file ({exc})")
+        if cur_gen == top_gen \
+                and hashlib.sha256(raw).hexdigest() != top_sha:
+            _quarantine_delta(dpath)
+            raise CheckpointCorrupt(
+                f"delta for generation {cur_gen} does not match the "
+                f"sha256 its generation meta committed")
+        try:
+            d = deltalog.decode_delta(raw)
+        except deltalog.DeltaCorrupt as exc:
+            _quarantine_delta(dpath)
+            raise CheckpointCorrupt(
+                f"corrupt delta for generation {cur_gen}: {exc}")
+        if d.gen != cur_gen or d.prev != cur_gen - 1 \
+                or d.base != base_gen:
+            _quarantine_delta(dpath)
+            raise CheckpointCorrupt(
+                f"delta header ({d.gen}/{d.prev}/{d.base}) does not "
+                f"link generation {cur_gen} to base {base_gen}")
+        deltas.append(d)
+        cur_gen -= 1
+    ppath = _gen_path(directory, suffix, base_gen)
+    try:
+        base_data = _load_verified(ppath)
+    except CheckpointCorrupt:
+        _quarantine(ppath, directory, suffix)
+        raise
+    except FileNotFoundError as exc:
+        # Missing link: fall back past it. Other OSErrors are
+        # environmental and propagate (supervisor retries).
+        raise CheckpointCorrupt(
+            f"chain broken at generation {base_gen}: {exc}")
+    if "meta_json" not in base_data:
+        raise CheckpointCorrupt(
+            f"chain base generation {base_gen} has no embedded meta")
+    pmeta = json.loads(bytes(base_data["meta_json"]).decode())
+    if pmeta.get("ckpt_delta") is not None:
+        raise CheckpointCorrupt(
+            f"chain base generation {base_gen} is itself incremental "
+            f"— the chain structure is inconsistent")
+    _decode_codec(base_data, pmeta)
+    blob = {k: base_data[f"scorer_{k}"] for k in _BLOB_KEYS
+            if f"scorer_{k}" in base_data}
+    latest = tuple(base_data[k] for k in _LATEST_KEYS)
+    aux = {k: base_data[k] for k in ("item_vocab", "user_vocab")}
+    if "hist" in base_data:
+        aux.update({k: base_data[k]
+                    for k in ("hist", "hist_len", "total", "draws")})
+    state = deltalog.ChainState(blob, latest,
+                                n_shards=deltas[0].n_shards, aux=aux)
+    try:
+        state.replay(list(reversed(deltas)))  # oldest first, one pass
+    except deltalog.DeltaCorrupt as exc:
+        raise CheckpointCorrupt(f"delta replay failed: {exc}")
+    return state.close()
+
+
 def step_back(directory: str, suffix: str = "") -> "int | None":
     """Retire the newest generation (crash-loop breaker: the supervisor
     calls this when restarts keep dying post-restore, so the next
@@ -348,6 +613,15 @@ def step_back(directory: str, suffix: str = "") -> "int | None":
         return None
     gen, path = gens[0]
     os.replace(path, path + ".rolledback")
+    dpath = deltalog.delta_path(directory, suffix, gen)
+    if os.path.exists(dpath):
+        # Retire the generation's delta with it: the remaining chain
+        # (base .. gen-1) stays intact, so stepping back from a delta
+        # generation lands on a restorable prefix.
+        try:
+            os.replace(dpath, dpath + ".rolledback")
+        except OSError:
+            pass
     _update_latest(directory, suffix)
     LOG.warning("crash-loop breaker: stepped back checkpoint generation "
                 "%d (%s -> *.rolledback); next restore uses generation %d",
@@ -364,7 +638,9 @@ def _sweep_aged_quarantine(directory: str, suffix: str,
     Called by :func:`save` alongside generation retention so the two
     windows can never drift apart."""
     pat = re.compile(
-        rf"^state{re.escape(suffix)}\.(\d+)\.npz\.(?:corrupt|partial)$")
+        rf"^(?:state{re.escape(suffix)}\.(\d+)\.npz"
+        rf"|delta{re.escape(suffix)}\.(\d+)\.bin)"
+        rf"\.(?:corrupt|partial)$")
     legacy = os.path.basename(_legacy_path(directory, suffix)) + ".corrupt"
     try:
         names = os.listdir(directory)
@@ -372,7 +648,8 @@ def _sweep_aged_quarantine(directory: str, suffix: str,
         return
     for name in names:
         m = pat.match(name)
-        gen = int(m.group(1)) if m else (0 if name == legacy else None)
+        gen = (int(m.group(1) or m.group(2)) if m
+               else (0 if name == legacy else None))
         if gen is None or gen >= oldest_kept:
             continue
         try:
@@ -409,6 +686,7 @@ def _sweep_orphan_tmps(directory: str) -> None:
 
 def save(job, directory: str, source=None) -> str:
     """Write a checkpoint of ``job`` (and optionally its file source)."""
+    t0 = time.monotonic()
     os.makedirs(directory, exist_ok=True)
     _sweep_orphan_tmps(directory)
     arrays = {}
@@ -479,6 +757,95 @@ def save(job, directory: str, source=None) -> str:
     arrays["latest_others"] = np.asarray(lat_others, dtype=np.int64)
     arrays["latest_scores"] = np.asarray(lat_scores, dtype=np.float64)
 
+    # Multi-host runs checkpoint per process (each host owns a row block
+    # and its partition of the results); the scorer supplies the suffix.
+    suffix = getattr(job.scorer, "process_suffix", "")
+    gens = generations(directory, suffix)
+    gen = (gens[0][0] + 1) if gens else 1
+    prev = gens[0][0] if gens else None
+
+    # Incremental generation decision (--checkpoint-incremental): write
+    # a row-delta file instead of the full slab when (a) the store's
+    # dirty log is armed and anchored at the newest on-disk generation
+    # (anything else — fresh store, foreign files — forces a base), (b)
+    # the log did not overflow to all-dirty, and (c) the existing chain
+    # is still under the compaction ratio. The big arrays are popped
+    # from the npz BEFORE the blob codec runs, so an incremental npz
+    # carries only the small state (vocabs, cuts, sampler, buffers).
+    delta_bytes = None
+    delta_file = deltalog.delta_path(directory, suffix, gen)
+    chain_len = 0
+    store = getattr(job.scorer, "store", None)
+    log = getattr(store, "ckpt_dirty", None) if store is not None else None
+    tracker = getattr(job, "_ckpt_dirty", None)
+    if (log is not None and tracker is not None
+            and getattr(job.config, "checkpoint_incremental", False)
+            and prev is not None and log.anchor_gen == prev
+            and tracker.users.anchor_gen == prev):
+        dirty, all_dirty = log.peek()
+        dirty_users, all_dirty_u = tracker.users.peek()
+        base, chain = chain_of(directory, suffix, prev)
+        base_b, chain_b = chain_bytes(directory, suffix, base, chain)
+        ratio = float(getattr(job.config, "checkpoint_compact_ratio",
+                              0.5))
+        if all_dirty or all_dirty_u:
+            LOG.info("incremental checkpoint: dirty log overflowed — "
+                     "writing a full base at generation %d", gen)
+        elif base_b <= 0 or not os.path.exists(
+                _gen_path(directory, suffix, base)):
+            LOG.warning("incremental checkpoint: base generation %d is "
+                        "missing — writing a full base at generation %d",
+                        base, gen)
+        elif chain_b > ratio * base_b:
+            # Ratio-triggered compaction: rewrite a fresh base; the old
+            # chain ages out under --checkpoint-retain.
+            REGISTRY.gauge(
+                COMPACTIONS_GAUGE,
+                help="ratio-triggered full-base rewrites "
+                     "(--checkpoint-compact-ratio)").add(1)
+            LOG.info("incremental checkpoint: delta chain %d B vs base "
+                     "%d B exceeded --checkpoint-compact-ratio %.3g — "
+                     "compacting to a full base at generation %d",
+                     chain_b, base_b, ratio, gen)
+        else:
+            blob = {}
+            for k in ("rows_key", "rows_cnt", "mh_rows_key",
+                      "mh_local_cnt", "row_sums"):
+                kk = f"scorer_{k}"
+                if kk in arrays:
+                    blob[k] = arrays.pop(kk)
+            blob["observed"] = arrays["scorer_observed"]
+            if "scorer_mh_local_shards" in arrays:
+                blob["mh_local_shards"] = arrays["scorer_mh_local_shards"]
+            latest_cols = (arrays.pop("latest_items"),
+                           arrays.pop("latest_offsets"),
+                           arrays.pop("latest_others"),
+                           arrays.pop("latest_scores"))
+            # Job-level row-indexed state rides the delta too: the
+            # reservoir table (dirty users) and the vocab appends.
+            aux = {"item_vocab": arrays.pop("item_vocab"),
+                   "user_vocab": arrays.pop("user_vocab"),
+                   "prev_item_len": tracker.item_vocab_len,
+                   "prev_user_len": tracker.user_vocab_len}
+            if "hist" in arrays:
+                aux.update(dirty_users=dirty_users,
+                           hist=arrays.pop("hist"),
+                           hist_len=arrays.pop("hist_len"),
+                           total=arrays.pop("total"),
+                           draws=arrays.pop("draws"))
+            rec = deltalog.extract_delta(
+                blob, latest_cols, dirty,
+                job.item_vocab.to_external_batch(dirty),
+                gen=gen, prev=prev, base=base,
+                n_shards=getattr(job.scorer, "n_shards", 0), aux=aux)
+            delta_bytes = deltalog.encode_delta(rec)
+            chain_len = len(chain) + 1
+            meta["ckpt_delta"] = {
+                "v": 1, "base": base, "prev": prev,
+                "sha256": hashlib.sha256(delta_bytes).hexdigest(),
+                "bytes": len(delta_bytes), "rows": int(len(dirty)),
+            }
+
     # Checkpoint blob codec (state/wire.py): the sorted cell-key array
     # delta+varint-encodes to a fraction of its raw bytes (sorted unique
     # keys -> tiny deltas, before the npz's own deflate even runs), and
@@ -495,7 +862,12 @@ def save(job, directory: str, source=None) -> str:
             arr = np.asarray(arr)
             if arr.ndim != 1 or arr.dtype != np.int64 or not len(arr):
                 continue
-            if name.endswith("rows_key"):
+            if name.endswith("rows_key") or name.endswith("tier_rows"):
+                # Sorted nonnegative id arrays: cell keys and the
+                # tiered store's stamped-row ids (the latter is
+                # O(touched-ever rows) and rides EVERY incremental npz,
+                # so raw int64 would put a vocab-scale floor under the
+                # per-generation commit bytes).
                 try:
                     packed[name] = ("sdv", len(arr), encode_sorted_u64(arr))
                 except ValueError:
@@ -526,13 +898,18 @@ def save(job, directory: str, source=None) -> str:
     arrays["digest_sha256"] = np.frombuffer(
         compute_digest(arrays).encode(), dtype=np.uint8)
 
-    # Multi-host runs checkpoint per process (each host owns a row block
-    # and its partition of the results); the scorer supplies the suffix.
-    suffix = getattr(job.scorer, "process_suffix", "")
-    gens = generations(directory, suffix)
-    gen = (gens[0][0] + 1) if gens else 1
     if faults.PLAN is not None:
         faults.PLAN.fire("checkpoint_pre_write", seq=job.windows_fired)
+    if delta_bytes is not None:
+        # Delta file first, npz second: the npz rename is THE commit
+        # point (its meta records the delta's sha256), so a crash here
+        # leaves an orphan delta the next save overwrites or sweeps —
+        # never a generation that references a missing delta.
+        fd, dtmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        os.close(fd)
+        with open(dtmp, "wb") as f:
+            f.write(delta_bytes)
+        os.replace(dtmp, delta_file)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     os.close(fd)
     with open(tmp, "wb") as f:
@@ -542,6 +919,22 @@ def save(job, directory: str, source=None) -> str:
         faults.PLAN.fire("checkpoint_post_write", seq=job.windows_fired,
                          path=tmp, rename_to=npz_path)
     os.replace(tmp, npz_path)
+    if delta_bytes is None and os.path.exists(delta_file):
+        # A full generation re-using a crashed predecessor's number must
+        # not leave that stale delta around: chain structure is derived
+        # from delta-file presence alone.
+        try:
+            os.remove(delta_file)
+        except OSError:
+            pass
+    if log is not None:
+        # The generation is renamed into place: rows accumulated so far
+        # are durable (full or delta either way); restart the dirty log
+        # anchored here. A crash before this line only widens the next
+        # delta — never narrows it.
+        log.commit(gen)
+    if tracker is not None:
+        tracker.commit(gen, len(job.item_vocab), len(job.user_vocab))
     # Atomic LATEST pointer: an operator breadcrumb only — restore
     # always directory-scans (ordering by generation number), so the
     # pointer is advisory, never load-bearing. Quarantine and step-back
@@ -579,13 +972,27 @@ def save(job, directory: str, source=None) -> str:
             help="newest checkpoint generation whose gang epoch marker "
                  "this process committed (multi-host only)").set(gen)
     # Retention: keep the newest N generations (quarantined/rolled-back
-    # files keep their renamed forms and are not counted). Epoch markers
-    # age out with their generation files.
+    # files keep their renamed forms and are not counted) — and, chain-
+    # aware, everything the oldest kept generation still chains
+    # through: deleting a base (or an intermediate delta) would orphan
+    # every retained generation built on it. Epoch markers age out with
+    # their generation files.
     retain = max(1, getattr(job.config, "checkpoint_retain", 3))
     survivors = generations(directory, suffix)
+    kept = survivors[:retain]
+    floor = kept[-1][0] if kept else 0
+    if kept:
+        base_floor, _chain = chain_of(directory, suffix, floor)
+        floor = min(floor, base_floor)
     for old_gen, old_path in survivors[retain:]:
+        if old_gen >= floor:
+            continue  # a retained generation's chain passes through it
         try:
             os.remove(old_path)
+        except OSError:
+            pass
+        try:
+            os.remove(deltalog.delta_path(directory, suffix, old_gen))
         except OSError:
             pass
         if suffix:
@@ -598,12 +1005,42 @@ def save(job, directory: str, source=None) -> str:
     # without a sweep a long-running crashy job accumulates them
     # forever. A corrupt generation still inside the window is kept —
     # its forensics are still current.
-    _sweep_aged_quarantine(directory, suffix,
-                           oldest_kept=(survivors[: retain][-1][0]
-                                        if survivors else 0))
+    _sweep_aged_quarantine(directory, suffix, oldest_kept=floor)
     REGISTRY.gauge(
         GENERATION_GAUGE,
         help="checkpoint generation last written or restored").set(gen)
+    # Commit accounting (the headline the incremental plane shrinks):
+    # total committed bytes, wall seconds, and the chain depth behind
+    # the written generation — gauges, the journal checkpoint record
+    # and /healthz all read these.
+    commit_bytes = 0
+    try:
+        commit_bytes = os.path.getsize(npz_path)
+    except OSError:
+        pass
+    if delta_bytes is not None:
+        commit_bytes += len(delta_bytes)
+    commit_seconds = time.monotonic() - t0
+    REGISTRY.gauge(
+        COMMIT_BYTES_GAUGE,
+        help="bytes committed by the last checkpoint generation "
+             "(npz + delta file)").set(commit_bytes)
+    REGISTRY.gauge(
+        COMMIT_SECONDS_GAUGE,
+        help="wall seconds of the last checkpoint commit").set(
+            commit_seconds)
+    REGISTRY.gauge(
+        CHAIN_LEN_GAUGE,
+        help="delta generations between the last written checkpoint "
+             "and its full base (0 = full)").set(chain_len)
+    global LAST_COMMIT
+    LAST_COMMIT = {
+        "gen": gen,
+        "kind": "delta" if delta_bytes is not None else "full",
+        "bytes": commit_bytes,
+        "seconds": commit_seconds,
+        "chain_len": chain_len,
+    }
     meta_tmp = os.path.join(directory, f"meta{suffix}.json.tmp")
     with open(meta_tmp, "w") as f:
         json.dump(meta, f)
@@ -620,8 +1057,12 @@ def restore(job, directory: str, source=None) -> None:
     operator breadcrumb, not an input). A generation that fails
     to load or verify is quarantined as ``*.corrupt`` and the walk
     continues — a torn latest checkpoint costs one generation, not a
-    crash loop. Config mismatches and legacy-format errors are operator
-    errors, not corruption: they raise immediately without quarantining.
+    crash loop. Incremental generations verify their WHOLE chain (base
+    npz + every delta, digests and header cross-links); a corrupt delta
+    is quarantined like a torn npz and the walk falls back exactly one
+    committed generation. Config mismatches and legacy-format errors
+    are operator errors, not corruption: they raise immediately without
+    quarantining.
     """
     suffix = getattr(job.scorer, "process_suffix", "")
     gens = generations(directory, suffix)
@@ -633,16 +1074,45 @@ def restore(job, directory: str, source=None) -> None:
     for gen, path in gens:
         try:
             data = _load_verified(path)
-            restored_gen = gen
-            break
+        except FileNotFoundError:
+            # An earlier chain walk may have quarantined this very
+            # generation (the gens list is a snapshot): skip the stale
+            # entry rather than crash the whole restore over it.
+            LOG.warning("checkpoint generation %d vanished mid-walk "
+                        "(quarantined by a chain verification?); "
+                        "skipping", gen)
+            continue
         except CheckpointCorrupt as exc:
             LOG.error("checkpoint generation %d failed verification: %s",
                       gen, exc)
             _quarantine(path, directory, suffix)
+            continue
+        if "meta_json" in data:
+            probe = json.loads(bytes(data["meta_json"]).decode())
+            if probe.get("ckpt_delta"):
+                # Incremental generation: reconstruct the big arrays
+                # from base + delta replay; the merged dict is exactly
+                # what a full generation would have held, so everything
+                # downstream is format-agnostic.
+                try:
+                    blob, latest, aux = _resolve_chain(
+                        directory, suffix, gen, probe)
+                except CheckpointCorrupt as exc:
+                    LOG.error("checkpoint generation %d delta chain "
+                              "failed: %s", gen, exc)
+                    data = None
+                    continue
+                for k, v in blob.items():
+                    data[f"scorer_{k}"] = v
+                for k, v in zip(_LATEST_KEYS, latest):
+                    data[k] = v
+                data.update(aux)
+        restored_gen = gen
+        break
     if data is None:
         raise CheckpointCorrupt(
             f"no checkpoint generation in {directory} verifies "
-            f"(all {len(gens)} quarantined)")
+            f"(walked all {len(gens)})")
     # Meta comes from inside the npz (the atomic commit point); the
     # meta.json sidecar is informational only and may lag by a crash.
     if "meta_json" not in data:
@@ -651,28 +1121,15 @@ def restore(job, directory: str, source=None) -> None:
             "meta_json (written by a pre-atomic-commit version of this "
             "framework) — re-checkpoint with the current version")
     meta = json.loads(bytes(data["meta_json"]).decode())
-    codec = meta.get("ckpt_codec")
-    if codec:
-        # New-generation format: decode the packed blobs back to the
-        # canonical arrays before any consumer sees them. Absent record
-        # = pre-codec file, restored through the raw path unchanged.
-        from .wire import decode_sorted_u64, decode_varint
-
-        if codec.get("v") != 1:
-            raise ValueError(
-                f"unknown checkpoint codec version {codec.get('v')!r} "
-                f"(written by a newer framework?)")
-        for name, (spec, count) in codec["arrays"].items():
-            blob = data.pop(name + "__packed")
-            if spec == "sdv":
-                data[name] = decode_sorted_u64(blob, count)
-            elif spec == "v":
-                data[name] = decode_varint(blob, count).astype(np.int64)
-            else:
-                raise ValueError(
-                    f"unknown checkpoint array codec {spec!r} for {name}")
+    # Decode the ckpt_codec-packed blobs back to the canonical arrays
+    # before any consumer sees them (no-op for incremental generations:
+    # their big arrays were reconstructed above, and nothing else packs).
+    _decode_codec(data, meta)
+    # window_millis included (a real gap the ckpt-format-roundtrip rule
+    # surfaced): restoring buffered in-flight events into a job with a
+    # different window size would silently re-window them.
     for key in ("seed", "skip_cuts", "item_cut", "user_cut", "top_k",
-                "window_slide"):
+                "window_slide", "window_millis"):
         if getattr(job.config, key) != meta.get(key):
             raise ValueError(
                 f"checkpoint config mismatch for {key}: "
@@ -732,6 +1189,18 @@ def restore(job, directory: str, source=None) -> None:
 
     if source is not None and "source" in meta:
         source.restore_state(meta["source"])
+    # Anchor the incremental dirty log at the restored generation: the
+    # in-memory state now equals that generation exactly, so rows
+    # touched from here on are precisely "dirty since restored_gen" and
+    # the next save may extend its chain.
+    store = getattr(job.scorer, "store", None)
+    log = getattr(store, "ckpt_dirty", None) if store is not None else None
+    if log is not None:
+        log.commit(restored_gen)
+    tracker = getattr(job, "_ckpt_dirty", None)
+    if tracker is not None:
+        tracker.commit(restored_gen, len(job.item_vocab),
+                       len(job.user_vocab))
     REGISTRY.gauge(
         GENERATION_GAUGE,
         help="checkpoint generation last written or restored").set(

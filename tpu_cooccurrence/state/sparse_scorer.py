@@ -1754,6 +1754,10 @@ class SparseDeviceScorer:
         # preserved; their cell values ride this window's update upload
         # as extra new-cell + delta entries — no extra dispatch.
         promo_n, promo_w = self.store.promote_touched(rows)
+        # Incremental-checkpoint dirty feed (state/delta.py): the SAME
+        # touched-rows set the recency clock stamps — one dirty source,
+        # two consumers. No-op unless --checkpoint-incremental armed it.
+        self.store.note_touched(rows)
         # Narrow-cell promotion, then the per-slab split: a cell routes by
         # its row's residency, decided BEFORE this window's deltas apply.
         if self.index_w is not None:

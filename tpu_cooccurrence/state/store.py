@@ -74,12 +74,46 @@ class StateStore:
     kind = "abstract"
     #: True when the store may hold rows outside the device slab.
     tiered = False
+    #: Checkpoint dirty-row log (state/delta.DirtyRowLog), lazily
+    #: created by :meth:`enable_ckpt_dirty` — class-level ``None``
+    #: default so subclasses need no ``__init__`` cooperation and a
+    #: run without ``--checkpoint-incremental`` pays nothing.
+    _ckpt_log = None
 
     def checkpoint_state(self) -> dict:
         raise NotImplementedError
 
     def restore_state(self, st: dict) -> None:
         raise NotImplementedError
+
+    # -- incremental-checkpoint dirty feed ------------------------------
+    #
+    # One dirty source, two consumers (ISSUE 12): the scorer calls
+    # note_touched with the SAME per-window touched-rows set the tiered
+    # store's recency clock stamps; the checkpoint writer drains it per
+    # generation (state/checkpoint.save) to emit delta files whose
+    # bytes scale with churn, not vocab.
+
+    def enable_ckpt_dirty(self):
+        """Arm dirty-row tracking (``--checkpoint-incremental``).
+        Returns the log."""
+        if self._ckpt_log is None:
+            from .delta import DirtyRowLog
+
+            self._ckpt_log = DirtyRowLog()
+        return self._ckpt_log
+
+    @property
+    def ckpt_dirty(self):
+        """The dirty log, or ``None`` when incremental checkpoints are
+        off."""
+        return self._ckpt_log
+
+    def note_touched(self, rows: np.ndarray) -> None:
+        """Record this window's touched rows for the checkpoint delta
+        (no-op unless :meth:`enable_ckpt_dirty` armed the log)."""
+        if self._ckpt_log is not None:
+            self._ckpt_log.note(rows)
 
     def tick(self) -> None:
         """Advance the window clock; spill whatever went cold."""
@@ -481,9 +515,14 @@ class TieredSlabStore(StateStore):
     # -- checkpoint blobs ------------------------------------------------
 
     def checkpoint_state(self) -> dict:
-        """The canonical blob, arena cells merged back in — byte-
-        identical to a spill-off run's checkpoint (placement is not a
-        checkpoint concern)."""
+        """The canonical blob, arena cells merged back in — the CELL
+        arrays stay byte-identical to a spill-off run's (placement is
+        not a checkpoint concern). The spill clock rides alongside as
+        supplemental ``tier_*`` arrays (ages relative to the clock, so
+        the values are resume-position-free): a restore resumes the
+        same residency trajectory instead of starting every row hot and
+        waiting ``threshold`` windows to re-spill the cold tail. Other
+        stores ignore the keys — blobs stay interchangeable."""
         st = self.scorer._device_checkpoint_state()
         keys_a, cnt_a = self.arena.all_cells()
         if len(keys_a):
@@ -495,18 +534,52 @@ class TieredSlabStore(StateStore):
             nz = vals != 0
             st["rows_key"] = keys[nz]
             st["rows_cnt"] = vals[nz]
+        stamped = np.flatnonzero(self.last_touch >= 0).astype(np.int64)
+        st["tier_clock"] = np.asarray([self.clock], dtype=np.int64)
+        st["tier_rows"] = stamped
+        # Ages clipped at the eligibility threshold: relative coldness
+        # among already-eligible rows is deliberately collapsed — the
+        # exact collapse the tick's bucket consolidation applies — so
+        # the rider stays a tiny-alphabet array (deflates to almost
+        # nothing at vocab scale) while eligibility round-trips
+        # exactly.
+        st["tier_ages"] = np.minimum(
+            self.clock - self.last_touch[stamped],
+            self.threshold).astype(np.int32)
         return st
 
     def restore_state(self, st: dict) -> None:
-        """Restore everything hot (recency is not checkpointed —
-        untouched rows re-spill ``threshold`` windows in)."""
+        """Restore everything hot. With ``tier_*`` arrays in the blob
+        the recency clock resumes where the writer left it (same
+        residency trajectory — untouched cold rows re-spill at the next
+        tick, pinned by the spill-parity-across-restore test); a legacy
+        blob without them restores with every row freshly stamped and
+        the cold tail re-spills ``threshold`` windows in."""
         self.scorer._device_restore_state(st)
         self.arena.reset()
         self._buckets.clear()
-        self.clock = 0
         self.last_touch = np.full(self.scorer.items_cap, -1,
                                   dtype=np.int64)
         self._resident = np.zeros(self.scorer.items_cap, dtype=bool)
+        if "tier_rows" in st:
+            self.clock = int(np.asarray(st["tier_clock"]).reshape(-1)[0])
+            rows = np.asarray(st["tier_rows"], dtype=np.int64)
+            ages = np.asarray(st["tier_ages"], dtype=np.int64)
+            # A stamped row whose cells all decayed to zero may sit past
+            # the restored capacity (restore sizes from cell keys).
+            ok = rows < self.scorer.items_cap
+            rows, ages = rows[ok], ages[ok]
+            stamps = self.clock - ages
+            self.last_touch[rows] = stamps
+            # One argsort + split (not a per-stamp scan: distinct
+            # stamps x rows would be quadratic-ish on long runs).
+            order = np.argsort(stamps, kind="stable")
+            uniq, starts = np.unique(stamps[order], return_index=True)
+            for s, part in zip(uniq.tolist(),
+                               np.split(rows[order], starts[1:])):
+                self._buckets[int(s)] = part
+            return
+        self.clock = 0
         rows = np.unique(
             (np.asarray(st["rows_key"]) >> 32).astype(np.int64))
         if len(rows):

@@ -346,6 +346,22 @@ def decode_varint(buf: np.ndarray, count: int) -> np.ndarray:
     return out
 
 
+def encode_zigzag_varint(values: np.ndarray) -> np.ndarray:
+    """Zigzag + LEB128 for SIGNED int64 values (the delta-log columns
+    that carry arbitrary-sign data: cell counts, external ids). Exact
+    over the full int64 domain."""
+    v = np.asarray(values, dtype=np.int64)
+    zz = ((v << np.int64(1)) ^ (v >> np.int64(63))).astype(np.uint64)
+    return encode_varint(zz)
+
+
+def decode_zigzag_varint(buf: np.ndarray, count: int) -> np.ndarray:
+    """Inverse of :func:`encode_zigzag_varint` -> int64 array."""
+    zz = decode_varint(buf, count)
+    return ((zz >> np.uint64(1)).astype(np.int64)
+            ^ -(zz & np.uint64(1)).astype(np.int64))
+
+
 def encode_sorted_u64(keys: np.ndarray) -> np.ndarray:
     """Delta + varint for a sorted nonnegative int64 array (cell keys:
     sorted, unique -> tiny deltas). Raises on unsorted input — the
