@@ -246,6 +246,10 @@ def query_storm(seconds: float = None, threads: int = None,
     total = len(flat)
     arr = np.asarray(flat) if flat else np.zeros(1)
     return {
+        # Explicit status flag (ISSUE 13 satellite): a degraded arm
+        # records {"ok": false, "error": ...} in bench_history.jsonl
+        # instead of a silently absent block.
+        "ok": True,
         "users": user_space,
         "threads": threads,
         "seconds": round(seconds, 3),
@@ -259,6 +263,336 @@ def query_storm(seconds: float = None, threads: int = None,
         "snapshot_swaps": job.serving.builder.swaps,
         "server_query_seconds": server_hist,
     }
+
+
+def storm_client(url: str, seconds: float, threads: int,
+                 fallback: str = None) -> dict:
+    """Closed-loop keep-alive client pool against ONE replica (the
+    ``--storm-client`` child mode of the fleet arm — client CPU must
+    live outside the replicas' processes AND outside the orchestrating
+    parent's GIL, or the fleet's aggregate qps would be client-bound).
+
+    ``fallback``: a survivor's URL. On a connection failure (the chaos
+    kill) the thread switches ALL remaining traffic there — the
+    load-balancer drain. The failed attempt counts as a
+    ``drain_failover``, not an error; errors AFTER the drain are the
+    chaos case's acceptance metric (must be zero).
+    """
+    import http.client
+    import urllib.parse
+
+    import numpy as np
+
+    def _conn(u):
+        netloc = urllib.parse.urlparse(u).netloc
+        host, _, port = netloc.partition(":")
+        return http.client.HTTPConnection(host, int(port), timeout=10)
+
+    latencies = [[] for _ in range(threads)]
+    errors = [0] * threads
+    failovers = [0] * threads
+    stop = threading.Event()
+
+    def client(tid: int) -> None:
+        target = url
+        conn = _conn(target)
+        rng = np.random.default_rng(tid)
+        lat = latencies[tid]
+        while not stop.is_set():
+            u = int(rng.integers(0, 1_000_000))
+            t0 = time.perf_counter()
+            try:
+                conn.request("GET", f"/recommend?user={u}&n=10")
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    errors[tid] += 1
+                    continue
+            except Exception:
+                conn.close()
+                if fallback is not None and target != fallback:
+                    # The drain: all remaining traffic to the survivor.
+                    target = fallback
+                    failovers[tid] += 1
+                else:
+                    errors[tid] += 1
+                conn = _conn(target)
+                continue
+            lat.append(time.perf_counter() - t0)
+        conn.close()
+
+    pool = [threading.Thread(target=client, args=(t,), daemon=True)
+            for t in range(threads)]
+    for t in pool:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in pool:
+        t.join(timeout=30)
+    flat = [x for lat in latencies for x in lat]
+    arr = (np.asarray(flat) if flat else np.zeros(1))
+    return {
+        "url": url,
+        "threads": threads,
+        "seconds": round(seconds, 3),
+        "queries": len(flat),
+        "errors": sum(errors),
+        "drain_failovers": sum(failovers),
+        "qps": round(len(flat) / max(seconds, 1e-9), 1),
+        "query_p50_s": round(float(np.percentile(arr, 50)), 6),
+        "query_p95_s": round(float(np.percentile(arr, 95)), 6),
+        "query_p99_s": round(float(np.percentile(arr, 99)), 6),
+    }
+
+
+def _wait_replica(port_file: str, timeout_s: float = 90.0) -> dict:
+    """Wait for a replica's port file AND a 200 /healthz; returns the
+    ``{"port", "pid", "url"}`` record."""
+    import urllib.request
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            with open(port_file) as f:
+                info = json.load(f)
+            urllib.request.urlopen(info["url"] + "/healthz", timeout=2)
+            return info
+        except Exception:
+            time.sleep(0.25)
+    raise TimeoutError(f"replica never came up ({port_file})")
+
+
+def _replica_health(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/healthz", timeout=5) as r:
+        return json.load(r)
+
+
+def _fleet_storm() -> dict:
+    """The replicated-serving-fleet arm (ISSUE 13).
+
+    One live ingest job (sparse backend, ``--checkpoint-incremental``)
+    commits delta generations throughout; stateless ``cooc-replica``
+    subprocesses bootstrap from its checkpoints and tail the delta log.
+    Three phases against the same live writer:
+
+    * **single** — 1 replica, 1 client subprocess: the per-replica
+      baseline;
+    * **fleet** — N (default 3) replicas under the serving-gang
+      supervisor (``cooc-replica --fleet N``), one client subprocess
+      per replica: per-replica and AGGREGATE qps + tails — reads scale
+      with replicas, not with the TPU job;
+    * **chaos** — mid-storm, replica 0 is SIGKILLed: its client drains
+      to a survivor (zero failed queries after drain), and the fleet
+      supervisor's relaunched replica re-syncs from checkpoint + delta
+      tail to the live generation.
+    """
+    import shutil
+    import signal
+    import tempfile
+
+    from tpu_cooccurrence.config import Backend, Config
+    from tpu_cooccurrence.io.synthetic import zipfian_interactions
+    from tpu_cooccurrence.job import CooccurrenceJob
+    from tpu_cooccurrence.observability import LEDGER
+    from tpu_cooccurrence.observability.registry import REGISTRY
+    from tpu_cooccurrence.state import checkpoint as ckpt
+
+    seconds = float(os.environ.get("BENCH_FLEET_SECONDS", 4.0))
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 3))
+    threads = int(os.environ.get("BENCH_FLEET_CLIENT_THREADS", 4))
+    n_events = int(os.environ.get("BENCH_FLEET_EVENTS", 120_000))
+    REGISTRY.reset()
+    LEDGER.reset()
+    users, items, ts = zipfian_interactions(
+        n_events, n_items=20_000, n_users=1_000_000, alpha=1.1, seed=9,
+        events_per_ms=200)
+    state_dir = tempfile.mkdtemp(prefix="bench-fleet-")
+    job = CooccurrenceJob(Config(
+        window_size=50, seed=0xC0FFEE, item_cut=500, user_cut=500,
+        backend=Backend.SPARSE, checkpoint_dir=state_dir,
+        checkpoint_every_windows=2, checkpoint_retain=10_000,
+        checkpoint_incremental=True))
+    # Enough ingest for a bootstrap checkpoint, then keep the writer
+    # live across both storms (generations keep committing — the
+    # replicas must tail a MOVING log, not a finished one).
+    warm = n_events // 3
+    chunk = 4000
+    for lo in range(0, warm, chunk):
+        job.add_batch(users[lo:lo + chunk], items[lo:lo + chunk],
+                      ts[lo:lo + chunk])
+    if not ckpt.generations(state_dir, ""):
+        job.checkpoint()
+    stop_feed = threading.Event()
+    # Pace the remaining stream across both storms (~2 storm windows),
+    # so the delta log the replicas tail keeps MOVING the whole time.
+    n_chunks = max((n_events - warm + chunk - 1) // chunk, 1)
+    feed_sleep = max(0.02, 2.0 * seconds / n_chunks)
+
+    def feed() -> None:
+        lo = warm
+        while not stop_feed.is_set() and lo < n_events:
+            hi = min(lo + chunk, n_events)
+            job.add_batch(users[lo:hi], items[lo:hi], ts[lo:hi])
+            lo = hi
+            time.sleep(feed_sleep)
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    feeder.start()
+
+    env = dict(os.environ)
+    procs = []
+
+    def spawn_replica(port_file: str, extra=()) -> "subprocess.Popen":
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tpu_cooccurrence.serving.replica",
+             "--state-dir", state_dir, "--port", "0",
+             "--port-file", port_file, "--poll-interval-s", "0.2",
+             "--stale-after-s", "0", *extra],
+            env=env, cwd=REPO, stderr=subprocess.DEVNULL)
+        procs.append(p)
+        return p
+
+    def spawn_client(url: str, fallback: str = None) -> "subprocess.Popen":
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--storm-client", url, str(seconds), str(threads)]
+        if fallback:
+            cmd.append(fallback)
+        p = subprocess.Popen(cmd, env=env, cwd=REPO,
+                             stdout=subprocess.PIPE, text=True)
+        procs.append(p)
+        return p
+
+    def client_result(p: "subprocess.Popen") -> dict:
+        out, _ = p.communicate(timeout=seconds + 120)
+        for line in reversed(out.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError("storm client printed no result")
+
+    try:
+        # -- single-replica baseline ---------------------------------
+        pf = os.path.join(state_dir, "single.port")
+        single_proc = spawn_replica(pf)
+        single = _wait_replica(pf)
+        single_res = client_result(spawn_client(single["url"]))
+        single_proc.terminate()
+
+        # -- fleet storm + chaos -------------------------------------
+        fleet_dir = os.path.join(state_dir, "fleet")
+        fleet_proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_cooccurrence.serving.replica",
+             "--state-dir", state_dir, "--fleet", str(n_replicas),
+             "--fleet-dir", fleet_dir, "--poll-interval-s", "0.2",
+             "--stale-after-s", "0", "--gang-stale-after-s", "0",
+             "--restart-on-failure", "3"],
+            env=env, cwd=REPO, stderr=subprocess.DEVNULL)
+        procs.append(fleet_proc)
+        infos = [_wait_replica(os.path.join(
+            fleet_dir, f"replica.p{i}.port")) for i in range(n_replicas)]
+        gen_start = _replica_health(infos[0]["url"])["replica"][
+            "generation"]
+        # Victim's client drains to replica 1; the rest have no chaos.
+        clients = [spawn_client(
+            infos[i]["url"],
+            fallback=(infos[1]["url"] if i == 0 and n_replicas > 1
+                      else None)) for i in range(n_replicas)]
+        time.sleep(seconds * 0.4)
+        os.kill(infos[0]["pid"], signal.SIGKILL)  # the chaos kill
+        fleet_res = [client_result(c) for c in clients]
+
+        # The supervisor relaunches slot 0; it must re-sync from
+        # checkpoint + delta tail to the LIVE generation.
+        stop_feed.set()
+        feeder.join(timeout=120)
+        job.finish()
+        live_gen = ckpt.generations(state_dir, "")[0][0]
+        relaunched_gen = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                info = _wait_replica(os.path.join(
+                    fleet_dir, "replica.p0.port"), timeout_s=5)
+                if info["pid"] != infos[0]["pid"]:
+                    h = _replica_health(info["url"])
+                    relaunched_gen = h["replica"]["generation"]
+                    if relaunched_gen >= live_gen:
+                        break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        aggregate_qps = round(sum(r["qps"] for r in fleet_res), 1)
+        survivors = fleet_res[1:] if n_replicas > 1 else fleet_res
+        return {
+            "ok": True,
+            "seconds": round(seconds, 3),
+            "events": n_events,
+            "replicas": n_replicas,
+            # Scaling context: aggregate qps scales with replicas only
+            # while cores outnumber them (replica processes + client
+            # processes + the live writer all need CPU) — a 2-core box
+            # records ~1x honestly; the >= 2x claim needs the cores to
+            # put the replicas on.
+            "cpus": os.cpu_count(),
+            "client_threads_per_replica": threads,
+            "single": single_res,
+            "fleet": {
+                "per_replica_qps": [r["qps"] for r in fleet_res],
+                "aggregate_qps": aggregate_qps,
+                "queries": sum(r["queries"] for r in fleet_res),
+                "query_p99_s_max": max(r["query_p99_s"]
+                                       for r in fleet_res),
+                "errors": sum(r["errors"] for r in fleet_res),
+            },
+            # The headline: reads scale with replicas (>= 2x at 3
+            # replicas on uncontended cores; recorded honestly either
+            # way — the arm runs wherever the bench runs).
+            "qps_scaling": round(aggregate_qps
+                                 / max(single_res["qps"], 1e-9), 3),
+            "chaos": {
+                "killed_pid": infos[0]["pid"],
+                "drain_failovers": fleet_res[0]["drain_failovers"],
+                # THE acceptance number: zero failed queries after the
+                # drain (survivor errors are post-drain by definition).
+                "errors_after_drain": sum(r["errors"]
+                                          for r in survivors),
+                "victim_errors_after_drain": fleet_res[0]["errors"],
+                "relaunched": relaunched_gen is not None,
+                "resynced_generation": relaunched_gen,
+                "live_generation": live_gen,
+            },
+            "generations": [gen_start, live_gen],
+        }
+    finally:
+        stop_feed.set()
+        # SIGTERM first: the fleet supervisor's handler tears its
+        # replica children down with it — a bare SIGKILL would orphan
+        # them (no --run-seconds, polling a deleted dir forever).
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 15
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(deadline - time.monotonic(),
+                                       0.1))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        # Belt and braces: any replica grandchild that survived its
+        # supervisor is findable through the port-file pids.
+        for dirpath, _dirs, files in os.walk(state_dir):
+            for name in files:
+                if not name.endswith(".port"):
+                    continue
+                try:
+                    with open(os.path.join(dirpath, name)) as f:
+                        os.kill(json.load(f)["pid"], signal.SIGKILL)
+                except (OSError, ValueError, KeyError):
+                    pass
+        shutil.rmtree(state_dir, ignore_errors=True)
 
 
 def _longtail_churn_stream(windows: int, users_per: int, events_per: int,
@@ -505,7 +839,8 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
                    fused: dict = None, compression: dict = None,
                    serving: dict = None, spill: dict = None,
                    fused_sparse: dict = None,
-                   checkpoint: dict = None) -> None:
+                   checkpoint: dict = None,
+                   fleet: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -557,6 +892,13 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         # restore-to-first-window comparison — the commit-bandwidth and
         # restart-replay headline numbers.
         entry["checkpoint"] = checkpoint
+    if fleet:
+        # The ISSUE-13 serving-fleet storm: 1-vs-N replica qps +
+        # aggregate scaling over the live delta log, and the kill-one
+        # chaos verdict (errors after drain, relaunch re-sync) —
+        # trajectory-visible like every other arm, ok:false when the
+        # arm degraded.
+        entry["fleet"] = fleet
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -837,7 +1179,25 @@ def measure() -> None:
     try:
         serving_storm = query_storm()
     except Exception as exc:
-        serving_storm = {"error": f"{type(exc).__name__}: {exc}"}
+        # ok: false — the degraded arm must be RECORDED as degraded in
+        # bench JSON + history, not read as a silently absent block.
+        serving_storm = {"ok": False,
+                         "error": f"{type(exc).__name__}: {exc}"}
+
+    # Replicated-serving fleet arm (ISSUE 13): 1-vs-3 stateless read
+    # replicas (cooc-replica subprocesses) tailing the same live
+    # incremental-checkpoint delta log, client subprocesses hammering
+    # each replica (client CPU out of this process's GIL so the fleet's
+    # aggregate is server-bound), plus the kill-one chaos case: a
+    # replica dies mid-storm, its client drains to a survivor with zero
+    # failed queries after the drain, and the fleet supervisor's
+    # relaunched replica re-syncs from checkpoint + delta tail to the
+    # live generation.
+    try:
+        fleet_storm = _fleet_storm()
+    except Exception as exc:
+        fleet_storm = {"ok": False,
+                       "error": f"{type(exc).__name__}: {exc}"}
 
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.
@@ -871,6 +1231,7 @@ def measure() -> None:
         "spill": spill_info,
         "checkpoint": ckpt_info,
         "serving": serving_storm,
+        "fleet": fleet_storm,
     }
     if journal:
         out["journal"] = journal
@@ -892,7 +1253,7 @@ def measure() -> None:
         _record_onchip(out["value"], out["vs_baseline"], backend,
                        pipeline_depth, occupancy, latency, degradation,
                        fused_info, compression, serving_storm, spill_info,
-                       fused_sparse, ckpt_info)
+                       fused_sparse, ckpt_info, fleet_storm)
     print(json.dumps(out))
 
 
@@ -987,6 +1348,23 @@ def main() -> None:
     # under measurement; flows to the measurement children via env so the
     # parent stays argv-compatible with the driver's bare invocation.
     argv = sys.argv[1:]
+    if "--storm-client" in argv:
+        # Fleet-arm client child: hammer one replica URL, fail over to
+        # an optional survivor URL on connection loss, print one JSON
+        # line. Kept out of the parent so client CPU never shares a GIL
+        # with orchestration (or with another client).
+        i = argv.index("--storm-client")
+        try:
+            url = argv[i + 1]
+            seconds = float(argv[i + 2])
+            threads = int(argv[i + 3])
+            fallback = argv[i + 4] if len(argv) > i + 4 else None
+        except (IndexError, ValueError):
+            sys.stderr.write("bench: --storm-client URL SECONDS THREADS "
+                             "[FALLBACK_URL]\n")
+            return 2
+        print(json.dumps(storm_client(url, seconds, threads, fallback)))
+        return 0
     if "--pipeline-depth" in argv:
         i = argv.index("--pipeline-depth")
         try:

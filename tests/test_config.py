@@ -183,7 +183,7 @@ def test_gang_workers_validation():
     with pytest.raises(ValueError, match="process-continuously"):
         Config(window_size=10, seed=1, backend=Backend.SHARDED,
                gang_workers=2, process_continuously=True)
-    with pytest.raises(ValueError, match="serving tier"):
+    with pytest.raises(ValueError, match="replica fleet"):
         Config(window_size=10, seed=1, backend=Backend.SHARDED,
                gang_workers=2, serve_port=0)
 

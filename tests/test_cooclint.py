@@ -1137,3 +1137,121 @@ def test_gang_fault_sites_flags_unfired_site(tmp_path):
     # All three gang sites are unplugged in this mini-repo.
     assert len(result.findings) == 3
     assert all(f.rule == "gang-fault-sites" for f in result.findings)
+
+
+# -- rule pack: serving fleet (replica routes + generation tag) --------
+
+
+def _mini_fleet_repo(tmp_path, replica_body, http_body=None):
+    """Mini repo with a registered route table, its docs/tests
+    obligations satisfied, and a replica module under test."""
+    obs = tmp_path / "tpu_cooccurrence" / "observability"
+    obs.mkdir(parents=True)
+    (obs / "http.py").write_text(
+        http_body if http_body is not None else
+        'ROUTE_METRICS = {"/metrics": "cooc_scrape_seconds"}\n\n\n'
+        "class MetricsServer:\n"
+        "    def recommend(self, query):\n"
+        '        return 200, {"generation": 1}\n')
+    serving = tmp_path / "tpu_cooccurrence" / "serving"
+    serving.mkdir()
+    (serving / "replica.py").write_text(replica_body)
+    (tmp_path / "README.md").write_text("Routes: /metrics\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_routes.py").write_text('URL = "/metrics"\n')
+    return tmp_path
+
+
+def test_serving_route_rule_flags_replica_only_route(tmp_path):
+    """A route-shaped literal the replica module quotes that is not in
+    observability/http.py ROUTE_METRICS is an unmeasured endpoint."""
+    root = _mini_fleet_repo(
+        tmp_path,
+        "from ..observability.http import MetricsServer\n\n\n"
+        "class ReplicaServer(MetricsServer):\n"
+        "    pass\n\n\n"
+        "def sneaky(handler):\n"
+        '    handler.route("/sneaky")\n')
+    result = Analyzer(str(root), rules=[RULES["serving-route"]],
+                      baseline=[]).run()
+    assert len(result.findings) == 1
+    f = result.findings[0]
+    assert f.file.endswith("serving/replica.py")
+    assert "/sneaky" in f.message and "ROUTE_METRICS" in f.message
+    # Registered routes quoted by the replica are fine.
+    root2 = _mini_fleet_repo(
+        tmp_path / "clean",
+        "from ..observability.http import MetricsServer\n\n\n"
+        "class ReplicaServer(MetricsServer):\n"
+        "    pass\n\n\n"
+        'PROBE = "/metrics"\n')
+    result = Analyzer(str(root2), rules=[RULES["serving-route"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_replica_generation_tag_inherited_body_is_clean():
+    src = ("from ..observability.http import MetricsServer\n\n\n"
+           "class ReplicaServer(MetricsServer):\n"
+           "    pass\n")
+    assert analyze_source(
+        src, path="tpu_cooccurrence/serving/replica.py",
+        rules=["replica-generation-tag"]) == []
+
+
+def test_replica_generation_tag_flags_untagged_override():
+    src = ("from ..observability.http import MetricsServer\n\n\n"
+           "class ReplicaServer(MetricsServer):\n"
+           "    def recommend(self, query):\n"
+           '        return 200, {"items": []}\n')
+    found = analyze_source(
+        src, path="tpu_cooccurrence/serving/replica.py",
+        rules=["replica-generation-tag"])
+    assert len(found) == 1
+    assert "generation" in found[0].message
+    # The same override carrying the tag is clean.
+    src_ok = src.replace('{"items": []}',
+                         '{"items": [], "generation": 1}')
+    assert analyze_source(
+        src_ok, path="tpu_cooccurrence/serving/replica.py",
+        rules=["replica-generation-tag"]) == []
+
+
+def test_replica_generation_tag_requires_metricsserver_subclass():
+    src = ("class LoneServer:\n"
+           "    def recommend(self, query):\n"
+           '        return 200, {"generation": 1}\n')
+    found = analyze_source(
+        src, path="tpu_cooccurrence/serving/replica.py",
+        rules=["replica-generation-tag"])
+    assert len(found) == 1
+    assert "MetricsServer subclass" in found[0].message
+
+
+def test_replica_generation_tag_flags_untagged_inherited_body(tmp_path):
+    """No override: the obligation lands on the inherited
+    observability/http.py recommend body."""
+    root = _mini_fleet_repo(
+        tmp_path,
+        "from ..observability.http import MetricsServer\n\n\n"
+        "class ReplicaServer(MetricsServer):\n"
+        "    pass\n",
+        http_body=(
+            'ROUTE_METRICS = {"/metrics": "cooc_scrape_seconds"}\n\n\n'
+            "class MetricsServer:\n"
+            "    def recommend(self, query):\n"
+            '        return 200, {"items": []}\n'))
+    result = Analyzer(str(root), rules=[RULES["replica-generation-tag"]],
+                      baseline=[]).run()
+    assert len(result.findings) == 1
+    assert result.findings[0].file.endswith("observability/http.py")
+    assert "generation" in result.findings[0].message
+
+
+def test_replica_generation_tag_silent_without_replica_module():
+    """Fixture repos for other rules (no serving/replica.py) must not
+    trip this rule."""
+    assert analyze_source(
+        "X = 1\n", path="tpu_cooccurrence/other.py",
+        rules=["replica-generation-tag"]) == []

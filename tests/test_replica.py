@@ -1,0 +1,678 @@
+"""Serving fleet read replicas (ISSUE 13).
+
+The contracts under test:
+
+* **Snapshot parity (the acceptance bar)** — after tailing the delta
+  log to generation G, a replica's ``TopKSnapshot`` rows are
+  BIT-IDENTICAL to the ingest process's snapshot at G, compared
+  restored-vs-restored (the only bit-exact sparse comparator: both
+  sides rebuild from the checkpointed float64 arrays through the same
+  float32 packing).
+* **Consumer semantics of the delta log** — an orphan delta (delta
+  file present, generation npz missing) is never consumed; a
+  ``DeltaCorrupt`` mid-tail drives the documented checkpoint-resync
+  fallback (and the replica NEVER renames the writer's files — it is a
+  read-only consumer); a full generation interposed in the log
+  (compaction) re-bootstraps instead of wedging.
+* **Read-your-window consistency** — every ``/recommend`` response
+  carries the delta-log ``generation`` tag, and ``min_gen`` answers
+  503 while the replica lags the client's last-seen generation.
+* **Observability** — the ``cooc_replica_generation_lag`` gauge, the
+  lag block on the replica's ``/healthz``, and one validated
+  ``replica`` journal record per replayed generation.
+* **Fleet robustness (slow)** — kill one replica mid-storm: zero
+  failed queries after the drain, and the supervisor's relaunched
+  replica re-syncs from checkpoint + delta tail to the live
+  generation, with no writer involvement.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config
+from tpu_cooccurrence.job import CooccurrenceJob
+from tpu_cooccurrence.observability.journal import (
+    read_records,
+    validate_record,
+)
+from tpu_cooccurrence.observability.registry import REGISTRY
+from tpu_cooccurrence.serving.recommend import UserHistory
+from tpu_cooccurrence.serving.replica import ReadReplica, ReplicaServer
+from tpu_cooccurrence.serving.snapshot import SnapshotBuilder
+from tpu_cooccurrence.state import checkpoint as ckpt
+from tpu_cooccurrence.state import delta as deltalog
+from tpu_cooccurrence.state.results import TopKBatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    REGISTRY.reset()
+    yield
+
+
+def _writer_cfg(d, **kw):
+    kw.setdefault("backend", Backend.SPARSE)
+    kw.setdefault("window_size", 10)
+    kw.setdefault("seed", 0xABCD)
+    kw.setdefault("item_cut", 5)
+    kw.setdefault("user_cut", 3)
+    kw.setdefault("checkpoint_every_windows", 2)
+    kw.setdefault("checkpoint_retain", 100)
+    kw.setdefault("checkpoint_incremental", True)
+    kw.setdefault("serve_port", 0)
+    # A pure delta chain (no ratio-triggered compaction): the tail and
+    # journal assertions below need a deterministic unbroken chain; the
+    # compaction/full-generation gap paths are constructed explicitly
+    # in their own tests.
+    kw.setdefault("checkpoint_compact_ratio", 100.0)
+    return Config(checkpoint_dir=d, **kw)
+
+
+@pytest.fixture(scope="module")
+def writer_repo(tmp_path_factory):
+    """One ingest run shared by every read-side test in this file:
+    live checkpoint+delta directory, plus a copy taken at the halfway
+    checkpoint (the replica's early-bootstrap origin)."""
+    root = tmp_path_factory.mktemp("replica")
+    d = str(root / "state")
+    rng = np.random.default_rng(7)
+    n = 1600
+    users = rng.integers(0, 25, n).astype(np.int64)
+    items = rng.integers(100, 180, n).astype(np.int64)
+    ts = np.cumsum(rng.integers(0, 2, n)).astype(np.int64)
+    job = CooccurrenceJob(_writer_cfg(d))
+    half = 700
+    for lo in range(0, half, 97):
+        job.add_batch(users[lo:lo + 97], items[lo:lo + 97],
+                      ts[lo:lo + 97])
+    job.checkpoint()
+    early = str(root / "state-early")
+    shutil.copytree(d, early)
+    for lo in range(half, n, 97):
+        job.add_batch(users[lo:lo + 97], items[lo:lo + 97],
+                      ts[lo:lo + 97])
+    job.finish()
+    return {"live": d, "early": early, "users": users}
+
+
+def _tailed_replica(writer_repo, tmp_path, journal=None):
+    """A replica bootstrapped from the EARLY copy, then tailed over the
+    live directory to the newest generation."""
+    rep = ReadReplica(writer_repo["early"], journal=journal)
+    g0 = rep.bootstrap()
+    rep.state_dir = writer_repo["live"]
+    applied = rep.poll()
+    return rep, g0, applied
+
+
+def _restored_writer_snapshot(writer_repo):
+    """The restored-vs-restored comparator's writer side: a fresh job
+    restored from the live directory, serving snapshot seeded from the
+    checkpointed results."""
+    job = CooccurrenceJob(_writer_cfg(writer_repo["live"],
+                                      checkpoint_every_windows=0))
+    job.restore()
+    return job
+
+
+# -- snapshot parity (the acceptance bar) -------------------------------
+
+
+def test_replica_snapshot_parity_restored_vs_restored(writer_repo,
+                                                      tmp_path):
+    rep, g0, applied = _tailed_replica(writer_repo, tmp_path)
+    live_gen = ckpt.generations(writer_repo["live"], "")[0][0]
+    assert applied > 0 and rep.generation == live_gen > g0
+    jr = _restored_writer_snapshot(writer_repo)
+    snap_w = jr.serving.builder.current
+    snap_r = rep.plane.builder.current
+    # The replica reconstructed the WRITER's dense id space exactly.
+    np.testing.assert_array_equal(jr.item_vocab.external_array(),
+                                  rep.item_vocab.external_array())
+    assert snap_w.rows == snap_r.rows > 0
+    # Row-for-row bit identity: membership, partner ids, float32 scores.
+    rows_checked = 0
+    for dense in range(len(jr.item_vocab)):
+        rw, rr = snap_w.row(dense), snap_r.row(dense)
+        assert (rw is None) == (rr is None)
+        if rw is None:
+            continue
+        np.testing.assert_array_equal(rw[0], rr[0])
+        np.testing.assert_array_equal(rw[1], rr[1])
+        assert rr[1].dtype == np.float32
+        rows_checked += 1
+    assert rows_checked == snap_w.rows
+    # The replica's snapshot is tagged with the LOG position, not the
+    # content counter.
+    assert snap_r.generation == live_gen
+
+
+def test_mid_stream_gap_rebootstraps_not_resyncs(writer_repo, tmp_path):
+    """A delta whose predecessor the replica never saw (the shape a
+    compaction or retention leaves behind) re-bootstraps from the
+    checkpoint — the resyncs counter (which means corruption) stays
+    untouched."""
+    d = str(tmp_path / "gap")
+    shutil.copytree(writer_repo["live"], d)
+    rep = ReadReplica(writer_repo["early"])
+    g0 = rep.bootstrap()
+    rep.state_dir = d
+    top = ckpt.generations(d, "")[0][0]
+    # The writer compacts (full base, no delta) ...
+    w = CooccurrenceJob(_writer_cfg(d, checkpoint_every_windows=0,
+                                    checkpoint_incremental=False))
+    w.restore()
+    w.checkpoint()
+    # ... then keeps streaming deltas chained from the base.
+    w2 = CooccurrenceJob(_writer_cfg(d, checkpoint_every_windows=0))
+    w2.restore()
+    t0 = int(w2.engine.max_ts_seen) + 100
+    w2.add_batch(np.asarray([1, 2]), np.asarray([101, 102]),
+                 np.asarray([t0, t0 + 1]))
+    w2.checkpoint()
+    newest = ckpt.generations(d, "")[0][0]
+    assert newest == top + 2
+    assert newest in deltalog.delta_generations(d, "")  # a delta ...
+    assert top + 1 not in deltalog.delta_generations(d, "")  # ... past
+    # a full base the replica never saw: the in-stream gap.
+    applied = rep.poll()
+    assert applied > 0
+    assert rep.generation == newest
+    assert rep.resyncs == 0
+    assert rep.lag() == 0
+
+
+def test_trailing_full_generation_rebootstraps(writer_repo, tmp_path):
+    """A FULL base at the TIP of the log (a compaction with no delta
+    after it yet) must not wedge the replica one generation behind:
+    poll re-bootstraps to it."""
+    d = str(tmp_path / "trail")
+    shutil.copytree(writer_repo["live"], d)
+    rep = ReadReplica(d)
+    top = rep.bootstrap()
+    # The writer compacts: a restored job commits one more FULL
+    # generation (no delta file) at the tip.
+    w = CooccurrenceJob(_writer_cfg(d, checkpoint_every_windows=0,
+                                    checkpoint_incremental=False))
+    w.restore()
+    w.checkpoint()
+    newest = ckpt.generations(d, "")[0][0]
+    assert newest == top + 1
+    assert newest not in deltalog.delta_generations(d, "")
+    applied = rep.poll()
+    assert applied > 0
+    assert rep.generation == newest
+    assert rep.resyncs == 0
+
+
+# -- delta-log consumer semantics ---------------------------------------
+
+
+def test_orphan_delta_is_never_consumed(writer_repo, tmp_path):
+    """A delta file without its generation npz (the crashed-save shape)
+    must never advance the replica — the writer may rewrite it with
+    different content on restart."""
+    d = str(tmp_path / "orphan")
+    shutil.copytree(writer_repo["live"], d)
+    top = ckpt.generations(d, "")[0][0]
+    some_delta = deltalog.delta_path(
+        d, "", deltalog.delta_generations(d, "")[-1])
+    orphan = deltalog.delta_path(d, "", top + 3)
+    shutil.copyfile(some_delta, orphan)
+    rep = ReadReplica(d)
+    rep.bootstrap()
+    applied = rep.poll()
+    assert applied == 0
+    assert rep.generation == top  # never walked into the orphan
+    # The orphan does not even count toward lag (npz-gated newest).
+    assert rep.lag() == 0
+
+
+def test_delta_corrupt_mid_tail_drives_checkpoint_resync(writer_repo,
+                                                         tmp_path):
+    """The documented consumer loop: DeltaCorrupt while tailing ->
+    resync from the newest VERIFYING checkpoint (exactly like restore's
+    fallback walk) — and the replica, a read-only consumer, never
+    quarantines or renames the writer's files."""
+    d = str(tmp_path / "corrupt")
+    shutil.copytree(writer_repo["live"], d)
+    rep = ReadReplica(d)
+    rep.bootstrap()
+    g_at = rep.generation
+    # Rewind the replica, then corrupt the first delta it will re-read.
+    chain_base, chain = ckpt.chain_of(d, "", g_at)
+    if not chain:
+        pytest.skip("newest generation is a full base on this stream")
+    rep.generation = chain[0] - 1
+    victim = deltalog.delta_path(d, "", chain[0])
+    raw = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    applied = rep.poll()
+    assert applied > 0
+    assert rep.resyncs == 1
+    # Resynced to the newest generation whose WHOLE chain verifies: the
+    # corrupt link poisons everything chained above it.
+    assert rep.generation == chain_base
+    assert REGISTRY.gauge("cooc_replica_resyncs_total").get() == 1
+    # Read-only contract: the corrupt delta is still in place, and no
+    # *.corrupt / *.partial quarantine file appeared.
+    assert os.path.exists(victim)
+    assert not [n for n in os.listdir(d)
+                if n.endswith((".corrupt", ".partial"))]
+    # Serving survives the resync (older but internally consistent).
+    items, snap, _fb = rep.query(None, 5)
+    assert snap.generation == chain_base
+
+
+def test_retention_race_keeps_serving(writer_repo, tmp_path):
+    """Mid-service re-bootstrap racing the writer's retention: if no
+    generation is restorable at that instant, the replica keeps
+    serving its current (older, consistent) snapshot and retries next
+    poll — it must not die with CheckpointCorrupt."""
+    d = str(tmp_path / "race")
+    shutil.copytree(writer_repo["live"], d)
+    rep = ReadReplica(writer_repo["early"])
+    g0 = rep.bootstrap()
+    rows0 = rep.rows
+    rep.state_dir = d
+    # The writer "retired" everything except the newest npz+delta pair,
+    # whose chain is now unresolvable (its base is gone): a gap the
+    # re-bootstrap cannot restore from, transiently.
+    top = ckpt.generations(d, "")[0][0]
+    for g, path in ckpt.generations(d, ""):
+        if g < top:
+            os.remove(path)
+    for g in deltalog.delta_generations(d, ""):
+        if g < top:
+            os.remove(deltalog.delta_path(d, "", g))
+    applied = rep.poll()  # must not raise
+    assert applied == 0
+    assert rep.generation == g0  # still serving the old generation
+    items, snap, _fb = rep.query(None, 5)
+    assert snap.generation == g0 and rep.rows == rows0
+
+
+def test_foreign_topk_record_is_delta_corrupt(writer_repo, tmp_path):
+    """A top-K record referencing items outside the replayed vocab
+    chain must resync, never silently diverge the dense id space."""
+    rep = ReadReplica(writer_repo["early"])
+    rep.bootstrap()
+    with pytest.raises(deltalog.DeltaCorrupt):
+        rep._pack_external(rep.item_vocab,
+                           np.asarray([10 ** 12]),  # never mapped
+                           np.asarray([1]), np.asarray([10 ** 12 + 1]),
+                           np.asarray([1.0]))
+
+
+# -- observability: lag gauge, healthz block, journal record ------------
+
+
+def test_lag_gauge_healthz_and_journal_records(writer_repo, tmp_path):
+    jp = str(tmp_path / "replica.jsonl")
+    rep = ReadReplica(writer_repo["early"], journal=jp)
+    rep.bootstrap()
+    rep.state_dir = writer_repo["live"]
+    live_gen = ckpt.generations(writer_repo["live"], "")[0][0]
+    # Before the first poll the replica lags the live directory.
+    assert rep.lag() == live_gen - rep.generation > 0
+    rep._refresh_lag()
+    assert REGISTRY.gauge("cooc_replica_generation_lag").get() \
+        == rep.lag()
+    rep.poll()
+    assert REGISTRY.gauge("cooc_replica_generation_lag").get() == 0
+    assert REGISTRY.gauge("cooc_replica_generation").get() == live_gen
+    # One validated journal record per replayed delta generation, with
+    # a monotone generation column.
+    recs = [r for r in read_records(jp) if "replica" in r]
+    assert recs, "no replica journal records written"
+    for r in recs:
+        validate_record(r)
+    gens = [r["replica"] for r in recs]
+    assert gens == sorted(gens)
+    assert all(r["resyncs"] == 0 for r in recs)
+    # The /healthz lag block.
+    srv = ReplicaServer(REGISTRY, rep, port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz") as resp:
+            h = json.load(resp)
+        assert h["status"] == "ok"
+        blk = h["replica"]
+        assert blk["generation"] == live_gen
+        assert blk["newest_generation"] == live_gen
+        assert blk["lag"] == 0
+        assert blk["resyncs"] == 0
+        assert blk["deltas_applied"] == rep.deltas_applied
+        assert h["snapshot_generation"] == live_gen
+    finally:
+        srv.stop()
+    rep.close()
+
+
+def test_replica_stale_healthz_drains(writer_repo):
+    """A wedged tail loop (no poll) reports replica_stale + 503."""
+    rep = ReadReplica(writer_repo["live"])
+    rep.bootstrap()
+    rep.last_poll_unix = time.time() - 3600
+    srv = ReplicaServer(REGISTRY, rep, port=0,
+                        stale_after_s=1.0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz")
+        assert ei.value.code == 503
+        assert json.load(ei.value)["status"] == "replica_stale"
+    finally:
+        srv.stop()
+
+
+# -- read-your-window: the generation tag + min_gen gate ----------------
+
+
+def test_recommend_carries_generation_and_min_gen_gate(writer_repo,
+                                                       tmp_path):
+    rep, _g0, _ = _tailed_replica(writer_repo, tmp_path)
+    srv = ReplicaServer(REGISTRY, rep, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(
+                f"{base}/recommend?user=3&n=5") as resp:
+            body = json.load(resp)
+        assert body["generation"] == rep.generation
+        # Satisfied gate: the client's last-seen generation is served.
+        with urllib.request.urlopen(
+                f"{base}/recommend?user=3&n=5"
+                f"&min_gen={rep.generation}") as resp:
+            assert json.load(resp)["generation"] >= rep.generation
+        # Lagging replica: 503 with the routing fields.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/recommend?user=3&n=5"
+                f"&min_gen={rep.generation + 7}")
+        assert ei.value.code == 503
+        err = json.load(ei.value)
+        assert err["generation"] == rep.generation
+        assert err["min_gen"] == rep.generation + 7
+        # Garbage min_gen is a 400, not a crash.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/recommend?user=3&n=5&min_gen=banana")
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_history_replay_personalizes_known_users(writer_repo, tmp_path):
+    """The delta log's reservoir records give replicas per-user
+    history: a user the writer sampled gets the BLEND path (not the
+    popularity fallback)."""
+    rep, _g0, _ = _tailed_replica(writer_repo, tmp_path)
+    blended = 0
+    for u in np.unique(writer_repo["users"])[:10].tolist():
+        items, _snap, fallback = rep.query(int(u), 5)
+        if not fallback and items:
+            blended += 1
+    assert blended > 0, "no sampled user got a personalized blend"
+    # An unknown user still answers (popularity fallback).
+    items, _snap, fallback = rep.query(10 ** 9, 5)
+    assert fallback and items
+
+
+# -- unit surfaces ------------------------------------------------------
+
+
+def test_user_history_set_rows_clamps_and_overwrites():
+    h = UserHistory(length=4)
+    users = np.asarray([2, 5])
+    lens = np.asarray([2, 6])  # 6 > ring length: keep first 4
+    flat = np.asarray([10, 11, 20, 21, 22, 23, 24, 25])
+    h.set_rows(users, lens, flat)
+    out = np.zeros(4, dtype=np.int64)
+    assert h.recent(2, out) == 2 and list(out[:2]) == [10, 11]
+    assert h.recent(5, out) == 4 and list(out) == [20, 21, 22, 23]
+    # A later set REPLACES the row (replica replay is a set, not an
+    # append).
+    h.set_rows(np.asarray([5]), np.asarray([1]), np.asarray([99]))
+    assert h.recent(5, out) == 1 and out[0] == 99
+
+
+def test_publish_with_explicit_generation_tags_and_retags():
+    class _Vocab:
+        def __len__(self):
+            return 8
+
+        def external_array(self):
+            return np.arange(8, dtype=np.int64)
+
+    b = SnapshotBuilder(_Vocab())
+    b.absorb(TopKBatch(np.asarray([1], np.int32),
+                       np.asarray([[2]], np.int32),
+                       np.asarray([[1.5]], np.float32)))
+    snap = b.publish(generation=17)
+    assert snap.generation == 17
+    # Quiet publish with a newer tag: same object, advanced tag
+    # (content at G == content at G-1 when the delta was empty).
+    snap2 = b.publish(generation=19)
+    assert snap2 is snap and snap.generation == 19
+    # Quiet publish without a tag keeps everything.
+    assert b.publish().generation == 19
+    # Dirty publish without a tag resumes the content counter.
+    b.absorb(TopKBatch(np.asarray([2], np.int32),
+                       np.asarray([[3]], np.int32),
+                       np.asarray([[1.0]], np.float32)))
+    assert b.publish().generation == 20
+
+
+def test_fleet_child_argv_strips_and_suffixes():
+    from tpu_cooccurrence.serving.replica import _fleet_child_argv
+
+    raw = ["--state-dir", "D", "--fleet", "3", "--fleet-dir", "F",
+           "--journal", "J.jsonl", "--run-seconds", "30", "--port=5"]
+    out = _fleet_child_argv(raw, "F", 1)
+    assert "--fleet" not in out and "--fleet-dir" not in out
+    assert "--port=5" not in out
+    # Per-process journal: two replicas must not interleave one file.
+    assert out[out.index("--journal") + 1] == "J.jsonl.p1"
+    assert out[out.index("--process-id") + 1] == "1"
+    assert out[out.index("--port-file") + 1].endswith("replica.p1.port")
+    out2 = _fleet_child_argv(["--state-dir", "D", "--journal=J.jsonl"],
+                             "F", 0)
+    assert "--journal=J.jsonl.p0" in out2
+
+
+# -- the fleet (subprocess surfaces; slow lane per the tier-1 budget) ---
+
+
+def _spawn_writer_dir(tmp_path, n=1200):
+    d = str(tmp_path / "state")
+    rng = np.random.default_rng(7)
+    users = rng.integers(0, 25, n).astype(np.int64)
+    items = rng.integers(100, 160, n).astype(np.int64)
+    ts = np.cumsum(rng.integers(0, 2, n)).astype(np.int64)
+    job = CooccurrenceJob(_writer_cfg(d, serve_port=None))
+    half = n // 2
+    for lo in range(0, half, 97):
+        job.add_batch(users[lo:lo + 97], items[lo:lo + 97],
+                      ts[lo:lo + 97])
+    job.checkpoint()
+    return d, job, (users, items, ts), half
+
+
+def _wait_port(path, timeout=90):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            with open(path) as f:
+                info = json.load(f)
+            urllib.request.urlopen(info["url"] + "/healthz", timeout=2)
+            return info
+        except Exception:
+            time.sleep(0.25)
+    raise TimeoutError(path)
+
+
+@pytest.mark.slow
+def test_cooc_replica_cli_serves_and_exits(tmp_path):
+    """The cooc-replica entrypoint: bootstrap, port file, tagged
+    /recommend, clean exit at --run-seconds. Slow lane: a subprocess
+    interpreter + a --run-seconds serve window (the tier-1 870s budget
+    is already tight; the in-process tests above cover the replica
+    logic, this pins the packaging)."""
+    d, job, (users, items, ts), half = _spawn_writer_dir(tmp_path)
+    job.finish()
+    pf = str(tmp_path / "r.port")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_cooccurrence.serving.replica",
+         "--state-dir", d, "--port", "0", "--port-file", pf,
+         "--poll-interval-s", "0.2", "--run-seconds", "4"],
+        cwd=REPO, stderr=subprocess.PIPE, text=True)
+    try:
+        info = _wait_port(pf)
+        live = ckpt.generations(d, "")[0][0]
+        deadline = time.monotonic() + 10
+        gen = -1
+        while time.monotonic() < deadline and gen < live:
+            with urllib.request.urlopen(info["url"] + "/healthz",
+                                        timeout=2) as resp:
+                gen = json.load(resp)["replica"]["generation"]
+            time.sleep(0.2)
+        assert gen == live
+        with urllib.request.urlopen(
+                info["url"] + "/recommend?user=3&n=5",
+                timeout=2) as resp:
+            assert json.load(resp)["generation"] == live
+        rc = proc.wait(timeout=30)
+        assert rc == 0, proc.stderr.read()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+@pytest.mark.slow
+def test_fleet_chaos_kill_one_replica_zero_errors_after_drain(tmp_path):
+    """The acceptance chaos case: 2-replica fleet under the serving
+    gang supervisor against a live ingest; SIGKILL one replica
+    mid-storm. The drained client (the survivor) serves zero failed
+    queries throughout, and the relaunched replica re-syncs from
+    checkpoint + delta tail to the live generation — no writer
+    involvement at any point."""
+    import signal
+
+    d, job, (users, items, ts), half = _spawn_writer_dir(tmp_path)
+    fleet_dir = str(tmp_path / "fleet")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_cooccurrence.serving.replica",
+         "--state-dir", d, "--fleet", "2", "--fleet-dir", fleet_dir,
+         "--poll-interval-s", "0.2", "--run-seconds", "45",
+         "--gang-stale-after-s", "0"],
+        cwd=REPO, stderr=subprocess.PIPE, text=True)
+    try:
+        i0 = _wait_port(os.path.join(fleet_dir, "replica.p0.port"))
+        i1 = _wait_port(os.path.join(fleet_dir, "replica.p1.port"))
+        # Live ingest continues while the fleet serves.
+        for lo in range(half, len(users), 97):
+            job.add_batch(users[lo:lo + 97], items[lo:lo + 97],
+                          ts[lo:lo + 97])
+        job.finish()
+        live = ckpt.generations(d, "")[0][0]
+        os.kill(i0["pid"], signal.SIGKILL)
+        # The drained client hammers the survivor: zero failures.
+        errors = queries = 0
+        relaunched = None
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            try:
+                with urllib.request.urlopen(
+                        i1["url"] + "/recommend?user=3&n=5",
+                        timeout=2) as resp:
+                    json.load(resp)
+                queries += 1
+            except Exception:
+                errors += 1
+            try:
+                with open(os.path.join(fleet_dir,
+                                       "replica.p0.port")) as f:
+                    info = json.load(f)
+                if info["pid"] != i0["pid"]:
+                    with urllib.request.urlopen(
+                            info["url"] + "/healthz",
+                            timeout=2) as resp:
+                        h = json.load(resp)
+                    if h["replica"]["generation"] >= live:
+                        relaunched = h
+                        break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert queries > 0 and errors == 0, (queries, errors)
+        assert relaunched is not None, "slot 0 never re-synced"
+        assert relaunched["replica"]["generation"] == live
+        assert relaunched["replica"]["bootstrap_generation"] <= live
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
+@pytest.mark.slow
+def test_multi_replica_storm_identical_tags(writer_repo, tmp_path):
+    """Multi-replica storm (in-process): every replica converges to the
+    same generation tag over the same log, and a client pool spread
+    across them sees zero errors and one consistent tag."""
+    reps = []
+    srvs = []
+    for _ in range(3):
+        rep = ReadReplica(writer_repo["early"])
+        rep.bootstrap()
+        rep.state_dir = writer_repo["live"]
+        rep.poll()
+        reps.append(rep)
+        srvs.append(ReplicaServer(REGISTRY, rep, port=0).start())
+    try:
+        live = ckpt.generations(writer_repo["live"], "")[0][0]
+        assert all(r.generation == live for r in reps)
+        errors = []
+        tags = []
+
+        def client(tid):
+            rng = np.random.default_rng(tid)
+            for _ in range(40):
+                srv = srvs[int(rng.integers(0, len(srvs)))]
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{srv.port}/recommend"
+                            f"?user={int(rng.integers(0, 30))}&n=5"
+                            f"&min_gen={live}", timeout=5) as resp:
+                        tags.append(json.load(resp)["generation"])
+                except Exception as exc:  # noqa: BLE001 - tallied
+                    errors.append(exc)
+
+        pool = [threading.Thread(target=client, args=(t,))
+                for t in range(4)]
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join(timeout=60)
+        assert not errors, errors[:3]
+        assert set(tags) == {live}
+    finally:
+        for s in srvs:
+            s.stop()

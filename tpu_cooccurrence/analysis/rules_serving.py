@@ -13,6 +13,20 @@ to all three obligations plus the reverse direction: a route string
 handled in ``do_GET`` (or quoted anywhere in the module) that is not in
 the table is a silent, unmeasured endpoint. AST-checked, baseline-free
 by construction — mirroring ``rules_fused``.
+
+The serving FLEET (ISSUE 13) adds a second server: the read replica
+(``serving/replica.py``). Two more obligations:
+
+* every route-shaped literal the replica module quotes must be in the
+  SAME ``ROUTE_METRICS`` table — a replica cannot grow an unmeasured
+  endpoint the job's server never had (``ServingRouteRule``, extended);
+* replica ``/recommend`` responses must carry the ``generation`` tag —
+  the read-your-window token a front tier compares across the fleet.
+  The replica serves through a ``MetricsServer`` subclass, so the tag
+  obligation lands on whichever ``recommend`` body actually answers:
+  the replica's own override when it has one, the inherited
+  ``observability/http.py`` body otherwise
+  (``ReplicaGenerationTagRule``).
 """
 
 from __future__ import annotations
@@ -27,11 +41,14 @@ from .core import (
     Finding,
     RepoContext,
     Rule,
+    dotted_name,
     register,
     string_constants,
 )
 
 _HTTP_PATH = "tpu_cooccurrence/observability/http.py"
+
+_REPLICA_PATH = "tpu_cooccurrence/serving/replica.py"
 
 #: A route-shaped string literal: one absolute path segment, lowercase.
 #: (Error bodies, content types and log lines never fully match.)
@@ -110,3 +127,91 @@ class ServingRouteRule(Rule):
                     message=(f"route-shaped literal {value!r} is not in "
                              f"ROUTE_METRICS — register it (with a "
                              f"latency metric) or rename it"))
+        # The replica server (serving/replica.py, ISSUE 13) answers
+        # through the same table: every route it quotes must be
+        # registered there too — a replica cannot grow an unmeasured
+        # endpoint the job's server never had.
+        rep = next((c for c in repo.files if c.path == _REPLICA_PATH),
+                   None)
+        if rep is not None and rep.tree is not None:
+            for ln, value in string_constants(rep.tree):
+                if _ROUTE_RE.match(value) and value not in table:
+                    yield Finding(
+                        rule=self.name, file=_REPLICA_PATH, line=ln,
+                        message=(f"replica route-shaped literal "
+                                 f"{value!r} is not in "
+                                 f"observability/http.py ROUTE_METRICS "
+                                 f"— the replica serves through the "
+                                 f"job's route table; register it "
+                                 f"(with a latency metric) or rename "
+                                 f"it"))
+
+
+def _subtree_strings(node: ast.AST) -> "set[str]":
+    return {n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def _find_recommend(tree: ast.Module) -> Optional[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "recommend":
+            return node
+    return None
+
+
+@register
+class ReplicaGenerationTagRule(Rule):
+    name = "replica-generation-tag"
+    description = ("replica /recommend responses must carry the "
+                   "generation tag (read-your-window token), served "
+                   "through a MetricsServer subclass")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        rep: Optional[FileContext] = next(
+            (c for c in repo.files if c.path == _REPLICA_PATH), None)
+        if rep is None or rep.tree is None:
+            return  # no replica module in this repo: nothing to pin
+        server_cls: Optional[ast.ClassDef] = None
+        for node in ast.walk(rep.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                    (dotted_name(b) or "").endswith("MetricsServer")
+                    for b in node.bases):
+                server_cls = node
+                break
+        if server_cls is None:
+            yield Finding(
+                rule=self.name, file=_REPLICA_PATH, line=1,
+                message="no MetricsServer subclass found — the replica "
+                        "must serve through the shared HTTP plane (one "
+                        "ROUTE_METRICS table, one latency histogram "
+                        "per route), not a parallel server")
+            return
+        own = next((n for n in server_cls.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                    and n.name == "recommend"), None)
+        if own is not None:
+            if "generation" not in _subtree_strings(own):
+                yield Finding(
+                    rule=self.name, file=_REPLICA_PATH, line=own.lineno,
+                    message=(f"{server_cls.name}.recommend overrides "
+                             f"the route body without a 'generation' "
+                             f"response key — replica responses must "
+                             f"carry the generation tag (the "
+                             f"read-your-window token)"))
+            return
+        # No override: the inherited observability/http.py body answers
+        # — the tag obligation lands there.
+        src = next((c for c in repo.files if c.path == _HTTP_PATH), None)
+        if src is None or src.tree is None:
+            return  # fixture repos without http.py cannot be judged
+        fn = _find_recommend(src.tree)
+        if fn is None or "generation" not in _subtree_strings(fn):
+            yield Finding(
+                rule=self.name, file=_HTTP_PATH,
+                line=fn.lineno if fn is not None else 1,
+                message="the inherited MetricsServer.recommend body "
+                        "serves the replica's /recommend but carries "
+                        "no 'generation' response key — replica "
+                        "responses must be generation-tagged")

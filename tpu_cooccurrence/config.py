@@ -349,8 +349,10 @@ class Config:
             if self.serve_port is not None:
                 raise ValueError(
                     "--serve-port is single-process only; gang workers "
-                    "hold partial top-K tables (front them with a real "
-                    "serving tier instead)")
+                    "hold partial top-K tables — serve reads from a "
+                    "replica fleet instead (cooc-replica --state-dir "
+                    "<checkpoint dir>, with --checkpoint-incremental "
+                    "on the ingest job)")
             backend_multihost = (
                 self.backend == Backend.SHARDED
                 or (self.backend in (Backend.SPARSE, Backend.HYBRID)
@@ -452,8 +454,10 @@ class Config:
                 # a partial catalog as if it were the whole table.
                 raise ValueError(
                     "--serve-port is single-process only (a multi-host "
-                    "process holds a partial top-K table; front it with "
-                    "a real serving tier instead)")
+                    "process holds a partial top-K table) — serve reads "
+                    "from a replica fleet instead (cooc-replica "
+                    "--state-dir <checkpoint dir>, with "
+                    "--checkpoint-incremental on the ingest job)")
         if self.serve_history < 1:
             raise ValueError(
                 f"--serve-history must be >= 1, got {self.serve_history}")

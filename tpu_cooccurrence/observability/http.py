@@ -24,7 +24,12 @@ into 503 so a load balancer can drain a wedged job.
 
 ``/recommend?user=U&n=N`` (``--serve-port`` only) answers from the
 serving plane's current snapshot: zero-lock, one generation per
-response. Its latency lands in the ``cooc_query_seconds`` histogram
+response. ``min_gen=G`` arms the read-your-window gate (serving
+fleet): a snapshot older than the client's last-seen generation
+answers 503 instead of travelling back in time, so a front tier can
+retry a caught-up replica. The read-replica server
+(``serving/replica.ReplicaServer``) subclasses this class — same
+routes, same latency histograms, replica-specific ``/healthz``. Its latency lands in the ``cooc_query_seconds`` histogram
 (p50/p95/p99 on ``/metrics``), and a query over the
 ``--serve-query-slo-s`` SLO raises the degradation plane's
 QUERY_PRESSURE signal — ingest sheds before query latency degrades,
@@ -247,14 +252,29 @@ class MetricsServer:
             user = (int(params["user"][0])
                     if "user" in params else None)
             n = int(params.get("n", ["10"])[0])
+            min_gen = (int(params["min_gen"][0])
+                       if "min_gen" in params else None)
         except ValueError:
             return 400, (json.dumps(
-                {"error": "user and n must be integers"}) + "\n").encode()
+                {"error": "user, n and min_gen must be integers"}
+            ) + "\n").encode()
         if n < 1:
             return 400, (json.dumps(
                 {"error": "n must be >= 1"}) + "\n").encode()
         t0 = time.perf_counter()
         items, snap, fallback = self.serving.query(user, n)
+        if min_gen is not None and snap.generation < min_gen:
+            # Read-your-window consistency (serving fleet): the client
+            # has already seen generation min_gen somewhere; answering
+            # from an older snapshot would travel back in time. 503 so
+            # a front tier retries a caught-up replica (the generation
+            # tag rides along for its routing table).
+            return 503, (json.dumps({
+                "error": "snapshot generation behind min_gen "
+                         "(replica still catching up)",
+                "generation": snap.generation,
+                "min_gen": min_gen,
+            }, sort_keys=True) + "\n").encode()
         elapsed = time.perf_counter() - t0
         slo = self.serving.query_slo_s
         if slo > 0 and elapsed > slo:

@@ -31,7 +31,11 @@ must reach disk even when no window ever completes again. Checkpoint
 commits append **checkpoint records** (:data:`CKPT_SCHEMA`,
 distinguished by a ``"checkpoint"`` key): per-generation commit bytes /
 seconds / full-vs-delta kind / chain depth — the incremental plane's
-cost trajectory.
+cost trajectory. Serving replicas (``serving/replica.py``) append
+**replica records** (:data:`REPLICA_SCHEMA`, distinguished by a
+``"replica"`` key): one per delta generation replayed — the replica's
+own flight record of its catch-up trajectory (generation, rows
+replayed, lag behind the writer, resync count).
 """
 
 from __future__ import annotations
@@ -118,11 +122,46 @@ CKPT_SCHEMA = {
 }
 
 
+#: Out-of-band replica record (distinguished by the ``"replica"`` key =
+#: the delta-log generation just replayed): one per applied delta
+#: generation, written by ``serving/replica.ReadReplica``. ``rows`` is
+#: the snapshot's live row count after the publish, ``topk_rows`` the
+#: top-K rows this generation replayed, ``lag`` the writer generations
+#: still unconsumed at record time, ``resyncs`` the checkpoint-resync
+#: count so far (DeltaCorrupt fallbacks).
+REPLICA_SCHEMA = {
+    "v": (True, int),
+    "replica": (True, int),      # delta-log generation replayed
+    "rows": (True, int),         # snapshot live rows after publish
+    "topk_rows": (True, int),    # top-K rows replayed this generation
+    "lag": (True, int),          # newest on-disk generation - replayed
+    "resyncs": (True, int),      # checkpoint resyncs so far
+    "wall_unix": (True, float),
+}
+
+
 def validate_record(rec: dict) -> None:
     """Raise ``ValueError`` unless ``rec`` matches :data:`SCHEMA` (window
-    records) or :data:`EVENT_SCHEMA` (out-of-band event records)."""
+    records) or one of the out-of-band schemas (:data:`EVENT_SCHEMA`,
+    :data:`CKPT_SCHEMA`, :data:`REPLICA_SCHEMA`)."""
     if not isinstance(rec, dict):
         raise ValueError(f"journal record is not an object: {rec!r}")
+    if "replica" in rec:
+        for field, (required, typ) in REPLICA_SCHEMA.items():
+            v = rec.get(field)
+            ok = (isinstance(v, (int, float)) if typ is float
+                  else isinstance(v, typ)) and not isinstance(v, bool)
+            if required and not ok:
+                raise ValueError(
+                    f"journal replica record field {field!r} bad: {rec}")
+        unknown = set(rec) - set(REPLICA_SCHEMA)
+        if unknown:
+            raise ValueError(
+                f"journal replica record has unknown fields "
+                f"{unknown}: {rec}")
+        if rec["v"] != VERSION:
+            raise ValueError(f"journal version {rec['v']} != {VERSION}")
+        return
     if "checkpoint" in rec:
         for field, (required, typ) in CKPT_SCHEMA.items():
             v = rec.get(field)

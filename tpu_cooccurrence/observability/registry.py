@@ -148,6 +148,13 @@ CANONICAL_METRICS = frozenset({
     "cooc_snapshot_rows",
     # degradation plane QUERY_PRESSURE signal (robustness/degrade.py)
     "cooc_query_pressure_events_total",
+    # serving fleet read replicas (serving/replica.py): delta-log
+    # catch-up position, the lag behind the ingest writer, and the
+    # robustness counters behind the lag block on the replica /healthz
+    "cooc_replica_generation",
+    "cooc_replica_generation_lag",
+    "cooc_replica_deltas_applied_total",
+    "cooc_replica_resyncs_total",
 })
 
 #: TransferLedger snapshot key -> exposition series name. Explicit

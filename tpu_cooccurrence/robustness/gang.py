@@ -47,6 +47,12 @@ The ``peers`` table on ``/healthz`` (:class:`PeerTable`) reads the same
 heartbeat files plus each suffix's committed-epoch markers, and turns a
 stale peer into a 503 so a load balancer drains the process before the
 gang restart lands.
+
+:class:`ReplicaFleetSupervisor` is the SERVING gang (ISSUE 13): the
+same spawn/heartbeat/liveness machinery supervising a fleet of read
+replicas (``serving/replica.py``) under the opposite restart policy —
+replicas hold no collectives, so a dead replica relaunches alone and
+re-syncs itself while the rest of the fleet keeps serving.
 """
 
 from __future__ import annotations
@@ -580,3 +586,140 @@ class GangSupervisor:
                 prev_delay, self.attempts - restarts)
             if prev_delay > 0:
                 time.sleep(prev_delay)
+
+
+# -- the serving gang (replica fleet) -----------------------------------
+
+
+class ReplicaFleetSupervisor:
+    """Supervision for a SERVING gang of read replicas
+    (``serving/replica.py``) — the same liveness machinery as
+    :class:`GangSupervisor` (spawn, monitor exits, heartbeat files in a
+    shared gang dir) with the OPPOSITE restart policy: replicas hold no
+    collectives, so one replica's death never invalidates the
+    survivors. A dead or heartbeat-stale replica is killed and
+    relaunched ALONE (it re-syncs itself from checkpoint + delta tail,
+    with no writer involvement); the rest of the fleet keeps serving
+    throughout — the availability property the whole fleet exists for.
+
+    ``child_argv_fn(process_id) -> argv`` builds one replica's full
+    command (the fleet has no coordinator to assign — replicas are
+    independent). ``attempts`` is the fleet-wide relaunch budget;
+    permanent exit codes (usage/config) abort the fleet immediately —
+    a bad flag does not get better per slot.
+
+    Runs until every replica has exited cleanly (bounded
+    ``--run-seconds`` fleets) or :meth:`stop` is called.
+    """
+
+    def __init__(self, child_argv_fn, num_replicas: int, gang_dir: str,
+                 attempts: int = 3, stale_after_s: float = 60.0,
+                 relaunch_delay_s: float = 0.5, stdout=None) -> None:
+        if num_replicas < 1:
+            raise ValueError(
+                f"a fleet needs >= 1 replica, got {num_replicas}")
+        self.child_argv_fn = child_argv_fn
+        self.num_replicas = num_replicas
+        self.gang_dir = gang_dir
+        self.attempts = attempts
+        self.stale_after_s = stale_after_s
+        self.relaunch_delay_s = relaunch_delay_s
+        self.stdout = stdout
+        self.relaunches = 0
+        self._stop = threading.Event()
+        self._workers: List[Optional[_Worker]] = [None] * num_replicas
+        os.makedirs(gang_dir, exist_ok=True)
+
+    def _spawn_one(self, pid: int) -> _Worker:
+        try:
+            os.remove(heartbeat_path(self.gang_dir, pid))
+        except OSError:
+            pass
+        env = dict(os.environ)
+        env[GANG_DIR_ENV] = self.gang_dir
+        spool = tempfile.TemporaryFile()
+        proc = subprocess.Popen(self.child_argv_fn(pid), stdout=spool,
+                                env=env)
+        return _Worker(proc, spool, time.monotonic())
+
+    def pids(self) -> "List[Optional[int]]":
+        """Live OS pids by fleet slot (None = exited) — chaos tests and
+        the bench kill a specific replica through this."""
+        return [w.proc.pid if w is not None and w.proc.poll() is None
+                else None for w in self._workers]
+
+    def stop(self) -> None:
+        """Kill the whole fleet and end :meth:`run` (deliberate
+        teardown — not counted against the relaunch budget)."""
+        self._stop.set()
+
+    def _heartbeat_stale(self, pid: int, w: _Worker) -> bool:
+        if self.stale_after_s <= 0:
+            return False
+        try:
+            age = time.time() - os.path.getmtime(
+                heartbeat_path(self.gang_dir, pid))
+            return age > self.stale_after_s
+        except OSError:
+            return (time.monotonic() - w.spawned
+                    > max(self.stale_after_s, HEARTBEAT_START_GRACE_S))
+
+    def run(self) -> int:
+        from ..supervisor import PERMANENT_EXIT_CODES, _kill_child
+
+        for pid in range(self.num_replicas):
+            self._workers[pid] = self._spawn_one(pid)
+        LOG.info("replica fleet spawned: %d replicas (heartbeats in %s)",
+                 self.num_replicas, self.gang_dir)
+        done = [False] * self.num_replicas
+        try:
+            while not self._stop.is_set():
+                for pid, w in enumerate(self._workers):
+                    if done[pid] or w is None:
+                        continue
+                    rc = w.proc.poll()
+                    if rc == 0:
+                        done[pid] = True
+                        continue
+                    stale = rc is None and self._heartbeat_stale(pid, w)
+                    if rc is None and not stale:
+                        continue
+                    if stale:
+                        LOG.error("replica %d heartbeat stale past "
+                                  "%.1fs; killing and relaunching it "
+                                  "(the rest of the fleet keeps "
+                                  "serving)", pid, self.stale_after_s)
+                        _kill_child(w.proc)
+                        rc = w.proc.poll()
+                    if rc in PERMANENT_EXIT_CODES:
+                        LOG.error("replica %d exited rc=%d (usage/"
+                                  "config — permanent); stopping the "
+                                  "fleet", pid, rc)
+                        return rc
+                    if self.relaunches >= self.attempts:
+                        LOG.error("replica %d died rc=%s; relaunch "
+                                  "budget (%d) exhausted", pid, rc,
+                                  self.attempts)
+                        return rc if isinstance(rc, int) and rc else 1
+                    self.relaunches += 1
+                    LOG.warning("replica %d died rc=%s; relaunching "
+                                "slot %d (relaunch %d/%d) — it will "
+                                "re-sync from checkpoint + delta tail",
+                                pid, rc, pid, self.relaunches,
+                                self.attempts)
+                    w.spool.close()
+                    if self.relaunch_delay_s > 0:
+                        time.sleep(self.relaunch_delay_s)
+                    self._workers[pid] = self._spawn_one(pid)
+                if all(done):
+                    LOG.info("replica fleet completed (%d relaunch(es))",
+                             self.relaunches)
+                    return 0
+                time.sleep(_POLL_S)
+            return 0
+        finally:
+            for w in self._workers:
+                if w is not None:
+                    if w.proc.poll() is None:
+                        _kill_child(w.proc)
+                    w.spool.close()

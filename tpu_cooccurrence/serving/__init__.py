@@ -1,5 +1,10 @@
 """Online serving plane: query the job's results while it ingests.
 
+The serving FLEET (``replica.py``) scales the read side horizontally:
+stateless ``cooc-replica`` processes bootstrap from the newest
+checkpoint and tail the delta log — reads scale with replicas, not
+with the TPU job.
+
 Before this package the computed top-K tables ended at stdout,
 ``LatestResults`` and checkpoints — nobody could *query* them. The
 serving plane turns the job into a recommender service:

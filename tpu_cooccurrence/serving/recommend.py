@@ -97,6 +97,34 @@ class UserHistory:
         self._items[us, pos] = its
         self._count[us[starts]] += run_len
 
+    def set_rows(self, dense_users: np.ndarray, lens: np.ndarray,
+                 flat: np.ndarray) -> None:
+        """Replace whole history rows from row-major packed prefixes
+        (``flat`` holds each user's ``lens[i]`` items concatenated) —
+        the read-replica replay path (``serving/replica.py``): a
+        replica never sees the ingest stream, so its history comes from
+        the delta log's reservoir records, a per-user *set*, not an
+        append. Prefixes longer than the ring keep their first
+        ``length`` items; the ring continues appending after them."""
+        if not len(dense_users):
+            return
+        from ..state.delta import _range_indices
+
+        u = np.asarray(dense_users, dtype=np.int64)
+        lens = np.asarray(lens, dtype=np.int64)
+        self._ensure(int(u.max()) + 1)
+        excl = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+        keep = np.minimum(lens, self.length)
+        if keep.sum():
+            # First keep[i] entries of each packed prefix, vectorized.
+            zero = np.zeros(len(keep), dtype=np.int64)
+            offs = _range_indices(zero, keep)   # per-row 0..keep[i]
+            src = _range_indices(excl, excl + keep)
+            rows = np.repeat(u, keep)
+            self._items[rows, offs] = np.asarray(flat,
+                                                 dtype=np.int64)[src]
+        self._count[u] = keep
+
     def recent(self, dense_user: int, out: np.ndarray) -> int:
         """Copy the user's ring into ``out`` (caller scratch, length >=
         ``self.length``); returns the number of valid entries."""
@@ -166,9 +194,11 @@ class ServingPlane:
         build buffer (published at the next :meth:`publish`)."""
         self.builder.absorb(window_out)
 
-    def publish(self) -> TopKSnapshot:
-        """Swap the next snapshot in (window boundary)."""
-        return self.builder.publish()
+    def publish(self, generation: Optional[int] = None) -> TopKSnapshot:
+        """Swap the next snapshot in (window boundary). ``generation``
+        tags the snapshot explicitly (the replica's delta-log position)
+        instead of the content counter — see ``SnapshotBuilder.publish``."""
+        return self.builder.publish(generation=generation)
 
     def seed(self, results_snapshot) -> None:
         """Restore path: serve the checkpointed rows immediately."""

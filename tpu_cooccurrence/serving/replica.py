@@ -1,0 +1,648 @@
+"""Read replicas: horizontal query scaling over the delta log (ISSUE 13).
+
+One process used to both ingest and serve (PR 8): query throughput was
+capped by the TPU job's host thread and died with it. This module splits
+the planes. The ingest job keeps its ``SnapshotBuilder`` and — under
+``--checkpoint-incremental`` — already emits every generation's changed
+top-K rows into the committed, corruption-gated delta log
+(``state/delta.py``, PR 12). A **read replica** is a stateless process
+that
+
+1. **bootstraps** from the newest verifying checkpoint generation's
+   results table (``state/checkpoint.load_serving_state`` — a READ-ONLY
+   walk: a replica shares the directory with the live writer and must
+   never quarantine or rename its files),
+2. **tails** ``state/delta.read_delta_stream(dir, start_gen=G)`` and
+   replays each :meth:`~tpu_cooccurrence.state.delta.DeltaGeneration.
+   iter_topk` record into its own immutable
+   :class:`~tpu_cooccurrence.serving.snapshot.TopKSnapshot` via the
+   existing builder/publish machinery — the same zero-lock
+   double-buffered swap the ingest job uses, and
+3. **serves** ``/recommend`` (plus ``/metrics`` and ``/healthz``) from
+   it, each response tagged with the *delta-log generation* the
+   snapshot was replayed to — a front tier compares tags across the
+   fleet to enforce read-your-window consistency (the ``min_gen``
+   query-param gate in ``observability/http.py`` answers 503 when this
+   replica lags the client's last-seen generation).
+
+Reads now scale with replicas, not with the TPU job: N replicas tail
+the same log with no writer involvement, and a dead replica relaunches
+(``robustness/gang.ReplicaFleetSupervisor`` — the serving gang's
+*independent-restart* policy: replicas hold no collectives, so peer
+death never invalidates the survivors) and re-syncs from checkpoint +
+delta tail by itself.
+
+**Corruption fallback.** ``DeltaCorrupt`` mid-tail triggers a
+checkpoint **resync** — drop the whole in-memory table and bootstrap
+again from the newest verifying generation — exactly like restore
+falls back a generation on a torn npz. The writer may legitimately
+compact/retire deltas out from under a lagging replica
+(``--checkpoint-retain``); a missing chain link is the same resync,
+not an error loop.
+
+**Dense-id discipline.** The replica reconstructs the WRITER's dense
+id space: the bootstrap restores the checkpointed vocab and every delta
+appends its ``voc_items`` / ``voc_users`` slices in writer order (IdMap
+is append-only, so the append list *is* the id assignment). Every
+external id a delta references must already be mapped — a mapping that
+would grow the vocab is a torn or foreign record and raises
+:class:`~tpu_cooccurrence.state.delta.DeltaCorrupt` (-> resync).
+
+The replica never imports jax: it is a pure host process (numpy +
+stdlib HTTP), so a fleet colocates with anything.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..observability.http import MetricsServer
+from ..observability.registry import REGISTRY
+from ..state import checkpoint as ckpt
+from ..state import delta as deltalog
+from ..state.delta import DeltaCorrupt, _range_indices
+from ..state.results import TopKBatch
+from ..state.vocab import IdMap
+from .recommend import ServingPlane
+
+LOG = logging.getLogger("tpu_cooccurrence.replica")
+
+#: Gauge names (CANONICAL_METRICS): the replica's delta-log position,
+#: its lag behind the writer, and the robustness counters.
+GENERATION_GAUGE = "cooc_replica_generation"
+LAG_GAUGE = "cooc_replica_generation_lag"
+APPLIED_GAUGE = "cooc_replica_deltas_applied_total"
+RESYNC_GAUGE = "cooc_replica_resyncs_total"
+
+
+class ReadReplica:
+    """Bootstrap + tail + publish: one replica's whole state machine.
+
+    Duck-types the :class:`~tpu_cooccurrence.serving.recommend.
+    ServingPlane` surface ``MetricsServer`` consumes (``query`` /
+    ``generation`` / ``rows`` / ``snapshot_age_seconds`` /
+    ``query_slo_s``), delegating to the current plane — a resync swaps
+    in a freshly built plane while in-flight queries finish on the old
+    one (still a valid, internally consistent older generation).
+
+    Thread contract: :meth:`bootstrap` / :meth:`poll` / :meth:`resync`
+    run on the single tail thread; queries run on any number of HTTP
+    threads against the published immutable snapshot (the PR-8
+    contract, unchanged).
+    """
+
+    def __init__(self, state_dir: str, suffix: str = "",
+                 history_len: int = 50, query_slo_s: float = 0.0,
+                 journal: Optional[str] = None) -> None:
+        self.state_dir = state_dir
+        self.suffix = suffix
+        self.history_len = history_len
+        self.query_slo_s = query_slo_s
+        #: Delta-log generation the published snapshot is replayed to.
+        self.generation = -1
+        self.bootstrap_generation = -1
+        self.deltas_applied = 0
+        self.resyncs = 0
+        self.last_poll_unix = 0.0
+        self.item_vocab = IdMap()
+        self.user_vocab = IdMap()
+        self.plane = ServingPlane(self.item_vocab, self.user_vocab,
+                                  history_len=history_len,
+                                  query_slo_s=query_slo_s)
+        self.journal = None
+        if journal:
+            from ..observability.journal import RunJournal
+
+            self.journal = RunJournal(journal)
+        self._gauge_gen = REGISTRY.gauge(
+            GENERATION_GAUGE,
+            help="delta-log generation this replica has replayed to")
+        self._gauge_lag = REGISTRY.gauge(
+            LAG_GAUGE,
+            help="ingest generation minus replica generation (newest "
+                 "on-disk checkpoint generation not yet replayed)")
+        self._gauge_applied = REGISTRY.gauge(
+            APPLIED_GAUGE,
+            help="delta generations this replica has replayed")
+        self._gauge_resyncs = REGISTRY.gauge(
+            RESYNC_GAUGE,
+            help="checkpoint resyncs (DeltaCorrupt / broken-chain "
+                 "fallbacks) this replica has performed")
+
+    # -- ServingPlane duck surface (MetricsServer reads these) ----------
+
+    def query(self, user, n):
+        return self.plane.query(user, n)
+
+    @property
+    def rows(self) -> int:
+        return self.plane.rows
+
+    def snapshot_age_seconds(self) -> float:
+        return self.plane.snapshot_age_seconds()
+
+    # -- bootstrap / resync ---------------------------------------------
+
+    def bootstrap(self) -> int:
+        """(Re)build the whole serving table from the newest verifying
+        checkpoint generation; returns the generation bootstrapped to.
+
+        Builds into FRESH vocab/plane objects and swaps them in only
+        once complete, so queries never see a half-built table.
+        """
+        st = ckpt.load_serving_state(self.state_dir, self.suffix)
+        item_vocab = IdMap()
+        item_vocab.restore_state(st["item_vocab"])
+        user_vocab = IdMap()
+        user_vocab.restore_state(st["user_vocab"])
+        plane = ServingPlane(item_vocab, user_vocab,
+                             history_len=self.history_len,
+                             query_slo_s=self.query_slo_s)
+        items, offsets, others, scores = st["latest"]
+        batch = self._pack_external(item_vocab, items,
+                                    np.diff(np.asarray(offsets,
+                                                       dtype=np.int64)),
+                                    others, scores)
+        if len(batch):
+            plane.absorb(batch)
+        if "hist" in st:
+            hist = st["hist"]
+            hlen = st["hist_len"]
+            users = np.flatnonzero(hlen > 0)
+            if len(users):
+                k = hist.shape[1]
+                sel = _range_indices(users * k, users * k + hlen[users])
+                plane.history.set_rows(users, hlen[users],
+                                       hist.reshape(-1)[sel])
+        plane.publish(generation=st["gen"])
+        # Swap the built world in (each assignment GIL-atomic; queries
+        # route through self.plane, taken once per query).
+        self.item_vocab = item_vocab
+        self.user_vocab = user_vocab
+        self.plane = plane
+        self.generation = st["gen"]
+        self.bootstrap_generation = st["gen"]
+        self._gauge_gen.set(st["gen"])
+        self._refresh_lag()
+        LOG.info("replica bootstrapped at generation %d (%d rows)",
+                 st["gen"], plane.rows)
+        return st["gen"]
+
+    def resync(self, reason: str) -> bool:
+        """Checkpoint resync — the DeltaCorrupt / broken-chain
+        fallback, exactly like restore's step-back: drop the in-memory
+        table, bootstrap again from the newest verifying generation."""
+        self.resyncs += 1
+        self._gauge_resyncs.set(self.resyncs)
+        LOG.warning("replica resync #%d from checkpoint (%s)",
+                    self.resyncs, reason)
+        return self._try_bootstrap("resync")
+
+    def _try_bootstrap(self, reason: str) -> bool:
+        """A MID-SERVICE re-bootstrap that tolerates a transiently
+        unrestorable directory: the live writer's retention may delete
+        every generation this replica just listed (the race window is
+        real on small ``--checkpoint-retain``). Keep serving the
+        current snapshot — older but internally consistent — and retry
+        on the next poll; only the STARTUP bootstrap (which has nothing
+        to serve yet) treats this as fatal, under its own deadline."""
+        try:
+            self.bootstrap()
+            return True
+        except (FileNotFoundError, ckpt.CheckpointCorrupt) as exc:
+            LOG.warning("re-bootstrap (%s) found no restorable "
+                        "generation (%s); keeping the current snapshot "
+                        "and retrying next poll", reason, exc)
+            return False
+
+    # -- the tail loop ---------------------------------------------------
+
+    def poll(self) -> int:
+        """Consume every committed delta generation past the current
+        position; returns how many were applied. ``DeltaCorrupt``
+        anywhere in the tail drives :meth:`resync`."""
+        applied = 0
+        # One directory listing per poll pass: lag is reported against
+        # this snapshot of the writer's position (catch-up replay must
+        # not re-list a live writer's directory 2x per generation).
+        newest = self.newest_available()
+        try:
+            for d in deltalog.read_delta_stream(
+                    self.state_dir, self.suffix,
+                    start_gen=self.generation):
+                if d.prev != self.generation:
+                    # A chain gap: the writer wrote a FULL generation
+                    # (compaction, dirty-log overflow) or retired the
+                    # chain past a lagging replica — the skipped
+                    # generation's changes live in no delta, so the
+                    # only sound catch-up is a fresh bootstrap from
+                    # the newest checkpoint (which lands at or beyond
+                    # every delta on disk). Not a corruption resync.
+                    LOG.info("delta generation %d chains from %d but "
+                             "replica is at %d (full generation "
+                             "interposed); re-bootstrapping",
+                             d.gen, d.prev, self.generation)
+                    if self._try_bootstrap("chain gap"):
+                        applied += 1
+                    break
+                self._apply(d, newest=newest)
+                applied += 1
+        except DeltaCorrupt as exc:
+            if self.resync(str(exc)):
+                applied += 1
+        if applied == 0:
+            if newest > self.generation and not any(
+                    g > self.generation for g in
+                    deltalog.delta_generations(self.state_dir,
+                                               self.suffix)):
+                # FULL generation(s) interposed with nothing to tail: a
+                # compaction (or dirty-log overflow) committed a base
+                # and no delta has landed since — the log alone can
+                # never carry the replica past it. Same re-bootstrap as
+                # the in-stream gap. (A delta file > our position with
+                # no npz yet is an uncommitted orphan: wait for the
+                # writer's commit instead.)
+                LOG.info("newest generation %d is a full base past the "
+                         "replica's %d with no delta to tail; "
+                         "re-bootstrapping", newest, self.generation)
+                if self._try_bootstrap("trailing full base"):
+                    applied += 1
+        self.last_poll_unix = time.time()
+        self._refresh_lag()
+        return applied
+
+    def newest_available(self) -> int:
+        """Newest on-disk generation (committed npz), or -1 — the
+        writer-side position the lag gauge measures against."""
+        gens = ckpt.generations(self.state_dir, self.suffix)
+        return gens[0][0] if gens else -1
+
+    def lag(self, newest: Optional[int] = None) -> int:
+        if newest is None:
+            newest = self.newest_available()
+        return max(newest - self.generation, 0)
+
+    def _refresh_lag(self, newest: Optional[int] = None) -> None:
+        self._gauge_lag.set(self.lag(newest))
+
+    # -- one delta generation -------------------------------------------
+
+    @staticmethod
+    def _pack_external(vocab: IdMap, items_ext, lens, others_ext,
+                       scores) -> TopKBatch:
+        """External-id row-major top-K records -> one padded dense-id
+        :class:`TopKBatch` (scores already descending per row; pads are
+        ``-inf`` so the snapshot's finite-prefix lens stay exact).
+
+        Every id must ALREADY be mapped: a lookup that would grow the
+        vocab means the record references items outside the replayed
+        append chain — a torn or foreign record, so
+        :class:`DeltaCorrupt` (-> checkpoint resync), never a silent
+        dense-space divergence."""
+        lens = np.asarray(lens, dtype=np.int64)
+        n = len(lens)
+        if n == 0:
+            return TopKBatch.empty(1)
+        n0 = len(vocab)
+        rows = vocab.map_batch(
+            np.asarray(items_ext, dtype=np.int64)).astype(np.int32)
+        others = vocab.map_batch(np.asarray(others_ext, dtype=np.int64))
+        if len(vocab) != n0:
+            raise DeltaCorrupt(
+                f"top-K records reference {len(vocab) - n0} item ids "
+                f"outside the replayed vocab chain")
+        k = max(int(lens.max()), 1)
+        idx = np.zeros((n, k), dtype=np.int32)
+        vals = np.full((n, k), -np.inf, dtype=np.float32)
+        pos = np.repeat(np.arange(n, dtype=np.int64), lens)
+        col = _range_indices(np.zeros(n, dtype=np.int64), lens)
+        idx[pos, col] = others.astype(np.int32)
+        vals[pos, col] = np.asarray(scores, dtype=np.float32)
+        return TopKBatch(rows, idx, vals)
+
+    def _apply(self, d, newest: Optional[int] = None) -> None:
+        """Replay one committed delta generation: vocab appends, top-K
+        rows, reservoir history — then publish tagged with the log
+        position. ``newest``: the caller's per-poll snapshot of the
+        writer's newest generation (lag reporting without re-listing
+        the shared directory per generation)."""
+        # Vocab appends must extend the replica's chain exactly (the
+        # same contract ChainState.replay enforces on restore).
+        if len(self.item_vocab) + len(d.voc_items) != d.item_vocab_len:
+            raise DeltaCorrupt(
+                f"delta generation {d.gen} item-vocab appends do not "
+                f"extend the replica ({len(self.item_vocab)} + "
+                f"{len(d.voc_items)} != {d.item_vocab_len})")
+        if len(self.user_vocab) + len(d.voc_users) != d.user_vocab_len:
+            raise DeltaCorrupt(
+                f"delta generation {d.gen} user-vocab appends do not "
+                f"extend the replica")
+        if len(d.voc_items):
+            self.item_vocab.map_batch(d.voc_items)
+        if len(d.voc_users):
+            self.user_vocab.map_batch(d.voc_users)
+        topk_rows = 0
+        if len(d.lat_rows):
+            batch = self._pack_external(self.item_vocab, d.lat_rows,
+                                        d.lat_lens, d.lat_others,
+                                        d.lat_scores)
+            self.plane.absorb(batch)
+            topk_rows = len(batch)
+        if len(d.usr_rows):
+            self.plane.history.set_rows(d.usr_rows, d.usr_lens,
+                                        d.usr_hist)
+        self.plane.publish(generation=d.gen)
+        self.generation = d.gen
+        self.deltas_applied += 1
+        self._gauge_gen.set(d.gen)
+        self._gauge_applied.set(self.deltas_applied)
+        self._refresh_lag(newest)
+        if self.journal is not None:
+            from ..observability.journal import VERSION
+
+            self.journal.record({
+                "v": VERSION, "replica": d.gen,
+                "rows": self.plane.rows, "topk_rows": topk_rows,
+                "lag": self.lag(newest), "resyncs": self.resyncs,
+                "wall_unix": round(time.time(), 3),
+            })
+
+    def close(self) -> None:
+        if self.journal is not None:
+            self.journal.close()
+
+
+class ReplicaServer(MetricsServer):
+    """The replica's HTTP plane: the same three routes as the job's
+    server (``/metrics``, ``/healthz``, ``/recommend`` — one
+    ``ROUTE_METRICS`` table, one latency histogram per route), with a
+    replica-specific ``/healthz``: the lag block (generation /
+    newest-on-disk / lag / resyncs) plus tail-loop liveness — a replica
+    whose poll loop wedged reports ``replica_stale`` and 503 so a front
+    tier drains it, exactly like the job's ``snapshot_stale``.
+
+    ``/recommend`` responses carry the ``generation`` tag through the
+    inherited route body (pinned by the cooclint ``replica-generation-
+    tag`` rule) — the read-your-window token.
+    """
+
+    def __init__(self, registry, replica: ReadReplica, port: int = 0,
+                 host: str = "127.0.0.1",
+                 stale_after_s: float = 300.0, peers=None) -> None:
+        super().__init__(registry, counters=None, ledger=None,
+                         port=port, host=host,
+                         stale_after_s=stale_after_s,
+                         serving=replica, peers=peers)
+        self.replica = replica
+
+    def health(self) -> "tuple[dict, bool]":
+        now = time.time()
+        r = self.replica
+        poll_age = now - (r.last_poll_unix or self._started_unix)
+        status = "ok"
+        if r.generation < 0:
+            status = "starting"
+        elif self.stale_after_s > 0 and poll_age > self.stale_after_s:
+            # The tail loop stopped polling: this replica's table will
+            # only age — drain it (the writer may be fine; siblings
+            # keep serving).
+            status = "replica_stale"
+        payload = {
+            "status": status,
+            "replica": {
+                "generation": r.generation,
+                "newest_generation": r.newest_available(),
+                "lag": r.lag(),
+                "bootstrap_generation": r.bootstrap_generation,
+                "deltas_applied": r.deltas_applied,
+                "resyncs": r.resyncs,
+                "last_poll_age_seconds": round(poll_age, 3),
+            },
+            "snapshot_generation": r.generation,
+            "snapshot_rows": r.rows,
+            "snapshot_age_seconds": round(r.snapshot_age_seconds(), 3),
+        }
+        if self.peers is not None:
+            rows, any_stale = self.peers.snapshot()
+            payload["peers"] = rows
+            if any_stale and status == "ok":
+                status = payload["status"] = "peer_stale"
+        return payload, status in ("ok", "starting")
+
+
+# -- the cooc-replica entry point ---------------------------------------
+
+
+def _write_port_file(path: str, port: int) -> None:
+    """Atomic ``{"port", "pid", "url"}`` drop the fleet supervisor /
+    bench / load balancer reads to find this replica."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"port": port, "pid": os.getpid(),
+                   "url": f"http://127.0.0.1:{port}"}, f)
+    os.replace(tmp, path)
+
+
+def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="cooc-replica",
+        description="Stateless read replica: bootstrap from the newest "
+                    "checkpoint, tail the delta log, serve /recommend",
+        allow_abbrev=False)
+    p.add_argument("--state-dir", required=True, dest="state_dir",
+                   help="The ingest job's --checkpoint-dir (the replica "
+                        "reads checkpoints + delta log; never writes)")
+    p.add_argument("--port", type=int, default=0,
+                   help="Serve /recommend, /metrics and /healthz on "
+                        "127.0.0.1:PORT (0 = ephemeral)")
+    p.add_argument("--port-file", default=None, dest="port_file",
+                   help="Write the bound port + pid here as JSON "
+                        "(fleet/LB discovery)")
+    p.add_argument("--poll-interval-s", type=float, default=0.5,
+                   dest="poll_interval_s",
+                   help="Delta-log tail poll interval (default: 0.5)")
+    p.add_argument("--run-seconds", type=float, default=0.0,
+                   dest="run_seconds",
+                   help="Exit cleanly after this many seconds "
+                        "(0 = serve until killed)")
+    p.add_argument("--serve-history", type=int, default=50,
+                   dest="serve_history",
+                   help="Per-user history ring length for the blend, "
+                        "replayed from the delta log's reservoir "
+                        "records (default: 50)")
+    p.add_argument("--journal", default=None,
+                   help="Append one replica record per replayed delta "
+                        "generation to this JSONL")
+    p.add_argument("--stale-after-s", type=float, default=300.0,
+                   dest="stale_after_s",
+                   help="/healthz reports 503 (replica_stale) once the "
+                        "tail loop has not polled for this many "
+                        "seconds (default: 300; 0 = off)")
+    p.add_argument("--bootstrap-timeout-s", type=float, default=60.0,
+                   dest="bootstrap_timeout_s",
+                   help="How long to wait for the writer's first "
+                        "checkpoint generation before giving up "
+                        "(default: 60)")
+    p.add_argument("--process-id", type=int, default=None,
+                   dest="process_id",
+                   help="Fleet slot id (heartbeat file suffix under "
+                        "the supervisor's gang dir)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="Run N replicas under the serving-gang "
+                        "supervisor (independent restart: a dead "
+                        "replica relaunches alone and re-syncs itself)")
+    p.add_argument("--fleet-dir", default=None, dest="fleet_dir",
+                   help="Directory for the fleet's port files and "
+                        "heartbeats (default: <state-dir>/fleet)")
+    p.add_argument("--restart-on-failure", type=int, default=3,
+                   dest="restart_on_failure",
+                   help="Fleet restart budget across all replicas "
+                        "(default: 3)")
+    p.add_argument("--gang-stale-after-s", type=float, default=60.0,
+                   dest="gang_stale_after_s",
+                   help="Fleet supervisor: heartbeat age past which a "
+                        "replica counts as wedged and is relaunched "
+                        "(default: 60; 0 = off)")
+    return p.parse_args(argv)
+
+
+def _fleet_child_argv(raw: List[str], fleet_dir: str,
+                      pid: int) -> List[str]:
+    """One fleet slot's argv: the supervisor's own flags stripped, the
+    slot identity + per-slot port file appended, and per-process output
+    paths (``--journal``) suffixed ``.p<i>`` — two replicas appending
+    to one journal would interleave their record streams (same rule as
+    the gang supervisor's ``_PER_PROCESS_FLAGS``)."""
+    strip_with_value = {"--fleet", "--fleet-dir", "--restart-on-failure",
+                        "--gang-stale-after-s", "--port", "--port-file",
+                        "--process-id"}
+    out: List[str] = []
+    skip = False
+    suffix_next = False
+    for a in raw:
+        if skip:
+            skip = False
+            continue
+        if suffix_next:
+            a = f"{a}.p{pid}"
+            suffix_next = False
+        else:
+            flag = a.split("=", 1)[0]
+            if flag in strip_with_value:
+                skip = "=" not in a
+                continue
+            if a == "--journal":
+                suffix_next = True
+            elif a.startswith("--journal="):
+                a = f"{a}.p{pid}"
+        out.append(a)
+    out += ["--process-id", str(pid), "--port", "0",
+            "--port-file", os.path.join(fleet_dir,
+                                        f"replica.p{pid}.port")]
+    return out
+
+
+def _run_fleet(args, raw: List[str]) -> int:
+    import signal
+
+    from ..robustness.gang import ReplicaFleetSupervisor
+
+    fleet_dir = args.fleet_dir or os.path.join(args.state_dir, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    LOG.info("replica fleet: %d replicas over %s (port files in %s)",
+             args.fleet, args.state_dir, fleet_dir)
+
+    def child_argv(pid: int) -> List[str]:
+        return [sys.executable, "-m", "tpu_cooccurrence.serving.replica"
+                ] + _fleet_child_argv(raw, fleet_dir, pid)
+
+    fleet = ReplicaFleetSupervisor(
+        child_argv, args.fleet, gang_dir=fleet_dir,
+        attempts=args.restart_on_failure,
+        stale_after_s=args.gang_stale_after_s)
+    # A SIGTERM to the supervisor must tear the whole fleet down (the
+    # run loop's finally kills the workers) — the default handler would
+    # die between poll cycles and orphan every replica child.
+    signal.signal(signal.SIGTERM, lambda *_a: fleet.stop())
+    return fleet.run()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, stream=sys.stderr,
+        format="%(asctime)s %(levelname)s %(name)s - %(message)s")
+    raw = list(argv) if argv is not None else sys.argv[1:]
+    try:
+        args = _parse_args(raw)
+        if args.fleet < 0 or args.serve_history < 1 \
+                or args.poll_interval_s <= 0:
+            raise ValueError("--fleet must be >= 0, --serve-history "
+                             ">= 1, --poll-interval-s > 0")
+    except ValueError as exc:
+        from ..supervisor import EX_CONFIG
+
+        LOG.error("configuration error: %s", exc)
+        return EX_CONFIG
+    if args.fleet:
+        return _run_fleet(args, raw)
+
+    # Fleet worker heartbeat (same beacon as gang workers): armed by the
+    # supervisor's gang-dir env + this slot's id.
+    from ..robustness.gang import GANG_DIR_ENV, HeartbeatWriter
+
+    heartbeat = None
+    gang_dir = os.environ.get(GANG_DIR_ENV)
+    if gang_dir and args.process_id is not None:
+        heartbeat = HeartbeatWriter(gang_dir, args.process_id).start()
+
+    replica = ReadReplica(args.state_dir,
+                          history_len=args.serve_history,
+                          journal=args.journal)
+    deadline = time.monotonic() + args.bootstrap_timeout_s
+    while True:
+        try:
+            replica.bootstrap()
+            break
+        except FileNotFoundError:
+            if time.monotonic() > deadline:
+                LOG.error("no checkpoint appeared in %s within "
+                          "--bootstrap-timeout-s", args.state_dir)
+                return 1
+            time.sleep(min(args.poll_interval_s, 1.0))
+        except ckpt.CheckpointCorrupt as exc:
+            if time.monotonic() > deadline:
+                LOG.error("no checkpoint generation verifies: %s", exc)
+                return 1
+            time.sleep(min(args.poll_interval_s, 1.0))
+    server = ReplicaServer(REGISTRY, replica, port=args.port,
+                           stale_after_s=args.stale_after_s).start()
+    if args.port_file:
+        _write_port_file(args.port_file, server.port)
+    LOG.info("replica serving on http://127.0.0.1:%d at generation %d",
+             server.port, replica.generation)
+    stop_at = (time.monotonic() + args.run_seconds
+               if args.run_seconds > 0 else None)
+    try:
+        while stop_at is None or time.monotonic() < stop_at:
+            replica.poll()
+            time.sleep(args.poll_interval_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        replica.close()
+        if heartbeat is not None:
+            heartbeat.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -292,7 +292,7 @@ class SnapshotBuilder:
 
     # -- publication (the swap) -----------------------------------------
 
-    def publish(self) -> TopKSnapshot:
+    def publish(self, generation: Optional[int] = None) -> TopKSnapshot:
         """Pack the build buffer and swap it in as :attr:`current`.
 
         Returns the published snapshot. A quiet boundary (nothing
@@ -301,6 +301,17 @@ class SnapshotBuilder:
         arrays would break the refcount ownership the buffer recycling
         rests on — while the swap counter and age stamp still advance,
         so an empty-window stream never reads as a wedged job.
+
+        ``generation``: explicit tag for the published snapshot instead
+        of the content counter (``prev + 1``). The serving-fleet
+        replicas (``serving/replica.py``) tag snapshots with the *delta
+        log position* they replayed to, so `/recommend` responses carry
+        a generation a front tier can compare across the whole fleet
+        (read-your-window consistency). In this mode a quiet publish
+        (an empty delta generation) re-tags the unchanged published
+        object — content at log position ``G`` IS content at ``G-1``
+        when the delta touched no top-K row, so either tag describes
+        the served table truthfully and the monotone tag must win.
         """
         now = time.time()
         self.swaps += 1
@@ -308,9 +319,18 @@ class SnapshotBuilder:
         self._gauge_swaps.add(1)
         self._gauge_built.set(now)
         if not self._dirty:
+            if generation is not None \
+                    and generation != self.current.generation:
+                # Content unchanged: advance the tag in place (one
+                # GIL-atomic int store; readers see the old or new tag,
+                # both truthful for identical content).
+                self.current.generation = generation
+                self._gauge_gen.set(generation)
             return self.current
         prev = self.current
-        snap = self._pack(prev.generation + 1, now)
+        snap = self._pack(
+            generation if generation is not None
+            else prev.generation + 1, now)
         self._dirty = False
         self.current = snap  # THE swap: one atomic reference assignment
         self._spare = prev

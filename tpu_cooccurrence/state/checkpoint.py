@@ -505,7 +505,8 @@ _LATEST_KEYS = ("latest_items", "latest_offsets", "latest_others",
 
 
 def _resolve_chain(directory: str, suffix: str, top_gen: int,
-                   top_meta: dict) -> "tuple[dict, tuple, dict]":
+                   top_meta: dict,
+                   quarantine: bool = True) -> "tuple[dict, tuple, dict]":
     """Reconstruct an incremental generation's big arrays: walk the
     delta files down to the full base, then replay them oldest-first
     over the base blob.
@@ -526,7 +527,10 @@ def _resolve_chain(directory: str, suffix: str, top_gen: int,
     Raises :class:`CheckpointCorrupt` on any broken link; provably
     corrupt files are quarantined (``*.corrupt``) so the restart loop
     cannot hit them again, while MISSING links quarantine nothing (the
-    walk simply falls back past the gap).
+    walk simply falls back past the gap). ``quarantine=False`` makes
+    the whole resolve READ-ONLY (corrupt files are skipped, never
+    renamed) — the serving-replica bootstrap path, which must not
+    mutate a live writer's directory.
     """
     deltas = []
     rec = top_meta["ckpt_delta"]
@@ -548,19 +552,22 @@ def _resolve_chain(directory: str, suffix: str, top_gen: int,
                 f"file ({exc})")
         if cur_gen == top_gen \
                 and hashlib.sha256(raw).hexdigest() != top_sha:
-            _quarantine_delta(dpath)
+            if quarantine:
+                _quarantine_delta(dpath)
             raise CheckpointCorrupt(
                 f"delta for generation {cur_gen} does not match the "
                 f"sha256 its generation meta committed")
         try:
             d = deltalog.decode_delta(raw)
         except deltalog.DeltaCorrupt as exc:
-            _quarantine_delta(dpath)
+            if quarantine:
+                _quarantine_delta(dpath)
             raise CheckpointCorrupt(
                 f"corrupt delta for generation {cur_gen}: {exc}")
         if d.gen != cur_gen or d.prev != cur_gen - 1 \
                 or d.base != base_gen:
-            _quarantine_delta(dpath)
+            if quarantine:
+                _quarantine_delta(dpath)
             raise CheckpointCorrupt(
                 f"delta header ({d.gen}/{d.prev}/{d.base}) does not "
                 f"link generation {cur_gen} to base {base_gen}")
@@ -570,7 +577,8 @@ def _resolve_chain(directory: str, suffix: str, top_gen: int,
     try:
         base_data = _load_verified(ppath)
     except CheckpointCorrupt:
-        _quarantine(ppath, directory, suffix)
+        if quarantine:
+            _quarantine(ppath, directory, suffix)
         raise
     except FileNotFoundError as exc:
         # Missing link: fall back past it. Other OSErrors are
@@ -1209,3 +1217,67 @@ def restore(job, directory: str, source=None) -> None:
         LOG.warning("restored checkpoint generation %d (newest was %d; "
                     "newer generations failed verification)",
                     restored_gen, gens[0][0])
+
+
+def load_serving_state(directory: str, suffix: str = "") -> dict:
+    """Read-only bootstrap loader for serving replicas
+    (``serving/replica.py``): the newest verifying generation's
+    *consumable* state — the emitted top-K table, the append-only
+    vocabularies and (when the writer runs a reservoir sampler) the
+    per-user history arrays — WITHOUT constructing a job and WITHOUT
+    ever renaming a file. A replica shares the directory with a live
+    writer (and with its sibling replicas), so corrupt or vanished
+    generations are skipped, never quarantined; the writer's own
+    restore walk owns quarantine.
+
+    Returns ``{"gen", "windows_fired", "latest": (items, offsets,
+    others, scores), "item_vocab", "user_vocab"[, "hist", "hist_len"]}``
+    — ``latest`` in the exact external-id arrays :func:`save` writes.
+    Raises :class:`FileNotFoundError` when the directory holds no
+    generation at all and :class:`CheckpointCorrupt` when none
+    verifies.
+    """
+    gens = generations(directory, suffix)
+    if not gens:
+        raise FileNotFoundError(
+            f"no checkpoint for suffix {suffix!r} in {directory}")
+    for gen, path in gens:
+        try:
+            data = _load_verified(path)
+        except FileNotFoundError:
+            continue  # the writer's retention raced the listing
+        except CheckpointCorrupt as exc:
+            LOG.warning("replica bootstrap: generation %d failed "
+                        "verification (%s); trying older", gen, exc)
+            continue
+        if "meta_json" not in data:
+            LOG.warning("replica bootstrap: generation %d has no "
+                        "embedded meta; trying older", gen)
+            continue
+        meta = json.loads(bytes(data["meta_json"]).decode())
+        if meta.get("ckpt_delta"):
+            try:
+                _blob, latest, aux = _resolve_chain(
+                    directory, suffix, gen, meta, quarantine=False)
+            except CheckpointCorrupt as exc:
+                LOG.warning("replica bootstrap: generation %d delta "
+                            "chain failed (%s); trying older", gen, exc)
+                continue
+            data.update(aux)
+        else:
+            _decode_codec(data, meta)
+            latest = tuple(data[k] for k in _LATEST_KEYS)
+        out = {
+            "gen": gen,
+            "windows_fired": int(meta.get("windows_fired", 0)),
+            "latest": tuple(np.asarray(a) for a in latest),
+            "item_vocab": np.asarray(data["item_vocab"], dtype=np.int64),
+            "user_vocab": np.asarray(data["user_vocab"], dtype=np.int64),
+        }
+        if "hist" in data:
+            out["hist"] = np.asarray(data["hist"])
+            out["hist_len"] = np.asarray(data["hist_len"], dtype=np.int64)
+        return out
+    raise CheckpointCorrupt(
+        f"no checkpoint generation in {directory} verifies for the "
+        f"replica bootstrap (walked all {len(gens)})")
