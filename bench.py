@@ -633,6 +633,120 @@ def _longtail_churn_stream(windows: int, users_per: int, events_per: int,
             np.concatenate(tss))
 
 
+def _rescale_arm() -> dict:
+    """Autoscale-seam arm (ISSUE 15): pairs/s across the load-forced
+    2→4 gang rescale on the churn stream.
+
+    A real 2-worker CPU gang (the autoscaler is gang machinery; the arm
+    must not fight the throughput bench for the chip, so it pins
+    ``JAX_PLATFORMS=cpu`` like the other subprocess arms) ingests the
+    churn stream with delay faults billed into three consecutive window
+    walls — the same injection the chaos capstone uses — and a scale-up
+    at ``--autoscale-trip-windows 2``. Scale-down is disabled (clear
+    threshold beyond the stream) so the arm isolates ONE seam. From
+    worker 0's journal: the rescale count, the **seam stall** (drain
+    record to the first post-resume window — relaunch + jax init +
+    cross-topology restore + first dispatch), **windows-to-recover**
+    (post-resume windows until the wall drops back under twice the
+    pre-seam median — recompile warm-up), and pre/post/overall pairs/s.
+    """
+    import tempfile
+
+    windows = int(os.environ.get("BENCH_RESCALE_WINDOWS", 24))
+    users_per = int(os.environ.get("BENCH_RESCALE_USERS_PER", 60))
+    events_per = int(os.environ.get("BENCH_RESCALE_EVENTS_PER", 800))
+    u, i, t = _longtail_churn_stream(
+        windows=windows, users_per=users_per, events_per=events_per,
+        n_items=4000, alpha=1.07, drift=100, seed=5, window_ms=100)
+    work = tempfile.mkdtemp(prefix="bench-rescale-")
+    try:
+        csv = os.path.join(work, "in.csv")
+        with open(csv, "w") as fh:
+            for uu, ii, tt in zip(u.tolist(), i.tolist(), t.tolist()):
+                fh.write(f"{uu},{ii},{tt}\n")
+        jpath = os.path.join(work, "journal.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=1")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_cooccurrence.cli",
+             "-i", csv, "-ws", "100", "-s", "0xC0FFEE",
+             "--backend", "sparse", "--num-shards", "2",
+             "--checkpoint-dir", os.path.join(work, "ck"),
+             "--checkpoint-every-windows", "1",
+             "--checkpoint-retain", "100",
+             "--gang-workers", "2", "--gang-heartbeat-s", "1",
+             "--collective-timeout-s", "60", "--restart-delay-ms", "0",
+             "--journal", jpath,
+             "--degrade", "--degrade-window-wall-s", "2.0",
+             "--degrade-trip-windows", "3",
+             "--autoscale", "on", "--autoscale-min-workers", "2",
+             "--autoscale-max-workers", "4",
+             "--autoscale-trip-windows", "2",
+             "--autoscale-clear-windows", "100000",
+             "--autoscale-cooldown-windows", "2",
+             "--inject-fault", "window_fire@0:3:delay_ms:2500",
+             "--inject-fault", "window_fire@0:4:delay_ms:2500",
+             "--inject-fault", "window_fire@0:5:delay_ms:2500",
+             "--fault-state-dir", os.path.join(work, "faults")],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"rescale arm gang exited rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}")
+        with open(jpath + ".p0") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        wrecs = [r for r in recs if "seq" in r]
+        scale = [r for r in recs if "autoscale" in r]
+        if not scale or not wrecs:
+            raise RuntimeError("rescale arm journal has no seam")
+        drain = scale[0]
+        pre = [r for r in wrecs if r["seq"] <= drain["window"]]
+        post = sorted((r for r in wrecs if r["seq"] > drain["window"]),
+                      key=lambda r: r["seq"])
+        seam_stall = round(post[0]["wall_unix"] - drain["wall_unix"], 3)
+        # Injected delays are load, not measurement: drop the delayed
+        # windows (wall over the 2.0 s overload threshold the arm
+        # configures) from the pre-seam baseline, or the recovery
+        # cutoff would sit above every post-seam window and the metric
+        # could never read anything but 0.
+        pre_walls = sorted(
+            w for w in (r["sample_seconds"] + r["score_seconds"]
+                        for r in pre) if w < 2.0)
+        baseline = (pre_walls[len(pre_walls) // 2] if pre_walls
+                    else 0.05)
+        recover = 0
+        for r in post:
+            if (r["sample_seconds"] + r["score_seconds"]
+                    <= max(2 * baseline, 0.05)):
+                break
+            recover += 1
+
+        def _rate(rs):
+            span = rs[-1]["wall_unix"] - rs[0]["wall_unix"]
+            return round(sum(r["pairs"] for r in rs) / max(span, 1e-9),
+                         1)
+
+        return {
+            "ok": True,
+            "events": int(len(u)),
+            "windows": len(wrecs),
+            "rescales": len(scale),
+            "from_to": [int(drain["from"]), int(drain["to"])],
+            "seam_stall_seconds": seam_stall,
+            "windows_to_recover": recover,
+            "pairs_per_sec": {
+                "pre_seam": _rate(pre) if len(pre) > 1 else None,
+                "post_seam": _rate(post) if len(post) > 1 else None,
+                "overall": _rate(wrecs),
+            },
+        }
+    finally:
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _checkpoint_arm(sp_u, sp_i, sp_t, window_ms: int = 100) -> dict:
     """Full-vs-incremental checkpoint A/B on the churn stream (PR 12).
 
@@ -840,7 +954,8 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
                    serving: dict = None, spill: dict = None,
                    fused_sparse: dict = None,
                    checkpoint: dict = None,
-                   fleet: dict = None) -> None:
+                   fleet: dict = None,
+                   rescale: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -899,6 +1014,13 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         # trajectory-visible like every other arm, ok:false when the
         # arm degraded.
         entry["fleet"] = fleet
+    if rescale:
+        # The ISSUE-15 autoscale seam: pairs/s across the load-forced
+        # 2→4 gang rescale (seam stall seconds, windows-to-recover,
+        # rescale count) — the cost of scaling must stay trajectory-
+        # visible, or a "free" rescale that quietly stalls a minute
+        # would never be caught.
+        entry["rescale"] = rescale
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -1199,6 +1321,15 @@ def measure() -> None:
         fleet_storm = {"ok": False,
                        "error": f"{type(exc).__name__}: {exc}"}
 
+    # Autoscale-seam arm (ISSUE 15): pairs/s across a load-forced 2→4
+    # gang rescale — seam stall seconds, windows-to-recover and the
+    # rescale count, from the gang's own journal.
+    try:
+        rescale_info = _rescale_arm()
+    except Exception as exc:
+        rescale_info = {"ok": False,
+                        "error": f"{type(exc).__name__}: {exc}"}
+
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.
     baseline_path = os.path.join(REPO, ".bench_baseline.json")
@@ -1232,6 +1363,7 @@ def measure() -> None:
         "checkpoint": ckpt_info,
         "serving": serving_storm,
         "fleet": fleet_storm,
+        "rescale": rescale_info,
     }
     if journal:
         out["journal"] = journal
@@ -1253,7 +1385,8 @@ def measure() -> None:
         _record_onchip(out["value"], out["vs_baseline"], backend,
                        pipeline_depth, occupancy, latency, degradation,
                        fused_info, compression, serving_storm, spill_info,
-                       fused_sparse, ckpt_info, fleet_storm)
+                       fused_sparse, ckpt_info, fleet_storm,
+                       rescale_info)
     print(json.dumps(out))
 
 

@@ -1,0 +1,692 @@
+"""Load-driven gang autoscaler (ISSUE 15): the unit layer.
+
+Policy hysteresis/cooldown/bounds, the worker-side tap's packed gang
+vote + pressure beacon + drain trigger, the supervisor's voluntary-exit
+accounting over fake workers (a rescale is never a billed restart), the
+topology-aware restore vote, the N→M blob merge, the scale-before-shed
+precedence hold, and the observability surfaces (AUTOSCALE journal
+records, gauges, the /healthz block). The real-CLI capstone — injected
+load forcing 2→4, idle decaying 4→2, bit-identical stdout, and the
+crash inside the rescale seam — is ``tests/test_autoscale_chaos.py``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config
+from tpu_cooccurrence.observability.http import MetricsServer
+from tpu_cooccurrence.observability.journal import (VERSION,
+                                                    validate_record)
+from tpu_cooccurrence.observability.registry import MetricsRegistry
+from tpu_cooccurrence.robustness import faults
+from tpu_cooccurrence.robustness.autoscale import (
+    RESCALE_EXIT,
+    AutoscaleTap,
+    LadderScalePolicy,
+    ScaleDecision,
+    ScalePolicy,
+    beacon_path,
+    read_json,
+    request_path,
+    write_json,
+)
+from tpu_cooccurrence.robustness.degrade import (DegradationController,
+                                                 DegradationLevel)
+from tpu_cooccurrence.robustness.gang import (GangSupervisor,
+                                              agree_restore_topology)
+from tpu_cooccurrence.state import checkpoint as ckpt
+from tpu_cooccurrence.state.store import merge_mh_cells, rebucket_cells
+
+
+# -- LadderScalePolicy ---------------------------------------------------
+
+
+def test_ladder_policy_grows_on_sustained_pressure():
+    p = LadderScalePolicy(max_workers=8, min_workers=2, trip_windows=3,
+                          clear_windows=8, cooldown_windows=0)
+    assert p.decide(1, True, False, 1, 0, 2) is None
+    assert p.decide(2, True, False, 2, 0, 2) is None
+    d = p.decide(3, True, False, 3, 0, 2)
+    assert (d.target, d.trigger, d.decision) == (4, "pressure", "grow")
+
+
+def test_ladder_policy_shrinks_on_sustained_idle_and_clamps():
+    p = LadderScalePolicy(max_workers=8, min_workers=2, trip_windows=3,
+                          clear_windows=2, cooldown_windows=0)
+    assert p.decide(1, False, True, 0, 1, 3) is None
+    d = p.decide(2, False, True, 0, 2, 3)
+    # 3 // 2 = 1 clamps to the min bound.
+    assert (d.target, d.trigger, d.decision) == (2, "idle", "shrink")
+    # At the floor the idle signal is a no-op.
+    assert p.decide(3, False, True, 0, 3, 2) is None
+
+
+def test_ladder_policy_caps_at_max_and_honors_cooldown():
+    p = LadderScalePolicy(max_workers=4, min_workers=2, trip_windows=1,
+                          clear_windows=1, cooldown_windows=2)
+    assert p.decide(1, True, False, 1, 0, 2).target == 4
+    # Cooldown: the next two observed windows are refractory even
+    # though their signals would decide.
+    assert p.decide(2, False, True, 0, 5, 4) is None
+    assert p.decide(3, False, True, 0, 6, 4) is None
+    assert p.decide(4, False, True, 0, 7, 4).target == 2
+    # At max, pressure is the ladder's business, not the policy's.
+    p2 = LadderScalePolicy(max_workers=4, min_workers=2, trip_windows=1,
+                           clear_windows=1, cooldown_windows=0)
+    assert p2.decide(1, True, False, 9, 0, 4) is None
+
+
+def test_ladder_policy_cooldown_discards_warmup_evidence():
+    """A warm-up that OUTLASTS the cooldown must not cascade a second
+    rescale on its stale run counter: the decision needs its full trip
+    run observed on post-cooldown windows (review fix)."""
+    p = LadderScalePolicy(max_workers=8, min_workers=2, trip_windows=2,
+                          clear_windows=2, cooldown_windows=2)
+    assert p.decide(1, True, False, 1, 0, 2) is None
+    assert p.decide(2, True, False, 2, 0, 2).target == 4
+    # Windows 3-4: cooldown. Windows 5+: the worker's bad_run kept
+    # climbing through the warm-up — but only post-cooldown windows
+    # count, so window 5 (bad_run=5, fresh=1) must NOT decide...
+    assert p.decide(3, True, False, 3, 0, 4) is None
+    assert p.decide(4, True, False, 4, 0, 4) is None
+    assert p.decide(5, True, False, 5, 0, 4) is None
+    # ...and window 6 (two fresh overloaded windows) may.
+    assert p.decide(6, True, False, 6, 0, 4).target == 8
+    # Same for the idle side after that second cooldown.
+    assert p.decide(7, False, True, 0, 9, 8) is None
+    assert p.decide(8, False, True, 0, 10, 8) is None
+    assert p.decide(9, False, True, 0, 11, 8) is None
+    assert p.decide(10, False, True, 0, 12, 8).target == 4
+
+
+def test_ladder_policy_dedupes_windows():
+    p = LadderScalePolicy(max_workers=4, min_workers=2, trip_windows=1,
+                          clear_windows=1, cooldown_windows=0)
+    assert p.decide(5, False, False, 0, 0, 2) is None
+    # Re-reading the same beacon window must not consume cooldown or
+    # double-count anything.
+    assert p.decide(5, True, False, 3, 0, 2) is None
+    assert p.decide(6, True, False, 3, 0, 2).target == 4
+
+
+def test_ladder_policy_validates_bounds():
+    with pytest.raises(ValueError):
+        LadderScalePolicy(max_workers=4, min_workers=1)
+    with pytest.raises(ValueError):
+        LadderScalePolicy(max_workers=2, min_workers=4)
+    with pytest.raises(ValueError):
+        LadderScalePolicy(max_workers=4, trip_windows=0)
+    with pytest.raises(ValueError):
+        LadderScalePolicy(max_workers=4, cooldown_windows=-1)
+    with pytest.raises(ValueError):
+        LadderScalePolicy(max_workers=4, factor=1)
+
+
+# -- the worker-side tap -------------------------------------------------
+
+
+def _tap(tmp_path, votes, pid=0, workers=2, idle_wall_s=0.1):
+    gang = str(tmp_path / "gang")
+    os.makedirs(gang, exist_ok=True)
+    calls = []
+
+    def exchange(v):
+        calls.append(v)
+        return votes.pop(0)
+
+    tap = AutoscaleTap(gang, pid, workers, idle_wall_s=idle_wall_s,
+                       exchange=exchange)
+    return tap, gang, calls
+
+
+def test_tap_packs_bits_and_counts_runs(tmp_path):
+    tap, gang, calls = _tap(tmp_path, votes=[[1, 0], [0, 0], [2, 2]])
+    # Overloaded window: bit 0 set locally; any peer bit -> gang over.
+    assert tap.observe(1, wall_seconds=0.5, overloaded=True) is False
+    assert calls[-1] & 1
+    assert (tap.bad_run, tap.idle_run) == (1, 0)
+    # Busy-but-healthy window (wall above the idle threshold): neither.
+    assert tap.observe(2, wall_seconds=0.5, overloaded=False) is False
+    assert calls[-1] == 0
+    assert (tap.bad_run, tap.idle_run) == (0, 0)
+    # Idle window: bit 1 set locally, AND-ed gang-wide.
+    assert tap.observe(3, wall_seconds=0.01, overloaded=False) is False
+    assert calls[-1] & 2
+    assert (tap.bad_run, tap.idle_run) == (0, 1)
+    beacon = read_json(beacon_path(gang, 0))
+    assert beacon["window"] == 3 and beacon["idle"] == 1
+    assert beacon["idle_run"] == 1 and beacon["bad_run"] == 0
+
+
+def test_tap_idle_needs_every_worker(tmp_path):
+    # Peer voted not-idle: the gang is not idle even though we are.
+    tap, _gang, _ = _tap(tmp_path, votes=[[2, 0]])
+    tap.observe(1, wall_seconds=0.01, overloaded=False)
+    assert tap.idle_run == 0
+
+
+def test_tap_overload_beats_idle(tmp_path):
+    # A window can never be both: gang pressure zeroes the idle run.
+    tap, _gang, _ = _tap(tmp_path, votes=[[2, 1]])
+    tap.observe(1, wall_seconds=0.01, overloaded=False)
+    assert (tap.bad_run, tap.idle_run) == (1, 0)
+
+
+def test_tap_drains_only_on_unanimous_request_vote(tmp_path):
+    tap, gang, calls = _tap(tmp_path, votes=[[4, 0], [4, 4]])
+    req = {"to": 4, "from": 2, "decision": "grow",
+           "trigger": "pressure", "window": 3, "cooldown": 2, "seq": 1}
+    write_json(request_path(gang), req)
+    # One peer has not seen the file yet: no drain this window.
+    assert tap.observe(1, 0.5, overloaded=False) is False
+    assert tap.drain is None
+    assert calls[-1] & 4  # but we DID vote ready
+    assert tap.observe(2, 0.5, overloaded=False) is True
+    assert tap.drain == req
+
+
+def test_tap_ignores_request_for_current_topology(tmp_path):
+    tap, gang, calls = _tap(tmp_path, votes=[[7, 7]])
+    write_json(request_path(gang), {"to": 2, "from": 2})
+    # A stale request naming our own size must not arm the ready bit
+    # (the peers' votes in `votes` are fabricated; ours is calls[-1]).
+    tap.observe(1, 0.5, overloaded=False)
+    assert not (calls[-1] & 4)
+
+
+def test_tap_validates_idle_wall(tmp_path):
+    with pytest.raises(ValueError):
+        AutoscaleTap(str(tmp_path), 0, 2, idle_wall_s=0.0)
+
+
+# -- scale-before-shed precedence ---------------------------------------
+
+
+def test_hold_escalation_keeps_ladder_at_normal():
+    c = DegradationController(window_wall_s=0.1, trip_windows=2,
+                              clear_windows=2)
+    c.hold_escalation = True
+    for _ in range(5):
+        c.observe_window(wall_seconds=1.0)
+    assert c.level == DegradationLevel.NORMAL
+    assert c.last_overloaded is True
+    # At max capacity the job leaves the flag False: same signals shed.
+    c.hold_escalation = False
+    c.observe_window(wall_seconds=1.0)
+    c.observe_window(wall_seconds=1.0)
+    assert c.level == DegradationLevel.SHED_SAMPLING
+
+
+def test_hold_never_blocks_deescalation():
+    c = DegradationController(window_wall_s=0.1, trip_windows=1,
+                              clear_windows=2)
+    c.observe_window(wall_seconds=1.0)
+    assert c.level == DegradationLevel.SHED_SAMPLING
+    c.hold_escalation = True
+    c.observe_window(wall_seconds=0.01)
+    c.observe_window(wall_seconds=0.01)
+    assert c.level == DegradationLevel.NORMAL
+
+
+# -- journal + /healthz surfaces ----------------------------------------
+
+
+def test_autoscale_journal_record_validates():
+    validate_record({"v": VERSION, "autoscale": "grow", "from": 2,
+                     "to": 4, "trigger": "pressure", "window": 7,
+                     "cooldown": 8, "wall_unix": time.time()})
+    with pytest.raises(ValueError, match="grow|shrink"):
+        validate_record({"v": VERSION, "autoscale": "explode", "from": 2,
+                         "to": 4, "trigger": "pressure", "window": 7,
+                         "cooldown": 8, "wall_unix": 0.0})
+    with pytest.raises(ValueError, match="pressure|idle"):
+        validate_record({"v": VERSION, "autoscale": "grow", "from": 2,
+                         "to": 4, "trigger": "vibes", "window": 7,
+                         "cooldown": 8, "wall_unix": 0.0})
+    with pytest.raises(ValueError, match="unknown"):
+        validate_record({"v": VERSION, "autoscale": "grow", "from": 2,
+                         "to": 4, "trigger": "idle", "window": 7,
+                         "cooldown": 8, "wall_unix": 0.0, "extra": 1})
+
+
+def test_healthz_autoscale_block():
+    reg = MetricsRegistry()
+    server = MetricsServer(reg)
+    payload, _healthy = server.health()
+    assert "autoscale" not in payload  # no tap armed
+    reg.gauge("cooc_gang_target_workers").set(4)
+    reg.gauge("cooc_gang_rescales_total").set(2)
+    reg.gauge("cooc_autoscale_level").set(-1)
+    payload, _healthy = server.health()
+    assert payload["autoscale"] == {"target_workers": 4,
+                                    "rescales_total": 2, "level": -1}
+    server._server.server_close()
+
+
+# -- the supervisor over fake workers ------------------------------------
+
+
+FAKE_WORKER = r"""
+import os, sys, time
+args = sys.argv[1:]
+def val(flag):
+    return args[args.index(flag) + 1]
+pid = int(val("--process-id"))
+state_dir = val("-i")   # scratch dir smuggled as the input
+mode = val("-ws")       # scenario name smuggled as the window size
+gang_dir = os.environ["TPU_COOC_GANG_DIR"]
+open(os.path.join(gang_dir, f"heartbeat.p{pid}"), "w").write("{}")
+if mode == "mixed":
+    # One worker finishes cleanly, the other takes the voluntary code
+    # with no request pending — the mixed-verdict failure shape.
+    if pid == 0:
+        print("row-from-p0")
+        sys.exit(0)
+    sys.exit(86)
+import json
+req_path = os.path.join(gang_dir, "RESCALE")
+deadline = time.time() + 1.2
+window = 0
+while time.time() < deadline:
+    window += 1
+    open(os.path.join(gang_dir, f"pressure.p{pid}.tmp"), "w").write(
+        json.dumps({"window": window, "overloaded": 1, "idle": 0,
+                    "bad_run": window, "idle_run": 0}))
+    os.replace(os.path.join(gang_dir, f"pressure.p{pid}.tmp"),
+               os.path.join(gang_dir, f"pressure.p{pid}"))
+    if os.path.exists(req_path):
+        if mode == "drain-crash" and pid == 0:
+            marker = os.path.join(state_dir, "crashed-once")
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                sys.exit(9)  # died INSIDE the seam
+        sys.exit(86)  # voluntary rescale exit
+    time.sleep(0.05)
+print(f"row-from-p{pid}")
+sys.exit(0)
+"""
+
+
+class ScriptedPolicy(ScalePolicy):
+    """Deterministic decision feed for supervisor tests: pops the next
+    target whenever a beacon window arrives, regardless of signals."""
+
+    def __init__(self, targets):
+        self.targets = list(targets)
+        self.applied = []
+
+    def decide(self, window, overloaded, idle, bad_run, idle_run,
+               workers):
+        if not self.targets:
+            return None
+        target = self.targets.pop(0)
+        return ScaleDecision(target=target,
+                             trigger=("pressure" if target > workers
+                                      else "idle"),
+                             window=window, cooldown=0)
+
+    def rescaled(self, workers):
+        self.applied.append(workers)
+
+
+def _fake_gang(tmp_path, mode, policy, attempts=1):
+    script = tmp_path / "fake_worker.py"
+    script.write_text(FAKE_WORKER)
+
+    class Sink:
+        def __init__(self):
+            self.text = ""
+
+        def write(self, s):
+            self.text += s
+
+    sink = Sink()
+    sup = GangSupervisor(
+        ["-i", str(tmp_path), "-ws", mode], num_workers=2,
+        attempts=attempts, gang_dir=str(tmp_path / "gang"),
+        stale_after_s=0.0, delay_s=0.0, timeout_s=60.0,
+        stdout=sink, python=[sys.executable, str(script)],
+        scale_policy=policy)
+    return sup, sink
+
+
+def test_supervisor_rescales_never_consume_restart_budget(tmp_path):
+    """The exit-code accounting satellite: a gang that rescales FIVE
+    times on a budget of one restart never aborts — voluntary exits
+    are free, and the final clean attempt's output forwards intact."""
+    policy = ScriptedPolicy([4, 2, 4, 2, 4])
+    sup, sink = _fake_gang(tmp_path, "rescale", policy, attempts=1)
+    assert sup.run() == 0
+    assert sup.rescales == 5
+    assert policy.applied == [4, 2, 4, 2, 4]
+    assert sup.num_workers == 4  # the last applied topology
+    # Only the final (clean, 4-worker) attempt's spools forward.
+    assert sink.text == ("row-from-p0\nrow-from-p1\n"
+                         "row-from-p2\nrow-from-p3\n")
+    # The request beacon never outlives its rescale.
+    assert not os.path.exists(request_path(str(tmp_path / "gang")))
+
+
+def test_supervisor_seam_crash_bills_budget_and_keeps_target(tmp_path):
+    """A worker crashing between the drain decision and the relaunch is
+    a REAL failure (one billed restart) — but the pending target is
+    still honored, because the topology-aware restore vote restores
+    whatever topology last committed at whatever size we relaunch."""
+    policy = ScriptedPolicy([4])
+    sup, sink = _fake_gang(tmp_path, "drain-crash", policy, attempts=1)
+    assert sup.run() == 0
+    assert sup.rescales == 0       # the drain never completed cleanly
+    assert sup.num_workers == 4    # the target applied anyway
+    assert policy.applied == [4]
+    assert "row-from-p3" in sink.text
+
+
+def test_supervisor_seam_crash_with_no_budget_aborts(tmp_path):
+    policy = ScriptedPolicy([4])
+    sup, _sink = _fake_gang(tmp_path, "drain-crash", policy, attempts=0)
+    assert sup.run() == 9
+
+
+def test_supervisor_mixed_verdict_never_exits_86(tmp_path):
+    """Mixed clean/RESCALE_EXIT codes are a failed attempt, and the
+    failure must never surface as 86 — that code is the voluntary
+    contract and automation keys on it (review fix)."""
+    sup, _sink = _fake_gang(tmp_path, "mixed", None, attempts=0)
+    rc = sup.run()
+    assert rc == 1
+    assert rc != RESCALE_EXIT
+    assert sup.rescales == 0
+
+
+def test_supervisor_fires_rescale_relaunch_site(tmp_path):
+    plan = faults.arm(["rescale_relaunch:exception"])
+    try:
+        policy = ScriptedPolicy([4])
+        sup, _sink = _fake_gang(tmp_path, "rescale", policy, attempts=1)
+        with pytest.raises(faults.InjectedFault):
+            sup.run()
+        assert plan.specs[0].fired
+    finally:
+        faults.disarm()
+
+
+def test_supervisor_clears_stale_beacons_on_spawn(tmp_path):
+    gang = tmp_path / "gang"
+    gang.mkdir()
+    # A decayed gang's retired slot left its beacon behind; the next
+    # spawn must clear it so the policy never reads a ghost signal.
+    write_json(str(gang / "pressure.p7"), {"window": 99})
+    sup, _sink = _fake_gang(tmp_path, "clean-noop", None, attempts=0)
+    workers = sup._spawn(0, 0, 0.0)
+    assert not os.path.exists(gang / "pressure.p7")
+    # Reap the fake workers (they exit 0 on their own within ~3s).
+    for w in workers:
+        w.proc.wait(timeout=30)
+        w.spool.close()
+
+
+# -- topology-aware restore vote ----------------------------------------
+
+
+def _commit_gen(d, pid, gen, writers, marker=True, legacy=False):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"state.p{pid}.{gen}.npz"), "wb") as f:
+        f.write(b"x")
+    if marker:
+        with open(os.path.join(d, f"EPOCH.p{pid}.{gen}"), "w") as f:
+            f.write(f"{gen}\n" if legacy else f"{gen} {writers}\n")
+
+
+def test_topology_committed_generations(tmp_path):
+    d = str(tmp_path / "ck")
+    # gen 1: fully committed by a 2-process topology.
+    for pid in (0, 1):
+        _commit_gen(d, pid, 1, 2)
+    # gen 2: only worker 0 marked (torn global commit).
+    _commit_gen(d, 0, 2, 2)
+    _commit_gen(d, 1, 2, 2, marker=False)
+    assert ckpt.topology_committed_generations(d) == [(1, 2)]
+    # gen 3: fully committed by a 4-process topology (post-rescale).
+    for pid in range(4):
+        _commit_gen(d, pid, 3, 4)
+    assert ckpt.topology_committed_generations(d) == [(3, 4), (1, 2)]
+
+
+def test_topology_vote_ignores_legacy_markers(tmp_path):
+    d = str(tmp_path / "ck")
+    for pid in (0, 1):
+        _commit_gen(d, pid, 1, 2, legacy=True)
+    assert ckpt.topology_committed_generations(d) == []
+
+
+def test_topology_vote_is_chain_aware(tmp_path):
+    d = str(tmp_path / "ck")
+    for pid in (0, 1):
+        _commit_gen(d, pid, 1, 2)
+        _commit_gen(d, pid, 2, 2)
+        # gen 2 is incremental for p0 but its base npz is gone: the
+        # whole generation must not count.
+        with open(os.path.join(d, "delta.p0.2.bin"), "wb") as f:
+            f.write(b"d")
+    os.remove(os.path.join(d, "state.p0.1.npz"))
+    assert ckpt.topology_committed_generations(d) == []
+
+
+def test_agree_restore_topology_quarantines_all_suffixes(tmp_path):
+    d = str(tmp_path / "ck")
+    for pid in (0, 1):
+        _commit_gen(d, pid, 1, 2)
+    # Torn newer generation on BOTH suffixes plus a retired-topology
+    # straggler: the vote sweeps them all aside.
+    _commit_gen(d, 0, 2, 2, marker=False)
+    _commit_gen(d, 1, 2, 2, marker=False)
+    _commit_gen(d, 3, 2, 4, marker=False)
+    barriers = []
+    agreed, writers = agree_restore_topology(
+        d, process_id=0, exchange=lambda v: v,
+        barrier=barriers.append)
+    assert (agreed, writers) == (1, 2)
+    assert barriers  # peers rendezvous after the sweep
+    partials = sorted(n for n in os.listdir(d) if n.endswith(".partial"))
+    assert partials == ["state.p0.2.npz.partial",
+                        "state.p1.2.npz.partial",
+                        "state.p3.2.npz.partial"]
+
+
+def test_agree_restore_topology_refuses_legacy_markers(tmp_path):
+    """Upgrade hazard: pre-autoscale markers carry no topology, and
+    guessing it from marker counts would qualify a torn legacy commit
+    — the vote refuses loudly instead of quarantining committed
+    state."""
+    d = str(tmp_path / "ck")
+    for pid in (0, 1):
+        _commit_gen(d, pid, 1, 2, legacy=True)
+    assert ckpt.has_legacy_epoch_markers(d)
+    with pytest.raises(ValueError, match="pre-autoscale"):
+        agree_restore_topology(d, process_id=0, exchange=lambda v: v,
+                               barrier=lambda n: None)
+    # Nothing was touched.
+    assert not any(n.endswith(".partial") for n in os.listdir(d))
+
+
+def test_agree_restore_topology_refuses_markerless_state(tmp_path):
+    """Pre-epoch legacy layout: per-process generation files with NO
+    markers at all hold committed state the fixed-topology vote would
+    restore — the topology vote must refuse, not quarantine it all
+    (review fix)."""
+    d = str(tmp_path / "ck")
+    for pid in (0, 1):
+        _commit_gen(d, pid, 1, 2, marker=False)
+    assert not ckpt.has_epoch_markers(d)
+    with pytest.raises(ValueError, match="no epoch markers"):
+        agree_restore_topology(d, process_id=0, exchange=lambda v: v,
+                               barrier=lambda n: None)
+    assert not any(n.endswith(".partial") for n in os.listdir(d))
+    # A genuinely torn history (SOME new-format markers, none complete)
+    # still proceeds to the quarantine: recovery, not refusal.
+    _commit_gen(d, 0, 2, 2)  # p0 marked gen 2; p1 never did
+    agreed, writers = agree_restore_topology(
+        d, process_id=0, exchange=lambda v: v, barrier=lambda n: None)
+    assert (agreed, writers) == (-1, 0)
+    assert any(n.endswith(".partial") for n in os.listdir(d))
+
+
+def test_supervisor_broken_policy_aborts_the_gang(tmp_path):
+    """A policy that raises must abort the run loudly: the workers hold
+    the shed ladder on the promise of rescaling, so a supervisor that
+    quietly dropped its policy would leave sustained overload with no
+    relief of either kind (review fix)."""
+
+    class BrokenPolicy(ScalePolicy):
+        def decide(self, *a):
+            raise RuntimeError("boom")
+
+    sup, _sink = _fake_gang(tmp_path, "rescale", BrokenPolicy(),
+                            attempts=1)
+    with pytest.raises(RuntimeError, match="boom"):
+        sup.run()
+
+
+def test_agree_restore_topology_stale_view_fails_loudly(tmp_path):
+    """The gang agreed on a generation this host cannot see (stale
+    directory view): fail the attempt with a transient error — never
+    limp into a zero-writer restore (review fix)."""
+    d = str(tmp_path / "ck")
+    for pid in (0, 1):
+        _commit_gen(d, pid, 2, 2)
+    with pytest.raises(RuntimeError, match="cannot see"):
+        agree_restore_topology(d, process_id=1,
+                               exchange=lambda v: 1,  # peers voted 1
+                               barrier=lambda n: None)
+
+
+def test_agree_restore_topology_fresh_dir(tmp_path):
+    d = str(tmp_path / "ck")
+    agreed, writers = agree_restore_topology(
+        d, process_id=1, exchange=lambda v: v, barrier=lambda n: None)
+    assert (agreed, writers) == (-1, 0)
+
+
+def test_process_suffixes(tmp_path):
+    d = str(tmp_path / "ck")
+    os.makedirs(d)
+    for name in ("state.p0.3.npz", "delta.p2.4.bin", "state.1.npz",
+                 "state.p1.2.npz.partial"):
+        open(os.path.join(d, name), "w").close()
+    assert ckpt.process_suffixes(d) == [".p0", ".p2"]
+
+
+# -- the N→M blob merge --------------------------------------------------
+
+
+def _mh_blobs(keys, cnt, d_old, owners_by_file):
+    """Split a global (keys, cnt) blob into fake per-process mh blobs
+    exactly the way _device_checkpoint_state lays them out."""
+    owner = (keys >> 32) % d_old
+    blobs = []
+    rs = np.arange(100, dtype=np.int64)
+    for shards in owners_by_file:
+        parts = [cnt[owner == d] for d in shards]
+        blobs.append({
+            "mh_rows_key": keys,
+            "mh_local_shards": np.asarray(shards, dtype=np.int64),
+            "mh_local_cnt": (np.concatenate(parts).astype(np.int64)
+                             if parts else np.zeros(0, np.int64)),
+            "row_sums": rs,
+            "observed": np.asarray([1234], dtype=np.int64),
+        })
+    return blobs
+
+
+def test_merge_mh_cells_reassembles_the_global_blob():
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 60, 200).astype(np.int64)
+    dst = rng.integers(0, 60, 200).astype(np.int64)
+    keys = np.unique((rows << 32) | dst)
+    cnt = rng.integers(1, 90, len(keys)).astype(np.int64)
+    merged = merge_mh_cells(_mh_blobs(keys, cnt, 2, [[0], [1]]))
+    assert np.array_equal(merged["rows_key"], keys)
+    assert np.array_equal(merged["rows_cnt"], cnt)
+    assert merged["observed"][0] == 1234
+    # Multi-shard-per-process layouts too (2 processes x 2 shards).
+    merged4 = merge_mh_cells(_mh_blobs(keys, cnt, 4, [[0, 1], [2, 3]]))
+    assert np.array_equal(merged4["rows_key"], keys)
+    assert np.array_equal(merged4["rows_cnt"], cnt)
+    # The merged blob round-trips through the rescale re-bucket.
+    parts = rebucket_cells(merged["rows_key"], merged["rows_cnt"], 3)
+    assert sum(len(lk) for lk, _v, _d in parts) == len(keys)
+
+
+def test_merge_mh_cells_keeps_zero_cells_like_mh_restore():
+    """A zeroed cell still owns its slot: the same-topology mh restore
+    keeps it, so the cross-topology merge must too — dropping it would
+    shift every later re-insertion's slot-ordered tie-breaks."""
+    keys = np.asarray([(1 << 32) | 2, (2 << 32) | 3], dtype=np.int64)
+    cnt = np.asarray([5, 0], dtype=np.int64)
+    merged = merge_mh_cells(_mh_blobs(keys, cnt, 2, [[0], [1]]))
+    assert np.array_equal(merged["rows_key"], keys)
+    assert np.array_equal(merged["rows_cnt"], cnt)
+
+
+def test_merge_mh_cells_rejects_missing_writer():
+    keys = np.asarray([(1 << 32) | 2], dtype=np.int64)
+    cnt = np.asarray([5], dtype=np.int64)
+    blobs = _mh_blobs(keys, cnt, 2, [[1]])  # shard 0's file missing
+    with pytest.raises(ValueError, match="missing"):
+        merge_mh_cells(blobs)
+
+
+# -- config gating -------------------------------------------------------
+
+
+def _auto_cfg(**kw):
+    base = dict(window_size=10, backend=Backend.SPARSE, num_shards=2,
+                gang_workers=2, degrade=True, checkpoint_dir="/tmp/ck",
+                autoscale="on", autoscale_max_workers=4)
+    base.update(kw)
+    return Config(**base)
+
+
+def test_autoscale_config_gating():
+    _auto_cfg()  # the valid shape
+    with pytest.raises(ValueError, match="off.on"):
+        _auto_cfg(autoscale="maybe")
+    with pytest.raises(ValueError, match="gang"):
+        _auto_cfg(gang_workers=0)
+    with pytest.raises(ValueError, match="degrade"):
+        _auto_cfg(degrade=False)
+    with pytest.raises(ValueError, match="checkpoint-dir"):
+        _auto_cfg(checkpoint_dir=None)
+    with pytest.raises(ValueError, match="sparse"):
+        _auto_cfg(backend=Backend.SHARDED, num_items=64)
+    with pytest.raises(ValueError, match="max-workers"):
+        _auto_cfg(autoscale_max_workers=0)
+    with pytest.raises(ValueError, match=">= 2"):
+        _auto_cfg(autoscale_min_workers=1)
+    with pytest.raises(ValueError, match="launch topology"):
+        _auto_cfg(gang_workers=8)
+    with pytest.raises(ValueError, match="trip"):
+        _auto_cfg(autoscale_trip_windows=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        _auto_cfg(autoscale_cooldown_windows=-1)
+    # Worker-side shape (the supervisor strips --gang-workers and
+    # assigns the multi-controller identity).
+    Config(window_size=10, backend=Backend.SPARSE, num_shards=2,
+           degrade=True, checkpoint_dir="/tmp/ck", autoscale="on",
+           autoscale_max_workers=4, coordinator="127.0.0.1:9",
+           num_processes=2, process_id=0)
+
+
+def test_autoscale_off_is_inert():
+    # The default never constrains anything else.
+    Config(window_size=10, autoscale_max_workers=0)
+
+
+def test_rescale_sites_registered():
+    assert "rescale_drain" in faults.SITES
+    assert "rescale_relaunch" in faults.SITES

@@ -1134,8 +1134,10 @@ def test_gang_fault_sites_flags_unfired_site(tmp_path):
     (pkg / "faults.py").write_text("SITES = {}\n")
     result = Analyzer(str(root), rules=[RULES["gang-fault-sites"]],
                       baseline=[]).run()
-    # All three gang sites are unplugged in this mini-repo.
-    assert len(result.findings) == 3
+    # Every gang site is unplugged in this mini-repo.
+    from tpu_cooccurrence.robustness.gang import GANG_SITES
+
+    assert len(result.findings) == len(GANG_SITES)
     assert all(f.rule == "gang-fault-sites" for f in result.findings)
 
 
@@ -1255,3 +1257,97 @@ def test_replica_generation_tag_silent_without_replica_module():
     assert analyze_source(
         "X = 1\n", path="tpu_cooccurrence/other.py",
         rules=["replica-generation-tag"]) == []
+
+
+# -- rule pack 12: scale-policy registry ---------------------------------
+
+
+def _mini_policy_repo(tmp_path, *, test_body, arch_body):
+    """A minimal repo for the scale-policy-registry rule: the base
+    class plus one direct subclass and one transitive subclass."""
+    root = tmp_path / "repo"
+    rob = root / "tpu_cooccurrence" / "robustness"
+    rob.mkdir(parents=True)
+    (rob / "autoscale.py").write_text(
+        "class ScalePolicy:\n"
+        "    def decide(self, *a):\n"
+        "        raise NotImplementedError\n\n\n"
+        "class MyLadderPolicy(ScalePolicy):\n"
+        "    pass\n\n\n"
+        "class MySteppedPolicy(MyLadderPolicy):\n"
+        "    pass\n")
+    (root / "tests").mkdir()
+    (root / "tests" / "test_policy_fixture.py").write_text(test_body)
+    (root / "docs").mkdir()
+    (root / "docs" / "ARCHITECTURE.md").write_text(arch_body)
+    return root
+
+
+def test_scale_policy_registry_clean_fixture_passes(tmp_path):
+    root = _mini_policy_repo(
+        tmp_path,
+        test_body=("def test_hysteresis():\n"
+                   "    assert MyLadderPolicy and MySteppedPolicy\n"),
+        arch_body=("| `MyLadderPolicy` | ladder |\n"
+                   "| `MySteppedPolicy` | stepped |\n"))
+    result = Analyzer(str(root), rules=[RULES["scale-policy-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_scale_policy_registry_flags_untested_policy(tmp_path):
+    root = _mini_policy_repo(
+        tmp_path,
+        test_body="def test_hysteresis():\n    assert MyLadderPolicy\n",
+        arch_body=("| `MyLadderPolicy` | ladder |\n"
+                   "| `MySteppedPolicy` | stepped |\n"))
+    result = Analyzer(str(root), rules=[RULES["scale-policy-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["scale-policy-registry"]
+    assert "MySteppedPolicy" in result.findings[0].message
+    assert "hysteresis" in result.findings[0].message
+
+
+def test_scale_policy_registry_flags_missing_arch_row(tmp_path):
+    root = _mini_policy_repo(
+        tmp_path,
+        test_body=("def test_hysteresis():\n"
+                   "    assert MyLadderPolicy and MySteppedPolicy\n"),
+        arch_body="# arch\n\nno scale-policy table here\n")
+    result = Analyzer(str(root), rules=[RULES["scale-policy-registry"]],
+                      baseline=[]).run()
+    assert sorted(f.rule for f in result.findings) == [
+        "scale-policy-registry", "scale-policy-registry"]
+    assert all("scale-policy table" in f.message
+               for f in result.findings)
+
+
+def test_scale_policy_registry_flags_vanished_arch_doc(tmp_path):
+    root = _mini_policy_repo(
+        tmp_path,
+        test_body=("def test_hysteresis():\n"
+                   "    assert MyLadderPolicy and MySteppedPolicy\n"),
+        arch_body="x\n")
+    os.remove(root / "docs" / "ARCHITECTURE.md")
+    result = Analyzer(str(root), rules=[RULES["scale-policy-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["scale-policy-registry"]
+    assert "ARCHITECTURE.md not found" in result.findings[0].message
+
+
+def test_scale_policy_registry_flags_empty_registry(tmp_path):
+    root = _mini_policy_repo(
+        tmp_path, test_body="x = 1\n", arch_body="x\n")
+    (root / "tpu_cooccurrence" / "robustness" / "autoscale.py"
+     ).write_text("class ScalePolicy:\n    pass\n")
+    result = Analyzer(str(root), rules=[RULES["scale-policy-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["scale-policy-registry"]
+    assert "registry this rule guards is gone" in result.findings[0].message
+
+
+def test_scale_policy_registry_silent_without_autoscale_module():
+    """Fixture repos for other rules must not trip this rule."""
+    assert analyze_source(
+        "X = 1\n", path="tpu_cooccurrence/other.py",
+        rules=["scale-policy-registry"]) == []

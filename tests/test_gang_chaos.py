@@ -38,7 +38,11 @@ ENV = dict(os.environ, JAX_PLATFORMS="cpu",
 def stream(tmp_path_factory):
     path = tmp_path_factory.mktemp("gang") / "in.csv"
     with open(path, "w") as fh:
-        for i in range(500):
+        # 350 events = 7 windows at ws 500: enough for the highest
+        # chaos ordinal in this module (window/generation 5) with
+        # margin, at ~2/3 the wall of the original 500-event stream —
+        # the fixture feeds four-plus real gang runs (tier-1 budget).
+        for i in range(350):
             fh.write(f"{i % 13},{i % 17},{i * 10}\n")
     return str(path)
 

@@ -562,11 +562,15 @@ def test_commit_gauges_and_healthz_fields(tmp_path):
 
 
 #: Generation-meta keys ``checkpoint.save`` writes (embedded meta_json).
+#: ``gang_topology`` / ``rescaled_from`` are multi-host-only (the
+#: autoscaler's rescale-tagged meta: the writing process layout, read
+#: back by restore_rescaled's topology check and the rescale log line).
 META_KEYS = {
     "seed", "skip_cuts", "item_cut", "user_cut", "top_k",
     "window_slide", "window_millis", "windows_fired", "emissions",
     "emissions_per_window_resume", "max_ts_seen", "counters",
-    "source", "ckpt_codec", "ckpt_delta",
+    "source", "ckpt_codec", "ckpt_delta", "gang_topology",
+    "rescaled_from",
 }
 
 #: Delta-file header keys ``delta.encode_delta`` writes.
@@ -586,7 +590,8 @@ def test_checkpoint_format_keys_pinned(chain_repo):
     gen, path = ckpt.generations(d, "")[0]
     data = ckpt._load_verified(path)
     meta = json.loads(bytes(data["meta_json"]).decode())
-    optional = {"source", "ckpt_codec", "ckpt_delta"}
+    optional = {"source", "ckpt_codec", "ckpt_delta", "gang_topology",
+                "rescaled_from"}
     assert META_KEYS - optional <= set(meta) <= META_KEYS
     rec = read_delta_file(
         deltalog.delta_path(d, "", deltalog.delta_generations(d, "")[-1]))

@@ -346,10 +346,13 @@ def test_device_deferred_matches_pipelined():
 def test_device_deferred_auto_capacity_growth():
     """Deferred table survives dense auto-capacity re-allocation
     (--num-items omitted): rows scored before the growth keep their
-    entries."""
+    entries. Window size 60 (not 10): the growth claim needs the vocab
+    to cross the dense capacity MID-stream with scored rows on both
+    sides, which ~60 windows prove as well as ~375 did at a sixth of
+    the wall time (tier-1 budget)."""
     from tpu_cooccurrence.job import CooccurrenceJob
 
-    kw = dict(window_size=10, seed=0xD4, skip_cuts=True,
+    kw = dict(window_size=60, seed=0xD4, skip_cuts=True,
               development_mode=True)
     users, items, ts = random_stream(47, n=2500, n_items=1500)
     a = run_production(Config(**kw, backend=Backend.ORACLE),

@@ -52,6 +52,11 @@ Layout:
   into generation meta or delta headers needs a restore-side reader in
   its module and a ``tests/`` round-trip reference — the two ends of
   the incremental-checkpoint format cannot drift silently);
+* :mod:`.rules_autoscale` — scale-policy registry drift (every
+  ``ScalePolicy`` implementation in ``robustness/autoscale.py`` needs
+  a ``tests/`` reference and a row in the ARCHITECTURE scale-policy
+  table — a rescale trigger nobody exercises tears down live gangs on
+  untested hysteresis);
 * ``__main__`` — the runner: ``python -m tpu_cooccurrence.analysis``
   exits 1 on non-baseline findings (``--format json|text``).
 
@@ -73,6 +78,7 @@ from .core import (  # noqa: F401
 )
 
 # Importing the rule modules registers their rules in RULES.
+from . import rules_autoscale  # noqa: F401,E402
 from . import rules_ckpt  # noqa: F401,E402
 from . import rules_degrade  # noqa: F401,E402
 from . import rules_fused  # noqa: F401,E402

@@ -1,0 +1,108 @@
+"""Scale-policy registry drift (baseline-free).
+
+Every :class:`~tpu_cooccurrence.robustness.autoscale.ScalePolicy`
+implementation in ``robustness/autoscale.py`` decides when a live gang
+is torn down and relaunched at a different size — a policy nothing
+exercises is a policy whose hysteresis, bounds and cooldown are
+untested folklore, and one the ARCHITECTURE scale-policy table does not
+name is a rescale trigger operators cannot reason about when the gang
+starts cycling. Same evidence model as ``state-store-registry``:
+AST-only (nothing imported), a class counts as covered when its NAME is
+referenced anywhere under ``tests/`` and appears in
+``docs/ARCHITECTURE.md``. Fixture-tested in ``tests/test_cooclint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from .core import FileContext, Finding, RepoContext, Rule, register
+
+_POLICY_PATH = "tpu_cooccurrence/robustness/autoscale.py"
+_ARCH_PATH = "docs/ARCHITECTURE.md"
+_BASE = "ScalePolicy"
+
+
+def _policy_subclasses(tree: ast.Module) -> Dict[str, ast.ClassDef]:
+    """Module-level classes deriving (directly or through another class
+    in the module) from ``ScalePolicy``."""
+    classes = {n.name: n for n in tree.body if isinstance(n, ast.ClassDef)}
+    derived: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, node in classes.items():
+            if name in derived or name == _BASE:
+                continue
+            for b in node.bases:
+                base = (b.id if isinstance(b, ast.Name)
+                        else b.attr if isinstance(b, ast.Attribute)
+                        else None)
+                if base == _BASE or base in derived:
+                    derived.add(name)
+                    changed = True
+    return {name: classes[name] for name in derived}
+
+
+def _test_referenced_names(repo: RepoContext) -> Set[str]:
+    refs: Set[str] = set()
+    for ctx in repo.python_files():
+        if not ctx.path.startswith("tests/") or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name):
+                refs.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                refs.add(node.attr)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    refs.add(alias.name.rsplit(".", 1)[-1])
+    return refs
+
+
+@register
+class ScalePolicyRegistryRule(Rule):
+    name = "scale-policy-registry"
+    description = ("every ScalePolicy implementation in "
+                   "robustness/autoscale.py needs a tests/ reference "
+                   "and a row in the ARCHITECTURE scale-policy table")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        src: Optional[FileContext] = next(
+            (c for c in repo.files if c.path == _POLICY_PATH), None)
+        if src is None or src.tree is None:
+            return
+        policies = _policy_subclasses(src.tree)
+        if not policies:
+            yield Finding(
+                rule=self.name, file=_POLICY_PATH, line=1,
+                message="no ScalePolicy implementations found (the "
+                        "scale-policy registry this rule guards is gone)")
+            return
+        refs = _test_referenced_names(repo)
+        arch = next((c for c in repo.files if c.path == _ARCH_PATH), None)
+        if arch is None:
+            # A vanished anchor doc must be a finding, not a silent
+            # waiver of the doc requirement for every policy (same
+            # posture as state-store-registry).
+            yield Finding(
+                rule=self.name, file=_POLICY_PATH, line=1,
+                message=(f"{_ARCH_PATH} not found — the scale-policy "
+                         f"table this rule checks implementations "
+                         f"against is gone"))
+        for name, node in sorted(policies.items()):
+            if name not in refs:
+                yield Finding(
+                    rule=self.name, file=_POLICY_PATH, line=node.lineno,
+                    message=(f"ScalePolicy implementation {name!r} has "
+                             f"no test evidence: nothing under tests/ "
+                             f"references it — a rescale trigger nobody "
+                             f"exercises tears down live gangs on "
+                             f"untested hysteresis"))
+            if arch is not None and name not in arch.source:
+                yield Finding(
+                    rule=self.name, file=_POLICY_PATH, line=node.lineno,
+                    message=(f"ScalePolicy implementation {name!r} is "
+                             f"not in {_ARCH_PATH} — add it to the "
+                             f"scale-policy table"))

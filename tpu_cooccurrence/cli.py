@@ -66,6 +66,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         gang_dir = (os.path.join(config.checkpoint_dir, "gang")
                     if config.checkpoint_dir
                     else tempfile.mkdtemp(prefix="cooc-gang-"))
+        scale_policy = None
+        if config.autoscale == "on":
+            # The supervisor-side half of the autoscaler: the policy
+            # reads the workers' pressure beacons from the gang dir and
+            # decides target topologies (robustness/autoscale.py).
+            from .robustness.autoscale import LadderScalePolicy
+
+            scale_policy = LadderScalePolicy(
+                max_workers=config.autoscale_max_workers,
+                min_workers=config.autoscale_min_workers,
+                trip_windows=config.autoscale_trip_windows,
+                clear_windows=config.autoscale_clear_windows,
+                cooldown_windows=config.autoscale_cooldown_windows)
+            LOG.info("autoscale armed: %d..%d workers, trip=%d "
+                     "clear=%d cooldown=%d windows",
+                     config.autoscale_min_workers,
+                     config.autoscale_max_workers,
+                     config.autoscale_trip_windows,
+                     config.autoscale_clear_windows,
+                     config.autoscale_cooldown_windows)
+        if config.inject_fault and any(
+                s.startswith("rescale_relaunch")
+                for s in config.inject_fault):
+            # The rescale_relaunch site fires in THIS (supervisor)
+            # process; every other site only ever fires in the job
+            # children, which arm their own plans from the pass-through
+            # argv — so the supervisor arms only when a spec actually
+            # targets its side of the seam. Markers are unqualified
+            # (no .p<i>), disjoint from the workers' namespaced ones.
+            from .robustness import faults
+
+            faults.arm(config.inject_fault, config.fault_state_dir)
+            LOG.warning("fault injection armed in the gang supervisor: "
+                        "%s", config.inject_fault)
         LOG.info("gang supervising %d workers (up to %d restart(s); "
                  "heartbeats in %s)", config.gang_workers,
                  config.restart_on_failure, gang_dir)
@@ -82,7 +116,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             journal_path=config.journal,
             watchdog_stale_after_s=(config.watchdog_stale_after_s
                                     if config.watchdog_stale_after_s > 0
-                                    else None)).run()
+                                    else None),
+            scale_policy=scale_policy).run()
 
     if config.restart_on_failure > 0:
         # Supervisor mode (Flink restart-strategy analogue, SURVEY §5):
@@ -206,6 +241,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 help="restart backoff delay the supervisor applied "
                      "before this attempt").set(
                          supervisor_info.get("backoff_ms", 0))
+            if "rescales" in supervisor_info:
+                # Gang autoscale accounting relayed by the supervisor:
+                # voluntary rescales performed so far (the /healthz
+                # autoscale block reads this beside the tap's gauges).
+                from .robustness.autoscale import RESCALES_GAUGE
+
+                REGISTRY.gauge(
+                    RESCALES_GAUGE,
+                    help="voluntary gang rescales the supervisor has "
+                         "performed this run").set(
+                             supervisor_info.get("rescales", 0))
         peers = None
         if gang_dir and config.num_processes:
             # /healthz peers table: heartbeat ages + committed epochs
@@ -246,24 +292,54 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .state import checkpoint as ckpt
 
         job.source = source
-        if config.coordinator is not None:
-            # Gang restore vote (robustness/gang.py): agree on the
-            # newest generation committed on EVERY host and quarantine
-            # anything newer as *.partial — a crash mid-epoch-commit
-            # falls back one generation everywhere instead of
-            # restoring a torn global state. Runs after job
-            # construction (the scorer's init joined the
-            # multi-controller runtime the vote's allgather needs).
-            from .robustness.gang import agree_restore_generation
+        if config.coordinator is not None and config.autoscale == "on":
+            # Topology-aware restore vote (the autoscale seam): the
+            # newest generation may have been committed by a DIFFERENT
+            # gang size — agree on the newest generation whose WHOLE
+            # writing topology committed, quarantine anything newer
+            # across every suffix, then restore either normally (same
+            # topology) or through the N->M merge + re-bucket path.
+            from .robustness.gang import agree_restore_topology
 
-            agreed = agree_restore_generation(
-                config.checkpoint_dir,
-                getattr(job.scorer, "process_suffix", ""))
-            LOG.info("gang restore vote: committed epoch %d", agreed)
-        if ckpt.exists(job, config.checkpoint_dir):
-            job.restore(source=source)
-            LOG.info("restored checkpoint from %s (windows_fired=%d)",
-                     config.checkpoint_dir, job.windows_fired)
+            try:
+                agreed, writers = agree_restore_topology(
+                    config.checkpoint_dir, config.process_id)
+            except ValueError as exc:
+                # Pre-autoscale markers (upgrade hazard): a permanent
+                # config-shaped failure — restarting cannot help.
+                LOG.error("autoscale restore vote refused: %s", exc)
+                return EX_CONFIG
+            LOG.info("gang restore vote: committed epoch %d (written "
+                     "by %d workers)", agreed, writers)
+            if agreed >= 0:
+                if writers == config.num_processes:
+                    job.restore(source=source)
+                else:
+                    job.restore_rescaled(agreed, writers, source=source)
+                LOG.info("restored checkpoint from %s "
+                         "(windows_fired=%d)", config.checkpoint_dir,
+                         job.windows_fired)
+        else:
+            if config.coordinator is not None:
+                # Gang restore vote (robustness/gang.py): agree on the
+                # newest generation committed on EVERY host and
+                # quarantine anything newer as *.partial — a crash
+                # mid-epoch-commit falls back one generation
+                # everywhere instead of restoring a torn global state.
+                # Runs after job construction (the scorer's init
+                # joined the multi-controller runtime the vote's
+                # allgather needs).
+                from .robustness.gang import agree_restore_generation
+
+                agreed = agree_restore_generation(
+                    config.checkpoint_dir,
+                    getattr(job.scorer, "process_suffix", ""))
+                LOG.info("gang restore vote: committed epoch %d", agreed)
+            if ckpt.exists(job, config.checkpoint_dir):
+                job.restore(source=source)
+                LOG.info("restored checkpoint from %s "
+                         "(windows_fired=%d)", config.checkpoint_dir,
+                         job.windows_fired)
     if config.emit_updates:
         from .state.results import TopKBatch
 
@@ -308,6 +384,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                  config.quarantine_file, config.max_quarantine_rate * 100)
 
     from .observability import xla_trace
+    from .robustness.autoscale import RESCALE_EXIT, RescaleDrain
     from .robustness.quarantine import QuarantineRateExceeded
     from .state.sparse_scorer import SlabCapacityError
 
@@ -326,6 +403,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # End-of-stream verdict (warm-up waived): a short input that
             # was mostly garbage must exit 2, not succeed on its crumbs.
             quarantine.check_final()
+    except RescaleDrain as exc:
+        # Voluntary rescale exit (robustness/autoscale.py): the drain
+        # checkpoint is committed gang-wide and the supervisor is
+        # waiting to relaunch this gang at the new size. Tear down
+        # cleanly (join workers, seal the journal — the AUTOSCALE
+        # record is already on disk) and take the dedicated exit code
+        # the supervisor never bills against the restart budget.
+        job.abort()
+        if heartbeat is not None:
+            heartbeat.stop()
+        LOG.info("rescale drain complete: %s; exiting %d for the gang "
+                 "supervisor to relaunch", exc, RESCALE_EXIT)
+        return RESCALE_EXIT
     except QuarantineRateExceeded as exc:
         # Exit 2 (permanent): a systematically malformed input does not
         # get better with supervised restarts — stop the run and point

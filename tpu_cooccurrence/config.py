@@ -279,6 +279,24 @@ class Config:
     # collectives cannot survive peer loss); --restart-on-failure is
     # the gang's restart budget. 0 = off
     gang_heartbeat_s: float = 5.0  # worker heartbeat write interval
+    autoscale: str = "off"  # load-driven gang autoscaler
+    # (robustness/autoscale.py, gang runs only): sustained SHED_*
+    # pressure grows the gang, sustained idle shrinks it — workers
+    # drain a checkpoint at a gang-voted window boundary and exit
+    # voluntarily, the supervisor relaunches at the new size, and the
+    # topology-aware restore vote re-buckets N-shard state onto M
+    # (scale before you shed; the degradation ladder only sheds once
+    # the gang is at --autoscale-max-workers). off = today's behavior
+    autoscale_min_workers: int = 2  # scale-down floor (a gang needs 2)
+    autoscale_max_workers: int = 0  # scale-up ceiling; REQUIRED (> 0)
+    # with --autoscale on — the operator owns the capacity budget
+    autoscale_trip_windows: int = 3  # consecutive gang-overloaded
+    # windows that trigger a scale-up (hysteresis mirrors the ladder)
+    autoscale_clear_windows: int = 8  # consecutive gang-idle windows
+    # that trigger a scale-down (asymmetric: grow fast, shrink slow)
+    autoscale_cooldown_windows: int = 8  # observed windows ignored
+    # after every rescale decision (restore + recompile warm-up must
+    # not read as a fresh signal)
     gang_stale_after_s: float = 60.0  # heartbeat age past which a peer
     # counts as dead: the gang supervisor restarts the gang, /healthz
     # 503s ("peer_stale") so a load balancer drains first; 0 = off
@@ -367,6 +385,66 @@ class Config:
             raise ValueError(
                 f"--gang-heartbeat-s must be positive, got "
                 f"{self.gang_heartbeat_s}")
+        if self.autoscale not in ("off", "on"):
+            raise ValueError(
+                f"--autoscale must be off|on, got {self.autoscale!r}")
+        if (self.autoscale_trip_windows < 1
+                or self.autoscale_clear_windows < 1):
+            raise ValueError(
+                "--autoscale-trip-windows and --autoscale-clear-windows "
+                "must be >= 1")
+        if self.autoscale_cooldown_windows < 0:
+            raise ValueError(
+                f"--autoscale-cooldown-windows must be >= 0, got "
+                f"{self.autoscale_cooldown_windows}")
+        if self.autoscale == "on":
+            if not self.gang_workers and self.coordinator is None:
+                raise ValueError(
+                    "--autoscale on is gang machinery — it needs "
+                    "--gang-workers (the supervisor owns relaunching at "
+                    "a new topology)")
+            if not self.degrade:
+                raise ValueError(
+                    "--autoscale on reads the degradation plane's "
+                    "per-window pressure signal — it needs --degrade")
+            if not self.checkpoint_dir:
+                raise ValueError(
+                    "--autoscale on drains a checkpoint at every "
+                    "rescale boundary — it needs --checkpoint-dir")
+            if self.backend not in (Backend.SPARSE, Backend.HYBRID):
+                raise ValueError(
+                    "--autoscale on needs --backend sparse (the N->M "
+                    "rescale restore re-buckets the sparse slab's "
+                    "global key space; the dense sharded matrix has no "
+                    "rescale-on-restore path)")
+            if self.partition_sampling:
+                raise ValueError(
+                    "--autoscale on cannot run with "
+                    "--partition-sampling: the per-process reservoir "
+                    "partition (u %% P) changes shape at a rescale and "
+                    "has no redistribution path")
+            if self.autoscale_min_workers < 2:
+                raise ValueError(
+                    f"--autoscale-min-workers must be >= 2 (a gang of "
+                    f"one is --restart-on-failure), got "
+                    f"{self.autoscale_min_workers}")
+            if self.autoscale_max_workers < self.autoscale_min_workers:
+                raise ValueError(
+                    "--autoscale on needs --autoscale-max-workers >= "
+                    f"--autoscale-min-workers (got "
+                    f"{self.autoscale_max_workers} < "
+                    f"{self.autoscale_min_workers}) — the operator "
+                    "owns the capacity ceiling")
+            launch = (self.gang_workers
+                      if self.gang_workers else (self.num_processes or 0))
+            if launch and not (self.autoscale_min_workers <= launch
+                               <= self.autoscale_max_workers):
+                raise ValueError(
+                    f"the launch topology ({launch} workers) must sit "
+                    f"inside [--autoscale-min-workers, "
+                    f"--autoscale-max-workers] = "
+                    f"[{self.autoscale_min_workers}, "
+                    f"{self.autoscale_max_workers}]")
         if self.gang_stale_after_s < 0:
             raise ValueError(
                 f"--gang-stale-after-s must be >= 0, got "
@@ -902,6 +980,40 @@ class Config:
                        dest="gang_heartbeat_s",
                        help="Worker heartbeat-file write interval "
                             "(default: 5)")
+        p.add_argument("--autoscale", choices=["off", "on"],
+                       default="off",
+                       help="Load-driven gang autoscaler: sustained "
+                            "pressure grows the gang, sustained idle "
+                            "shrinks it — workers drain a checkpoint "
+                            "at a gang-voted window boundary and the "
+                            "supervisor relaunches at the new size, "
+                            "re-bucketing N-shard state onto M; the "
+                            "degradation ladder only sheds once the "
+                            "gang is at --autoscale-max-workers "
+                            "(needs --gang-workers, --degrade and "
+                            "--checkpoint-dir; default: off)")
+        p.add_argument("--autoscale-min-workers", type=int, default=2,
+                       dest="autoscale_min_workers",
+                       help="Scale-down floor (default: 2 — the gang "
+                            "minimum)")
+        p.add_argument("--autoscale-max-workers", type=int, default=0,
+                       dest="autoscale_max_workers",
+                       help="Scale-up ceiling; required with "
+                            "--autoscale on (the operator owns the "
+                            "capacity budget)")
+        p.add_argument("--autoscale-trip-windows", type=int, default=3,
+                       dest="autoscale_trip_windows",
+                       help="Consecutive gang-overloaded windows that "
+                            "trigger a scale-up (default: 3)")
+        p.add_argument("--autoscale-clear-windows", type=int, default=8,
+                       dest="autoscale_clear_windows",
+                       help="Consecutive gang-idle windows that "
+                            "trigger a scale-down (asymmetric on "
+                            "purpose; default: 8)")
+        p.add_argument("--autoscale-cooldown-windows", type=int,
+                       default=8, dest="autoscale_cooldown_windows",
+                       help="Windows ignored by the scale policy after "
+                            "every rescale decision (default: 8)")
         p.add_argument("--gang-stale-after-s", type=float, default=60.0,
                        dest="gang_stale_after_s",
                        help="Heartbeat age past which a gang peer counts "

@@ -139,6 +139,39 @@ class CooccurrenceJob:
             from .parallel.distributed import allgather_max
 
             self.degrade.exchange = allgather_max
+        # Load-driven autoscaling (--autoscale on, robustness/
+        # autoscale.py): the tap votes one packed idle/drain int per
+        # window, writes the gang-dir pressure beacon the supervisor's
+        # scale policy reads, and flips the drain flag once the whole
+        # gang has seen a RESCALE request. Armed only inside a gang
+        # worker (gang dir env + multi-controller identity).
+        self.autoscale = None
+        if config.autoscale == "on" and config.coordinator is not None:
+            import os as _os
+
+            from .robustness.autoscale import AutoscaleTap
+            from .robustness.gang import GANG_DIR_ENV
+
+            gang_dir = _os.environ.get(GANG_DIR_ENV)
+            if gang_dir:
+                self.autoscale = AutoscaleTap(
+                    gang_dir, config.process_id, config.num_processes,
+                    idle_wall_s=config.degrade_window_wall_s / 4.0)
+                if (self.degrade is not None
+                        and config.num_processes
+                        < config.autoscale_max_workers):
+                    # Scale-before-shed precedence: with capacity
+                    # headroom the ladder may not leave NORMAL —
+                    # sustained pressure is a rescale trigger first.
+                    # At max capacity the flag stays False and the
+                    # ladder sheds exactly as before. Static per
+                    # attempt and identical on every host, so the
+                    # multi-host transition lockstep is preserved.
+                    # Guarded by the tap arming: a worker launched
+                    # outside gang supervision (no gang dir) has no
+                    # autoscaler to relieve the pressure, so holding
+                    # its ladder would strip ALL shed protection.
+                    self.degrade.hold_escalation = True
         if (getattr(self.scorer, "wants_baskets", False)
                 and isinstance(self.sampler, UserReservoirSampler)):
             # Fused-window uplink (--fused-window, ops/device_scorer):
@@ -531,8 +564,6 @@ class CooccurrenceJob:
     def _drain(self, final: bool) -> None:
         for ts, users, items in self.engine.fire_ready(final=final):
             self.windows_fired += 1
-            if faults.PLAN is not None:
-                faults.PLAN.fire("window_fire", seq=self.windows_fired)
             if self._ckpt_dirty is not None:
                 # Incremental-checkpoint user feed: the reservoir only
                 # mutates for this window's users, so they are exactly
@@ -562,6 +593,13 @@ class CooccurrenceJob:
                         setk(self.degrade.effective_top_k(
                             self.config.top_k))
             with clock() as sample_clock:
+                # Inside the sample clock on purpose: a delay_ms
+                # injected here bills the window's wall time, so chaos
+                # tests can manufacture exactly the overloaded windows
+                # the degradation/autoscale planes key on. (Crash kinds
+                # are indifferent to the clock.)
+                if faults.PLAN is not None:
+                    faults.PLAN.fire("window_fire", seq=self.windows_fired)
                 if self.sliding:
                     pairs = self.sampler.fire(users, items)
                 else:
@@ -607,12 +645,34 @@ class CooccurrenceJob:
                     score_seconds=score_clock.seconds),
                     seq=self.windows_fired)
                 self._absorb(window_out)
-            if (self.config.checkpoint_dir
-                    and self.config.checkpoint_every_windows > 0
-                    and self.windows_fired % self.config.checkpoint_every_windows == 0):
+            checkpointed = (
+                self.config.checkpoint_dir
+                and self.config.checkpoint_every_windows > 0
+                and self.windows_fired
+                % self.config.checkpoint_every_windows == 0)
+            if checkpointed:
                 # checkpoint() barriers the pipeline first, so the
                 # snapshot point is identical to the serial path's.
                 self.checkpoint(source=self.source)
+            if self.autoscale is not None and self.autoscale.drain:
+                # Rescale drain boundary (gang-voted this window, so
+                # every worker drains HERE): commit a checkpoint under
+                # the epoch protocol — unless the periodic save above
+                # already committed this exact boundary — journal the
+                # AUTOSCALE record, and take the voluntary exit. The
+                # rescale_drain site sits between commit and exit: a
+                # crash there dies inside the seam, after the state is
+                # durable and before the supervisor relaunches.
+                from .robustness.autoscale import RescaleDrain
+
+                if not checkpointed:
+                    self.checkpoint(source=self.source)
+                req = self.autoscale.drain
+                self._journal_autoscale(req, self.windows_fired)
+                if faults.PLAN is not None:
+                    faults.PLAN.fire("rescale_drain",
+                                     seq=self.windows_fired)
+                raise RescaleDrain(req, self.windows_fired)
         if final:
             if self.pipeline is not None:
                 self.pipeline.barrier()
@@ -671,6 +731,15 @@ class CooccurrenceJob:
                 ring_capacity=(self.pipeline.depth
                                if self.pipeline is not None else 0),
                 stall_seconds=stall_seconds)
+        if self.autoscale is not None:
+            # Autoscale vote + pressure beacon (one guarded allgather;
+            # every process, every window, in the same order — right
+            # after the controller's own vote). The pressure input is
+            # the controller's post-exchange gang-max bit.
+            self.autoscale.observe(
+                seq, stats.seconds,
+                self.degrade.last_overloaded
+                if self.degrade is not None else False)
         if self.journal is not None:
             from .observability.journal import VERSION
 
@@ -721,6 +790,26 @@ class CooccurrenceJob:
 
         self.journal.record({"v": VERSION, "event": event,
                              "wall_unix": round(time.time(), 3)})
+
+    def _journal_autoscale(self, request: dict, window: int) -> None:
+        """Append the AUTOSCALE drain record (journal.AUTOSCALE_SCHEMA)
+        before the voluntary rescale exit: decision, from/to workers,
+        trigger signal and the policy cooldown armed by the decision —
+        the flight-recorder proof of every scale-before-shed event."""
+        if self.journal is None:
+            return
+        from .observability.journal import VERSION
+
+        self.journal.record({
+            "v": VERSION,
+            "autoscale": str(request.get("decision", "grow")),
+            "from": int(request.get("from", 0)),
+            "to": int(request.get("to", 0)),
+            "trigger": str(request.get("trigger", "pressure")),
+            "window": int(window),
+            "cooldown": int(request.get("cooldown", 0)),
+            "wall_unix": round(time.time(), 3),
+        })
 
     def _flush_scorer(self) -> WindowTopK:
         flush = getattr(self.scorer, "flush", None)
@@ -786,6 +875,23 @@ class CooccurrenceJob:
                 "chain_len": int(c["chain_len"]),
                 "wall_unix": round(time.time(), 3),
             })
+
+    def restore_rescaled(self, gen: int, writers: int,
+                         source=None) -> None:
+        """Cross-topology gang restore (the autoscale rescale seam):
+        land the generation the topology-aware restore vote agreed on,
+        written by a ``writers``-process gang, in THIS differently-
+        sized gang (state/checkpoint.restore_rescaled merges the old
+        per-process blobs and re-buckets onto this run's shards)."""
+        from .state import checkpoint as ckpt
+
+        ckpt.restore_rescaled(self, self.config.checkpoint_dir, gen,
+                              writers, source=source)
+        # Same post-restore bookkeeping as restore() below.
+        if self.serving is not None:
+            self.serving.seed(self.latest.snapshot())
+        self._prev_counters = self.counters.as_dict()
+        self._prev_wire = LEDGER.snapshot()
 
     def restore(self, source=None) -> None:
         from .state import checkpoint as ckpt

@@ -224,6 +224,22 @@ class MetricsServer:
                     and snap_age > self.serve_stale_after_s
                     and status not in ("stale", "paused")):
                 status = payload["status"] = "snapshot_stale"
+        from ..robustness.autoscale import (LEVEL_GAUGE, RESCALES_GAUGE,
+                                            TARGET_WORKERS_GAUGE)
+
+        autoscale_workers = int(self.registry.gauge(
+            TARGET_WORKERS_GAUGE).get())
+        if autoscale_workers:
+            # Autoscale block (robustness/autoscale.py, gang workers):
+            # the topology this worker was launched at, the voluntary
+            # rescales the supervisor has performed, and the last
+            # gang-wide load signal the per-window vote produced.
+            payload["autoscale"] = {
+                "target_workers": autoscale_workers,
+                "rescales_total": int(self.registry.gauge(
+                    RESCALES_GAUGE).get()),
+                "level": int(self.registry.gauge(LEVEL_GAUGE).get()),
+            }
         if self.peers is not None:
             rows, any_stale = self.peers.snapshot()
             payload["peers"] = rows

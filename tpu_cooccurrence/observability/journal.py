@@ -122,6 +122,25 @@ CKPT_SCHEMA = {
 }
 
 
+#: Out-of-band autoscale record (distinguished by the ``"autoscale"``
+#: key = the decision, ``"grow"`` or ``"shrink"``): one per rescale
+#: drain, written by the job at the gang-voted drain boundary just
+#: before its voluntary exit (robustness/autoscale.py). The from/to
+#: topology, the trigger signal and the policy cooldown armed by the
+#: decision make the journal the flight-recorder proof that the gang
+#: scaled BEFORE the ladder shed.
+AUTOSCALE_SCHEMA = {
+    "v": (True, int),
+    "autoscale": (True, str),    # decision: "grow" | "shrink"
+    "from": (True, int),         # workers before the rescale
+    "to": (True, int),           # target workers after it
+    "trigger": (True, str),      # "pressure" | "idle"
+    "window": (True, int),       # fired-window ordinal of the drain
+    "cooldown": (True, int),     # policy cooldown windows armed
+    "wall_unix": (True, float),
+}
+
+
 #: Out-of-band replica record (distinguished by the ``"replica"`` key =
 #: the delta-log generation just replayed): one per applied delta
 #: generation, written by ``serving/replica.ReadReplica``. ``rows`` is
@@ -146,6 +165,30 @@ def validate_record(rec: dict) -> None:
     :data:`CKPT_SCHEMA`, :data:`REPLICA_SCHEMA`)."""
     if not isinstance(rec, dict):
         raise ValueError(f"journal record is not an object: {rec!r}")
+    if "autoscale" in rec:
+        for field, (required, typ) in AUTOSCALE_SCHEMA.items():
+            v = rec.get(field)
+            ok = (isinstance(v, (int, float)) if typ is float
+                  else isinstance(v, typ)) and not isinstance(v, bool)
+            if required and not ok:
+                raise ValueError(
+                    f"journal autoscale record field {field!r} bad: {rec}")
+        unknown = set(rec) - set(AUTOSCALE_SCHEMA)
+        if unknown:
+            raise ValueError(
+                f"journal autoscale record has unknown fields "
+                f"{unknown}: {rec}")
+        if rec["v"] != VERSION:
+            raise ValueError(f"journal version {rec['v']} != {VERSION}")
+        if rec["autoscale"] not in ("grow", "shrink"):
+            raise ValueError(
+                f"journal autoscale decision {rec['autoscale']!r} "
+                f"must be grow|shrink")
+        if rec["trigger"] not in ("pressure", "idle"):
+            raise ValueError(
+                f"journal autoscale trigger {rec['trigger']!r} "
+                f"must be pressure|idle")
+        return
     if "replica" in rec:
         for field, (required, typ) in REPLICA_SCHEMA.items():
             v = rec.get(field)

@@ -97,6 +97,12 @@ CANONICAL_METRICS = frozenset({
     "cooc_epoch_committed",
     "cooc_checkpoint_partial_total",
     "cooc_gang_stale_peers",
+    # load-driven gang autoscaler (robustness/autoscale.py): the
+    # topology in force, voluntary rescales performed, and the last
+    # gang-wide load signal (-1 idle / 0 neutral / 1 pressure)
+    "cooc_gang_target_workers",
+    "cooc_gang_rescales_total",
+    "cooc_autoscale_level",
     # sharded scorers (parallel/sharded.py)
     "cooc_scorer_dispatch_rows",
     "cooc_shard_row_imbalance",

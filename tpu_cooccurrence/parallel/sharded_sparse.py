@@ -791,6 +791,16 @@ class ShardedSparseScorer:
         """Checkpoint filename suffix: multi-host runs save per process."""
         return f".p{jax.process_index()}" if jax.process_count() > 1 else ""
 
+    @property
+    def local_shard_ids(self) -> "List[int]":
+        """Global shard ids this process's chips own — the multi-host
+        emission/ownership contract, derived from the mesh layout alone
+        (no device fetch; the cross-topology restore filters the merged
+        top-K table through this before any slab exists)."""
+        me = jax.process_index()
+        return sorted(d for d, dev in enumerate(
+            self.mesh.devices.reshape(-1)) if dev.process_index == me)
+
     def _global_key(self, d: int, local_key: np.ndarray) -> np.ndarray:
         local_rows = (local_key >> 32).astype(np.int64)
         return ((local_rows * self.n_shards + d) << 32) | (

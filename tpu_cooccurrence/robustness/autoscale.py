@@ -1,0 +1,351 @@
+"""Load-driven gang autoscaler: scale before you shed.
+
+The reference job's only answer to load is an *operator-performed*
+Flink savepoint-and-rescale (PAPER.md lineage §0); the PR-5 degradation
+ladder automated the opposite response — destroying work (tighter cuts,
+truncated top-K, paused ingest) under pressure. Every piece of elastic
+capacity already exists — ``ShardedRescaleStore`` restores an N-shard
+checkpoint onto M shards, the ``GangSupervisor`` relaunches whole gangs
+from epoch-committed generations, and incremental checkpoints made the
+commit at a rescale seam cheap — this module connects them: sustained
+SHED_* pressure *grows* the gang, sustained idle *shrinks* it, and the
+ladder only sheds once capacity is exhausted.
+
+The loop (``--autoscale on``; timeline in docs/ARCHITECTURE.md
+"Elastic capacity"):
+
+1. **Signal** — every fired window, each worker's :class:`AutoscaleTap`
+   exchanges one packed int over the watchdog-guarded allgather: its
+   local idle bit (window wall under a quarter of
+   ``--degrade-window-wall-s``) and its drain-readiness bit, alongside
+   the :class:`~.degrade.DegradationController`'s already-gang-maxed
+   overloaded bit. The gang-wide signal (pressure = any overloaded,
+   idle = all idle) plus the running consecutive-window counters land
+   in a per-worker ``pressure.p<i>`` beacon in the gang dir — the same
+   channel the heartbeat files ride.
+2. **Decision** — the supervisor polls the beacons and feeds a
+   :class:`ScalePolicy` (per-window signals in → target topology out).
+   The default :class:`LadderScalePolicy` mirrors the degradation
+   ladder's hysteresis: asymmetric consecutive-window counters
+   (``--autoscale-trip-windows`` overloaded grows, the larger
+   ``--autoscale-clear-windows`` idle shrinks), a cooldown after every
+   rescale, and hard ``--autoscale-min/max-workers`` bounds.
+3. **Drain** — a decision becomes a ``RESCALE`` request beacon in the
+   gang dir. Workers see it at a window boundary, vote it gang-wide
+   (all workers must have read it — the drain window is identical on
+   every host by construction), checkpoint under the epoch-commit
+   protocol, journal an AUTOSCALE record, and exit with
+   :data:`RESCALE_EXIT` — a *voluntary* code the supervisor never
+   counts against ``--restart-on-failure`` and never feeds the
+   crash-loop breaker.
+4. **Relaunch** — the supervisor respawns the gang at M workers; the
+   topology-aware restore vote (``gang.agree_restore_topology``) finds
+   the newest generation committed by the *writing* topology, merges
+   the N per-process blobs into the canonical global key space
+   (``state/store.merge_mh_cells``) and ``rebucket_cells`` lands it on
+   M shards — the run resumes bit-identically.
+
+Degradation precedence is explicit: while the gang is below
+``--autoscale-max-workers``, the controller's escalation is held
+(``hold_escalation``) so sustained pressure triggers a rescale attempt
+*before* the ladder may leave NORMAL; at max capacity (or with
+``--autoscale off``) the ladder behaves exactly as before.
+
+Chaos sites: ``rescale_drain`` fires in the worker between the drain
+commit and the voluntary exit; ``rescale_relaunch`` fires in the
+supervisor between the drain verdict and the relaunch — together they
+bracket the rescale seam the recovery tests crash inside.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Callable, List, Optional
+
+from ..observability.registry import REGISTRY
+
+LOG = logging.getLogger("tpu_cooccurrence.autoscale")
+
+#: Voluntary rescale exit code: the whole gang drained a checkpoint at
+#: a window boundary and is asking to be relaunched at a new topology.
+#: NOT a failure — the gang supervisor relaunches without consuming the
+#: ``--restart-on-failure`` budget and without tripping the crash-loop
+#: breaker. Distinct from the permanent codes (2, 78), the collective
+#: watchdog's 75 and the timeout's 124.
+RESCALE_EXIT = 86
+
+#: Rescale-request beacon filename inside the gang dir: the supervisor
+#: writes it (atomic rename), workers read it at window boundaries and
+#: drain once the whole gang has seen it.
+REQUEST_NAME = "RESCALE"
+
+#: Worker pressure-beacon filename pattern inside the gang dir.
+_BEACON_FMT = "pressure.p{pid}"
+
+#: Autoscale gauges (CANONICAL_METRICS): the topology in force, the
+#: rescales performed so far, and the last gang-wide load signal.
+TARGET_WORKERS_GAUGE = "cooc_gang_target_workers"
+RESCALES_GAUGE = "cooc_gang_rescales_total"
+LEVEL_GAUGE = "cooc_autoscale_level"
+
+
+class RescaleDrain(Exception):
+    """Raised by the job at the drain boundary: the drain checkpoint is
+    committed and this worker must exit :data:`RESCALE_EXIT`."""
+
+    def __init__(self, request: dict, window: int) -> None:
+        super().__init__(
+            f"gang rescale drain at window {window}: "
+            f"{request.get('from')} -> {request.get('to')} workers")
+        self.request = request
+        self.window = window
+
+
+def beacon_path(gang_dir: str, process_id: int) -> str:
+    return os.path.join(gang_dir, _BEACON_FMT.format(pid=process_id))
+
+
+def request_path(gang_dir: str) -> str:
+    return os.path.join(gang_dir, REQUEST_NAME)
+
+
+def read_json(path: str) -> Optional[dict]:
+    """Best-effort read of a beacon/request file; ``None`` when missing
+    or torn (the writer replaces atomically, so a parse failure is a
+    transient race, not corruption)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def write_json(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, sort_keys=True)
+    os.replace(tmp, path)
+
+
+# -- the policy interface ----------------------------------------------
+
+
+@dataclasses.dataclass
+class ScaleDecision:
+    """One policy verdict: rescale the gang to ``target`` workers."""
+
+    target: int
+    trigger: str       # "pressure" | "idle"
+    window: int        # fired-window ordinal the decision observed
+    cooldown: int      # policy cooldown windows armed by this decision
+
+    @property
+    def decision(self) -> str:
+        return "grow" if self.trigger == "pressure" else "shrink"
+
+
+class ScalePolicy:
+    """Per-window signals in → target topology out.
+
+    ``decide`` is fed once per *new* beacon window with the gang-wide
+    bits and the worker-computed consecutive-run counters; it returns a
+    :class:`ScaleDecision` or ``None``. ``rescaled`` notifies the
+    policy that a decision was applied (the gang relaunched at
+    ``workers``). Implementations must be registered: the cooclint
+    ``scale-policy-registry`` rule requires every subclass to carry a
+    ``tests/`` reference and a row in the ARCHITECTURE scale-policy
+    table.
+    """
+
+    def decide(self, window: int, overloaded: bool, idle: bool,
+               bad_run: int, idle_run: int,
+               workers: int) -> Optional[ScaleDecision]:
+        raise NotImplementedError
+
+    def rescaled(self, workers: int) -> None:
+        """A decision was applied; the gang now runs ``workers``."""
+
+
+class LadderScalePolicy(ScalePolicy):
+    """Default policy: the degradation ladder's hysteresis, pointed at
+    capacity instead of fidelity.
+
+    * ``trip_windows`` consecutive gang-overloaded windows grow the
+      gang by ``factor`` (clamped to ``max_workers``).
+    * ``clear_windows`` consecutive gang-idle windows shrink it by
+      ``factor`` (clamped to ``min_workers``) — asymmetric on purpose,
+      exactly like the ladder: grow fast, shrink slow, never flap.
+    * Every decision arms a ``cooldown_windows`` refractory period so
+      the post-rescale warm-up (restore, recompiles, catch-up windows)
+      can never read as a fresh signal — and the run counters
+      accumulated DURING the cooldown never count as evidence either:
+      a decision needs its full trip/clear run observed on
+      post-cooldown windows, so a warm-up that outlasts the cooldown
+      cannot cascade a second rescale on one fresh window.
+    """
+
+    def __init__(self, max_workers: int, min_workers: int = 2,
+                 trip_windows: int = 3, clear_windows: int = 8,
+                 cooldown_windows: int = 8, factor: int = 2) -> None:
+        if min_workers < 2:
+            raise ValueError(
+                f"min_workers must be >= 2 (a gang of one is "
+                f"--restart-on-failure), got {min_workers}")
+        if max_workers < min_workers:
+            raise ValueError(
+                f"max_workers ({max_workers}) must be >= min_workers "
+                f"({min_workers})")
+        if trip_windows < 1 or clear_windows < 1:
+            raise ValueError("trip/clear window counts must be >= 1")
+        if cooldown_windows < 0:
+            raise ValueError(
+                f"cooldown_windows must be >= 0, got {cooldown_windows}")
+        if factor < 2:
+            raise ValueError(f"factor must be >= 2, got {factor}")
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.trip_windows = trip_windows
+        self.clear_windows = clear_windows
+        self.cooldown_windows = cooldown_windows
+        self.factor = factor
+        self._last_window = -1
+        self._cooldown = 0
+        # Windows observed since the last cooldown expired: a run
+        # counter only counts as evidence up to this (see class doc).
+        self._fresh = 0
+
+    def decide(self, window: int, overloaded: bool, idle: bool,
+               bad_run: int, idle_run: int,
+               workers: int) -> Optional[ScaleDecision]:
+        if window <= self._last_window:
+            return None  # already observed (beacons are re-read per poll)
+        self._last_window = window
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            self._fresh = 0
+            return None
+        self._fresh += 1
+        # min(run, fresh): bad_run >= trip proves the last `trip`
+        # windows were consecutively overloaded; fresh >= trip proves
+        # they were all observed AFTER the cooldown — together, the
+        # evidence is entirely post-warm-up.
+        bad_run = min(bad_run, self._fresh)
+        idle_run = min(idle_run, self._fresh)
+        if bad_run >= self.trip_windows and workers < self.max_workers:
+            target = min(self.max_workers, workers * self.factor)
+            trigger = "pressure"
+        elif idle_run >= self.clear_windows and workers > self.min_workers:
+            target = max(self.min_workers, workers // self.factor)
+            trigger = "idle"
+        else:
+            return None
+        self._cooldown = self.cooldown_windows
+        return ScaleDecision(target=target, trigger=trigger,
+                             window=window,
+                             cooldown=self.cooldown_windows)
+
+    def rescaled(self, workers: int) -> None:
+        # The cooldown armed at decision time keeps ticking over the
+        # relaunched gang's windows; nothing else carries over (the
+        # worker-side run counters reset with the worker processes).
+        pass
+
+
+# -- the worker-side tap -----------------------------------------------
+
+
+class AutoscaleTap:
+    """Worker-side autoscale plumbing: one gang vote per fired window,
+    one pressure beacon write, and the drain trigger.
+
+    ``exchange`` (injectable for tests) allgathers one packed int per
+    process and returns the per-process values; default is the
+    watchdog-guarded ``parallel/distributed.guarded_allgather``. Bits:
+    1 = overloaded (already gang-maxed by the degradation controller's
+    own vote; OR-ing is idempotent), 2 = locally idle (AND-ed: the gang
+    is idle only when every worker is), 4 = rescale request seen
+    (AND-ed: the gang drains only at a window where *every* worker has
+    read the request — the drain boundary is therefore identical on
+    every host, which is what lets the epoch-commit barrier inside the
+    drain checkpoint line up).
+    """
+
+    def __init__(self, gang_dir: str, process_id: int,
+                 num_processes: int, idle_wall_s: float,
+                 exchange: Optional[Callable[[int], List[int]]] = None
+                 ) -> None:
+        if idle_wall_s <= 0:
+            raise ValueError(
+                f"idle_wall_s must be positive, got {idle_wall_s}")
+        self.gang_dir = gang_dir
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.idle_wall_s = idle_wall_s
+        self.exchange = exchange
+        self.bad_run = 0
+        self.idle_run = 0
+        #: The request dict once the gang voted to drain (job reads it
+        #: at the window boundary and raises :class:`RescaleDrain`).
+        self.drain: Optional[dict] = None
+        REGISTRY.gauge(
+            TARGET_WORKERS_GAUGE,
+            help="gang worker count this process was launched at "
+                 "(the autoscaler's topology in force)").set(num_processes)
+        self._gauge_level = REGISTRY.gauge(
+            LEVEL_GAUGE,
+            help="last gang-wide autoscale signal "
+                 "(-1=idle 0=neutral 1=pressure)")
+        self._gauge_level.set(0)
+
+    def _exchange(self, value: int) -> List[int]:
+        if self.exchange is not None:
+            return self.exchange(value)
+        import numpy as np
+
+        from ..parallel.distributed import guarded_allgather
+
+        return [int(v) for v in np.asarray(
+            guarded_allgather(np.asarray([value], dtype=np.int64))
+        ).reshape(-1)]
+
+    def observe(self, window: int, wall_seconds: float,
+                overloaded: bool) -> bool:
+        """Feed one fired window; returns True when the gang voted to
+        drain at this boundary (:attr:`drain` then holds the request)."""
+        idle_local = (not overloaded) and wall_seconds <= self.idle_wall_s
+        req = read_json(request_path(self.gang_dir))
+        ready = (req is not None
+                 and int(req.get("to", 0)) >= 2
+                 and int(req.get("to", 0)) != self.num_processes)
+        packed = (int(bool(overloaded))
+                  | (int(idle_local) << 1)
+                  | (int(ready) << 2))
+        votes = self._exchange(packed)
+        gang_over = any(v & 1 for v in votes)
+        gang_idle = all(v & 2 for v in votes) and not gang_over
+        gang_ready = bool(votes) and all(v & 4 for v in votes)
+        self.bad_run = self.bad_run + 1 if gang_over else 0
+        self.idle_run = self.idle_run + 1 if gang_idle else 0
+        self._gauge_level.set(1 if gang_over else (-1 if gang_idle else 0))
+        try:
+            write_json(beacon_path(self.gang_dir, self.process_id), {
+                "window": window,
+                "overloaded": int(gang_over),
+                "idle": int(gang_idle),
+                "bad_run": self.bad_run,
+                "idle_run": self.idle_run,
+                "wall_unix": round(time.time(), 3),
+            })
+        except OSError as exc:
+            # Pressure reporting must never kill the worker it reports
+            # on; a stale beacon just delays the supervisor's decision.
+            LOG.warning("pressure beacon write failed: %s", exc)
+        if gang_ready:
+            self.drain = req
+            return True
+        return False

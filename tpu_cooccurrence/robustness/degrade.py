@@ -165,6 +165,21 @@ class DegradationController:
         # wall-clock staleness escalation is disabled — it is
         # per-host-nondeterministic and would desynchronize the vote.
         self.exchange: Optional[Callable[[int], int]] = None
+        # Scale-before-shed precedence (robustness/autoscale.py): while
+        # True, the ladder may never ESCALATE — sustained pressure is
+        # the autoscaler's rescale trigger first, and only once the
+        # gang is at --autoscale-max-workers (the job then leaves this
+        # False) may the same signal start destroying work. Static per
+        # attempt (derived from config on every host identically), so
+        # the multi-host transition lockstep is preserved.
+        # De-escalation is never held: relieving pressure is always
+        # allowed.
+        self.hold_escalation = False
+        # The gang-wide overloaded bit of the last observed window
+        # (post-exchange on multi-host runs) — the autoscale tap's
+        # pressure input. Written under the leaf lock, read by the
+        # window-record thread right after observe_window returns.
+        self.last_overloaded = False
         self._transitions = 0
         # Staleness baseline before any window completes: controller
         # construction time — a scorer that wedges on its very FIRST
@@ -235,6 +250,7 @@ class DegradationController:
             # lockstep across hosts.
             overloaded = bool(self.exchange(int(overloaded)))
         with self._lock:
+            self.last_overloaded = bool(overloaded)
             if overloaded:
                 self._bad += 1
                 self._good = 0
@@ -242,7 +258,8 @@ class DegradationController:
                 self._good += 1
                 self._bad = 0
             if (self._bad >= self.trip_windows
-                    and self._level < DegradationLevel.PAUSE_INGEST):
+                    and self._level < DegradationLevel.PAUSE_INGEST
+                    and not self.hold_escalation):
                 self._transition(DegradationLevel(self._level + 1))
             elif (self._good >= self.clear_windows
                     and self._level > DegradationLevel.NORMAL):

@@ -99,6 +99,16 @@ SITES = {
                       "about to touch this process's liveness file "
                       "(seq = 1-based beat ordinal; delay_ms simulates "
                       "a silently wedged peer)",
+    "rescale_drain": "job.py — the autoscale drain checkpoint is "
+                     "committed and the worker is about to take its "
+                     "voluntary rescale exit (seq = fired-window "
+                     "ordinal of the drain boundary); a crash here "
+                     "dies INSIDE the rescale seam, after the commit "
+                     "and before the relaunch",
+    "rescale_relaunch": "robustness/gang.py — the gang supervisor saw "
+                        "the whole gang drain voluntarily and is about "
+                        "to relaunch it at the new topology (seq = "
+                        "1-based rescale ordinal)",
 }
 
 KINDS = ("crash", "exception", "delay_ms", "torn_write")

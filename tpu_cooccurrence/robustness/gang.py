@@ -71,7 +71,7 @@ import time
 from typing import List, Optional, Sequence
 
 from ..observability.registry import REGISTRY
-from . import faults
+from . import autoscale, faults
 
 LOG = logging.getLogger("tpu_cooccurrence.gang")
 
@@ -81,8 +81,11 @@ GANG_DIR_ENV = "TPU_COOC_GANG_DIR"
 
 #: The robustness plane's process-qualified fault sites (registered in
 #: ``faults.SITES``; the cooclint ``gang-fault-sites`` rule holds this
-#: tuple to the registry and to live fire() call sites).
-GANG_SITES = ("barrier_enter", "ckpt_commit", "peer_heartbeat")
+#: tuple to the registry and to live fire() call sites). The two
+#: ``rescale_*`` sites bracket the autoscaler's rescale seam
+#: (robustness/autoscale.py): drain-commit → voluntary exit → relaunch.
+GANG_SITES = ("barrier_enter", "ckpt_commit", "peer_heartbeat",
+              "rescale_drain", "rescale_relaunch")
 
 #: Stale-peer gauge refreshed by :meth:`PeerTable.snapshot` (the
 #: /healthz scrape): peers whose heartbeat age exceeded the threshold.
@@ -269,6 +272,104 @@ def agree_restore_generation(directory: str, suffix: str,
     return agreed
 
 
+def agree_restore_topology(directory: str, process_id: int,
+                           exchange=None, barrier=None
+                           ) -> "tuple[int, int]":
+    """Topology-aware restore vote (autoscale gangs): returns
+    ``(agreed_gen, writers)`` — the newest generation committed by its
+    WHOLE writing topology, which may differ from the topology voting
+    (the rescale seam's defining property). ``(-1, 0)`` = fresh start.
+
+    The per-host candidate list comes from epoch markers + directory
+    listings alone (``checkpoint.topology_committed_generations``);
+    the gang still exchanges the minimum — on the shared directory all
+    hosts compute the same value, and the collective doubles as the
+    rendezvous that keeps peers from racing the quarantine below.
+    Process 0 then quarantines every generation above the agreed one
+    across ALL suffixes (current and retired topologies alike), and a
+    barrier holds the peers until the renames are durable — no peer
+    may walk the directory while files are moving aside.
+
+    ``exchange``/``barrier`` are injectable for tests; defaults are the
+    watchdog-guarded collectives.
+    """
+    from ..state import checkpoint as ckpt
+
+    cands = ckpt.topology_committed_generations(directory)
+    local, writers = cands[0] if cands else (-1, 0)
+    if not cands:
+        # Upgrade hazards: voting -1 over a directory that actually
+        # holds COMMITTED state would quarantine all of it and
+        # silently restart from zero. Two shapes must refuse loudly:
+        # topology-less markers (pre-autoscale commits — guessing the
+        # topology from marker counts would qualify a torn legacy
+        # commit), and per-process generation files with NO markers at
+        # all (pre-epoch-commit legacy, which the fixed-topology vote
+        # restores with a warning). A dir with SOME new-format markers
+        # but no complete topology is a genuinely torn commit history
+        # and proceeds to the quarantine below.
+        if ckpt.has_legacy_epoch_markers(directory):
+            raise ValueError(
+                f"--autoscale on found pre-autoscale epoch markers in "
+                f"{directory}: run one checkpoint cycle at a fixed "
+                f"topology with the current version (its markers "
+                f"record the writing process count) before enabling "
+                f"the autoscaler")
+        if (not ckpt.has_epoch_markers(directory)
+                and ckpt.process_suffixes(directory)):
+            raise ValueError(
+                f"--autoscale on found per-process checkpoint files "
+                f"but no epoch markers in {directory} (pre-epoch-"
+                f"commit legacy, or a gang that never finished its "
+                f"first commit): restore once at a fixed topology — "
+                f"or clear the directory — before enabling the "
+                f"autoscaler")
+    if exchange is None:
+        from ..parallel.distributed import allgather_min
+
+        exchange = allgather_min
+    if barrier is None:
+        from ..parallel.distributed import gang_barrier
+
+        barrier = gang_barrier
+    agreed = int(exchange(local))
+    if agreed != local:
+        LOG.warning(
+            "topology restore vote: this host saw committed generation "
+            "%d but the gang agreed on %d — taking the minimum", local,
+            agreed)
+        writers = next((w for g, w in cands if g == agreed), 0)
+        if writers == 0 and agreed >= 0:
+            # The agreed generation was not in this host's candidate
+            # snapshot (stale directory view — e.g. NFS attribute-cache
+            # lag). Re-list once; if it is still invisible, fail THIS
+            # attempt loudly (a transient, restartable error) rather
+            # than limping into a zero-writer restore.
+            writers = next(
+                (w for g, w in
+                 ckpt.topology_committed_generations(directory)
+                 if g == agreed), 0)
+        if writers == 0 and agreed >= 0:
+            raise RuntimeError(
+                f"topology restore vote agreed on generation {agreed} "
+                f"but this host cannot see its committed markers "
+                f"(stale directory view?) — failing the attempt for "
+                f"the supervisor to retry")
+    if process_id == 0:
+        # One host sweeps: peers would race each other's renames on the
+        # shared directory, and the quarantine set is identical anyway.
+        for sfx in ckpt.process_suffixes(directory):
+            quarantined = ckpt.quarantine_uncommitted(directory, sfx,
+                                                      agreed)
+            if quarantined:
+                LOG.warning(
+                    "topology restore vote: quarantined generation(s) "
+                    "%s for suffix %r (agreed epoch %d)", quarantined,
+                    sfx, agreed)
+    barrier(f"rescale-vote/{agreed}")
+    return agreed, writers
+
+
 # -- the gang supervisor (parent side) ---------------------------------
 
 
@@ -352,7 +453,8 @@ class GangSupervisor:
                  stdout=None,
                  journal_path: Optional[str] = None,
                  watchdog_stale_after_s: Optional[float] = None,
-                 python: Optional[Sequence[str]] = None) -> None:
+                 python: Optional[Sequence[str]] = None,
+                 scale_policy=None) -> None:
         if num_workers < 2:
             raise ValueError(
                 f"a gang needs >= 2 workers, got {num_workers}")
@@ -376,6 +478,17 @@ class GangSupervisor:
         #: Command prefix for one worker (overridable in tests).
         self.python = list(python) if python is not None else [
             sys.executable, "-m", "tpu_cooccurrence.cli"]
+        # Load-driven autoscaling (robustness/autoscale.py, --autoscale
+        # on): the policy reads the workers' pressure beacons from the
+        # gang dir and decides target topologies; the supervisor turns a
+        # decision into a RESCALE request beacon, treats the workers'
+        # voluntary drain exits as "relaunch at the new size, free of
+        # charge", and keeps the pending target across a crash inside
+        # the seam (the topology-aware restore vote restores whatever
+        # topology last committed, at whatever size we relaunch).
+        self.scale_policy = scale_policy
+        self.rescales = 0
+        self._pending: Optional[dict] = None
         os.makedirs(gang_dir, exist_ok=True)
 
     # -- one attempt ---------------------------------------------------
@@ -384,11 +497,23 @@ class GangSupervisor:
                backoff_s: float) -> List[_Worker]:
         from ..supervisor import SUPERVISOR_STATE_ENV
 
-        # Clear the previous attempt's heartbeat files: a dead gang's
-        # recent mtimes must not vouch for the new gang's liveness.
-        for pid in range(self.num_workers):
+        # Clear the previous attempt's heartbeat and pressure files: a
+        # dead gang's recent mtimes must not vouch for the new gang's
+        # liveness, and a dead gang's load signals must not feed the
+        # scale policy. (Beacons beyond num_workers too: a decayed gang
+        # leaves the retired slots' files behind.)
+        for name in os.listdir(self.gang_dir):
+            if name.startswith(("heartbeat.p", "pressure.p")):
+                try:
+                    os.remove(os.path.join(self.gang_dir, name))
+                except OSError:
+                    pass
+        if self._pending is None:
+            # A stale RESCALE request (the gang dir persists under the
+            # checkpoint dir across supervisor runs) must not make a
+            # fresh gang drain on sight.
             try:
-                os.remove(heartbeat_path(self.gang_dir, pid))
+                os.remove(autoscale.request_path(self.gang_dir))
             except OSError:
                 pass
         coordinator = f"127.0.0.1:{_free_port()}"
@@ -400,6 +525,8 @@ class GangSupervisor:
             "backoff_ms": int(backoff_s * 1000) if restarts else 0,
             "last_restart_unix": round(time.time(), 3) if restarts else 0,
             "stepped_back": False,
+            "rescales": self.rescales,
+            "target_workers": self.num_workers,
         })
         workers = []
         now = time.monotonic()
@@ -455,22 +582,54 @@ class GangSupervisor:
 
     def _watch(self, workers: List[_Worker]) -> int:
         """Wait for a gang verdict: 0 = every worker exited cleanly;
-        nonzero = the first failure's exit code (the survivors are
-        gang-killed — their collectives can never complete without the
-        dead peer); 124 = overall timeout or stale heartbeat."""
+        :data:`autoscale.RESCALE_EXIT` = the whole gang drained
+        voluntarily for a rescale (never a failure); other nonzero =
+        the first failure's exit code (the survivors are gang-killed —
+        their collectives can never complete without the dead peer);
+        124 = overall timeout or stale heartbeat."""
         start = time.monotonic()
         while True:
             codes = [w.proc.poll() for w in workers]
-            failed = next((rc for rc in codes
-                           if rc is not None and rc != 0), None)
+            # A voluntary rescale exit is not a death: its peers are
+            # commits away from the same exit (the drain boundary was
+            # gang-voted), so keep waiting for them instead of
+            # gang-killing a checkpointing worker mid-commit.
+            failed = next(
+                (rc for rc in codes if rc is not None
+                 and rc not in (0, autoscale.RESCALE_EXIT)), None)
             if failed is not None:
                 LOG.error("gang worker died with rc=%d; gang-killing "
                           "the survivors (a lost peer invalidates every "
                           "surviving process's collectives)", failed)
                 self._kill_gang(workers)
                 return failed
-            if all(rc == 0 for rc in codes):
-                return 0
+            if all(rc is not None for rc in codes):
+                if all(rc == 0 for rc in codes):
+                    return 0
+                if all(rc == autoscale.RESCALE_EXIT for rc in codes):
+                    return autoscale.RESCALE_EXIT
+                # Mixed 0 / RESCALE_EXIT: the lockstep drain vote makes
+                # this unreachable short of a bug — treat it as one
+                # failed attempt (the restore vote re-synchronizes).
+                LOG.error("gang exited with mixed clean/rescale codes "
+                          "%s; counting a failed attempt", codes)
+                return autoscale.RESCALE_EXIT
+            if (self.scale_policy is not None
+                    and self._pending is None):
+                try:
+                    self._poll_autoscale()
+                except Exception:
+                    # A broken policy must abort the RUN, not linger:
+                    # the workers hold the degradation ladder at
+                    # NORMAL on the promise that rescaling exists —
+                    # continuing without it would leave sustained
+                    # overload with no relief of either kind.
+                    LOG.exception(
+                        "scale policy failed; aborting the gang (its "
+                        "workers hold the shed ladder on the promise "
+                        "of rescaling)")
+                    self._kill_gang(workers)
+                    raise
             if (self.timeout_s is not None
                     and time.monotonic() - start > self.timeout_s):
                 LOG.error("gang exceeded timeout_s=%.1f; gang-killing",
@@ -493,6 +652,66 @@ class GangSupervisor:
                 self._kill_gang(workers)
                 return 124
             time.sleep(_POLL_S)
+
+    def _poll_autoscale(self) -> None:
+        """Feed the freshest pressure beacon to the scale policy and
+        turn a decision into the RESCALE request beacon.
+
+        The beacons carry GANG-WIDE bits and consecutive-run counters
+        (the workers vote them per window, robustness/autoscale.py), so
+        one beacon — whichever reports the newest window — is a
+        complete, lossless signal; reading all of them just tolerates a
+        lagging writer."""
+        freshest = None
+        for pid in range(self.num_workers):
+            b = autoscale.read_json(
+                autoscale.beacon_path(self.gang_dir, pid))
+            if b is None or "window" not in b:
+                continue
+            if freshest is None or b["window"] > freshest["window"]:
+                freshest = b
+        if freshest is None:
+            return
+        decision = self.scale_policy.decide(
+            int(freshest["window"]),
+            bool(freshest.get("overloaded")),
+            bool(freshest.get("idle")),
+            int(freshest.get("bad_run", 0)),
+            int(freshest.get("idle_run", 0)),
+            self.num_workers)
+        if decision is None or decision.target == self.num_workers:
+            return
+        self._pending = {
+            "to": int(decision.target),
+            "from": self.num_workers,
+            "decision": decision.decision,
+            "trigger": decision.trigger,
+            "window": int(decision.window),
+            "cooldown": int(decision.cooldown),
+            "seq": self.rescales + 1,
+        }
+        autoscale.write_json(autoscale.request_path(self.gang_dir),
+                             self._pending)
+        LOG.warning(
+            "autoscale decision: %s %d -> %d workers (trigger=%s at "
+            "window %d); RESCALE request beacon written — workers drain "
+            "a checkpoint at the next gang-voted window boundary",
+            decision.decision, self.num_workers, decision.target,
+            decision.trigger, decision.window)
+
+    def _apply_rescale(self, target: int) -> None:
+        """Commit a pending topology change before the next spawn."""
+        try:
+            os.remove(autoscale.request_path(self.gang_dir))
+        except OSError:
+            pass
+        if self.num_workers != target:
+            LOG.info("gang topology: %d -> %d workers", self.num_workers,
+                     target)
+        self.num_workers = target
+        self._pending = None
+        if self.scale_policy is not None:
+            self.scale_policy.rescaled(target)
 
     def _stale_journal(self, workers: List[_Worker]) -> Optional[int]:
         """Process id of a worker whose journal stopped growing past
@@ -555,14 +774,50 @@ class GangSupervisor:
                 rc = self._watch(workers)
                 if rc == 0:
                     self._forward(workers)
-                    if restarts:
-                        LOG.info("gang completed after %d restart(s)",
-                                 restarts)
+                    if restarts or self.rescales:
+                        LOG.info("gang completed after %d restart(s) "
+                                 "and %d rescale(s)", restarts,
+                                 self.rescales)
                     return 0
+                voluntary = (rc == autoscale.RESCALE_EXIT
+                             and all(w.proc.returncode
+                                     == autoscale.RESCALE_EXIT
+                                     for w in workers))
             finally:
                 for w in workers:
                     w.spool.close()
+            if voluntary and self._pending is not None:
+                # The whole gang drained a committed checkpoint and took
+                # the voluntary exit: relaunch at the requested topology
+                # immediately — no restart budget, no crash-loop
+                # accounting, no backoff (nothing failed).
+                self.rescales += 1
+                target = int(self._pending["to"])
+                LOG.warning(
+                    "gang rescale %d: all %d workers drained "
+                    "voluntarily; relaunching at %d workers from the "
+                    "drain-committed epoch", self.rescales,
+                    self.num_workers, target)
+                self._apply_rescale(target)
+                if faults.PLAN is not None:
+                    faults.PLAN.fire("rescale_relaunch",
+                                     seq=self.rescales)
+                continue
+            if rc == autoscale.RESCALE_EXIT:
+                # Mixed clean/drain codes (or a drain with no pending
+                # request): a failed attempt — but 86 is the VOLUNTARY
+                # contract code and must never surface as a failure
+                # status, least of all as the supervisor's own exit.
+                rc = 1
             last_rc = rc
+            if self._pending is not None:
+                # A crash inside the rescale seam (between the drain
+                # decision and a clean relaunch): still honor the
+                # pending target — the topology-aware restore vote
+                # restores whatever topology last committed onto
+                # whatever size we relaunch, so the target is always
+                # safe — but the crash itself stays a billed restart.
+                self._apply_rescale(int(self._pending["to"]))
             if rc in PERMANENT_EXIT_CODES:
                 LOG.error("gang worker failed with rc=%d (usage/config "
                           "error — permanent); not restarting", rc)

@@ -769,8 +769,28 @@ def save(job, directory: str, source=None) -> str:
     # and its partition of the results); the scorer supplies the suffix.
     suffix = getattr(job.scorer, "process_suffix", "")
     gens = generations(directory, suffix)
-    gen = (gens[0][0] + 1) if gens else 1
+    # Generation numbering continues past a gang rescale: a worker slot
+    # that did not exist in the previous topology has no files under
+    # its own suffix, but its first save must still land ABOVE the
+    # restored generation — the epoch barrier's name is the generation
+    # number, so diverging per-suffix counters would wedge the gang.
+    # restore()/restore_rescaled() leave the floor on the job.
+    newest = gens[0][0] if gens else 0
+    newest = max(newest, int(getattr(job, "_ckpt_gen_floor", 0)))
+    gen = newest + 1
     prev = gens[0][0] if gens else None
+    if suffix:
+        # Rescale-tagged generation meta (robustness/autoscale.py): the
+        # topology that WROTE this generation, so forensics (and the
+        # meta.json sidecar) can tell which process layout a mixed
+        # directory's files belong to. The restore-side source of truth
+        # stays the epoch markers (the vote must not open npz files).
+        meta["gang_topology"] = {
+            "processes": int(job.config.num_processes or 1),
+            "shards": int(getattr(job.scorer, "n_shards", 1)),
+        }
+        if getattr(job, "_rescaled_from", None):
+            meta["rescaled_from"] = int(job._rescaled_from)
 
     # Incremental generation decision (--checkpoint-incremental): write
     # a row-delta file instead of the full slab when (a) the store's
@@ -970,7 +990,12 @@ def save(job, directory: str, source=None) -> str:
         gang_barrier(f"ckpt/{gen}")
         epoch_tmp = _epoch_path(directory, suffix, gen) + ".tmp"
         with open(epoch_tmp, "w") as f:
-            f.write(f"{gen}\n")
+            # "<gen> <processes>": the writing topology rides in the
+            # marker so the autoscaler's topology-aware restore vote
+            # (gang.agree_restore_topology) can tell how many markers a
+            # globally-committed generation needs — without opening any
+            # npz. Pre-autoscale readers only split the first token.
+            f.write(f"{gen} {int(job.config.num_processes or 1)}\n")
             f.flush()
             os.fsync(f.fileno())
         os.replace(epoch_tmp, _epoch_path(directory, suffix, gen))
@@ -1121,13 +1146,35 @@ def restore(job, directory: str, source=None) -> None:
         raise CheckpointCorrupt(
             f"no checkpoint generation in {directory} verifies "
             f"(walked all {len(gens)})")
+    _apply_restored(job, data, restored_gen, source=source)
+    if restored_gen != gens[0][0]:
+        LOG.warning("restored checkpoint generation %d (newest was %d; "
+                    "newer generations failed verification)",
+                    restored_gen, gens[0][0])
+
+
+def _apply_restored(job, data: "dict[str, np.ndarray]", restored_gen: int,
+                    source=None, own_rows_only: bool = False,
+                    anchor_dirty: bool = True) -> None:
+    """Land a fully-resolved checkpoint ``data`` dict (codec decoded,
+    delta chains replayed) in ``job``.
+
+    ``own_rows_only`` filters the restored ``latest`` table down to the
+    rows this process's shards own under the CURRENT topology — the
+    cross-topology (gang rescale) path, where the merged table holds
+    every writer's partition and the multi-host emission contract says
+    each process may only ever print its own. ``anchor_dirty=False``
+    leaves the incremental dirty log un-anchored so the next save
+    writes a full base (a delta against another topology's chain would
+    be key-aligned to the wrong shard layout).
+    """
     # Meta comes from inside the npz (the atomic commit point); the
     # meta.json sidecar is informational only and may lag by a crash.
     if "meta_json" not in data:
         raise ValueError(
-            f"incompatible checkpoint format in {directory}: no embedded "
-            "meta_json (written by a pre-atomic-commit version of this "
-            "framework) — re-checkpoint with the current version")
+            f"incompatible checkpoint format: no embedded meta_json "
+            "(written by a pre-atomic-commit version of this framework) "
+            "— re-checkpoint with the current version")
     meta = json.loads(bytes(data["meta_json"]).decode())
     # Decode the ckpt_codec-packed blobs back to the canonical arrays
     # before any consumer sees them (no-op for incremental generations:
@@ -1184,39 +1231,273 @@ def restore(job, directory: str, source=None) -> None:
 
     # The store keeps dense ids; the .npz holds external ids (the public
     # result shape), so map back through the already-restored vocab.
+    # Cross-topology restores filter by NEW ownership: the merged table
+    # holds every old writer's partition, and each process may only
+    # ever emit the rows its shards own.
+    owned = None
+    if own_rows_only:
+        local = getattr(job.scorer, "local_shard_ids", None)
+        if local is not None:
+            owned = (set(local), int(job.scorer.n_shards))
     job.latest.clear()
     items = data["latest_items"]
     offsets = data["latest_offsets"]
     to_dense = job.item_vocab.to_dense
     for pos, item in enumerate(items.tolist()):
+        dense = to_dense(item)
+        if owned is not None and dense % owned[1] not in owned[0]:
+            continue
         lo, hi = int(offsets[pos]), int(offsets[pos + 1])
         top = list(zip(
             (to_dense(j) for j in data["latest_others"][lo:hi].tolist()),
             data["latest_scores"][lo:hi].tolist()))
-        job.latest.set_row(to_dense(item), top)
+        job.latest.set_row(dense, top)
 
     if source is not None and "source" in meta:
         source.restore_state(meta["source"])
     # Anchor the incremental dirty log at the restored generation: the
     # in-memory state now equals that generation exactly, so rows
     # touched from here on are precisely "dirty since restored_gen" and
-    # the next save may extend its chain.
-    store = getattr(job.scorer, "store", None)
-    log = getattr(store, "ckpt_dirty", None) if store is not None else None
-    if log is not None:
-        log.commit(restored_gen)
-    tracker = getattr(job, "_ckpt_dirty", None)
-    if tracker is not None:
-        tracker.commit(restored_gen, len(job.item_vocab),
-                       len(job.user_vocab))
+    # the next save may extend its chain. Cross-topology restores skip
+    # the anchor on purpose — the first post-rescale save must write a
+    # FULL base (a delta would be key-aligned per the OLD shard layout).
+    if anchor_dirty:
+        store = getattr(job.scorer, "store", None)
+        log = (getattr(store, "ckpt_dirty", None)
+               if store is not None else None)
+        if log is not None:
+            log.commit(restored_gen)
+        tracker = getattr(job, "_ckpt_dirty", None)
+        if tracker is not None:
+            tracker.commit(restored_gen, len(job.item_vocab),
+                           len(job.user_vocab))
+    # Generation floor for save(): a rescaled-in worker slot has no
+    # files under its own suffix, but its first save must still number
+    # past the restored generation (the epoch barrier is named by it).
+    job._ckpt_gen_floor = int(restored_gen)
     REGISTRY.gauge(
         GENERATION_GAUGE,
         help="checkpoint generation last written or restored").set(
             restored_gen)
-    if restored_gen != gens[0][0]:
-        LOG.warning("restored checkpoint generation %d (newest was %d; "
-                    "newer generations failed verification)",
-                    restored_gen, gens[0][0])
+
+
+def restore_rescaled(job, directory: str, gen: int, writers: int,
+                     source=None) -> None:
+    """Cross-topology gang restore (the autoscaler's N→M seam): land
+    generation ``gen``, written by a ``writers``-process gang, in a job
+    running a DIFFERENT process count.
+
+    Every old per-process file is loaded and verified (incremental
+    chains resolved per suffix); the per-shard slab counts merge back
+    into the canonical GLOBAL key space (``state/store.merge_mh_cells``
+    — the key union is host-replicated, so any file supplies it) and
+    the scorer's ordinary global-blob restore re-buckets onto THIS
+    run's shard count, exactly like a single-process rescale. The
+    replicated job state (vocabularies, cuts, sampler, window buffers,
+    counters, source offset) comes from writer 0's file — ingest is
+    deterministic and replicated, so every writer held the identical
+    copy. The emitted-top-K table is merged across writers and then
+    filtered down to the rows THIS process owns under the new topology.
+
+    Corruption here raises :class:`CheckpointCorrupt` without walking
+    older generations: the caller (the topology-aware restore vote)
+    already agreed gang-wide on ``gen``, and silently restoring an
+    older epoch on one host only would be exactly the torn global
+    state the vote exists to prevent.
+    """
+    from .store import merge_mh_cells
+
+    datas = []
+    metas = []
+    for p in range(writers):
+        suffix = f".p{p}"
+        path = _gen_path(directory, suffix, gen)
+        data = _load_verified(path)
+        if "meta_json" not in data:
+            raise CheckpointCorrupt(
+                f"rescale restore: {path} has no embedded meta")
+        meta = json.loads(bytes(data["meta_json"]).decode())
+        # The rescale-tagged meta is the belt to the epoch markers'
+        # braces: the file itself records which process layout wrote
+        # it, so a marker/file mismatch cannot silently merge the
+        # wrong number of blobs.
+        topo = meta.get("gang_topology")
+        if topo is not None and int(topo.get("processes", 0)) != writers:
+            raise CheckpointCorrupt(
+                f"rescale restore: {path} records topology "
+                f"{topo.get('processes')} processes but the restore "
+                f"vote agreed on {writers} writers")
+        if meta.get("rescaled_from"):
+            LOG.info("rescale restore: generation %d was itself the "
+                     "first commit after a rescale from %d workers",
+                     gen, int(meta["rescaled_from"]))
+        if meta.get("ckpt_delta"):
+            blob, latest, aux = _resolve_chain(directory, suffix, gen,
+                                               meta, quarantine=False)
+            for k, v in blob.items():
+                data[f"scorer_{k}"] = v
+            for k, v in zip(_LATEST_KEYS, latest):
+                data[k] = v
+            data.update(aux)
+        else:
+            _decode_codec(data, meta)
+        datas.append(data)
+        metas.append(meta)
+    if not datas:
+        raise CheckpointCorrupt(
+            f"rescale restore: generation {gen} has no writer files")
+    # Merge the per-process slab blobs into one canonical global blob.
+    merged = merge_mh_cells([
+        {k[len("scorer_"):]: v for k, v in d.items()
+         if k.startswith("scorer_")} for d in datas])
+    base = dict(datas[0])
+    for k in list(base):
+        if k.startswith("scorer_"):
+            del base[k]
+    for k, v in merged.items():
+        base[f"scorer_{k}"] = v
+    # The per-file arrays are already codec-decoded and chain-resolved;
+    # rewrite the merged meta without the codec/delta records so the
+    # common applier does not decode (or chain-walk) a second time.
+    meta0 = dict(metas[0])
+    meta0.pop("ckpt_codec", None)
+    meta0.pop("ckpt_delta", None)
+    base["meta_json"] = np.frombuffer(
+        json.dumps(meta0).encode(), dtype=np.uint8)
+    # Merge the emitted top-K across writers (disjoint partitions),
+    # item-sorted so the rebuild below is deterministic.
+    rows = []
+    for d in datas:
+        items = d["latest_items"]
+        offsets = d["latest_offsets"]
+        for pos, item in enumerate(items.tolist()):
+            lo, hi = int(offsets[pos]), int(offsets[pos + 1])
+            rows.append((int(item), d["latest_others"][lo:hi],
+                         d["latest_scores"][lo:hi]))
+    rows.sort(key=lambda r: r[0])
+    base["latest_items"] = np.asarray([r[0] for r in rows],
+                                      dtype=np.int64)
+    base["latest_offsets"] = np.concatenate(
+        [[0], np.cumsum([len(r[1]) for r in rows])]).astype(np.int64)
+    base["latest_others"] = (np.concatenate([r[1] for r in rows])
+                             if rows else np.zeros(0, dtype=np.int64))
+    base["latest_scores"] = (np.concatenate([r[2] for r in rows])
+                             if rows else np.zeros(0, dtype=np.float64))
+    _apply_restored(job, base, gen, source=source, own_rows_only=True,
+                    anchor_dirty=False)
+    job._rescaled_from = int(writers)
+    LOG.info("rescale restore: generation %d (written by %d processes) "
+             "re-bucketed onto %d shards", gen, writers,
+             int(getattr(job.scorer, "n_shards", 1)))
+
+
+def topology_committed_generations(directory: str
+                                   ) -> "list[tuple[int, int]]":
+    """``(gen, writers)`` for every generation committed by its WHOLE
+    writing topology, newest first — the autoscaler's restore-vote
+    input, derived from epoch markers and directory listings alone.
+
+    A generation qualifies when its markers record a topology ``P``
+    (autoscale-era markers carry ``"<gen> <P>"``), markers exist for
+    every pid in ``range(P)``, and each suffix's delta chain at that
+    generation is fully present (``_chain_restorable``). Legacy markers
+    without a topology token never qualify — the fixed-topology vote
+    (:func:`~tpu_cooccurrence.robustness.gang.agree_restore_generation`)
+    owns those directories.
+    """
+    pat = re.compile(r"^EPOCH\.p(\d+)\.(\d+)$")
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    by_gen: "dict[int, dict[int, int | None]]" = {}
+    for m in filter(None, map(pat.match, names)):
+        pid, gen = int(m.group(1)), int(m.group(2))
+        declared: "int | None" = None
+        try:
+            with open(os.path.join(directory, m.group(0))) as f:
+                parts = f.read().split()
+            if len(parts) >= 2:
+                declared = int(parts[1])
+        except (OSError, ValueError):
+            declared = None
+        by_gen.setdefault(gen, {})[pid] = declared
+    out = []
+    for gen in sorted(by_gen, reverse=True):
+        markers = by_gen[gen]
+        topo = {p for p in markers.values() if p is not None}
+        if len(topo) != 1:
+            continue  # legacy or self-disagreeing markers
+        writers = topo.pop()
+        if set(markers) != set(range(writers)):
+            continue  # torn global commit: some writer never marked
+        if all(_chain_restorable(directory, f".p{i}", gen)
+               for i in range(writers)):
+            out.append((gen, writers))
+    return out
+
+
+def has_epoch_markers(directory: str) -> bool:
+    """True when the directory holds ANY per-process epoch marker —
+    the topology-aware vote's tell between "a gang with commit history
+    (some of it possibly torn)" and "per-process files with no epoch
+    plane at all" (pre-epoch legacy, which must not be quarantined)."""
+    pat = re.compile(r"^EPOCH\.p\d+\.\d+$")
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return False
+    return any(map(pat.match, names))
+
+
+def has_legacy_epoch_markers(directory: str) -> bool:
+    """True when the directory holds epoch markers WITHOUT a recorded
+    topology (written before the autoscaler existed). The topology-
+    aware restore vote refuses to run over them: guessing the writing
+    process count from the marker COUNT would qualify a torn legacy
+    commit as a smaller gang's complete one."""
+    pat = re.compile(r"^EPOCH\.p\d+\.\d+$")
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return False
+    for name in filter(pat.match, names):
+        try:
+            with open(os.path.join(directory, name)) as f:
+                if len(f.read().split()) < 2:
+                    return True
+        except OSError:
+            continue
+    return False
+
+
+def _chain_restorable(directory: str, suffix: str, gen: int) -> bool:
+    """``gen`` is restorable for ``suffix`` from directory listings
+    alone: its npz exists and, when incremental, every delta down to a
+    present full base exists too (mirrors ``newest_committed``'s chain
+    walk, pinned at one generation)."""
+    present = {g for g, _p in generations(directory, suffix)}
+    if gen not in present:
+        return False
+    dset = set(deltalog.delta_generations(directory, suffix))
+    cur = gen
+    while cur in dset and (cur - 1) in present:
+        cur -= 1
+    return cur not in dset
+
+
+def process_suffixes(directory: str) -> "list[str]":
+    """Every per-process checkpoint suffix with files in ``directory``
+    (``.p0``, ``.p1``, …) — the quarantine sweep of the topology-aware
+    restore vote walks all of them, current and retired topologies
+    alike."""
+    pat = re.compile(r"^(?:state|delta)(\.p(\d+))\.\d+\.(?:npz|bin)$")
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted({m.group(1) for m in filter(None, map(pat.match, names))},
+                  key=lambda s: int(s[2:]))
 
 
 def load_serving_state(directory: str, suffix: str = "") -> dict:

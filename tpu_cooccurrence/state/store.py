@@ -638,6 +638,64 @@ def rebucket_cells(keys: np.ndarray, vals: Optional[np.ndarray],
     return out
 
 
+def merge_mh_cells(blobs: "list[dict]") -> dict:
+    """Merge the per-process multi-host slab blobs of ONE generation
+    back into the canonical GLOBAL key-space blob — the gang rescale's
+    N→M bridge (``checkpoint.restore_rescaled``).
+
+    Every per-process file carries the identical host-replicated key
+    union (``mh_rows_key``, sorted global keys) and the counts of the
+    shards its chips owned (``mh_local_cnt``, laid out per shard in
+    ascending ``mh_local_shards`` order, within a shard in sorted
+    local-key order — which is the same relative order as the sorted
+    global union restricted to that shard, because the global key
+    ``(local_row * D + d) << 32 | dst`` is monotone in the local key
+    within a residue class). So each file's count segments scatter
+    straight into the union by ownership mask. Zero-count cells are
+    KEPT, exactly like the same-topology mh restore keeps them: a
+    zeroed cell still owns its slot, and dropping it would shift the
+    slot-ordered top-K tie-breaks of every later re-insertion — the
+    cross-topology restore must canonicalize to the same within-row
+    layout a fixed-topology recovery at the same boundary would. The
+    result restores through the ordinary ``rebucket_cells`` path onto
+    ANY shard count.
+    """
+    if not blobs:
+        raise ValueError("merge_mh_cells needs at least one blob")
+    keys = np.asarray(blobs[0]["mh_rows_key"], dtype=np.int64)
+    shard_ids = sorted({int(s) for b in blobs
+                        for s in np.asarray(b["mh_local_shards"]).tolist()})
+    d_old = (shard_ids[-1] + 1) if shard_ids else 1
+    if shard_ids != list(range(d_old)):
+        raise ValueError(
+            f"multi-host blobs cover shards {shard_ids}, expected the "
+            f"full range 0..{d_old - 1} — a writer's file is missing")
+    owner = ((keys >> 32) % d_old).astype(np.int64)
+    cnt = np.zeros(len(keys), dtype=np.int64)
+    for b in blobs:
+        if len(np.asarray(b["mh_rows_key"])) != len(keys):
+            raise ValueError(
+                "multi-host blobs disagree on the replicated key union "
+                "— files from different generations?")
+        local_cnt = np.asarray(b["mh_local_cnt"], dtype=np.int64)
+        lo = 0
+        for d in np.asarray(b["mh_local_shards"]).tolist():
+            sel = owner == int(d)
+            n = int(sel.sum())
+            cnt[sel] = local_cnt[lo: lo + n]
+            lo += n
+        if lo != len(local_cnt):
+            raise ValueError(
+                "multi-host blob count segments do not cover its "
+                "declared shards")
+    return {
+        "rows_key": keys.copy(),
+        "rows_cnt": cnt,
+        "row_sums": np.asarray(blobs[0]["row_sums"], dtype=np.int64),
+        "observed": np.asarray(blobs[0]["observed"], dtype=np.int64),
+    }
+
+
 class ShardedRescaleStore(StateStore):
     """Rescale-on-restore for the sharded-sparse backend.
 
