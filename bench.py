@@ -747,6 +747,138 @@ def _rescale_arm() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _fused_gang_arm() -> dict:
+    """Fused-vs-chained gang A/B (ISSUE 16): one launch per worker.
+
+    Three real 2-worker CPU gangs (multi-controller sharded sparse —
+    the production topology, pinned to ``JAX_PLATFORMS=cpu`` like the
+    other subprocess arms) ingest the same steady-keyed stream (fixed
+    event population repeated per window, so the pair population
+    stabilizes after window 1 and the fused path owns the steady
+    state):
+
+    * ``--fused-window off`` — the chained two-launch baseline;
+    * ``--fused-window on`` — the one-launch fused window; per-worker
+      dispatch splits and bucket compiles from each worker's journal;
+    * ``--fused-window on`` + the ISSUE-15 load-forced 2→4 rescale —
+      the **seam-recompile cost**: the first post-seam window must
+      route chained (cold plans), and the fresh topology's bucket
+      recompile count and seam stall ride the arm.
+    """
+    import tempfile
+
+    import numpy as np
+
+    windows = int(os.environ.get("BENCH_FUSED_GANG_WINDOWS", 14))
+    events_per = int(os.environ.get("BENCH_FUSED_GANG_EVENTS_PER", 500))
+    rng = np.random.default_rng(16)
+    base_u = rng.integers(0, 8, events_per)
+    base_i = rng.integers(0, 64, events_per)
+    work = tempfile.mkdtemp(prefix="bench-fused-gang-")
+    try:
+        csv = os.path.join(work, "in.csv")
+        with open(csv, "w") as fh:
+            for w in range(windows):
+                for uu, ii in zip(base_u.tolist(), base_i.tolist()):
+                    fh.write(f"{uu},{ii},{w * 100 + 50}\n")
+            fh.write(f"0,9999,{windows * 100 + 50}\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=1")
+
+        def gang_run(tag, fused, seam):
+            jpath = os.path.join(work, f"journal-{tag}.jsonl")
+            argv = [sys.executable, "-m", "tpu_cooccurrence.cli",
+                    "-i", csv, "-ws", "100", "-s", "0xC0FFEE",
+                    "--backend", "sparse", "--num-shards", "2",
+                    "--gang-workers", "2", "--gang-heartbeat-s", "1",
+                    "--collective-timeout-s", "60",
+                    "--restart-delay-ms", "0",
+                    "--fused-window", fused, "--journal", jpath]
+            if seam:
+                argv += ["--checkpoint-dir", os.path.join(work, "ck"),
+                         "--checkpoint-every-windows", "1",
+                         "--checkpoint-retain", "100",
+                         "--degrade", "--degrade-window-wall-s", "2.0",
+                         "--degrade-trip-windows", "3",
+                         "--autoscale", "on",
+                         "--autoscale-min-workers", "2",
+                         "--autoscale-max-workers", "4",
+                         "--autoscale-trip-windows", "2",
+                         "--autoscale-clear-windows", "100000",
+                         "--autoscale-cooldown-windows", "2",
+                         "--inject-fault", "window_fire@0:3:delay_ms:2500",
+                         "--inject-fault", "window_fire@0:4:delay_ms:2500",
+                         "--inject-fault", "window_fire@0:5:delay_ms:2500",
+                         "--fault-state-dir", os.path.join(work, "faults")]
+            proc = subprocess.run(argv, env=env, cwd=REPO,
+                                  capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"fused-gang arm ({tag}) exited "
+                    f"rc={proc.returncode}: {proc.stderr[-500:]}")
+            out = {}
+            for p in ("p0", "p1"):
+                with open(f"{jpath}.{p}") as f:
+                    out[p] = [json.loads(line) for line in f
+                              if line.strip()]
+            return out
+
+        def _rate(recs):
+            wrecs = sorted((r for r in recs if "seq" in r),
+                           key=lambda r: r["seq"])
+            span = wrecs[-1]["wall_unix"] - wrecs[0]["wall_unix"]
+            return (sum(r["pairs"] for r in wrecs) / max(span, 1e-9),
+                    wrecs)
+
+        def _split(wrecs):
+            flags = [r.get("fused", 0) for r in wrecs]
+            return {"fused": int(sum(flags)),
+                    "chained": int(len(flags) - sum(flags)),
+                    "bucket_compiles": int(
+                        wrecs[-1].get("fused_compiles", 0))}
+
+        chained = gang_run("chained", "off", seam=False)
+        fused = gang_run("fused", "on", seam=False)
+        c_rate, _ = _rate(chained["p0"])
+        f_rate, _ = _rate(fused["p0"])
+        per_worker = {p: _split(_rate(fused[p])[1]) for p in fused}
+        if not any(s["fused"] for s in per_worker.values()):
+            raise RuntimeError(
+                "fused-gang arm: no worker ever took the fused path")
+
+        seam = gang_run("seam", "on", seam=True)
+        recs0 = seam["p0"]
+        scale = [r for r in recs0 if "autoscale" in r]
+        if not scale:
+            raise RuntimeError("fused-gang seam run never rescaled")
+        drain = scale[0]
+        _, wrecs = _rate(recs0)
+        post = [r for r in wrecs if r["seq"] > drain["window"]]
+        return {
+            "ok": True,
+            "windows": windows,
+            "pairs_per_sec_chained": round(c_rate, 1),
+            "pairs_per_sec_fused": round(f_rate, 1),
+            "vs_chained": round(f_rate / max(c_rate, 1e-9), 3),
+            "per_worker_dispatches": per_worker,
+            "seam": {
+                "from_to": [int(drain["from"]), int(drain["to"])],
+                "stall_seconds": round(
+                    post[0]["wall_unix"] - drain["wall_unix"], 3),
+                # Cold plans: the window after the seam must not fuse.
+                "first_post_seam_fused": int(post[0].get("fused", 0)),
+                # What the fresh topology paid to re-specialize.
+                "recompiles_post_seam": int(
+                    post[-1].get("fused_compiles", 0)),
+            },
+        }
+    finally:
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _checkpoint_arm(sp_u, sp_i, sp_t, window_ms: int = 100) -> dict:
     """Full-vs-incremental checkpoint A/B on the churn stream (PR 12).
 
@@ -955,7 +1087,8 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
                    fused_sparse: dict = None,
                    checkpoint: dict = None,
                    fleet: dict = None,
-                   rescale: dict = None) -> None:
+                   rescale: dict = None,
+                   fused_gang: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -1021,6 +1154,13 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         # visible, or a "free" rescale that quietly stalls a minute
         # would never be caught.
         entry["rescale"] = rescale
+    if fused_gang:
+        # The ISSUE-16 fused-SHARDED A/B: one launch per worker vs the
+        # chained two-launch gang on the steady-keyed stream (pairs/s
+        # ratio, per-worker dispatch splits, bucket compiles, and the
+        # 2→4 seam's recompile cost) — trajectory-visible like the
+        # single-process fused arms.
+        entry["fused_gang"] = fused_gang
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -1330,6 +1470,15 @@ def measure() -> None:
         rescale_info = {"ok": False,
                         "error": f"{type(exc).__name__}: {exc}"}
 
+    # Fused-gang arm (ISSUE 16): chained-vs-fused A/B at
+    # --gang-workers 2 — one launch per worker, per-worker dispatch
+    # splits, bucket compiles, and the 2→4 seam-recompile cost.
+    try:
+        fused_gang_info = _fused_gang_arm()
+    except Exception as exc:
+        fused_gang_info = {"ok": False,
+                           "error": f"{type(exc).__name__}: {exc}"}
+
     # Baseline: the exact host (oracle) backend on the same stream, cached
     # in .bench_baseline.json on first run.
     baseline_path = os.path.join(REPO, ".bench_baseline.json")
@@ -1364,6 +1513,7 @@ def measure() -> None:
         "serving": serving_storm,
         "fleet": fleet_storm,
         "rescale": rescale_info,
+        "fused_gang": fused_gang_info,
     }
     if journal:
         out["journal"] = journal
@@ -1386,7 +1536,7 @@ def measure() -> None:
                        pipeline_depth, occupancy, latency, degradation,
                        fused_info, compression, serving_storm, spill_info,
                        fused_sparse, ckpt_info, fleet_storm,
-                       rescale_info)
+                       rescale_info, fused_gang_info)
     print(json.dumps(out))
 
 

@@ -203,6 +203,7 @@ def test_autoscale_grow_shrink_bit_identical(tmp_path, stream, elastic):
     assert proc.stdout == ref
 
 
+@pytest.mark.slow
 def test_crash_inside_rescale_seam_recovers_via_vote(tmp_path, stream):
     """rescale_drain@1:crash: worker 1 dies AFTER the drain commit and
     BEFORE its voluntary exit. The crash bills one restart, the gang

@@ -106,6 +106,14 @@ def test_bench_configs_record_standin_model(monkeypatch):
     from tpu_cooccurrence.config import Backend
 
     monkeypatch.delenv("MOVIELENS_100K", raising=False)
+    # Provenance is decided by which stream path ran, not by its length
+    # — truncate the calibrated stand-in so the label check doesn't pay
+    # for a full 100k-event oracle measurement (tier-1 budget).
+    real_100k = configs._movielens_100k
+    def _small_100k():
+        u, i, t, model = real_100k()
+        return u[:12_000], i[:12_000], t[:12_000], model
+    monkeypatch.setattr(configs, "_movielens_100k", _small_100k)
     r = configs.config2_ml100k(backend=Backend.ORACLE)
     d = r.as_dict()
     assert d["synthetic_standin"] is True

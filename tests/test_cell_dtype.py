@@ -125,9 +125,15 @@ def test_narrow_flags_rejected_off_sparse():
     with pytest.raises(ValueError, match="wire-format"):
         Config(window_size=10, seed=1, backend=Backend.DEVICE,
                wire_format="packed")
+    # Single-controller sharded sparse carries the wide side-table and
+    # the packed uplink (PR 16) — narrow cells are accepted there; only
+    # multi-controller runs still reject an explicit narrow request.
+    Config(window_size=10, seed=1, backend=Backend.SPARSE,
+           num_shards=2, cell_dtype="int16")
     with pytest.raises(ValueError, match="cell-dtype"):
         Config(window_size=10, seed=1, backend=Backend.SPARSE,
-               num_shards=2, cell_dtype="int16")
+               num_shards=2, coordinator="127.0.0.1:9999",
+               num_processes=2, process_id=0, cell_dtype="int16")
 
 
 @pytest.mark.parametrize("wire_a,wire_b", [

@@ -788,6 +788,130 @@ def test_pallas_kernel_registry_covers_out_of_tree_kernel_via_wrapper(
     assert result.findings == []
 
 
+# -- rule pack 6b: fused fallback-reason registry -----------------------
+
+
+def _mini_fallback_repo(tmp_path, *, scorer_body, arch_body, test_body):
+    """A minimal repo for the fused-fallback-registry rule: the sharded
+    scorer with _fallback_chained call sites, the ARCHITECTURE fallback
+    table, and a test asserting the reason literals."""
+    root = tmp_path / "repo"
+    par = root / "tpu_cooccurrence" / "parallel"
+    par.mkdir(parents=True)
+    (par / "sharded_sparse.py").write_text(scorer_body)
+    (root / "docs").mkdir()
+    (root / "docs" / "ARCHITECTURE.md").write_text(arch_body)
+    (root / "tests").mkdir()
+    (root / "tests" / "test_fallback_fixture.py").write_text(test_body)
+    return root
+
+
+_FALLBACK_SCORER = (
+    "class S:\n"
+    "    def _fallback_chained(self, reason):\n"
+    "        self.last_fallback_reason = reason\n\n"
+    "    def window(self, cold):\n"
+    "        if cold:\n"
+    "            self._fallback_chained('plan-rebuild')\n")
+
+
+def test_fused_fallback_registry_documented_and_tested_passes(tmp_path):
+    root = _mini_fallback_repo(
+        tmp_path,
+        scorer_body=_FALLBACK_SCORER,
+        arch_body="| `plan-rebuild` | cold plans |\n",
+        test_body="def test_cold():\n"
+                  "    assert reason == 'plan-rebuild'\n")
+    result = Analyzer(str(root), rules=[RULES["fused-fallback-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_fused_fallback_registry_flags_undocumented_reason(tmp_path):
+    """A reason absent from the ARCHITECTURE fallback table is a
+    finding; prose mentioning the bare word does not count — the table
+    quotes reasons backticked."""
+    root = _mini_fallback_repo(
+        tmp_path,
+        scorer_body=_FALLBACK_SCORER,
+        arch_body="plans rebuild after a plan-rebuild window\n",  # prose
+        test_body="def test_cold():\n"
+                  "    assert reason == 'plan-rebuild'\n")
+    result = Analyzer(str(root), rules=[RULES["fused-fallback-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["fused-fallback-registry"]
+    assert "fallback table" in result.findings[0].message
+    assert "plan-rebuild" in result.findings[0].message
+
+
+def test_fused_fallback_registry_flags_untested_reason(tmp_path):
+    root = _mini_fallback_repo(
+        tmp_path,
+        scorer_body=_FALLBACK_SCORER,
+        arch_body="| `plan-rebuild` | cold plans |\n",
+        test_body="def test_nothing():\n    pass\n")
+    result = Analyzer(str(root), rules=[RULES["fused-fallback-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["fused-fallback-registry"]
+    assert "never asserted under tests/" in result.findings[0].message
+
+
+def test_fused_fallback_registry_flags_dynamic_reason(tmp_path):
+    """A non-literal reason defeats static registry checking and is a
+    finding at the call site."""
+    root = _mini_fallback_repo(
+        tmp_path,
+        scorer_body=("class S:\n"
+                     "    def window(self, why):\n"
+                     "        self._fallback_chained(why)\n"),
+        arch_body="| `plan-rebuild` |\n",
+        test_body="def test_nothing():\n    pass\n")
+    result = Analyzer(str(root), rules=[RULES["fused-fallback-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["fused-fallback-registry"]
+    assert "not a string literal" in result.findings[0].message
+
+
+def test_fused_fallback_registry_flags_gone_registry(tmp_path):
+    """The sharded scorer defining _fallback_chained with zero call
+    sites = the fallback taxonomy this rule guards is gone; other
+    fixture repos (no sharded_sparse.py) stay silent."""
+    root = _mini_fallback_repo(
+        tmp_path,
+        scorer_body=("class S:\n"
+                     "    def _fallback_chained(self, reason):\n"
+                     "        pass\n"),
+        arch_body="| `plan-rebuild` |\n",
+        test_body="def test_nothing():\n    pass\n")
+    result = Analyzer(str(root), rules=[RULES["fused-fallback-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["fused-fallback-registry"]
+    assert "registry this rule guards is gone" in result.findings[0].message
+    # No _fallback_chained anywhere at all -> silence (fixture repos for
+    # other rules are not fallback registries).
+    (root / "tpu_cooccurrence" / "parallel" / "sharded_sparse.py"
+     ).write_text("def plain(x):\n    return x\n")
+    result = Analyzer(str(root), rules=[RULES["fused-fallback-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_fused_fallback_registry_flags_missing_arch(tmp_path):
+    """A vanished ARCHITECTURE.md is a finding, not a silent waiver of
+    the doc half of the registry."""
+    root = _mini_fallback_repo(
+        tmp_path,
+        scorer_body=_FALLBACK_SCORER,
+        arch_body="| `plan-rebuild` |\n",
+        test_body="def test_cold():\n"
+                  "    assert reason == 'plan-rebuild'\n")
+    (root / "docs" / "ARCHITECTURE.md").unlink()
+    result = Analyzer(str(root), rules=[RULES["fused-fallback-registry"]],
+                      baseline=[]).run()
+    assert [f.rule for f in result.findings] == ["fused-fallback-registry"]
+    assert "not found" in result.findings[0].message
+
+
 # -- rule pack 8: serving route registry --------------------------------
 
 

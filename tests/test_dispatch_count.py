@@ -257,6 +257,112 @@ def test_fused_sparse_relocation_window_falls_back_chained(monkeypatch):
     assert fused_gauge.get() - f0 == 1
 
 
+class ShardedDispatchCounter:
+    """Counting shims around a ShardedSparseScorer's per-instance jitted
+    callables. The sharded programs are instance-level closures (the
+    mesh is baked in), so the module-level monkeypatch idiom above
+    cannot see them — instead every cached-program *getter* is wrapped
+    so the callable it returns counts its invocations, plus the direct
+    ``_update`` attribute. Attach AFTER warmup: ``_build_update()``
+    replaces ``_update`` on growth, which would silently unwrap it."""
+
+    GETTERS = ("_moves_fn", "_score_fn", "_score_window_into_fn",
+               "_grow_fn", "_compact_gather_fn", "_promote_fn",
+               "_fused_fn")
+
+    def __init__(self, scorer):
+        self.scorer = scorer
+        self.counts = {name: 0 for name in self.GETTERS + ("_update",)}
+        for name in self.GETTERS:
+            setattr(scorer, name,
+                    self._wrap_getter(name, getattr(scorer, name)))
+        orig_update = scorer._update
+
+        def counted_update(*args, **kwargs):
+            self.counts["_update"] += 1
+            return orig_update(*args, **kwargs)
+
+        scorer._update = counted_update
+
+    def _wrap_getter(self, name, getter):
+        def counting_getter(*args, **kwargs):
+            fn = getter(*args, **kwargs)
+
+            def counted(*fargs, **fkwargs):
+                self.counts[name] += 1
+                return fn(*fargs, **fkwargs)
+
+            return counted
+
+        return counting_getter
+
+    def reset(self):
+        for name in self.counts:
+            self.counts[name] = 0
+
+    @property
+    def total(self):
+        return sum(self.counts.values())
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+@pytest.mark.parametrize("wire", ["packed", "raw"])
+def test_fused_sharded_steady_state_is_one_launch_per_worker(wire):
+    """--fused-window on, sharded sparse: a steady-state window is
+    exactly ONE jit(shard_map) launch — decode + update + psum + mirror
+    sync + rescore + table scatter; no chained update or score program
+    leaks beside it, on the packed and the raw wire alike."""
+    from tpu_cooccurrence.parallel.sharded_sparse import ShardedSparseScorer
+
+    scorer = ShardedSparseScorer(
+        5, num_shards=2, defer_results=True, fused_window="on",
+        wire_format=wire,
+        cell_dtype="int16" if wire == "packed" else "int32")
+    pairs = _clique_window()
+    for w in range(3):  # warmup: allocation, cold plan-rebuild, compile
+        scorer.process_window(w * 10, pairs)
+    assert scorer.last_dispatch_fused is True, "warmup never fused"
+    counter = ShardedDispatchCounter(scorer)
+    for w in range(3, 8):
+        counter.reset()
+        scorer.process_window(w * 10, pairs)
+        assert counter.counts["_fused_fn"] == 1, (
+            f"window {w}: {counter.counts}")
+        assert counter.total == 1, (
+            f"window {w}: a dispatch leaked beside the fused launch "
+            f"({counter.counts})")
+        assert scorer.last_dispatch_fused is True
+    # The identical windows compiled exactly one fused program shape.
+    assert scorer.fused_compilations == 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+def test_fused_sharded_relocation_falls_back_then_one_launch():
+    """A relocation window (rows outgrow pow2 caps) routes chained on
+    the sharded path — no fused launch — and the next repeat of the
+    same population is back to exactly one launch."""
+    from tpu_cooccurrence.parallel.sharded_sparse import ShardedSparseScorer
+
+    scorer = ShardedSparseScorer(
+        5, num_shards=2, defer_results=True, fused_window="on")
+    pairs = _clique_window(24)
+    for w in range(3):
+        scorer.process_window(w * 10, pairs)
+    assert scorer.last_dispatch_fused is True
+    counter = ShardedDispatchCounter(scorer)
+    grow = _clique_window(64)
+    scorer.process_window(100, grow)
+    assert counter.counts["_fused_fn"] == 0, counter.counts
+    assert scorer.last_dispatch_fused is False
+    assert scorer.last_fallback_reason == "relocation"
+    # Re-attach: the growth window may have rebuilt ``_update``.
+    counter = ShardedDispatchCounter(scorer)
+    scorer.process_window(110, grow)
+    assert counter.counts["_fused_fn"] == 1, counter.counts
+    assert counter.total == 1, counter.counts
+    assert scorer.last_dispatch_fused is True
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_sharded_sparse_program_cache_is_monotone():
     """The sharded-sparse fused-window program cache grows monotonically and

@@ -264,15 +264,23 @@ def test_fused_sparse_uplink_is_ledger_booked(tmp_path):
 
 
 def test_fused_sparse_config_validation():
-    # Single-process sparse now accepts a forced 'on'.
+    # Single-process sparse accepts a forced 'on'.
     Config(window_size=10, backend=Backend.SPARSE, fused_window="on")
-    # ... but not sharded, nor with per-window result streaming.
-    with pytest.raises(ValueError, match="single-process"):
-        Config(window_size=10, backend=Backend.SPARSE, num_shards=2,
-               fused_window="on")
+    # Sharded sparse now accepts it too (PR 16: one launch per worker).
+    Config(window_size=10, backend=Backend.SPARSE, num_shards=2,
+           fused_window="on")
+    # ... but per-window result streaming still cannot fuse, on any
+    # topology (the fused program scatters results on device).
     with pytest.raises(ValueError, match="deferred results"):
         Config(window_size=10, backend=Backend.SPARSE, emit_updates=True,
                fused_window="on")
+    with pytest.raises(ValueError, match="deferred results"):
+        Config(window_size=10, backend=Backend.SPARSE, num_shards=2,
+               emit_updates=True, fused_window="on")
+    # Hybrid's sparse half stays single-process fused only.
+    with pytest.raises(ValueError, match="single-process"):
+        Config(window_size=10, backend=Backend.HYBRID, num_shards=2,
+               item_cut=100, fused_window="on")
     # Oracle stays chained-only.
     with pytest.raises(ValueError, match="device or sparse"):
         Config(window_size=10, backend=Backend.ORACLE, fused_window="on")

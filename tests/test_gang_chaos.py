@@ -133,6 +133,7 @@ def _run_sparse(stream, ck_dir, incremental, extra, timeout=420):
         timeout=timeout)
 
 
+@pytest.mark.slow
 def test_gang_incremental_ckpt_mid_delta_crash_bit_identical(
         tmp_path, stream):
     """ISSUE 12 acceptance: a 2-process sparse gang running INCREMENTAL

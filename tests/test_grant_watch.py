@@ -90,7 +90,7 @@ def test_watch_stage_timeout_then_grant_lost(monkeypatch, tmp_path):
     # SIGKILL — the hang comes from the sleep, not slow startup.
     captures = grant_watch.watch(
         interval_s=0, probe_timeout_s=60, max_cycles=1, log_path=log,
-        stages=[("hang", hang_cmd, 15.0), ("after", after_cmd, 60.0)])
+        stages=[("hang", hang_cmd, 8.0), ("after", after_cmd, 60.0)])
     assert captures == 0  # incomplete sessions don't count as captures
     assert not never.exists(), "stages after grant-loss must be skipped"
     events = [e["event"] for e in _read_log(log)]

@@ -19,13 +19,16 @@ from tpu_cooccurrence.bench.ml25m import (PSUM_LATENCY_DEFAULT_S,
 
 @pytest.fixture(scope="module")
 def measured_20k():
-    """ONE 20k-event measured run shared by every projection test: the
+    """ONE measured stand-in run shared by every projection test: the
     monkeypatched capture file only changes :func:`ml25m.project_v5e8`'s
     constants (arithmetic), never the measured stream numbers — so the
-    expensive measurement half runs once per module, not per test."""
+    expensive measurement half runs once per module, not per test. The
+    projection tests consume host/device seconds and the window count
+    arithmetically, so the stream length only needs enough windows to
+    make the per-window collective term visible."""
     with pytest.MonkeyPatch.context() as mp:
         mp.delenv("MOVIELENS_25M", raising=False)  # stand-in stream
-        return ml25m.measure_full(20_000, host_only=False)
+        return ml25m.measure_full(8_000, host_only=False)
 
 
 def test_psum_default_when_no_capture(tmp_path, monkeypatch):

@@ -54,7 +54,7 @@ def _reset_registries():
     degrade.uninstall()
 
 
-def _stream(seed, n=20_000, n_users=150, n_items=400):
+def _stream(seed, n=9_000, n_users=150, n_items=400):
     rng = np.random.default_rng(seed)
     users = rng.integers(0, n_users, n).astype(np.int64)
     items = rng.integers(0, n_items, n).astype(np.int64)
@@ -368,8 +368,8 @@ def test_recommend_hammer_during_live_swaps(depth):
     job = CooccurrenceJob(cfg)
     srv = MetricsServer(REGISTRY, counters=job.counters, ledger=LEDGER,
                         port=0, serving=job.serving).start()
-    users, items, ts = _window_aligned_stream(8 + depth, n_chunks=40,
-                                              per_chunk=600, window_ms=50)
+    users, items, ts = _window_aligned_stream(8 + depth, n_chunks=24,
+                                              per_chunk=500, window_ms=50)
     stop = threading.Event()
     results = []
     errors = []

@@ -38,8 +38,11 @@ Layout:
   ``parallel/distributed.py``; the gang chaos sites must stay
   registered and fired);
 * :mod:`.rules_fused` — Pallas kernel registry drift (every
-  ``pallas_call`` entry point in ``ops/pallas_score.py`` parity-tested
-  from ``tests/`` and listed in the ARCHITECTURE kernel table);
+  ``pallas_call`` entry point under ``tpu_cooccurrence/`` parity-tested
+  from ``tests/`` and listed in the ARCHITECTURE kernel table) plus the
+  fused fallback-reason registry (every
+  ``_fallback_chained("<reason>")`` literal quoted in the ARCHITECTURE
+  fused fallback table and asserted by a test);
 * :mod:`.rules_serving` — HTTP route registry drift (every route in
   ``observability/http.py``'s ``ROUTE_METRICS`` needs a
   CANONICAL_METRICS latency metric, a README mention and a tests/

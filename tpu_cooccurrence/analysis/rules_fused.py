@@ -23,15 +23,34 @@ Scope (extended for the fused-sparse plane): EVERY module under
 just ``ops/pallas_score.py`` — a fused-sparse program that grew its own
 kernel in ``state/`` must register a parity surface and an ARCHITECTURE
 kernel-table row exactly like the ops-layer kernels (wrapper coverage
-stays one hop wide *within the defining module*).
+stays one hop wide *within the defining module*). The sharded scorer in
+``parallel/sharded_sparse.py`` is covered by the same sweep — its fused
+program bodies call the shared kernels through module-level wrappers.
+
+A second registry rides the same module (``fused-fallback-registry``):
+every *chained-fallback reason* the sharded fused window can take — the
+string literal at a ``_fallback_chained("<reason>")`` call site — is an
+operator-facing contract twice over: the ARCHITECTURE fallback table
+names it (an operator reading ``last_fallback_reason`` in the journal
+must find it documented), and a test exercises it (a fallback branch
+nothing ever drives is exactly the untested-escape-hatch class the
+fused plane's bit-identity claim cannot survive). Baseline-free,
+AST-only, fixture-tested in ``tests/test_cooclint.py``.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, Set
+from typing import Dict, Iterable, List, Set, Tuple
 
-from .core import Finding, RepoContext, Rule, register
+from .core import (
+    FileContext,
+    Finding,
+    RepoContext,
+    Rule,
+    register,
+    string_constants,
+)
 
 _PALLAS_PATH = "tpu_cooccurrence/ops/pallas_score.py"
 _PKG_PREFIX = "tpu_cooccurrence/"
@@ -144,3 +163,107 @@ class FusedKernelRegistryRule(Rule):
                         message=(f"Pallas kernel entry point {kernel!r} "
                                  f"is not in {_ARCH_PATH} — add it to "
                                  f"the Pallas kernel table"))
+
+
+_SHARDED_PATH = "tpu_cooccurrence/parallel/sharded_sparse.py"
+
+
+def _fallback_sites(
+        tree: ast.Module) -> Tuple[List[Tuple[int, str]], List[int]]:
+    """``_fallback_chained("<reason>")`` call sites: (line, reason) for
+    literal reasons, plus lines whose reason is NOT a string literal
+    (those defeat static registry checking and are findings
+    themselves)."""
+    literal: List[Tuple[int, str]] = []
+    dynamic: List[int] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_fallback_chained"):
+            continue
+        if (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            literal.append((node.lineno, node.args[0].value))
+        else:
+            dynamic.append(node.lineno)
+    return literal, dynamic
+
+
+@register
+class FusedFallbackRegistryRule(Rule):
+    name = "fused-fallback-registry"
+    description = ("every chained-fallback reason literal at a "
+                   "_fallback_chained(...) call site must be quoted in "
+                   "the ARCHITECTURE fallback table and asserted by a "
+                   "test under tests/")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        sites: List[Tuple[FileContext, int, str]] = []
+        any_call_sites = False
+        for ctx in repo.package_files():
+            if ctx.tree is None:
+                continue
+            literal, dynamic = _fallback_sites(ctx.tree)
+            any_call_sites = any_call_sites or bool(literal or dynamic)
+            for lineno in dynamic:
+                yield Finding(
+                    rule=self.name, file=ctx.path, line=lineno,
+                    message=("_fallback_chained reason is not a string "
+                             "literal — the fallback-reason registry is "
+                             "only checkable when every call site names "
+                             "its reason inline"))
+            for lineno, reason in literal:
+                sites.append((ctx, lineno, reason))
+        if not any_call_sites:
+            # Anchor: the sharded scorer defining _fallback_chained with
+            # zero call sites means the fallback taxonomy this rule
+            # guards is gone (every fused gate must route through it).
+            src = next((c for c in repo.files
+                        if c.path == _SHARDED_PATH), None)
+            if (src is not None and src.tree is not None
+                    and "_fallback_chained" in src.source):
+                yield Finding(
+                    rule=self.name, file=_SHARDED_PATH, line=1,
+                    message=("_fallback_chained is defined but never "
+                             "called with a reason literal (the "
+                             "fallback-reason registry this rule guards "
+                             "is gone)"))
+            return
+        if not sites:
+            return
+        arch = next((c for c in repo.files if c.path == _ARCH_PATH), None)
+        if arch is None:
+            yield Finding(
+                rule=self.name, file=sites[0][0].path, line=1,
+                message=(f"{_ARCH_PATH} not found — the fused fallback "
+                         f"table this rule checks reasons against is "
+                         f"gone"))
+        test_literals: Set[str] = set()
+        for ctx in repo.python_files():
+            if not ctx.path.startswith("tests/") or ctx.tree is None:
+                continue
+            for _lineno, value in string_constants(ctx.tree):
+                test_literals.add(value)
+        seen: Set[str] = set()
+        for ctx, lineno, reason in sites:
+            if reason in seen:
+                continue
+            seen.add(reason)
+            # The table quotes reasons backticked — plain prose mention
+            # of a generic word like "promotion" is not registry
+            # evidence.
+            if arch is not None and f"`{reason}`" not in arch.source:
+                yield Finding(
+                    rule=self.name, file=ctx.path, line=lineno,
+                    message=(f"fallback reason {reason!r} is not in the "
+                             f"{_ARCH_PATH} fused fallback table — an "
+                             f"operator reading last_fallback_reason "
+                             f"must find it documented"))
+            if reason not in test_literals:
+                yield Finding(
+                    rule=self.name, file=ctx.path, line=lineno,
+                    message=(f"fallback reason {reason!r} is never "
+                             f"asserted under tests/ — a fallback "
+                             f"branch nothing drives is an untested "
+                             f"escape hatch in the fused plane's "
+                             f"bit-identity contract"))

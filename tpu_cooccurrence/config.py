@@ -624,19 +624,28 @@ class Config:
         sparse_single = (self.backend in (Backend.SPARSE, Backend.HYBRID)
                          and self.num_shards == 1
                          and self.coordinator is None)
-        if self.cell_dtype in ("int16", "int8") and not sparse_single:
+        # The sharded-sparse mesh (single controller) carries the wide
+        # side-table and the packed uplink too; only multi-controller
+        # runs are excluded (per-process snapshots have no wide blocks,
+        # and every worker would re-encode the same replicated window).
+        sparse_local = (sparse_single
+                        or (self.backend == Backend.SPARSE
+                            and self.coordinator is None))
+        if self.cell_dtype in ("int16", "int8") and not sparse_local:
             # 'auto' degrades gracefully; an explicit narrow request the
             # backend cannot honor must fail loudly (same rule as
             # --fused-window on).
             raise ValueError(
-                f"--cell-dtype {self.cell_dtype} is single-process "
-                f"--backend sparse only (the wide-promotion side-table "
-                f"is per-process slab state)")
-        if self.wire_format == "packed" and not sparse_single:
+                f"--cell-dtype {self.cell_dtype} is --backend sparse "
+                f"without --coordinator only (multi-controller "
+                f"per-process snapshots carry no wide side-table "
+                f"blocks)")
+        if self.wire_format == "packed" and not (
+                sparse_local or self.backend == Backend.SPARSE):
             raise ValueError(
-                "--wire-format packed applies to the single-process "
-                "sparse backend's update uplink (other backends ship "
-                "raw COO or basket formats)")
+                "--wire-format packed applies to the sparse backend's "
+                "update uplink (other backends ship raw COO or basket "
+                "formats)")
         if self.spill_threshold_windows < 0:
             raise ValueError(
                 f"--spill-threshold-windows must be >= 0, got "
@@ -674,11 +683,10 @@ class Config:
                         "--fused-window on is single-process only (the "
                         "partitioned sampler allgathers expanded COO)")
             elif self.backend in (Backend.SPARSE, Backend.HYBRID):
-                if not sparse_single:
+                if self.backend == Backend.HYBRID and not sparse_single:
                     raise ValueError(
-                        "--fused-window on with --backend sparse is "
-                        "single-process only (the sharded-sparse mesh "
-                        "stays on the chained path)")
+                        "--fused-window on with --backend hybrid is "
+                        "single-process only")
                 if self.emit_updates:
                     raise ValueError(
                         "--fused-window on with --backend sparse needs "

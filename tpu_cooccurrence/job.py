@@ -372,6 +372,8 @@ class CooccurrenceJob:
                 # precede any backend initialization.
                 mesh = maybe_multihost_mesh(self.config)
                 from .parallel.sharded_sparse import ShardedSparseScorer
+                from .state.wire import (resolve_cell_dtype,
+                                         resolve_wire_format)
 
                 return ShardedSparseScorer(
                     self.config.top_k, num_shards=self.config.num_shards,
@@ -381,7 +383,13 @@ class CooccurrenceJob:
                     score_ladder=self.config.score_ladder,
                     defer_results=not self.config.emit_updates,
                     fixed_shapes=fixed,
-                    use_pallas=self.config.pallas)
+                    use_pallas=self.config.pallas,
+                    cell_dtype=resolve_cell_dtype(
+                        self.config.cell_dtype, sparse_single_device=False),
+                    wire_format=resolve_wire_format(
+                        self.config.wire_format,
+                        sparse_single_device=False),
+                    fused_window=self.config.fused_window)
             if self.config.coordinator is not None:
                 # A coordinator with the default single shard would run one
                 # full independent job per process (and clobber a shared
@@ -761,6 +769,15 @@ class CooccurrenceJob:
                     rec["degrade_events"] = degrade_events
             if fused is not None:
                 rec["fused"] = int(fused)
+                reason = getattr(self.scorer, "last_fallback_reason",
+                                 None)
+                if not fused and reason:
+                    rec["fallback_reason"] = reason
+            fc = getattr(self.scorer, "fused_compilations", None)
+            if fc is not None:
+                # Cumulative distinct fused-program shapes: a seam or a
+                # fresh bucket shows up as a step in this series.
+                rec["fused_compiles"] = int(fc)
             if self.serving is not None:
                 # Swap bookkeeping: the snapshot generation and row count
                 # in force when this record was written (this window's

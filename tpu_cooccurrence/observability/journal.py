@@ -79,8 +79,18 @@ SCHEMA = {
                                          # (closed | half_open | open)
     "fused": (False, int),               # 1 = this window took the fused
                                          # one-dispatch path, 0 = chained
-                                         # (present for device-backend
-                                         # runs only)
+                                         # (present for backends that
+                                         # expose the dispatch split)
+    "fused_compiles": (False, int),      # cumulative distinct fused-
+                                         # program shapes (= XLA
+                                         # compiles) when this record
+                                         # was written — a seam or new
+                                         # bucket steps this series
+    "fallback_reason": (False, str),     # why a chained (fused: 0)
+                                         # window fell back, when the
+                                         # backend names it — one of the
+                                         # ARCHITECTURE fallback-table
+                                         # reasons (sharded sparse)
     # Serving plane (serving/, --serve-port): snapshot double-buffer
     # bookkeeping — the generation and live row count queries saw while
     # this window computed (the window's own swap lands right after).

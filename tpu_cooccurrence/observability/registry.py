@@ -136,6 +136,11 @@ CANONICAL_METRICS = frozenset({
     # entries here are the f-string prefixes the emission sites use
     "cooc_host_index_rss_bytes_shard",
     "cooc_slab_live_cells_shard",
+    # per-shard fused/chained dispatch split (sharded-sparse fused
+    # window, parallel/sharded_sparse.py): same <name><shard-id>
+    # prefix convention as the RSS gauges above
+    "cooc_fused_dispatches_total_shard",
+    "cooc_chained_dispatches_total_shard",
     # tiered elastic state (state/store.TieredSlabStore): spill/promote
     # counters and the host arena footprint, refreshed per window
     "cooc_spill_evictions_total",

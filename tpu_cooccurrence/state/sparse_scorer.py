@@ -1990,6 +1990,13 @@ class SparseDeviceScorer:
             S = fixed_block(R, self.FIXED_BUDGET, self.FIXED_ROW_CAP)
             plan_buckets[b] = max(plan_buckets.get(b, 0), -(-n_rows // S))
 
+    @property
+    def fused_compilations(self) -> int:
+        """Distinct fused-program static shapes dispatched so far (=
+        XLA compiles of the fused window; the journal's per-window
+        ``fused_compiles`` field)."""
+        return len(self._fused_shapes)
+
     def _note_fused_shape(self, key) -> None:
         """Track distinct fused-program static shapes (= XLA compiles):
         the per-bucket shape-specialization churn gauge."""
