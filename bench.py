@@ -1088,7 +1088,8 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
                    checkpoint: dict = None,
                    fleet: dict = None,
                    rescale: dict = None,
-                   fused_gang: dict = None) -> None:
+                   fused_gang: dict = None,
+                   regression: dict = None) -> None:
     """Append a successful on-chip measurement to the bench history.
 
     ``pipeline_depth`` and the per-stage occupancy ride along so the
@@ -1161,6 +1162,12 @@ def _record_onchip(value: float, vs_baseline: float, backend: str,
         # 2→4 seam's recompile cost) — trajectory-visible like the
         # single-process fused arms.
         entry["fused_gang"] = fused_gang
+    if regression:
+        # The ISSUE-17 regression gate's verdict (bench.regress):
+        # whether THIS run's tracked metrics sat inside the history's
+        # noise bands when it landed. flatten() skips this subtree, so
+        # a recorded verdict never bands future verdicts.
+        entry["regression"] = regression
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(entry) + "\n")
 
@@ -1517,6 +1524,21 @@ def measure() -> None:
     }
     if journal:
         out["journal"] = journal
+    # Regression gate (bench.regress, ISSUE-17): band this run's
+    # tracked metrics against the same-backend history BEFORE the run
+    # is appended to it; the verdict rides the bench JSON and (on-chip)
+    # the history entry itself. Gate failures never fail the bench —
+    # the verify skill's post-bench step is where exit 1 bites.
+    try:
+        from tpu_cooccurrence.bench import regress as _regress
+
+        candidate = dict(out)
+        candidate["pairs_per_sec"] = out["value"]
+        candidate["backend"] = backend
+        out["regression"] = _regress.evaluate(
+            _regress.read_history(_HISTORY), candidate)
+    except Exception as exc:  # pragma: no cover - defensive
+        out["regression"] = {"ok": True, "error": str(exc)}
     if backend == "cpu":
         out["platform"] = ("cpu-fallback"
                            if os.environ.get("BENCH_CPU_FALLBACK") else "cpu")
@@ -1536,7 +1558,8 @@ def measure() -> None:
                        pipeline_depth, occupancy, latency, degradation,
                        fused_info, compression, serving_storm, spill_info,
                        fused_sparse, ckpt_info, fleet_storm,
-                       rescale_info, fused_gang_info)
+                       rescale_info, fused_gang_info,
+                       regression=out.get("regression"))
     print(json.dumps(out))
 
 

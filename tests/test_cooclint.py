@@ -1475,3 +1475,87 @@ def test_scale_policy_registry_silent_without_autoscale_module():
     assert analyze_source(
         "X = 1\n", path="tpu_cooccurrence/other.py",
         rules=["scale-policy-registry"]) == []
+
+
+# ---------------------------------------------------------------------------
+# journal-schema-registry (ISSUE 17): every journal-emitted key must be
+# in the schema tables, the ARCHITECTURE journal table, and tests/
+
+
+def test_journal_registry_flags_unregistered_key():
+    src = (
+        "class J:\n"
+        "    def emit(self):\n"
+        "        self.journal.record({'v': 1, 'seq': 1,\n"
+        "                             'warp_factor': 9})\n"
+    )
+    findings = analyze_source(src, path="tpu_cooccurrence/fixmod.py",
+                              rules=["journal-schema-registry"])
+    assert [f.rule for f in findings] == ["journal-schema-registry"]
+    assert "warp_factor" in findings[0].message
+    assert "*_SCHEMA" in findings[0].message
+
+
+def test_journal_registry_sees_through_stamp_and_name_args():
+    """The writers pass dict literals through a stamping wrapper or
+    build the record incrementally (``rec = {...}; rec["k"] = ...``) —
+    the collector must see every shape."""
+    wrapped = (
+        "class J:\n"
+        "    def emit(self):\n"
+        "        self.journal.record(self._stamp({'v': 1,\n"
+        "                                         'bogus_a': 1}))\n"
+    )
+    findings = analyze_source(wrapped, path="tpu_cooccurrence/fm.py",
+                              rules=["journal-schema-registry"])
+    assert ["bogus_a" in f.message for f in findings] == [True]
+    built = (
+        "class J:\n"
+        "    def emit(self):\n"
+        "        rec = {'v': 1, 'seq': 1}\n"
+        "        rec['bogus_b'] = 2\n"
+        "        self.journal.record(self._stamp(rec))\n"
+    )
+    findings = analyze_source(built, path="tpu_cooccurrence/fm.py",
+                              rules=["journal-schema-registry"])
+    assert ["bogus_b" in f.message for f in findings] == [True]
+
+
+def test_journal_registry_docs_and_tests_legs(tmp_path):
+    """With docs/ and tests/ trees present, a registered-but-
+    undocumented / untested key is flagged on those legs too."""
+    root = tmp_path / "repo"
+    (root / "tpu_cooccurrence").mkdir(parents=True)
+    (root / "docs").mkdir()
+    (root / "tests").mkdir()
+    (root / "tpu_cooccurrence" / "writer.py").write_text(
+        "class J:\n"
+        "    def emit(self):\n"
+        "        self.journal.record({'v': 1, 'seq': 1})\n")
+    # `v` documented + tested; `seq` neither.
+    (root / "docs" / "ARCHITECTURE.md").write_text(
+        "| `v` | version |\n")
+    (root / "tests" / "test_x.py").write_text("K = 'v'\n")
+    result = Analyzer(str(root),
+                      rules=[RULES["journal-schema-registry"]],
+                      baseline=[]).run()
+    msgs = sorted(f.message for f in result.findings)
+    assert len(msgs) == 2
+    assert all("'seq'" in m for m in msgs)
+    assert any("undocumented" in m for m in msgs)
+    assert any("no tests/ reference" in m for m in msgs)
+
+
+def test_journal_registry_silent_without_writers():
+    """Fixture repos for other rules must not trip this rule."""
+    assert analyze_source(
+        "X = 1\n", path="tpu_cooccurrence/other.py",
+        rules=["journal-schema-registry"]) == []
+
+
+def test_journal_registry_clean_on_repo():
+    """The real writers, schema tables, ARCHITECTURE journal table and
+    tests/ registry are in sync right now."""
+    result = Analyzer(REPO, rules=[RULES["journal-schema-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []

@@ -22,6 +22,11 @@ Layout:
   annotation requirements for new locks in worker code paths;
 * :mod:`.rules_jit` — jit/device hygiene (host syncs inside jitted
   functions, donated-buffer reuse);
+* :mod:`.rules_journal` — journal schema-registry drift (every key a
+  ``journal.record(...)`` writer emits must be in the journal schema
+  tables, documented in the ARCHITECTURE journal table and referenced
+  under ``tests/`` — cooc-trace and validate_record only see
+  registered fields);
 * :mod:`.rules_registry` — registry drift (metric names, fault sites,
   CLI flags vs config fields vs docs);
 * :mod:`.rules_native` — dtype discipline at the native (ctypes) and
@@ -87,6 +92,7 @@ from . import rules_degrade  # noqa: F401,E402
 from . import rules_fused  # noqa: F401,E402
 from . import rules_gang  # noqa: F401,E402
 from . import rules_jit  # noqa: F401,E402
+from . import rules_journal  # noqa: F401,E402
 from . import rules_lock  # noqa: F401,E402
 from . import rules_native  # noqa: F401,E402
 from . import rules_registry  # noqa: F401,E402

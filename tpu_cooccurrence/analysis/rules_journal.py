@@ -1,0 +1,160 @@
+"""Journal schema-registry guard (baseline-free).
+
+``journal-schema-registry`` — the journal is the fleet's flight
+recorder and, since the tracing plane landed, also cooc-trace's input
+format: three consumers (``validate_record``, the offline analyzer, the
+operators reading ``docs/ARCHITECTURE.md``) all believe the schema
+tables in ``observability/journal.py`` are the whole truth. Nothing
+structural stops a writer from emitting a key the tables never heard
+of: with validation off the record flushes fine, cooc-trace silently
+ignores the field, and the ARCHITECTURE table quietly lies.
+
+The rule walks every ``*.journal.record(...)`` call site in the package
+(dict-literal args, args wrapped in a stamping helper such as
+``self._stamp({...})``, and ``record(rec)`` where ``rec`` is built up
+by dict-literal assignment plus constant subscript stores) and requires
+every emitted string key to
+
+* appear in one of the journal schema tables (``SCHEMA`` /
+  ``EVENT_SCHEMA`` / ``CKPT_SCHEMA`` / ``AUTOSCALE_SCHEMA`` /
+  ``REPLICA_SCHEMA`` — imported directly, so the registry can never
+  drift from what the analyzer enforces),
+* be documented in the ARCHITECTURE journal table (backtick-quoted in
+  ``docs/ARCHITECTURE.md``), and
+* appear as a string constant somewhere under ``tests/`` — the fixture
+  reference that pins the field's semantics
+  (``tests/test_trace.py`` keeps the canonical registry list).
+
+Baseline-free: a new journal field lands in the same PR as its schema
+entry, its docs row and its test, or tier-1 fails. The docs and tests
+legs are scope-guarded on those trees being present in the scan (pure
+fixture snippets exercise the schema-membership leg only).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set, Tuple
+
+from .core import (FileContext, Finding, RepoContext, Rule, dotted_name,
+                   register)
+from ..observability.journal import (AUTOSCALE_SCHEMA, CKPT_SCHEMA,
+                                     EVENT_SCHEMA, REPLICA_SCHEMA, SCHEMA)
+
+#: Union of every schema table's keys — the registry this rule enforces.
+_SCHEMA_KEYS: Set[str] = (set(SCHEMA) | set(EVENT_SCHEMA)
+                          | set(CKPT_SCHEMA) | set(AUTOSCALE_SCHEMA)
+                          | set(REPLICA_SCHEMA))
+
+#: Where the operator-facing journal table lives.
+_DOCS_PATH = "docs/ARCHITECTURE.md"
+
+
+def _dict_keys(node: ast.Dict) -> "Iterable[Tuple[str, int]]":
+    for k in node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            yield k.value, k.lineno
+
+
+def _name_keys(tree: ast.Module, var: str) -> "Iterable[Tuple[str, int]]":
+    """Keys flowing into a ``record(rec)``-style Name argument: dict
+    literals assigned to ``var`` plus constant subscript stores on it,
+    module-wide (this also catches stamping helpers whose parameter
+    shares the name — ``def _stamp(self, rec): rec["run_id"] = ...``)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Name) and tgt.id == var
+                    and isinstance(node.value, ast.Dict)):
+                yield from _dict_keys(node.value)
+            if (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == var
+                    and isinstance(tgt.slice, ast.Constant)
+                    and isinstance(tgt.slice.value, str)):
+                yield tgt.slice.value, tgt.lineno
+
+
+def _emitted_keys(ctx: FileContext) -> Dict[str, int]:
+    """``{key: first emission line}`` for every ``*.journal.record(...)``
+    call site in one module."""
+    out: Dict[str, int] = {}
+    if ctx.tree is None:
+        return out
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        name = dotted_name(node.func)
+        if name is None or not name.endswith("journal.record"):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Call):
+            # Stamping wrapper: journal.record(self._stamp({...})) /
+            # journal.record(self._stamp(rec)).
+            arg = arg.args[0] if arg.args else arg
+        if isinstance(arg, ast.Dict):
+            for key, line in _dict_keys(arg):
+                out.setdefault(key, line)
+        elif isinstance(arg, ast.Name):
+            for key, line in _name_keys(ctx.tree, arg.id):
+                out.setdefault(key, line)
+    return out
+
+
+def _tests_constants(repo: RepoContext) -> Set[str]:
+    out: Set[str] = set()
+    for ctx in repo.python_files():
+        if not ctx.path.startswith("tests/") or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                out.add(node.value)
+    return out
+
+
+@register
+class JournalSchemaRegistryRule(Rule):
+    name = "journal-schema-registry"
+    description = ("every key a journal writer emits must be in the "
+                   "journal schema tables, documented in the "
+                   "ARCHITECTURE journal table, and referenced under "
+                   "tests/")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        emitters = [(ctx, _emitted_keys(ctx))
+                    for ctx in repo.package_files()]
+        emitters = [(ctx, keys) for ctx, keys in emitters if keys]
+        # Scope guard: a scan root with no journal writer at all (other
+        # rules' fixture repos, partial trees) is silent.
+        if not emitters:
+            return
+        docs = next((c for c in repo.files if c.path == _DOCS_PATH), None)
+        has_tests = any(c.path.startswith("tests/")
+                        for c in repo.python_files())
+        tests = _tests_constants(repo) if has_tests else None
+        for ctx, keys in emitters:
+            for key, line in sorted(keys.items()):
+                if key not in _SCHEMA_KEYS:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=line,
+                        message=(f"journal writer emits key {key!r} "
+                                 f"that no journal schema table "
+                                 f"declares — add it to the matching "
+                                 f"*_SCHEMA in observability/journal.py "
+                                 f"(validate_record and cooc-trace "
+                                 f"only see registered fields)"))
+                if docs is not None and f"`{key}`" not in docs.source:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=line,
+                        message=(f"journal key {key!r} is emitted but "
+                                 f"undocumented — add a `{key}` row to "
+                                 f"the journal table in {_DOCS_PATH}"))
+                if tests is not None and key not in tests:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=line,
+                        message=(f"journal key {key!r} has no tests/ "
+                                 f"reference — pin it in "
+                                 f"tests/test_trace.py's "
+                                 f"JOURNAL_SCHEMA_KEYS registry"))

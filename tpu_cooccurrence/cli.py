@@ -263,12 +263,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             peers = PeerTable(gang_dir, config.num_processes,
                               stale_after_s=config.gang_stale_after_s,
                               checkpoint_dir=config.checkpoint_dir)
+        # /healthz last_window block: the job reassigns the dict whole
+        # per window, so the HTTP thread's read is a snapshot.
+        last_window = lambda: job.last_window_health  # noqa: E731
         if config.metrics_port is not None:
             metrics_server = MetricsServer(
                 REGISTRY, counters=job.counters, ledger=LEDGER,
                 port=config.metrics_port,
                 stale_after_s=config.healthz_stale_after_s,
-                supervisor_info=supervisor_info, peers=peers).start()
+                supervisor_info=supervisor_info, peers=peers,
+                last_window=last_window).start()
         if config.serve_port is not None:
             # The serving endpoint carries the scrape routes too (one
             # port to probe behind a load balancer); --metrics-port may
@@ -279,7 +283,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 stale_after_s=config.healthz_stale_after_s,
                 supervisor_info=supervisor_info,
                 serving=job.serving,
-                serve_stale_after_s=config.serve_stale_after_s).start()
+                serve_stale_after_s=config.serve_stale_after_s,
+                last_window=last_window).start()
     source = FileMonitorSource(
         config.input, job.counters,
         process_continuously=config.process_continuously)

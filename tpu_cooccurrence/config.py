@@ -271,6 +271,11 @@ class Config:
     coordinator: Optional[str] = None  # host:port of process 0
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    run_id: Optional[str] = None  # tracing correlation id stamped on
+    # every journal record (observability/journal.py); None = inherit
+    # TPU_COOC_RUN_ID from a supervising parent, else mint fresh. Set
+    # explicitly to join separately launched processes (e.g. a writer
+    # and a standalone replica) into one cooc-trace timeline
     gang_workers: int = 0  # gang supervision (robustness/gang.py): this
     # process becomes the gang supervisor — it launches N workers with
     # the multi-controller identity flags filled in (fresh local
@@ -1102,6 +1107,13 @@ class Config:
                        dest="num_processes", help="Multi-host: process count")
         p.add_argument("--process-id", type=int, default=None,
                        dest="process_id", help="Multi-host: this process's id")
+        p.add_argument("--run-id", default=None, dest="run_id",
+                       help="Tracing: correlation id stamped on every "
+                            "journal record (default: inherit "
+                            "TPU_COOC_RUN_ID from a supervising parent, "
+                            "else mint fresh); set explicitly to join "
+                            "separately launched processes into one "
+                            "cooc-trace timeline")
         raw = list(argv) if argv is not None else sys.argv[1:]
         if any(
                 a == "--sample-workers" or a.startswith("--sample-workers=")

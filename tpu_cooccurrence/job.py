@@ -224,6 +224,25 @@ class CooccurrenceJob:
         self.emissions = 0
         self.windows_fired = 0
         self.step_timer = StepTimer()
+        # Tracing plane (observability/journal.py): fleet correlation
+        # identity, stamped on every journal record this job writes. A
+        # supervising parent mints run_id once and threads it (plus the
+        # restart-attempt ordinal) through the env; an unsupervised run
+        # mints its own. --run-id overrides for deliberate joins.
+        from .observability.journal import run_context
+        env_run_id, self.attempt = run_context()
+        self.run_id = config.run_id or env_run_id
+        self.process_id = int(config.process_id or 0)
+        # Boundary-stage seconds (snapshot-publish measured in _absorb,
+        # checkpoint-commit in checkpoint()) land AFTER the window's
+        # record flushed — they ride the NEXT record as trailing spans
+        # (see journal.SPAN_STAGES) and stay out of the core-span
+        # wall-seconds reconciliation.
+        self._pending_publish_s = 0.0
+        self._pending_ckpt_s = 0.0
+        # /healthz last_window block: reassigned atomically per window
+        # (readers on the HTTP thread only ever see a whole dict).
+        self.last_window_health: Optional[dict] = None
         # Flight recorder (observability/journal.py): one flushed JSONL
         # record per fired window. Per-window counter / wire deltas diff
         # against these snapshots; both are read only by whichever thread
@@ -608,14 +627,21 @@ class CooccurrenceJob:
                 # are indifferent to the clock.)
                 if faults.PLAN is not None:
                     faults.PLAN.fire("window_fire", seq=self.windows_fired)
+                admit_seconds = 0.0
                 if self.sliding:
+                    # The sliding sampler folds admission into its own
+                    # fire; no separate admission cut to time.
                     pairs = self.sampler.fire(users, items)
                 else:
-                    # Item cut (or pass-through when --skip-cuts).
-                    if self.config.skip_cuts:
-                        sampled = np.ones(len(items), dtype=bool)
-                    else:
-                        sampled = self.item_cut.fire(items)
+                    # Item cut (or pass-through when --skip-cuts). Timed
+                    # separately: the journal's ingest-admission span is
+                    # the admission-cut share of sample_seconds.
+                    with clock() as admit_clock:
+                        if self.config.skip_cuts:
+                            sampled = np.ones(len(items), dtype=bool)
+                        else:
+                            sampled = self.item_cut.fire(items)
+                    admit_seconds = admit_clock.seconds
                     # User reservoir.
                     pairs, feedback_items = self.sampler.fire(users, items, sampled)
                     # Feedback decrements before the next window fire
@@ -635,7 +661,8 @@ class CooccurrenceJob:
                     ts=ts, payload=payload, events=len(items),
                     raw_pairs=len(pairs),
                     sample_seconds=sample_clock.seconds, slot=slot,
-                    seq=self.windows_fired, stall_seconds=stall))
+                    seq=self.windows_fired, stall_seconds=stall,
+                    admit_seconds=admit_seconds))
             else:
                 # Score on the backend.
                 if faults.PLAN is not None:
@@ -651,7 +678,8 @@ class CooccurrenceJob:
                                         len(window_out)),
                     sample_seconds=sample_clock.seconds,
                     score_seconds=score_clock.seconds),
-                    seq=self.windows_fired)
+                    seq=self.windows_fired,
+                    admit_seconds=admit_seconds)
                 self._absorb(window_out)
             checkpointed = (
                 self.config.checkpoint_dir
@@ -699,9 +727,59 @@ class CooccurrenceJob:
             return payload, slot, self.pipeline.ring.last_stall_seconds
         return pairs, None, 0.0
 
+    def _build_spans(self, stats: WindowStats,
+                     admit_seconds: float) -> list:
+        """Carve one window's wall time into ordered journal span tuples
+        ``[stage, start_offset_s, seconds]`` (journal.SPAN_STAGES).
+
+        The five core stages partition ``sample_seconds +
+        score_seconds`` exactly by construction: admission is the timed
+        cut share of sampling (clamped), uplink-encode / rescore come
+        from the scorer's StageClock (clamped into score_seconds), and
+        dispatch is the residual. Boundary stages stashed by the
+        PREVIOUS window's post-record work (_absorb publish, checkpoint
+        commit) ride this record as trailing spans.
+        """
+        admit = max(0.0, min(admit_seconds, stats.sample_seconds))
+        sc = getattr(self.scorer, "stage_clock", None)
+        stage_s = sc.seconds if sc is not None else {}
+        enc = max(0.0, min(stage_s.get("uplink-encode", 0.0),
+                           stats.score_seconds))
+        resc = max(0.0, min(stage_s.get("rescore", 0.0),
+                            stats.score_seconds - enc))
+        disp = max(0.0, stats.score_seconds - enc - resc)
+        off = 0.0
+        spans = []
+        for name, secs in (("ingest-admission", admit),
+                           ("sample", stats.sample_seconds - admit),
+                           ("uplink-encode", enc),
+                           ("dispatch", disp),
+                           ("rescore", resc)):
+            spans.append([name, round(off, 9), round(secs, 9)])
+            off += secs
+        pub, self._pending_publish_s = self._pending_publish_s, 0.0
+        ck, self._pending_ckpt_s = self._pending_ckpt_s, 0.0
+        if pub > 0.0:
+            spans.append(["snapshot-publish", round(off, 9),
+                          round(pub, 9)])
+            off += pub
+        if ck > 0.0:
+            spans.append(["checkpoint-commit", round(off, 9),
+                          round(ck, 9)])
+        return spans
+
+    def _stamp(self, rec: dict) -> dict:
+        """Stamp the uniform correlation trio (run_id / process_id /
+        attempt) every record type carries — cooc-trace's join keys."""
+        rec["run_id"] = self.run_id
+        rec["process_id"] = self.process_id
+        rec["attempt"] = self.attempt
+        return rec
+
     def _record_window(self, stats: WindowStats, seq: int,
                        ring_depth: int = 0,
-                       stall_seconds: float = 0.0) -> None:
+                       stall_seconds: float = 0.0,
+                       admit_seconds: float = 0.0) -> None:
         """One fired window's observability fan-out: step timer ring,
         latency/byte histograms, liveness gauges, and (when attached)
         one flushed journal record.
@@ -748,6 +826,17 @@ class CooccurrenceJob:
                 seq, stats.seconds,
                 self.degrade.last_overloaded
                 if self.degrade is not None else False)
+        spans = self._build_spans(stats, admit_seconds)
+        # /healthz last_window block (observability/http.py): the same
+        # stage carve, visible without pulling the journal. One dict
+        # reassignment — HTTP-thread readers see whole snapshots only.
+        self.last_window_health = {
+            "window_seq": seq,
+            "seconds": round(stats.seconds, 6),
+            "fused": bool(fused) if fused is not None else None,
+            "stages": {name: round(secs, 6)
+                       for name, _off, secs in spans},
+        }
         if self.journal is not None:
             from .observability.journal import VERSION
 
@@ -763,6 +852,8 @@ class CooccurrenceJob:
                 "counters": counter_delta,
                 "wire": wire_delta,
             }
+            self._stamp(rec)
+            rec["spans"] = spans
             if level is not None:
                 rec["degradation_level"] = level
                 if degrade_events:
@@ -805,8 +896,10 @@ class CooccurrenceJob:
         admission-side transition path — see journal.EVENT_SCHEMA)."""
         from .observability.journal import VERSION
 
-        self.journal.record({"v": VERSION, "event": event,
-                             "wall_unix": round(time.time(), 3)})
+        self.journal.record(self._stamp(
+            {"v": VERSION, "event": event,
+             "wall_unix": round(time.time(), 3),
+             "window_seq": self.windows_fired}))
 
     def _journal_autoscale(self, request: dict, window: int) -> None:
         """Append the AUTOSCALE drain record (journal.AUTOSCALE_SCHEMA)
@@ -817,7 +910,7 @@ class CooccurrenceJob:
             return
         from .observability.journal import VERSION
 
-        self.journal.record({
+        self.journal.record(self._stamp({
             "v": VERSION,
             "autoscale": str(request.get("decision", "grow")),
             "from": int(request.get("from", 0)),
@@ -826,7 +919,7 @@ class CooccurrenceJob:
             "window": int(window),
             "cooldown": int(request.get("cooldown", 0)),
             "wall_unix": round(time.time(), 3),
-        })
+        }))
 
     def _flush_scorer(self) -> WindowTopK:
         flush = getattr(self.scorer, "flush", None)
@@ -859,9 +952,14 @@ class CooccurrenceJob:
             # tear). Runs on the absorbing thread (caller serially, the
             # scorer worker pipelined), same single-writer contract as
             # `latest` absorption.
-            if len(window_out):
-                self.serving.absorb(window_out)
-            self.serving.publish()
+            with clock() as publish_clock:
+                if len(window_out):
+                    self.serving.absorb(window_out)
+                self.serving.publish()
+            # Rides the NEXT window record as a trailing
+            # snapshot-publish span (journal.SPAN_STAGES): this swap
+            # lands after the current record already flushed.
+            self._pending_publish_s += publish_clock.seconds
         if self.on_update is not None and len(window_out):
             self.on_update(window_out)
 
@@ -885,13 +983,21 @@ class CooccurrenceJob:
             from .observability.journal import VERSION
 
             c = ckpt.LAST_COMMIT
-            self.journal.record({
+            self.journal.record(self._stamp({
                 "v": VERSION, "checkpoint": c["gen"], "kind": c["kind"],
                 "bytes": int(c["bytes"]),
                 "seconds": round(c["seconds"], 6),
                 "chain_len": int(c["chain_len"]),
                 "wall_unix": round(time.time(), 3),
-            })
+                # cooc-trace's window -> generation join for freshness:
+                # the fired-window ordinal this commit snapshotted, and
+                # the uniform generation alias replica records share.
+                "window_seq": self.windows_fired,
+                "generation": int(c["gen"]),
+            }))
+            # The commit's wall seconds ride the next window record as
+            # a trailing checkpoint-commit span (journal.SPAN_STAGES).
+            self._pending_ckpt_s += float(c["seconds"])
 
     def restore_rescaled(self, gen: int, writers: int,
                          source=None) -> None:

@@ -259,3 +259,31 @@ class clock:  # noqa: N801 - tiny helper
     def __exit__(self, *exc):
         self.seconds = time.perf_counter() - self._t0
         return False
+
+
+class StageClock:
+    """Per-window stage-seconds accumulator for the tracing plane.
+
+    The scorers :meth:`reset` it at ``process_window`` entry and wrap
+    their encode/upload and dispatch sections with :meth:`stage`; the
+    job reads :attr:`seconds` afterwards to carve the window's
+    ``score_seconds`` into journal span tuples. Re-entering the same
+    stage accumulates (the chained path uploads three operand groups
+    under one ``uplink-encode`` stage). Not thread-safe by design: one
+    scorer thread owns one clock.
+    """
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+
+    def reset(self) -> None:
+        self.seconds = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = (self.seconds.get(name, 0.0)
+                                  + time.perf_counter() - t0)

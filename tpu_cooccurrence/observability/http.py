@@ -90,7 +90,7 @@ class MetricsServer:
                  stale_after_s: float = 300.0,
                  supervisor_info: Optional[dict] = None,
                  serving=None, serve_stale_after_s: float = 0.0,
-                 peers=None) -> None:
+                 peers=None, last_window=None) -> None:
         self.registry = registry
         self.counters = counters
         self.ledger = ledger
@@ -106,6 +106,10 @@ class MetricsServer:
         # 503s ("peer_stale") when any peer is stale — the
         # load-balancer drain signal ahead of the gang restart.
         self.peers = peers
+        # Tracing plane: a callable returning the job's last-window
+        # stage breakdown (job.last_window_health) — /healthz shows a
+        # wedged stage without anyone pulling the journal.
+        self.last_window = last_window
         self._started_unix = time.time()
         # Per-route request-latency histograms, registered up front so
         # they render on /metrics (at zero) from the first scrape.
@@ -251,6 +255,12 @@ class MetricsServer:
                 status = payload["status"] = "peer_stale"
         if self.supervisor_info is not None:
             payload["last_restart"] = self.supervisor_info
+        if self.last_window is not None:
+            # Per-stage seconds + fused flag + window_seq of the newest
+            # completed window (None until the first window fires).
+            lw = self.last_window()
+            if lw is not None:
+                payload["last_window"] = lw
         return payload, status not in ("stale", "paused", "snapshot_stale",
                                        "peer_stale")
 

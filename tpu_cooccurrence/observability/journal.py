@@ -36,6 +36,23 @@ cost trajectory. Serving replicas (``serving/replica.py``) append
 ``"replica"`` key): one per delta generation replayed — the replica's
 own flight record of its catch-up trajectory (generation, rows
 replayed, lag behind the writer, resync count).
+
+**Correlation fields (the tracing plane).** Every record type carries
+the same optional trio — ``run_id`` (minted once by the supervising
+parent or the CLI and inherited by every child process and restart
+attempt through :data:`RUN_ID_ENV`), ``process_id`` (gang/fleet slot)
+and ``attempt`` (supervisor restart ordinal, :data:`ATTEMPT_ENV`) — so
+``cooc-trace`` (:mod:`.trace`) can merge a fleet's journals into one
+timeline and stitch pre-crash records to their post-restart successors.
+Window and replica records additionally carry ``spans``: ordered
+``[stage, start_offset_s, seconds]`` tuples (:data:`SPAN_STAGES` /
+:data:`REPLICA_SPAN_STAGES`) formalizing the stage-seconds breakdown.
+The core window stages (``ingest-admission`` → ``sample`` →
+``uplink-encode`` → ``dispatch`` → ``rescore``) partition
+``sample_seconds + score_seconds`` exactly; the boundary stages
+(``snapshot-publish``, ``checkpoint-commit``) run after the record is
+flushed, so they are journaled on the first record *after* the boundary
+work ran and excluded from the wall-seconds reconciliation.
 """
 
 from __future__ import annotations
@@ -44,12 +61,62 @@ import io
 import json
 import os
 import threading
-from typing import Dict, Iterator, List, Optional
+import uuid
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..robustness import faults
 
 #: Journal format version (bump on breaking schema changes).
 VERSION = 1
+
+#: Env var carrying the fleet-wide run id: minted once by whichever
+#: process is the root of the tree (gang supervisor, single-process
+#: supervisor, replica-fleet supervisor, or an unsupervised CLI job)
+#: and inherited by every child so one run's journals join on it.
+RUN_ID_ENV = "TPU_COOC_RUN_ID"
+
+#: Env var carrying the supervisor restart ordinal (0 = first attempt).
+#: Threaded through both supervisors so a restart's journal records
+#: link to the prior attempt's instead of starting an unrelated stream.
+ATTEMPT_ENV = "TPU_COOC_ATTEMPT"
+
+#: Canonical window-record span stages, in lifecycle order. The first
+#: five partition ``sample_seconds + score_seconds`` exactly; the last
+#: two are boundary stages measured after the record flushes (journaled
+#: on the NEXT record, excluded from wall-seconds reconciliation).
+SPAN_STAGES = ("ingest-admission", "sample", "uplink-encode", "dispatch",
+               "rescore", "snapshot-publish", "checkpoint-commit")
+
+#: Replica-record span stages: replay one delta generation, then swap
+#: the snapshot — the window's lifetime across the process boundary.
+REPLICA_SPAN_STAGES = ("delta-apply", "publish")
+
+#: Correlation trio shared by every record type (all optional: journals
+#: written before the tracing plane stay valid).
+_CORRELATION_FIELDS = {
+    "run_id": (False, str),      # fleet-wide run id (RUN_ID_ENV)
+    "process_id": (False, int),  # gang/fleet slot (0 single-process)
+    "attempt": (False, int),     # supervisor restart ordinal
+}
+
+
+def mint_run_id() -> str:
+    """A fresh run id (12 hex chars — short enough to read in a log
+    line, random enough that two fleets over one state dir never
+    collide)."""
+    return uuid.uuid4().hex[:12]
+
+
+def run_context() -> Tuple[str, int]:
+    """(run_id, attempt) for this process: inherited from the
+    supervising parent's env when present, otherwise a fresh mint with
+    attempt 0 (the unsupervised-run shape)."""
+    run_id = os.environ.get(RUN_ID_ENV) or mint_run_id()
+    try:
+        attempt = int(os.environ.get(ATTEMPT_ENV, "0"))
+    except ValueError:
+        attempt = 0
+    return run_id, attempt
 
 #: Field name -> (required, type). ``counters`` / ``wire`` hold per-window
 #: deltas (not totals); empty deltas are omitted from ``counters``.
@@ -101,6 +168,13 @@ SCHEMA = {
     # written — restart forensics show which epoch the gang resumed
     # from.
     "epoch": (False, int),
+    # Tracing plane (this module + trace.py): fleet-wide correlation
+    # trio, uniform across every record type.
+    "run_id": (False, str),      # fleet run id (RUN_ID_ENV)
+    "process_id": (False, int),  # gang/fleet slot (0 single-process)
+    "attempt": (False, int),     # supervisor restart ordinal
+    "spans": (False, list),      # ordered [stage, start_offset_s,
+                                 # seconds] tuples (SPAN_STAGES)
 }
 
 
@@ -112,6 +186,10 @@ EVENT_SCHEMA = {
     "v": (True, int),
     "event": (True, str),
     "wall_unix": (True, float),
+    "window_seq": (False, int),  # fired-window ordinal at emit time
+    "run_id": (False, str),
+    "process_id": (False, int),
+    "attempt": (False, int),
 }
 
 
@@ -129,6 +207,14 @@ CKPT_SCHEMA = {
     "seconds": (True, float),    # commit wall seconds
     "chain_len": (True, int),    # delta generations behind this one
     "wall_unix": (True, float),
+    "window_seq": (False, int),  # fired-window ordinal at commit — the
+                                 # window→generation join cooc-trace
+                                 # uses for freshness
+    "generation": (False, int),  # uniform join-key alias of
+                                 # "checkpoint" (same value)
+    "run_id": (False, str),
+    "process_id": (False, int),
+    "attempt": (False, int),
 }
 
 
@@ -148,6 +234,9 @@ AUTOSCALE_SCHEMA = {
     "window": (True, int),       # fired-window ordinal of the drain
     "cooldown": (True, int),     # policy cooldown windows armed
     "wall_unix": (True, float),
+    "run_id": (False, str),
+    "process_id": (False, int),
+    "attempt": (False, int),
 }
 
 
@@ -166,7 +255,39 @@ REPLICA_SCHEMA = {
     "lag": (True, int),          # newest on-disk generation - replayed
     "resyncs": (True, int),      # checkpoint resyncs so far
     "wall_unix": (True, float),
+    "generation": (False, int),  # uniform join-key alias of "replica"
+                                 # (same value)
+    "run_id": (False, str),
+    "process_id": (False, int),
+    "attempt": (False, int),
+    "spans": (False, list),      # [stage, start_offset_s, seconds]
+                                 # tuples (REPLICA_SPAN_STAGES)
 }
+
+
+def _validate_spans(spans: list, stages: tuple, rec: dict) -> None:
+    """Spans are ordered ``[stage, start_offset_s, seconds]`` triples
+    whose stages come from the canonical table and appear in table
+    order (a stage may be absent, never out of order)."""
+    last_idx = -1
+    for span in spans:
+        if (not isinstance(span, (list, tuple)) or len(span) != 3
+                or not isinstance(span[0], str)
+                or any(isinstance(x, bool)
+                       or not isinstance(x, (int, float))
+                       for x in span[1:])):
+            raise ValueError(
+                f"journal span {span!r} is not [stage, start_offset_s, "
+                f"seconds]: {rec}")
+        if span[0] not in stages:
+            raise ValueError(
+                f"journal span stage {span[0]!r} not in {stages}: {rec}")
+        idx = stages.index(span[0])
+        if idx <= last_idx:
+            raise ValueError(
+                f"journal span stage {span[0]!r} out of order "
+                f"(canonical order {stages}): {rec}")
+        last_idx = idx
 
 
 def validate_record(rec: dict) -> None:
@@ -214,6 +335,8 @@ def validate_record(rec: dict) -> None:
                 f"{unknown}: {rec}")
         if rec["v"] != VERSION:
             raise ValueError(f"journal version {rec['v']} != {VERSION}")
+        if "spans" in rec:
+            _validate_spans(rec["spans"], REPLICA_SPAN_STAGES, rec)
         return
     if "checkpoint" in rec:
         for field, (required, typ) in CKPT_SCHEMA.items():
@@ -269,6 +392,8 @@ def validate_record(rec: dict) -> None:
         raise ValueError(f"journal record has unknown fields {unknown}: {rec}")
     if rec["v"] != VERSION:
         raise ValueError(f"journal version {rec['v']} != {VERSION}")
+    if "spans" in rec:
+        _validate_spans(rec["spans"], SPAN_STAGES, rec)
 
 
 class RunJournal:

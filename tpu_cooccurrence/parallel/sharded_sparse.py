@@ -66,7 +66,7 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
-from ..observability import LEDGER
+from ..observability import LEDGER, StageClock
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              narrow_deltas_int32)
 from ..ops.device_scorer import pad_pow2, pad_pow4
@@ -222,6 +222,9 @@ class ShardedSparseScorer:
             fused_window)
         self.last_dispatch_fused = False
         self.last_fallback_reason: Optional[str] = None
+        # Tracing plane: per-window stage-seconds (uplink-encode /
+        # rescore) the job carves into journal span tuples.
+        self.stage_clock = StageClock()
         self._fused_shapes = set()
         # The rescale/restore seam and cold start: bucket plans must
         # rebuild from live registry state before any fused static plan
@@ -584,6 +587,7 @@ class ShardedSparseScorer:
         self.last_dispatched_rows = 0
         self.last_dispatch_fused = False
         self.last_fallback_reason = None
+        self.stage_clock.reset()
         D = self.n_shards
         if len(pairs) == 0:
             if self.defer_results:
@@ -658,17 +662,18 @@ class ShardedSparseScorer:
             return TopKBatch.empty(self.top_k)
 
         self._record_dispatch_gauges(fused=False)
-        if cell_wide is not None and cell_wide.any():
-            # Wide rows ride the same update program on the wide slab
-            # pair; row sums travel once, with the narrow call.
-            self._window_update(src_d[~cell_wide], dst_d[~cell_wide],
-                                d_val32[~cell_wide], rows, rs_delta)
-            self._window_update(src_d[cell_wide], dst_d[cell_wide],
-                                d_val32[cell_wide], rows[:0], rs_delta[:0],
-                                wide=True)
-        else:
-            self._window_update(src_d, dst_d, d_val32, rows, rs_delta,
-                                prealloc=prealloc)
+        with self.stage_clock.stage("uplink-encode"):
+            if cell_wide is not None and cell_wide.any():
+                # Wide rows ride the same update program on the wide slab
+                # pair; row sums travel once, with the narrow call.
+                self._window_update(src_d[~cell_wide], dst_d[~cell_wide],
+                                    d_val32[~cell_wide], rows, rs_delta)
+                self._window_update(src_d[cell_wide], dst_d[cell_wide],
+                                    d_val32[cell_wide], rows[:0],
+                                    rs_delta[:0], wide=True)
+            else:
+                self._window_update(src_d, dst_d, d_val32, rows, rs_delta,
+                                    prealloc=prealloc)
 
         if self.development_mode:
             self._check_row_sums(rows)
@@ -676,14 +681,15 @@ class ShardedSparseScorer:
         self.counters.add(RESCORED_ITEMS, len(rows))
         self.last_dispatched_rows = len(rows)
         _record_shard_metrics(len(rows), owner_counts)
-        if self.indexes_w is not None and self.wide_rows[rows].any():
-            wmask = self.wide_rows[rows]
-            chunks = self._dispatch_scoring(rows[~wmask],
-                                            row_owner[~wmask])
-            chunks += self._dispatch_scoring(rows[wmask],
-                                             row_owner[wmask], wide=True)
-        else:
-            chunks = self._dispatch_scoring(rows, row_owner)
+        with self.stage_clock.stage("rescore"):
+            if self.indexes_w is not None and self.wide_rows[rows].any():
+                wmask = self.wide_rows[rows]
+                chunks = self._dispatch_scoring(rows[~wmask],
+                                                row_owner[~wmask])
+                chunks += self._dispatch_scoring(rows[wmask],
+                                                 row_owner[wmask], wide=True)
+            else:
+                chunks = self._dispatch_scoring(rows, row_owner)
         self._record_state_gauges()
         prev, self._pending = self._pending, chunks
         return (self._materialize(prev) if prev is not None
@@ -1041,17 +1047,20 @@ class ShardedSparseScorer:
             # Ownership-partitioned packed uplink: each shard's sections
             # encode independently; word streams pad to the widest
             # shard's pow2 bucket (+1 guard word for the decode gather).
-            enc = [encode_update(upd[d], bounds[d], n_per[d])
-                   for d in range(D)]
-            wi_w = pad_pow2(max(len(e[0]) for e in enc) + 1, minimum=256)
-            wv_w = pad_pow2(max(len(e[1]) for e in enc) + 1, minimum=256)
-            wi = np.zeros((D, wi_w), dtype=np.uint32)
-            wv = np.zeros((D, wv_w), dtype=np.uint32)
-            hdr = np.zeros((D, 5), dtype=np.int32)
-            for d, (ei, ev, eh) in enumerate(enc):
-                wi[d, : len(ei)] = ei
-                wv[d, : len(ev)] = ev
-                hdr[d] = eh
+            with self.stage_clock.stage("uplink-encode"):
+                enc = [encode_update(upd[d], bounds[d], n_per[d])
+                       for d in range(D)]
+                wi_w = pad_pow2(max(len(e[0]) for e in enc) + 1,
+                                minimum=256)
+                wv_w = pad_pow2(max(len(e[1]) for e in enc) + 1,
+                                minimum=256)
+                wi = np.zeros((D, wi_w), dtype=np.uint32)
+                wv = np.zeros((D, wv_w), dtype=np.uint32)
+                hdr = np.zeros((D, 5), dtype=np.int32)
+                for d, (ei, ev, eh) in enumerate(enc):
+                    wi[d, : len(ei)] = ei
+                    wv[d, : len(ev)] = ev
+                    hdr[d] = eh
             LEDGER.up_encoded("fused-window-packed",
                               upd.nbytes + bounds.nbytes, wi, wv, hdr)
             LEDGER.up("fused-window-meta", reg_upd, rows_all)

@@ -94,6 +94,8 @@ class StagedWindow:
     slot: Optional["_StagingSlot"] = None  # ring slot backing the payload
     seq: int = 0             # fired-window ordinal (journal record id)
     stall_seconds: float = 0.0  # producer wait for a free ring slot
+    admit_seconds: float = 0.0  # admission-cut share of sample_seconds
+                                # (the journal's ingest-admission span)
 
 
 class _StagingSlot:
@@ -278,6 +280,7 @@ class PipelineDriver:
             sample_seconds=item.sample_seconds,
             score_seconds=score_clock.seconds),
             seq=item.seq, ring_depth=ring_depth,
-            stall_seconds=item.stall_seconds)
+            stall_seconds=item.stall_seconds,
+            admit_seconds=item.admit_seconds)
         job._absorb(window_out)
         self.windows_processed += 1

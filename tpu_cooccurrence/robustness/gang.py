@@ -489,6 +489,12 @@ class GangSupervisor:
         self.scale_policy = scale_policy
         self.rescales = 0
         self._pending: Optional[dict] = None
+        # Tracing correlation: one run id for the whole gang's lifetime
+        # — every worker, every restart attempt, every rescale topology
+        # journals under it (inherited when an outer parent already
+        # minted one).
+        from ..observability.journal import RUN_ID_ENV, mint_run_id
+        self.run_id = os.environ.get(RUN_ID_ENV) or mint_run_id()
         os.makedirs(gang_dir, exist_ok=True)
 
     # -- one attempt ---------------------------------------------------
@@ -516,9 +522,13 @@ class GangSupervisor:
                 os.remove(autoscale.request_path(self.gang_dir))
             except OSError:
                 pass
+        from ..observability.journal import ATTEMPT_ENV, RUN_ID_ENV
+
         coordinator = f"127.0.0.1:{_free_port()}"
         env = dict(os.environ)
         env[GANG_DIR_ENV] = self.gang_dir
+        env[RUN_ID_ENV] = self.run_id
+        env[ATTEMPT_ENV] = str(restarts)
         env[SUPERVISOR_STATE_ENV] = json.dumps({
             "restarts": restarts,
             "last_rc": last_rc,
@@ -527,6 +537,8 @@ class GangSupervisor:
             "stepped_back": False,
             "rescales": self.rescales,
             "target_workers": self.num_workers,
+            "run_id": self.run_id,
+            "attempt": restarts,
         })
         workers = []
         now = time.monotonic()
@@ -883,15 +895,26 @@ class ReplicaFleetSupervisor:
         self.relaunches = 0
         self._stop = threading.Event()
         self._workers: List[Optional[_Worker]] = [None] * num_replicas
+        # Tracing correlation: one run id for the fleet; each slot's
+        # relaunch count is its attempt ordinal (replicas restart
+        # independently, so the ordinal is per-slot, not fleet-wide).
+        from ..observability.journal import RUN_ID_ENV, mint_run_id
+        self.run_id = os.environ.get(RUN_ID_ENV) or mint_run_id()
+        self._slot_attempts = [0] * num_replicas
         os.makedirs(gang_dir, exist_ok=True)
 
     def _spawn_one(self, pid: int) -> _Worker:
+        from ..observability.journal import ATTEMPT_ENV, RUN_ID_ENV
+
         try:
             os.remove(heartbeat_path(self.gang_dir, pid))
         except OSError:
             pass
         env = dict(os.environ)
         env[GANG_DIR_ENV] = self.gang_dir
+        env[RUN_ID_ENV] = self.run_id
+        env[ATTEMPT_ENV] = str(self._slot_attempts[pid])
+        self._slot_attempts[pid] += 1
         spool = tempfile.TemporaryFile()
         proc = subprocess.Popen(self.child_argv_fn(pid), stdout=spool,
                                 env=env)

@@ -101,11 +101,22 @@ class ReadReplica:
 
     def __init__(self, state_dir: str, suffix: str = "",
                  history_len: int = 50, query_slo_s: float = 0.0,
-                 journal: Optional[str] = None) -> None:
+                 journal: Optional[str] = None,
+                 process_id: int = 0) -> None:
         self.state_dir = state_dir
         self.suffix = suffix
         self.history_len = history_len
         self.query_slo_s = query_slo_s
+        # Tracing correlation (observability/journal.py): a fleet child
+        # inherits the supervisor's run id + its slot's relaunch
+        # ordinal; a standalone replica mints its own. Launch the
+        # writer and a standalone replica with the same TPU_COOC_RUN_ID
+        # (or --run-id on the writer) to join them in one trace;
+        # cooc-trace also joins across run ids on the shared state
+        # dir's generation stream.
+        from ..observability.journal import run_context
+        self.run_id, self.attempt = run_context()
+        self.process_id = int(process_id)
         #: Delta-log generation the published snapshot is replayed to.
         self.generation = -1
         self.bootstrap_generation = -1
@@ -345,6 +356,7 @@ class ReadReplica:
             raise DeltaCorrupt(
                 f"delta generation {d.gen} user-vocab appends do not "
                 f"extend the replica")
+        t0 = time.perf_counter()
         if len(d.voc_items):
             self.item_vocab.map_batch(d.voc_items)
         if len(d.voc_users):
@@ -359,7 +371,9 @@ class ReadReplica:
         if len(d.usr_rows):
             self.plane.history.set_rows(d.usr_rows, d.usr_lens,
                                         d.usr_hist)
+        apply_s = time.perf_counter() - t0
         self.plane.publish(generation=d.gen)
+        publish_s = time.perf_counter() - t0 - apply_s
         self.generation = d.gen
         self.deltas_applied += 1
         self._gauge_gen.set(d.gen)
@@ -373,6 +387,17 @@ class ReadReplica:
                 "rows": self.plane.rows, "topk_rows": topk_rows,
                 "lag": self.lag(newest), "resyncs": self.resyncs,
                 "wall_unix": round(time.time(), 3),
+                # Tracing plane: the window's lifetime across the
+                # process boundary — the uniform generation join key
+                # plus the replay's own delta-apply -> publish span
+                # pair (journal.REPLICA_SPAN_STAGES).
+                "generation": d.gen,
+                "run_id": self.run_id,
+                "process_id": self.process_id,
+                "attempt": self.attempt,
+                "spans": [["delta-apply", 0.0, round(apply_s, 9)],
+                          ["publish", round(apply_s, 9),
+                           round(publish_s, 9)]],
             })
 
     def close(self) -> None:
@@ -605,7 +630,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     replica = ReadReplica(args.state_dir,
                           history_len=args.serve_history,
-                          journal=args.journal)
+                          journal=args.journal,
+                          process_id=args.process_id or 0)
     deadline = time.monotonic() + args.bootstrap_timeout_s
     while True:
         try:

@@ -58,7 +58,7 @@ import jax
 import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
-from ..observability import LEDGER
+from ..observability import LEDGER, StageClock
 from ..observability.registry import REGISTRY
 from ..robustness import faults
 from ..ops.aggregate import (AggregatedPairs, aggregate_window_coo,
@@ -1587,6 +1587,10 @@ class SparseDeviceScorer:
         # Which path the LAST process_window dispatch took — the job's
         # fused-vs-chained wall-time split and journal field read it.
         self.last_dispatch_fused = False
+        # Tracing plane: per-window stage-seconds (uplink-encode /
+        # rescore) the job carves into journal span tuples; the
+        # unattributed remainder of score_seconds becomes "dispatch".
+        self.stage_clock = StageClock()
         self._fused_dispatches = REGISTRY.gauge(
             "cooc_fused_dispatches_total",
             help="windows dispatched through the fused one-dispatch "
@@ -1688,6 +1692,7 @@ class SparseDeviceScorer:
             faults.PLAN.fire("scorer_breaker", seq=self._breaker_seq)
         self.last_dispatched_rows = 0
         self.last_dispatch_fused = False
+        self.stage_clock.reset()
         if len(pairs) == 0:
             if self.defer_results:
                 # Idle window: results are intentionally held on device for
@@ -1789,16 +1794,17 @@ class SparseDeviceScorer:
             return TopKBatch.empty(self.top_k)
 
         self._chained_dispatches.add(1)
-        if cell_wide is not None and (cell_wide.any()
-                                      or promo_w is not None):
-            self._window_update(d_key[~cell_wide], d_val32[~cell_wide],
-                                rows, rs_delta, wide=False, promo=promo_n)
-            self._window_update(d_key[cell_wide], d_val32[cell_wide],
-                                rows[:0], rs_delta[:0], wide=True,
-                                promo=promo_w)
-        else:
-            self._window_update(d_key, d_val32, rows, rs_delta,
-                                wide=False, promo=promo_n, plan=pre_plan)
+        with self.stage_clock.stage("uplink-encode"):
+            if cell_wide is not None and (cell_wide.any()
+                                          or promo_w is not None):
+                self._window_update(d_key[~cell_wide], d_val32[~cell_wide],
+                                    rows, rs_delta, wide=False, promo=promo_n)
+                self._window_update(d_key[cell_wide], d_val32[cell_wide],
+                                    rows[:0], rs_delta[:0], wide=True,
+                                    promo=promo_w)
+            else:
+                self._window_update(d_key, d_val32, rows, rs_delta,
+                                    wide=False, promo=promo_n, plan=pre_plan)
 
         if self.development_mode:
             self._check_row_sums(rows)
@@ -1806,12 +1812,13 @@ class SparseDeviceScorer:
         # Score every updated row, length-bucketed (padding is device-only).
         self.counters.add(RESCORED_ITEMS, len(rows))
         self.last_dispatched_rows = len(rows)
-        if self.index_w is not None and self.wide_rows[rows].any():
-            wmask = self.wide_rows[rows]
-            chunks = self._dispatch_scoring(rows[~wmask], wide=False)
-            chunks += self._dispatch_scoring(rows[wmask], wide=True)
-        else:
-            chunks = self._dispatch_scoring(rows)
+        with self.stage_clock.stage("rescore"):
+            if self.index_w is not None and self.wide_rows[rows].any():
+                wmask = self.wide_rows[rows]
+                chunks = self._dispatch_scoring(rows[~wmask], wide=False)
+                chunks += self._dispatch_scoring(rows[wmask], wide=True)
+            else:
+                chunks = self._dispatch_scoring(rows)
         self._record_state_gauges()
 
         prev, self._pending = self._pending, chunks
@@ -2027,8 +2034,9 @@ class SparseDeviceScorer:
             return False, plan
         self._ensure_heap(self.index.heap_end)
 
-        upd, bounds, n = self._pack_update(self.index, plan, d_key,
-                                           d_val32, rows, rs_delta, None)
+        with self.stage_clock.stage("uplink-encode"):
+            upd, bounds, n = self._pack_update(self.index, plan, d_key,
+                                               d_val32, rows, rs_delta, None)
         n_pad = upd.shape[1]
         if split_upload_auto(upd) is not None:
             return False, plan
@@ -2085,9 +2093,10 @@ class SparseDeviceScorer:
         if self.wire_packed:
             from .wire import encode_update
 
-            words_i, words_v, header = encode_update(upd, bounds, n)
-            wi = _pad_words(words_i)
-            wv = _pad_words(words_v)
+            with self.stage_clock.stage("uplink-encode"):
+                words_i, words_v, header = encode_update(upd, bounds, n)
+                wi = _pad_words(words_i)
+                wv = _pad_words(words_v)
             LEDGER.up_encoded("fused-window-packed",
                               upd.nbytes + bounds.nbytes, wi, wv, header)
             LEDGER.up("fused-window-meta", reg_upd, rows_all)

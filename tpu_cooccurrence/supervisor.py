@@ -255,6 +255,8 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
     jitter capped at ``backoff_max_s``. ``checkpoint_dir`` arms the
     crash-loop breaker's generation step-back.
     """
+    from .observability.journal import ATTEMPT_ENV, RUN_ID_ENV, mint_run_id
+
     sink = stdout if stdout is not None else sys.stdout
     restarts = 0
     stepped_back = False
@@ -262,18 +264,28 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
     failure_times: List[float] = []
     prev_delay = backoff_base_s if backoff_base_s is not None else delay_s
     last_rc = 0
+    # Tracing correlation: mint the fleet run id ONCE, before the first
+    # attempt, and hand every attempt the same id plus its restart
+    # ordinal — a post-crash child's journal records then stitch to the
+    # prior attempt's instead of starting an unrelated stream. An
+    # already-present env id (outer supervisor, operator) is inherited.
+    run_id = os.environ.get(RUN_ID_ENV) or mint_run_id()
     while True:
         # Journal size at spawn: the crash-forensics quote below must only
         # fire for records THIS attempt wrote (append mode keeps earlier
         # attempts' records in the same file).
         journal_size_before = _journal_size(journal_path)
         env = dict(os.environ)
+        env[RUN_ID_ENV] = run_id
+        env[ATTEMPT_ENV] = str(restarts)
         env[SUPERVISOR_STATE_ENV] = json.dumps({
             "restarts": restarts,
             "last_rc": last_rc,
             "backoff_ms": int(prev_delay * 1000) if restarts else 0,
             "last_restart_unix": round(time.time(), 3) if restarts else 0,
             "stepped_back": stepped_back,
+            "run_id": run_id,
+            "attempt": restarts,
         })
         # One anonymous spool per attempt: auto-deleted on close, so a
         # failed attempt's partial output vanishes without cleanup code.
