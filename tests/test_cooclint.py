@@ -1559,3 +1559,111 @@ def test_journal_registry_clean_on_repo():
     result = Analyzer(REPO, rules=[RULES["journal-schema-registry"]],
                       baseline=[]).run()
     assert result.findings == []
+
+
+# -- rule pack: ingest offset-codec registry (ISSUE 18) -----------------
+
+
+_OK_FILES_SRC = ("def offsets_state(self):\n"
+                 "    in_flight = {\"path\": self.p}\n"
+                 "    offsets = {\"v\": 1, \"in_flight\": in_flight}\n"
+                 "    return offsets\n\n\n"
+                 "def restore_offsets(self, state):\n"
+                 "    self.v = state.get(\"v\")\n"
+                 "    guard = state.get(\"in_flight\")\n"
+                 "    self.p = guard[\"path\"]\n")
+
+_OK_PART_SRC = ("def offsets_state(self):\n"
+                "    partitions = {}\n"
+                "    partitions[name] = {\"byte_offset\": 0}\n"
+                "    offsets = {\"v\": 1, \"partitions\": partitions}\n"
+                "    return offsets\n\n\n"
+                "def restore_offsets(self, state):\n"
+                "    self.v = state.get(\"v\")\n"
+                "    for e in state[\"partitions\"].values():\n"
+                "        self.b = e[\"byte_offset\"]\n")
+
+
+def _mini_ingest_repo(tmp_path, *, files_src=_OK_FILES_SRC,
+                      part_src=_OK_PART_SRC, test_body="x = 1\n"):
+    root = tmp_path / "repo"
+    io_dir = root / "tpu_cooccurrence" / "io"
+    io_dir.mkdir(parents=True)
+    (io_dir / "source.py").write_text(files_src)
+    (io_dir / "partitioned.py").write_text(part_src)
+    (root / "tests").mkdir()
+    (root / "tests" / "test_ingest_fixture.py").write_text(test_body)
+    return root
+
+
+def test_ingest_registry_clean_fixture_passes(tmp_path):
+    root = _mini_ingest_repo(
+        tmp_path,
+        test_body=("KEYS = {\"v\", \"in_flight\", \"path\", "
+                   "\"partitions\", \"byte_offset\"}\n"))
+    result = Analyzer(str(root), rules=[RULES["ingest-offset-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+def test_ingest_registry_flags_writer_only_key(tmp_path):
+    """An offset field with no restore-side reader silently stops
+    steering where the wire resumes — the drift this rule exists for."""
+    root = _mini_ingest_repo(
+        tmp_path,
+        files_src=("def offsets_state(self):\n"
+                   "    offsets = {\"v\": 1, \"orphan\": 2}\n"
+                   "    return offsets\n\n\n"
+                   "def restore_offsets(self, state):\n"
+                   "    self.v = state.get(\"v\")\n"),
+        test_body="KEYS = {\"v\", \"orphan\", \"partitions\", "
+                  "\"byte_offset\"}\n")
+    msgs = [f.message for f in Analyzer(
+        str(root), rules=[RULES["ingest-offset-registry"]],
+        baseline=[]).run().findings]
+    assert any("'orphan'" in m and "never read back" in m for m in msgs)
+    # The healthy partitioned module contributed no findings.
+    assert not any("byte_offset" in m for m in msgs)
+
+
+def test_ingest_registry_flags_untested_key(tmp_path):
+    root = _mini_ingest_repo(
+        tmp_path,
+        test_body="KEYS = {\"v\", \"in_flight\", \"path\", "
+                  "\"partitions\"}\n")  # byte_offset missing
+    msgs = [f.message for f in Analyzer(
+        str(root), rules=[RULES["ingest-offset-registry"]],
+        baseline=[]).run().findings]
+    assert len(msgs) == 1
+    assert "'byte_offset'" in msgs[0]
+    assert "round-trip reference" in msgs[0]
+    assert "test_ingest_offsets.py" in msgs[0]
+
+
+def test_ingest_registry_flags_vanished_module(tmp_path):
+    """One end of the codec going missing is a finding (the other
+    module is still present, so the scope guard does not waive it)."""
+    root = _mini_ingest_repo(
+        tmp_path,
+        test_body=("KEYS = {\"v\", \"in_flight\", \"path\", "
+                   "\"partitions\", \"byte_offset\"}\n"))
+    os.remove(root / "tpu_cooccurrence" / "io" / "partitioned.py")
+    msgs = [f.message for f in Analyzer(
+        str(root), rules=[RULES["ingest-offset-registry"]],
+        baseline=[]).run().findings]
+    assert any("missing" in m for m in msgs)
+
+
+def test_ingest_registry_silent_without_ingest_modules():
+    """Fixture repos for other rules must not trip this rule."""
+    assert analyze_source(
+        "offsets = {\"v\": 1}\n", path="tpu_cooccurrence/other.py",
+        rules=["ingest-offset-registry"]) == []
+
+
+def test_ingest_registry_clean_on_repo():
+    """The real sources, their restore paths and the
+    tests/test_ingest_offsets.py registry are in sync right now."""
+    result = Analyzer(REPO, rules=[RULES["ingest-offset-registry"]],
+                      baseline=[]).run()
+    assert result.findings == []

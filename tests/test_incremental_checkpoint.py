@@ -570,14 +570,14 @@ META_KEYS = {
     "window_slide", "window_millis", "windows_fired", "emissions",
     "emissions_per_window_resume", "max_ts_seen", "counters",
     "source", "ckpt_codec", "ckpt_delta", "gang_topology",
-    "rescaled_from",
+    "rescaled_from", "ingest_offsets",
 }
 
 #: Delta-file header keys ``delta.encode_delta`` writes.
 HEADER_KEYS = {
     "v", "gen", "prev", "base", "kind", "observed", "row_sums_len",
     "n_rows", "n_shards", "local_shards", "hist_k", "item_vocab_len",
-    "user_vocab_len", "payload", "sections",
+    "user_vocab_len", "payload", "sections", "ingest_offsets",
 }
 
 
@@ -591,7 +591,7 @@ def test_checkpoint_format_keys_pinned(chain_repo):
     data = ckpt._load_verified(path)
     meta = json.loads(bytes(data["meta_json"]).decode())
     optional = {"source", "ckpt_codec", "ckpt_delta", "gang_topology",
-                "rescaled_from"}
+                "rescaled_from", "ingest_offsets"}
     assert META_KEYS - optional <= set(meta) <= META_KEYS
     rec = read_delta_file(
         deltalog.delta_path(d, "", deltalog.delta_generations(d, "")[-1]))

@@ -1,0 +1,363 @@
+"""Ingest offset codec (ISSUE 18): ``meta["ingest_offsets"]``.
+
+The contracts under test:
+
+* **Registry exactness** — :data:`OFFSET_KEYS` is the canonical list of
+  every field either source writes into its offset section (the
+  ``ingest-offset-registry`` cooclint rule points here); the sections
+  the real sources produce carry exactly these keys, no more, no less.
+* **Round-trip** — a section committed by ``job.checkpoint(source=...)``
+  rides the npz meta (and the incremental delta header) verbatim, and a
+  fresh job + source restored from it reproduce the identical section —
+  across cell dtypes, wire formats and StateStores.
+* **Legacy fallback** — a checkpoint written before the offset section
+  existed restores from the cursor markers with the documented warning.
+* **Rescale merge** — :func:`checkpoint.merge_ingest_offsets` keeps the
+  owner's copy under agreement, takes the conservative minimum (loudly)
+  under disagreement, and resets the rotation cursor when writers
+  disagree on it.
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from tpu_cooccurrence.config import Backend, Config
+from tpu_cooccurrence.io.partitioned import PartitionedLogSource
+from tpu_cooccurrence.io.source import FileMonitorSource
+from tpu_cooccurrence.job import CooccurrenceJob
+from tpu_cooccurrence.state import checkpoint as ckpt
+from tpu_cooccurrence.state import delta as deltalog
+
+from test_pipeline import random_stream
+
+#: Canonical ingest-offset codec: every string key either source writes
+#: into its ``offsets_state()`` section. The baseline-free cooclint rule
+#: ``ingest-offset-registry`` (analysis/rules_ingest.py) requires each
+#: key to appear under tests/ — this list is that reference, and
+#: test_offset_key_registry_is_exact pins it against the real sections.
+OFFSET_KEYS = [
+    # section envelope (both formats)
+    "v", "format",
+    # files format: FileMonitorSource's in-flight rewrite guard
+    "in_flight", "path", "mtime", "size", "head_hash",
+    # partitioned format: per-partition cursors + the rotation cursor
+    "partitions", "byte_offset", "records", "quarantined",
+    "rr_part", "rr_remaining",
+]
+
+#: StateStore selection via Config knobs (the test_state_store trio).
+STORES = {
+    "direct": {},
+    "tiered": dict(spill_threshold_windows=2, spill_target_hbm_frac=0.0),
+    "sharded": dict(num_shards=2),
+}
+
+
+def cfg(tmp_path, subdir="ckpt", incremental=False, **kw):
+    kw.setdefault("backend", Backend.SPARSE)
+    kw.setdefault("window_size", 10)
+    kw.setdefault("seed", 0xABCD)
+    kw.setdefault("item_cut", 5)
+    kw.setdefault("user_cut", 3)
+    kw.setdefault("development_mode", True)
+    return Config(checkpoint_dir=str(tmp_path / subdir),
+                  checkpoint_incremental=incremental, **kw)
+
+
+def feed(job, users, items, ts, chunk=97):
+    for lo in range(0, len(users), chunk):
+        job.add_batch(users[lo:lo + chunk], items[lo:lo + chunk],
+                      ts[lo:lo + chunk])
+
+
+def write_partitions(root, counts=(40, 40, 40)):
+    root.mkdir()
+    for p, n in enumerate(counts):
+        (root / f"part-{p:03d}").write_text(
+            "".join(f"p{p}:{i}\n" for i in range(n)))
+    return str(root)
+
+
+def consume(source, k):
+    it = source.lines()
+    return [next(it) for _ in range(k)], it
+
+
+def section_keys(section):
+    """Every codec key a section carries (partition NAMES are data, not
+    codec keys — descend into the per-partition entries only)."""
+    out = set(section)
+    if isinstance(section.get("in_flight"), dict):
+        out |= set(section["in_flight"])
+    for entry in (section.get("partitions") or {}).values():
+        out |= set(entry)
+    return out
+
+
+# -- registry exactness ------------------------------------------------
+
+
+def test_offset_key_registry_is_exact(tmp_path):
+    """OFFSET_KEYS == exactly the keys the real sources produce: a new
+    field must land here (and in a reader — the cooclint rule checks
+    that end) in the same PR."""
+    f = tmp_path / "events.csv"
+    f.write_text("".join(f"{i},{i},{i}\n" for i in range(10)))
+    files_src = FileMonitorSource(str(f))
+    consume(files_src, 4)  # mid-file, so the in-flight guard is armed
+    files_section = files_src.offsets_state()
+    assert files_section["format"] == "files"
+    assert files_section["in_flight"] is not None
+
+    part_src = PartitionedLogSource(
+        write_partitions(tmp_path / "plog"), turn_records=4)
+    consume(part_src, 9)  # mid-turn, so the rotation cursor is armed
+    part_section = part_src.offsets_state()
+    assert part_section["format"] == "partitioned"
+
+    produced = section_keys(files_section) | section_keys(part_section)
+    assert len(OFFSET_KEYS) == len(set(OFFSET_KEYS))
+    assert produced == set(OFFSET_KEYS), produced ^ set(OFFSET_KEYS)
+
+
+# -- checkpoint round-trips --------------------------------------------
+
+
+def _newest_meta(directory):
+    gen, path = ckpt.generations(directory, "")[0]
+    data = ckpt._load_verified(path)
+    return gen, path, json.loads(bytes(data["meta_json"]).decode())
+
+
+@pytest.mark.parametrize("store", sorted(STORES))
+@pytest.mark.parametrize("cell_dtype,wire_format", [
+    ("int32", "raw"),
+    ("int16", "packed"),
+])
+def test_partitioned_offsets_round_trip(tmp_path, store, cell_dtype,
+                                        wire_format):
+    """The committed section rides the npz meta verbatim and a restored
+    source reproduces it bit-for-bit — across stores, cell dtypes and
+    wire formats (the offset section must be codec-independent)."""
+    kw = dict(STORES[store], cell_dtype=cell_dtype,
+              wire_format=wire_format)
+    plog = write_partitions(tmp_path / "plog")
+    src = PartitionedLogSource(plog, turn_records=7)
+    consume(src, 53)  # 7 full turns + 4 into the 8th: mid-turn cursor
+    users, items, ts = random_stream(51, n=300, n_items=40, n_users=16)
+    job = CooccurrenceJob(cfg(tmp_path, **kw))
+    feed(job, users, items, ts)
+    job.checkpoint(source=src)
+    committed = src.offsets_state()
+    assert committed["rr_remaining"] not in (0, 7)  # genuinely mid-turn
+
+    _, _, meta = _newest_meta(job.config.checkpoint_dir)
+    assert meta["ingest_offsets"] == committed
+
+    job2 = CooccurrenceJob(cfg(tmp_path, **kw))
+    src2 = PartitionedLogSource(plog, turn_records=7)
+    job2.restore(source=src2)
+    src2._discover()
+    assert src2.offsets_state() == committed
+
+
+def test_files_offsets_round_trip(tmp_path):
+    f = tmp_path / "events.csv"
+    f.write_text("".join(f"{i},{i},{i}\n" for i in range(20)))
+    src = FileMonitorSource(str(f))
+    consume(src, 7)
+    users, items, ts = random_stream(52, n=120, n_items=20, n_users=10)
+    job = CooccurrenceJob(cfg(tmp_path))
+    feed(job, users, items, ts)
+    job.checkpoint(source=src)
+    committed = src.offsets_state()
+    assert committed["in_flight"]["path"] == str(f)
+    assert committed["in_flight"]["size"] == f.stat().st_size
+
+    _, _, meta = _newest_meta(job.config.checkpoint_dir)
+    assert meta["ingest_offsets"] == committed
+
+    job2 = CooccurrenceJob(cfg(tmp_path))
+    src2 = FileMonitorSource(str(f))
+    job2.restore(source=src2)
+    assert src2.offsets_state() == committed
+
+
+def test_incremental_chain_carries_offsets(tmp_path):
+    """Every delta generation's header carries the offsets committed at
+    its boundary (the replica/catch-up feed sees the wire position),
+    and a chain restore lands the NEWEST section."""
+    plog = write_partitions(tmp_path / "plog")
+    src = PartitionedLogSource(plog, turn_records=5)
+    it = src.lines()
+    users, items, ts = random_stream(53, n=600, n_items=50, n_users=20)
+    job = CooccurrenceJob(cfg(tmp_path, incremental=True))
+    feed(job, users[:300], items[:300], ts[:300])
+    for _ in range(31):
+        next(it)
+    job.checkpoint(source=src)
+    first = src.offsets_state()
+    feed(job, users[300:], items[300:], ts[300:])
+    for _ in range(40):
+        next(it)
+    job.checkpoint(source=src)
+    second = src.offsets_state()
+    assert second != first
+
+    directory = job.config.checkpoint_dir
+    gens = deltalog.delta_generations(directory, "")
+    assert gens, "incremental run wrote no delta generations"
+    d = deltalog.read_delta_file(
+        os.path.join(directory, f"delta.{gens[-1]}.bin"))
+    assert d.ingest_offsets == second
+    _, _, meta = _newest_meta(directory)
+    assert meta["ingest_offsets"] == second
+
+    job2 = CooccurrenceJob(cfg(tmp_path, incremental=True))
+    src2 = PartitionedLogSource(plog, turn_records=5)
+    job2.restore(source=src2)
+    src2._discover()
+    assert src2.offsets_state() == second
+
+
+# -- legacy fallback ---------------------------------------------------
+
+
+def test_legacy_checkpoint_without_offsets_warns(tmp_path, caplog):
+    """A pre-offset-section checkpoint (doctored npz: section removed,
+    digest recomputed) restores from the cursor markers with the
+    documented warning — marker-exact, but unguarded."""
+    f = tmp_path / "events.csv"
+    f.write_text("".join(f"{i},{i},{i}\n" for i in range(20)))
+    src = FileMonitorSource(str(f))
+    consume(src, 6)
+    users, items, ts = random_stream(54, n=120, n_items=20, n_users=10)
+    job = CooccurrenceJob(cfg(tmp_path))
+    feed(job, users, items, ts)
+    job.checkpoint(source=src)
+
+    _, path, meta = _newest_meta(job.config.checkpoint_dir)
+    assert "ingest_offsets" in meta
+    arrays = dict(ckpt._load_verified(path))
+    del meta["ingest_offsets"]
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    arrays["digest_sha256"] = np.frombuffer(
+        ckpt.compute_digest(arrays).encode(), dtype=np.uint8)
+    np.savez(path, **arrays)
+
+    job2 = CooccurrenceJob(cfg(tmp_path))
+    src2 = FileMonitorSource(str(f))
+    with caplog.at_level(logging.WARNING,
+                         logger="tpu_cooccurrence.checkpoint"):
+        job2.restore(source=src2)
+    assert "offsets absent, replaying from source markers" in caplog.text
+    # The markers still landed; only the rewrite guard is gone.
+    assert src2._current_line == 6
+    assert src2._in_flight_guard is None
+
+
+# -- format / version guards -------------------------------------------
+
+
+def test_format_mismatch_is_a_launch_error(tmp_path):
+    src = FileMonitorSource(str(tmp_path / "f"))
+    with pytest.raises(ValueError, match="--source-format files"):
+        src.restore_offsets({"v": 1, "format": "partitioned"})
+    psrc = PartitionedLogSource(write_partitions(tmp_path / "plog"))
+    psrc.restore_offsets({"v": 1, "format": "files", "in_flight": None})
+    with pytest.raises(ValueError, match="--source-format partitioned"):
+        psrc._discover()
+
+
+def test_format_mismatch_through_full_restore_path(tmp_path):
+    """The SAME clean error through ``job.restore``: the offsets-format
+    guard must fire before the legacy marker restore, which would
+    otherwise choke on the foreign marker shape (KeyError on
+    ``global_modification_time``) instead of naming the flag."""
+    plog = write_partitions(tmp_path / "plog")
+    src = PartitionedLogSource(plog, turn_records=7)
+    consume(src, 20)
+    users, items, ts = random_stream(53, n=120, n_items=20, n_users=10)
+    job = CooccurrenceJob(cfg(tmp_path))
+    feed(job, users, items, ts)
+    job.checkpoint(source=src)
+
+    job2 = CooccurrenceJob(cfg(tmp_path))
+    src2 = FileMonitorSource(str(tmp_path / "plog"))
+    with pytest.raises(ValueError, match="--source-format files"):
+        job2.restore(source=src2)
+
+
+def test_newer_section_version_warns_best_effort(tmp_path, caplog):
+    f = tmp_path / "events.csv"
+    f.write_text("a,b,1\n")
+    src = FileMonitorSource(str(f))
+    with caplog.at_level(logging.WARNING):
+        src.restore_offsets({"v": 2, "format": "files",
+                             "in_flight": None})
+    assert "newer than this reader" in caplog.text
+
+
+# -- rescale merge -----------------------------------------------------
+
+
+def _section(offs, rr_part="part-000", rr_remaining=3):
+    partitions = {
+        name: {"byte_offset": b, "records": r, "head_hash": f"h{name}",
+               "quarantined": False}
+        for name, (b, r) in offs.items()}
+    return {"v": 1, "format": "partitioned", "partitions": partitions,
+            "rr_part": rr_part, "rr_remaining": rr_remaining}
+
+
+def test_merge_agreement_passes_through():
+    s = _section({"part-000": (10, 2), "part-001": (20, 4)})
+    replica = json.loads(json.dumps(s))
+    assert ckpt.merge_ingest_offsets([s, replica], 2) == s
+
+
+def test_merge_disagreement_takes_conservative_minimum(caplog):
+    a = _section({"part-000": (10, 2)})
+    b = _section({"part-000": (8, 1)})
+    with caplog.at_level(logging.WARNING,
+                         logger="tpu_cooccurrence.checkpoint"):
+        merged = ckpt.merge_ingest_offsets([a, b], 2)
+    assert merged["partitions"]["part-000"]["byte_offset"] == 8
+    assert merged["partitions"]["part-000"]["records"] == 1
+    assert "disagree" in caplog.text
+
+
+def test_merge_rr_cursor_disagreement_resets_rotation(caplog):
+    a = _section({"part-000": (10, 2)}, rr_remaining=3)
+    b = _section({"part-000": (10, 2)}, rr_remaining=1)
+    with caplog.at_level(logging.WARNING,
+                         logger="tpu_cooccurrence.checkpoint"):
+        merged = ckpt.merge_ingest_offsets([a, b], 2)
+    assert merged["rr_part"] is None
+    assert merged["rr_remaining"] == 0
+    # The partition offsets themselves were NOT disturbed.
+    assert merged["partitions"]["part-000"]["byte_offset"] == 10
+
+
+def test_merge_takes_union_of_partitions():
+    a = _section({"part-000": (10, 2)})
+    b = _section({"part-000": (10, 2), "part-001": (20, 4)})
+    merged = ckpt.merge_ingest_offsets([a, b], 2)
+    assert set(merged["partitions"]) == {"part-000", "part-001"}
+    assert merged["partitions"]["part-001"]["byte_offset"] == 20
+
+
+def test_merge_files_format_is_writer0_copy():
+    a = {"v": 1, "format": "files", "in_flight": {"path": "x"}}
+    b = {"v": 1, "format": "files", "in_flight": {"path": "y"}}
+    assert ckpt.merge_ingest_offsets([a, b], 2) == a
+
+
+def test_merge_empty_sections_is_none():
+    assert ckpt.merge_ingest_offsets([], 2) is None
+    assert ckpt.merge_ingest_offsets([None, {}], 2) is None

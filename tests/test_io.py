@@ -207,3 +207,132 @@ def test_batched_lines_captures_origin_per_line(tmp_path):
     assert ei.value.source_path == str(p)
     assert ei.value.lineno == 4  # raw file lineno, blank line included
     assert ei.value.raw == "BAD"
+
+
+# -- in-flight rewrite guard (ISSUE 18) --------------------------------
+
+
+class RecordingQuarantine:
+    def __init__(self):
+        self.records = []
+
+    def quarantine(self, path, lineno, raw, reason):
+        self.records.append((path, lineno, raw, reason))
+
+
+def test_inflight_guard_resumes_after_append_with_new_mtime(tmp_path):
+    """Append-only growth moves the mtime, but the guard (size +
+    head-prefix hash) proves the consumed prefix is intact: resume at
+    the exact line instead of the legacy whole-file re-read."""
+    f = tmp_path / "a.csv"
+    f.write_text("l1\nl2\nl3\nl4\n")
+    src = FileMonitorSource(str(f))
+    it = src.lines()
+    assert [next(it) for _ in range(2)] == ["l1", "l2"]
+    state = src.checkpoint_state()
+    offsets = src.offsets_state()
+
+    with open(f, "a") as fh:
+        fh.write("l5\nl6\n")  # mtime moves; prefix untouched
+    src2 = FileMonitorSource(str(f))
+    src2.restore_state(state)
+    src2.restore_offsets(offsets)
+    assert list(src2.lines()) == ["l3", "l4", "l5", "l6"]
+
+
+def test_inflight_guard_dead_letters_rewritten_file(tmp_path):
+    """A rewritten in-flight file (same length, different bytes) is
+    dead-lettered and skipped — its prefix is NOT double-counted into
+    still-open windows, and later files still flow."""
+    a, b = tmp_path / "a.csv", tmp_path / "b.csv"
+    a.write_text("a1\na2\na3\n")
+    b.write_text("b1\nb2\n")
+    t = os.stat(a).st_mtime_ns
+    os.utime(b, ns=(t + 1000, t + 1000))  # b strictly newer
+
+    src = FileMonitorSource(str(tmp_path))
+    it = src.lines()
+    assert [next(it) for _ in range(2)] == ["a1", "a2"]
+    state = src.checkpoint_state()
+    offsets = src.offsets_state()
+
+    a.write_text("x1\nx2\nx3\n")  # rewrite: same size, new content
+    src2 = FileMonitorSource(str(tmp_path))
+    events = []
+    q = RecordingQuarantine()
+    src2.attach(quarantine=q, on_event=events.append)
+    src2.restore_state(state)
+    src2.restore_offsets(offsets)
+    got = list(src2.lines())
+    assert got == ["b1", "b2"]  # nothing from a.csv, old or new
+    assert events == ["ingest/file-rewritten:a.csv"]
+    assert q.records and "rewritten" in q.records[0][3]
+    assert q.records[0][0] == str(a)
+
+
+def test_inflight_guard_shrunk_file_is_rewritten(tmp_path):
+    f = tmp_path / "a.csv"
+    f.write_text("l1\nl2\nl3\nl4\n")
+    src = FileMonitorSource(str(f))
+    it = src.lines()
+    [next(it) for _ in range(3)]
+    state, offsets = src.checkpoint_state(), src.offsets_state()
+
+    f.write_text("l1\n")  # shrunk below the consumed prefix
+    src2 = FileMonitorSource(str(f))
+    events = []
+    src2.attach(on_event=events.append)
+    src2.restore_state(state)
+    src2.restore_offsets(offsets)
+    assert list(src2.lines()) == []
+    assert events == ["ingest/file-rewritten:a.csv"]
+
+
+def test_legacy_restore_keeps_mtime_rule(tmp_path):
+    """A checkpoint without the offsets section (markers only) keeps
+    the pre-guard behavior: resume on an unchanged mtime, re-read the
+    whole file when the mtime moved — the exposure the guard closes,
+    preserved for legacy snapshots rather than silently skipping."""
+    f = tmp_path / "a.csv"
+    f.write_text("l1\nl2\nl3\n")
+    src = FileMonitorSource(str(f))
+    it = src.lines()
+    [next(it) for _ in range(2)]
+    state = src.checkpoint_state()
+
+    # Unchanged mtime: marker-exact resume.
+    src2 = FileMonitorSource(str(f))
+    src2.restore_state(state)
+    assert list(src2.lines()) == ["l3"]
+
+    # Touched (mtime moved, content identical): legacy re-read whole.
+    now_ns = os.stat(f).st_mtime_ns + 10_000_000
+    os.utime(f, ns=(now_ns, now_ns))
+    src3 = FileMonitorSource(str(f))
+    src3.restore_state(state)
+    assert list(src3.lines()) == ["l1", "l2", "l3"]
+
+
+def test_same_mtime_sibling_sweep(tmp_path):
+    """Checkpoint/restore (markers + guard) at EVERY position across
+    two files sharing mtime_ns — including k=3, a restore taken exactly
+    between the two files — never re-reads or drops a line."""
+    a, b = tmp_path / "a.csv", tmp_path / "b.csv"
+    a.write_text("a1\na2\na3\n")
+    b.write_text("b1\nb2\nb3\n")
+    t = os.stat(a).st_mtime_ns
+    os.utime(a, ns=(t, t))
+    os.utime(b, ns=(t, t))  # identical mtime: the sort is the order
+    full = ["a1", "a2", "a3", "b1", "b2", "b3"]
+    assert list(FileMonitorSource(str(tmp_path)).lines()) == full
+
+    for k in range(len(full) + 1):
+        src = FileMonitorSource(str(tmp_path))
+        it = src.lines()
+        got = [next(it) for _ in range(k)]
+        assert got == full[:k], k
+        state, offsets = src.checkpoint_state(), src.offsets_state()
+        src2 = FileMonitorSource(str(tmp_path))
+        src2.restore_state(state)
+        src2.restore_offsets(offsets)
+        assert got + list(src2.lines()) == full, k

@@ -1,0 +1,286 @@
+"""PartitionedLogSource (ISSUE 18): the Kafka shape on plain files.
+
+The contracts under test:
+
+* **Deterministic interleave** — chunked round-robin over the
+  lexicographic ``part-*`` order, identical across independent readers
+  (the replicated-ingest invariant the sharded backends assume).
+* **Exactly-once resume** — at EVERY consumption position, a source
+  restored from ``offsets_state()`` delivers precisely the unconsumed
+  suffix: no byte re-read, no record dropped.
+* **Poison partitions lag alone** — a rewritten/shrunk partition is
+  quarantined (dead-letter record + journaled event) while healthy
+  partitions keep flowing; the quarantine flag rides the next
+  committed section.
+* **Record framing** — a torn tail (no newline yet) is deferred in
+  continuous mode so a committed offset never splits a record.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_cooccurrence.io.partitioned import PartitionedLogSource
+
+
+def write_partitions(root, counts=(40, 40, 40)):
+    root.mkdir()
+    for p, n in enumerate(counts):
+        (root / f"part-{p:03d}").write_text(
+            "".join(f"p{p}:{i}\n" for i in range(n)))
+    return str(root)
+
+
+def drain(source):
+    return [line for line in source.lines() if line is not None]
+
+
+class RecordingQuarantine:
+    def __init__(self):
+        self.records = []
+
+    def quarantine(self, path, lineno, raw, reason):
+        self.records.append((path, lineno, raw, reason))
+
+
+def pump(it, limit=50):
+    """Next non-heartbeat record from a continuous source (or None if
+    ``limit`` heartbeats pass without one)."""
+    for _ in range(limit):
+        value = next(it)
+        if value is not None:
+            return value
+    return None
+
+
+# -- deterministic interleave ------------------------------------------
+
+
+def test_interleave_is_deterministic_and_chunked(tmp_path):
+    root = write_partitions(tmp_path / "plog", counts=(5, 9, 2))
+    a = drain(PartitionedLogSource(root, turn_records=3))
+    b = drain(PartitionedLogSource(root, turn_records=3))
+    assert a == b
+    # First full rotation: 3 records per partition, part order fixed by
+    # the lexicographic sort.
+    assert a[:8] == ["p0:0", "p0:1", "p0:2",
+                     "p1:0", "p1:1", "p1:2",
+                     "p2:0", "p2:1"]
+    # Every record exactly once.
+    expected = [f"p{p}:{i}" for p, n in enumerate((5, 9, 2))
+                for i in range(n)]
+    assert sorted(a) == sorted(expected)
+
+
+def test_single_file_degenerate(tmp_path):
+    f = tmp_path / "events.csv"
+    f.write_text("a\nb\nc\n")
+    src = PartitionedLogSource(str(f))
+    assert drain(src) == ["a", "b", "c"]
+    section = src.offsets_state()
+    assert list(section["partitions"]) == ["events.csv"]
+    assert section["partitions"]["events.csv"]["records"] == 3
+
+
+def test_expected_partitions_mismatch_raises(tmp_path):
+    root = write_partitions(tmp_path / "plog")
+    with pytest.raises(ValueError, match="offset contract"):
+        next(PartitionedLogSource(root, expected_partitions=4).lines())
+    # The matching count is accepted.
+    assert drain(PartitionedLogSource(root, expected_partitions=3))
+
+
+# -- exactly-once resume -----------------------------------------------
+
+
+def test_resume_at_every_position_is_exactly_once(tmp_path):
+    """The exhaustive sweep: checkpoint after k records for every k,
+    restore a fresh source from the section, and the suffix completes
+    the full stream with no overlap and no gap — including mid-turn
+    cursors and partition-exhaustion boundaries."""
+    root = write_partitions(tmp_path / "plog", counts=(5, 8, 3))
+    full = drain(PartitionedLogSource(root, turn_records=3))
+    assert len(full) == 16
+    for k in range(len(full) + 1):
+        src = PartitionedLogSource(root, turn_records=3)
+        it = src.lines()
+        got = [next(it) for _ in range(k)]
+        assert got == full[:k], k
+        # The JSON round-trip mirrors the npz meta the section rides.
+        section = json.loads(json.dumps(src.offsets_state()))
+        resumed = PartitionedLogSource(root, turn_records=3)
+        resumed.restore_offsets(section)
+        assert got + drain(resumed) == full, k
+
+
+def test_offsets_advance_before_yield(tmp_path):
+    """A checkpoint taken at any batch boundary covers every delivered
+    record: the committed record count equals the yield count."""
+    root = write_partitions(tmp_path / "plog", counts=(4, 4, 4))
+    src = PartitionedLogSource(root, turn_records=3)
+    it = src.lines()
+    for k in range(1, 9):
+        next(it)
+        section = src.offsets_state()
+        committed = sum(e["records"]
+                        for e in section["partitions"].values())
+        assert committed == k
+
+
+# -- poison partitions --------------------------------------------------
+
+
+def test_rewritten_partition_quarantined_on_restore(tmp_path):
+    root = write_partitions(tmp_path / "plog", counts=(6, 6, 6))
+    src = PartitionedLogSource(root, turn_records=3)
+    it = src.lines()
+    got = [next(it) for _ in range(6)]  # 3 from p0, 3 from p1
+    section = src.offsets_state()
+    assert section["partitions"]["part-001"]["byte_offset"] > 0
+    # Rewrite part-001 in place: same size, different bytes — the
+    # committed head-prefix hash no longer matches.
+    p1 = os.path.join(root, "part-001")
+    size = os.path.getsize(p1)
+    with open(p1, "wb") as f:
+        f.write(b"X" * (size - 1) + b"\n")
+
+    resumed = PartitionedLogSource(root, turn_records=3)
+    events = []
+    q = RecordingQuarantine()
+    resumed.attach(quarantine=q, on_event=events.append)
+    resumed.restore_offsets(section)
+    rest = drain(resumed)
+    # Healthy partitions keep flowing; the poisoned one lags alone —
+    # none of its bytes (old or rewritten) reach the stream again.
+    assert all(not r.startswith("X") and not r.startswith("p1")
+               for r in rest)
+    assert sorted(rest) == sorted(
+        [f"p0:{i}" for i in range(3, 6)] + [f"p2:{i}" for i in range(6)])
+    assert events == ["ingest/partition-quarantined:part-001"]
+    assert q.records and "rewritten under a checkpoint" in q.records[0][3]
+    # The quarantine flag rides the next committed section.
+    next_section = resumed.offsets_state()
+    assert next_section["partitions"]["part-001"]["quarantined"] is True
+
+
+def test_quarantined_flag_round_trips(tmp_path):
+    """A partition quarantined before a checkpoint stays quarantined
+    after restore — no verification re-run resurrects it."""
+    root = write_partitions(tmp_path / "plog", counts=(3, 3))
+    src = PartitionedLogSource(root, turn_records=2)
+    consume_all = drain(src)
+    assert consume_all
+    section = src.offsets_state()
+    section["partitions"]["part-000"]["quarantined"] = True
+    resumed = PartitionedLogSource(root, turn_records=2)
+    resumed.restore_offsets(json.loads(json.dumps(section)))
+    drain(resumed)
+    assert resumed.offsets_state()["partitions"]["part-000"][
+        "quarantined"] is True
+
+
+def test_shrunk_partition_quarantined_mid_run(tmp_path):
+    """Continuous-mode poll guard: a partition whose file shrank below
+    the committed offset is quarantined mid-run; appends to healthy
+    partitions keep flowing."""
+    root = write_partitions(tmp_path / "plog", counts=(3, 3))
+    src = PartitionedLogSource(root, process_continuously=True,
+                               poll_interval_s=0.0, turn_records=2)
+    events = []
+    q = RecordingQuarantine()
+    src.attach(quarantine=q, on_event=events.append)
+    it = src.lines()
+    got = [pump(it) for _ in range(6)]
+    assert sorted(got) == sorted(
+        [f"p0:{i}" for i in range(3)] + [f"p1:{i}" for i in range(3)])
+    # Truncate part-000 below its committed offset.
+    with open(os.path.join(root, "part-000"), "wb") as f:
+        f.write(b"p0:0\n")
+    # Append to the healthy partition: it must still be delivered.
+    with open(os.path.join(root, "part-001"), "ab") as f:
+        f.write(b"p1:new\n")
+    assert pump(it) == "p1:new"
+    # Drain to an idle round so the poll-time append-only check runs.
+    assert pump(it, limit=4) is None
+    assert "ingest/partition-quarantined:part-000" in events
+    assert any("shrank below the committed offset" in r[3]
+               for r in q.records)
+
+
+def test_missing_and_unknown_partitions_warn(tmp_path, caplog):
+    import logging
+
+    root = write_partitions(tmp_path / "plog", counts=(3, 3))
+    src = PartitionedLogSource(root, turn_records=2)
+    section = drain(src) and src.offsets_state()
+    # A checkpointed partition that vanished + a live one that was
+    # never checkpointed both warn (and neither aborts the restore).
+    section["partitions"]["part-999"] = section["partitions"].pop(
+        "part-001")
+    resumed = PartitionedLogSource(root, turn_records=2)
+    resumed.restore_offsets(section)
+    with caplog.at_level(logging.WARNING,
+                         logger="tpu_cooccurrence.io.partitioned"):
+        rest = drain(resumed)
+    assert "is gone" in caplog.text
+    assert "reading it from the start" in caplog.text
+    # The un-checkpointed partition really was re-read from the start.
+    assert rest == [f"p1:{i}" for i in range(3)]
+
+
+# -- record framing ----------------------------------------------------
+
+
+def test_torn_tail_is_deferred_until_complete(tmp_path):
+    root = tmp_path / "plog"
+    root.mkdir()
+    (root / "part-000").write_text("a\nb\nc")  # torn tail: no newline
+    src = PartitionedLogSource(str(root), process_continuously=True,
+                               poll_interval_s=0.0, turn_records=4)
+    it = src.lines()
+    assert pump(it) == "a"
+    assert pump(it) == "b"
+    assert pump(it, limit=5) is None  # "c" is torn — deferred
+    offsets = src.offsets_state()["partitions"]["part-000"]
+    assert offsets["records"] == 2  # the committed offset excludes it
+    with open(root / "part-000", "ab") as f:
+        f.write(b"\n")
+    assert pump(it) == "c"
+
+
+def test_process_once_reads_torn_tail(tmp_path):
+    """PROCESS_ONCE has no writer to wait for: the snapshot is final,
+    so a missing trailing newline still yields the last record."""
+    root = tmp_path / "plog"
+    root.mkdir()
+    (root / "part-000").write_text("a\nb\nc")
+    assert drain(PartitionedLogSource(str(root))) == ["a", "b", "c"]
+
+
+# -- health / ownership ------------------------------------------------
+
+
+def test_ingest_health_shape_and_ownership(tmp_path):
+    root = write_partitions(tmp_path / "plog", counts=(4, 4, 4))
+    src = PartitionedLogSource(root, turn_records=3, process_id=0,
+                               num_processes=2)
+    assert src.ingest_health() is None  # pre-discovery: nothing to say
+    it = src.lines()
+    for _ in range(5):
+        next(it)
+    health = src.ingest_health()
+    assert health["format"] == "partitioned"
+    assert health["quarantined_partitions"] == 0
+    assert set(health["partitions"]) == {"part-000", "part-001",
+                                         "part-002"}
+    entry = health["partitions"]["part-000"]
+    assert set(entry) == {"byte_offset", "records", "lag",
+                          "quarantined", "owner"}
+    # Modular ownership at the current topology.
+    assert [health["partitions"][n]["owner"]
+            for n in sorted(health["partitions"])] == [0, 1, 0]
+    # Lag is live bytes-behind: file size minus committed offset.
+    size = os.path.getsize(os.path.join(root, "part-000"))
+    assert entry["lag"] == size - entry["byte_offset"]
+    assert src.partition_owner(5) == 5 % 2

@@ -32,6 +32,7 @@ JOURNAL_SCHEMA_KEYS = [
     "degrade_events", "breaker_state", "fused", "fused_compiles",
     "fallback_reason", "snapshot_generation", "snapshot_rows", "epoch",
     "run_id", "process_id", "attempt", "spans",
+    "ingest_offsets", "ingest_lag",
     # event records (EVENT_SCHEMA)
     "event", "window_seq",
     # checkpoint records (CKPT_SCHEMA)

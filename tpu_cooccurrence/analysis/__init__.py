@@ -60,6 +60,11 @@ Layout:
   into generation meta or delta headers needs a restore-side reader in
   its module and a ``tests/`` round-trip reference — the two ends of
   the incremental-checkpoint format cannot drift silently);
+* :mod:`.rules_ingest` — ingest offset-codec drift (every field
+  written into a source's offset section — the files in-flight guard,
+  the partitioned per-partition cursors — needs a restore-side reader
+  in its module and a ``tests/`` round-trip reference: a writer-only
+  offset field silently turns exactly-once resume into replay);
 * :mod:`.rules_autoscale` — scale-policy registry drift (every
   ``ScalePolicy`` implementation in ``robustness/autoscale.py`` needs
   a ``tests/`` reference and a row in the ARCHITECTURE scale-policy
@@ -91,6 +96,7 @@ from . import rules_ckpt  # noqa: F401,E402
 from . import rules_degrade  # noqa: F401,E402
 from . import rules_fused  # noqa: F401,E402
 from . import rules_gang  # noqa: F401,E402
+from . import rules_ingest  # noqa: F401,E402
 from . import rules_jit  # noqa: F401,E402
 from . import rules_journal  # noqa: F401,E402
 from . import rules_lock  # noqa: F401,E402

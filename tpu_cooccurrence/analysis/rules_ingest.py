@@ -1,0 +1,158 @@
+"""Ingest offset-codec drift guard (baseline-free).
+
+``ingest-offset-registry`` — the ingest offset section rides the
+checkpoint meta (``meta["ingest_offsets"]``) and the delta header, but
+its INTERNAL keys are produced and consumed inside the sources
+themselves (``io/source.py`` ``offsets_state``/``restore_offsets`` for
+the files-format in-flight guard; ``io/partitioned.py`` for the
+per-partition byte/record cursors). Nothing structural stops a
+writer-side offset field from landing with no restore-side reader: the
+checkpoint still commits, the digest still verifies, and the field
+silently never influences where the wire resumes — exactly-once becomes
+at-least-once one rescale later.
+
+The rule makes the offset codec explicit: every string key written into
+the section dicts (the dict literals / subscript stores on ``offsets``
+and ``in_flight`` in ``io/source.py``; ``offsets`` and ``partitions``
+in ``io/partitioned.py``) must
+
+* have a matching restore-side READ of the same key string somewhere in
+  its module (a read-position constant — subscript load, ``.get``,
+  membership test), and
+* appear as a string constant somewhere under ``tests/`` — the
+  round-trip fixture reference that pins the field's semantics
+  (``tests/test_ingest_offsets.py`` keeps the canonical list).
+
+Baseline-free: a new offset field lands in the same PR as its reader
+and its test, or tier-1 fails.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set, Tuple
+
+from .core import FileContext, Finding, RepoContext, Rule, register
+
+#: Module -> dict-variable names whose string keys form the offset codec.
+_FORMAT_FILES = {
+    "tpu_cooccurrence/io/source.py": ("offsets", "in_flight"),
+    "tpu_cooccurrence/io/partitioned.py": ("offsets", "partitions"),
+}
+
+
+def _written_keys(ctx: FileContext,
+                  names) -> "Tuple[Dict[str, int], Set[int]]":
+    """``{key: first write line}`` plus the AST node ids of the write-
+    position key constants (so the read scan can exclude them)."""
+    written: Dict[str, int] = {}
+    write_nodes: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                # offsets = {"k": ...} / in_flight = {"k": ...}
+                if (isinstance(tgt, ast.Name) and tgt.id in names
+                        and isinstance(node.value, ast.Dict)):
+                    for k in node.value.keys:
+                        if (isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)):
+                            written.setdefault(k.value, k.lineno)
+                            write_nodes.add(id(k))
+                # offsets["k"] = ... / partitions[name] = {"k": ...}
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in names):
+                    if (isinstance(tgt.slice, ast.Constant)
+                            and isinstance(tgt.slice.value, str)):
+                        written.setdefault(tgt.slice.value, tgt.lineno)
+                        write_nodes.add(id(tgt.slice))
+                    # The partitioned source stores one dict PER
+                    # partition name (a variable subscript): its value
+                    # literal's keys are format keys too.
+                    if isinstance(node.value, ast.Dict):
+                        for k in node.value.keys:
+                            if (isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str)):
+                                written.setdefault(k.value, k.lineno)
+                                write_nodes.add(id(k))
+    return written, write_nodes
+
+
+def _read_constants(ctx: FileContext, write_nodes: Set[int]) -> Set[str]:
+    """Every string constant in the module that is NOT one of the
+    write-position keys — the reader-evidence pool (subscript loads,
+    ``.get`` arguments, membership tests all surface here)."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and id(node) not in write_nodes):
+            out.add(node.value)
+    return out
+
+
+def _tests_constants(repo: RepoContext) -> Set[str]:
+    out: Set[str] = set()
+    for ctx in repo.python_files():
+        if not ctx.path.startswith("tests/") or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                out.add(node.value)
+    return out
+
+
+@register
+class IngestOffsetRegistryRule(Rule):
+    name = "ingest-offset-registry"
+    description = ("every field written into an ingest offset section "
+                   "needs a restore-side reader in its module and a "
+                   "tests/ round-trip reference")
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        # Scope guard (the rules_ckpt posture): silent when neither
+        # source module is present (fixture repos, partial trees); a
+        # repo where one end of the codec vanished is flagged.
+        present = {path: next((c for c in repo.files if c.path == path),
+                              None)
+                   for path in _FORMAT_FILES}
+        if not any(c is not None for c in present.values()):
+            return
+        tests = None
+        for path, names in sorted(_FORMAT_FILES.items()):
+            src = present[path]
+            if src is None or src.tree is None:
+                yield Finding(
+                    rule=self.name, file=path, line=1,
+                    message=(f"ingest module {path} is missing or "
+                             f"unparseable — the offset-codec registry "
+                             f"this rule guards is gone"))
+                continue
+            written, write_nodes = _written_keys(src, names)
+            if not written:
+                yield Finding(
+                    rule=self.name, file=path, line=1,
+                    message=(f"no offset-section keys found on {names} "
+                             f"in {path} (writer moved? update "
+                             f"rules_ingest._FORMAT_FILES)"))
+                continue
+            reads = _read_constants(src, write_nodes)
+            if tests is None:
+                tests = _tests_constants(repo)
+            for key, line in sorted(written.items()):
+                if key not in reads:
+                    yield Finding(
+                        rule=self.name, file=path, line=line,
+                        message=(f"offset key {key!r} is written but "
+                                 f"never read back in {path} — a "
+                                 f"writer-only field silently stops "
+                                 f"steering where the wire resumes; add "
+                                 f"the restore-side reader (or drop the "
+                                 f"field)"))
+                if key not in tests:
+                    yield Finding(
+                        rule=self.name, file=path, line=line,
+                        message=(f"offset key {key!r} has no tests/ "
+                                 f"round-trip reference — pin it in "
+                                 f"tests/test_ingest_offsets.py's "
+                                 f"offset-key registry"))

@@ -209,6 +209,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                  config.pipeline_depth)
 
     job = CooccurrenceJob(config)
+    # Ingest source selection (--source-format): the file-monitor tail,
+    # or the partitioned log whose per-partition offsets commit with the
+    # checkpoint (io/partitioned.py). Constructed before the HTTP plane
+    # so /healthz can carry the ingest block.
+    if config.source_format == "partitioned":
+        from .io.partitioned import PartitionedLogSource
+
+        source = PartitionedLogSource(
+            config.input, job.counters,
+            process_continuously=config.process_continuously,
+            expected_partitions=config.ingest_partitions,
+            process_id=config.process_id or 0,
+            num_processes=config.num_processes or 1)
+    else:
+        source = FileMonitorSource(
+            config.input, job.counters,
+            process_continuously=config.process_continuously)
+    # The job sees the source unconditionally: checkpoints snapshot its
+    # cursor + offsets, and the journal's per-window ingest fields read
+    # its health even on checkpoint-less runs.
+    job.source = source
     # Supervisor state rides in on an env var (the scrape plane lives in
     # this child process, not the parent): restart/backoff gauges on
     # /metrics, last-restart info on /healthz.
@@ -272,7 +293,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 port=config.metrics_port,
                 stale_after_s=config.healthz_stale_after_s,
                 supervisor_info=supervisor_info, peers=peers,
-                last_window=last_window).start()
+                last_window=last_window,
+                ingest=source.ingest_health).start()
         if config.serve_port is not None:
             # The serving endpoint carries the scrape routes too (one
             # port to probe behind a load balancer); --metrics-port may
@@ -284,10 +306,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 supervisor_info=supervisor_info,
                 serving=job.serving,
                 serve_stale_after_s=config.serve_stale_after_s,
-                last_window=last_window).start()
-    source = FileMonitorSource(
-        config.input, job.counters,
-        process_continuously=config.process_continuously)
+                last_window=last_window,
+                ingest=source.ingest_health).start()
     # Crash recovery (the reference delegates this to Flink restarts): when
     # a checkpoint exists in --checkpoint-dir, restore it — including the
     # source's exact position, mid-file included — and continue from there.
@@ -296,7 +316,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if config.checkpoint_dir:
         from .state import checkpoint as ckpt
 
-        job.source = source
         if config.coordinator is not None and config.autoscale == "on":
             # Topology-aware restore vote (the autoscale seam): the
             # newest generation may have been committed by a DIFFERENT
@@ -317,10 +336,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             LOG.info("gang restore vote: committed epoch %d (written "
                      "by %d workers)", agreed, writers)
             if agreed >= 0:
-                if writers == config.num_processes:
-                    job.restore(source=source)
-                else:
-                    job.restore_rescaled(agreed, writers, source=source)
+                try:
+                    if writers == config.num_processes:
+                        job.restore(source=source)
+                    else:
+                        job.restore_rescaled(agreed, writers,
+                                             source=source)
+                except ValueError as exc:
+                    # A checkpoint the launch flags cannot consume
+                    # (e.g. an ingest-offset section written by the
+                    # other --source-format) is permanent: restarting
+                    # replays the same mismatch.
+                    LOG.error("restore refused: %s", exc)
+                    return EX_CONFIG
                 LOG.info("restored checkpoint from %s "
                          "(windows_fired=%d)", config.checkpoint_dir,
                          job.windows_fired)
@@ -341,7 +369,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     getattr(job.scorer, "process_suffix", ""))
                 LOG.info("gang restore vote: committed epoch %d", agreed)
             if ckpt.exists(job, config.checkpoint_dir):
-                job.restore(source=source)
+                try:
+                    job.restore(source=source)
+                except ValueError as exc:
+                    LOG.error("restore refused: %s", exc)
+                    return EX_CONFIG
                 LOG.info("restored checkpoint from %s "
                          "(windows_fired=%d)", config.checkpoint_dir,
                          job.windows_fired)
@@ -387,6 +419,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                 max_bytes=config.max_quarantine_bytes)
         LOG.info("quarantine armed: dead-letter %s, max rate %.2f%%",
                  config.quarantine_file, config.max_quarantine_rate * 100)
+    # Arm the source's own dead-letter path (rewritten in-flight files,
+    # poisoned partitions) and its journal event hook — after quarantine
+    # construction, before the stream starts.
+    source.attach(quarantine=quarantine,
+                  on_event=job._journal_ingest_event)
 
     from .observability import xla_trace
     from .robustness.autoscale import RESCALE_EXIT, RescaleDrain

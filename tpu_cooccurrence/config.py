@@ -86,6 +86,17 @@ class Config:
     buffer_timeout: int = 100  # ms a parsed line may wait in a partial
     # batch when tailing continuously (reference: record flush bound,
     # FlinkCooccurrences.java:46); no-op in process-once runs
+    source_format: str = "files"  # ingest source shape: "files" = the
+    # reference's file-monitor tail (io/source.py); "partitioned" = the
+    # append-only partitioned log (io/partitioned.py: part-* files,
+    # Kafka shape without the dependency) whose per-partition offsets
+    # commit atomically with the checkpoint under the epoch protocol —
+    # exactly-once from the wire up
+    ingest_partitions: int = 0  # expected part-* file count with
+    # --source-format partitioned: pins the partition/offset contract up
+    # front (a drifted directory fails fast, like a Kafka topic changing
+    # partition count under a consumer group); 0 = derive from the
+    # directory at first listing
 
     # --- TPU-framework extensions (no reference analogue) ---
     backend: Backend = Backend.DEVICE
@@ -321,6 +332,19 @@ class Config:
             self.seed = time.time_ns()  # reference: System.nanoTime()
         if self.top_k <= 0:
             raise ValueError(f"{self.top_k} is <= 0")
+        if self.source_format not in ("files", "partitioned"):
+            raise ValueError(
+                f"--source-format must be 'files' or 'partitioned', got "
+                f"{self.source_format!r}")
+        if self.ingest_partitions < 0:
+            raise ValueError(
+                f"--ingest-partitions must be >= 0, got "
+                f"{self.ingest_partitions}")
+        if self.ingest_partitions and self.source_format != "partitioned":
+            raise ValueError(
+                "--ingest-partitions only applies to --source-format "
+                "partitioned (the files source has no partition "
+                "contract to pin)")
         if self.restart_on_failure > 0 and self.process_continuously:
             raise ValueError(
                 "--restart-on-failure buffers each attempt's stdout until "
@@ -763,6 +787,20 @@ class Config:
         )
         p.add_argument("-i", "--input", required=True,
                        help="Input file/directory to consume (expected format 'user,item,timestamp')")
+        p.add_argument("--source-format", choices=("files", "partitioned"),
+                       default="files", dest="source_format",
+                       help="Ingest source shape: 'files' tails the "
+                            "input in modification-time order; "
+                            "'partitioned' consumes an append-only "
+                            "partitioned log (part-* files) whose "
+                            "per-partition offsets commit atomically "
+                            "with the checkpoint (default: files)")
+        p.add_argument("--ingest-partitions", type=int, default=0,
+                       dest="ingest_partitions",
+                       help="Expected part-* partition count with "
+                            "--source-format partitioned; a directory "
+                            "with a different count fails fast "
+                            "(0 = derive from the directory)")
         p.add_argument("-sc", "--skip-cuts", action="store_true", dest="skip_cuts",
                        help="Skip the interaction cuts")
         p.add_argument("-ic", "--item-cut", type=int, default=500, dest="item_cut",

@@ -1,0 +1,442 @@
+"""Partitioned-log source: Kafka's shape without the dependency.
+
+The Flink reference's exactly-once story (PAPER.md L0/L1) rests on the
+source being a *replayable partitioned log* whose per-partition offsets
+commit atomically with operator state. :class:`PartitionedLogSource`
+reproduces that shape on plain files: a directory of ``part-*`` files,
+each an independent append-only partition, consumed in a deterministic
+chunked round-robin whose cursor — together with every partition's
+(byte offset, record count, head-prefix hash) — is the first-class
+``ingest_offsets`` section of the checkpoint/delta codec
+(``state/checkpoint.py`` / ``state/delta.py``). Recovery therefore
+resumes each partition exactly once: no byte is re-read, no record is
+dropped, across crash, gang restart and the autoscale rescale seam.
+
+Invariants:
+
+  * **Partition order** is the lexicographic sort of the ``part-*``
+    names — stable across listings, processes and restores; the
+    partition COUNT is fixed at first discovery (``--ingest-partitions``
+    pins it up front; a mismatch is a configuration error, exactly like
+    a Kafka topic changing partition count under a consumer group).
+  * **Replicated ingest**: every gang worker reads every partition in
+    the same order (the same contract the sharded backends assume for
+    the line stream — ingest is deterministic and replicated; ownership
+    masks carve the *state*, not the wire). Partition OWNERSHIP
+    (``parallel/``'s modular ownership idiom, ``index % processes``)
+    governs which worker is authoritative for a partition's offsets in
+    the rescaled-restore merge and for its lag in journal/healthz
+    reporting — re-derived from the same formula at the new topology on
+    the rescale seam.
+  * **Append-only enforcement**: a partition whose file shrank below
+    the committed offset, or whose consumed head-prefix hash changed,
+    was rewritten — it is quarantined (dead-letter record + journaled
+    ``ingest/partition-quarantined`` event) and skipped while healthy
+    partitions keep flowing; the admission ladder
+    (``robustness/degrade.py``) gates each partition's turn the same
+    way it gates file splits.
+  * **Record framing** is newline-delimited; in continuous mode a
+    torn tail (no trailing newline yet) is deferred until the writer
+    completes it, so offsets never split a record.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..metrics import Counters, SPLIT_READER_NUM_SPLITS
+from ..robustness import degrade, faults
+from .source import ADMIT_EVERY_LINES, Source, head_hash
+
+LOG = logging.getLogger("tpu_cooccurrence.io.partitioned")
+
+#: Records consumed from one partition before rotating to the next —
+#: the interleave grain. Small enough that windows mix partitions,
+#: large enough that the per-turn bookkeeping stays off the hot path.
+TURN_RECORDS = 256
+
+#: Partition files must match this prefix (everything else in the
+#: directory — manifests, dead-letter files, tmp writes — is ignored).
+PARTITION_PREFIX = "part-"
+
+
+class _Partition:
+    """One append-only partition file and its committed position."""
+
+    __slots__ = ("name", "path", "byte_offset", "records", "quarantined",
+                 "_handle")
+
+    def __init__(self, name: str, path: str) -> None:
+        self.name = name
+        self.path = path
+        self.byte_offset = 0
+        self.records = 0
+        self.quarantined = False
+        self._handle = None
+
+
+class PartitionedLogSource(Source):
+    """Streams records from N append-only partition files, exactly once."""
+
+    def __init__(
+        self,
+        path: str,
+        counters: Optional[Counters] = None,
+        process_continuously: bool = False,
+        poll_interval_s: float = 1.0,
+        expected_partitions: int = 0,
+        process_id: int = 0,
+        num_processes: int = 1,
+        turn_records: int = TURN_RECORDS,
+    ) -> None:
+        self.path = path
+        self.counters = counters or Counters()
+        self.process_continuously = process_continuously
+        self.poll_interval_s = poll_interval_s
+        self.expected_partitions = int(expected_partitions)
+        self.process_id = int(process_id)
+        self.num_processes = max(1, int(num_processes))
+        self.turn_records = int(turn_records)
+        self._parts: Dict[str, _Partition] = {}
+        self._order: List[str] = []
+        self._discovered = False
+        self._rr_pos = 0
+        self._rr_remaining = self.turn_records
+        self._restored_offsets: Optional[dict] = None
+        self._current_name: Optional[str] = None
+        self._opens = 0
+
+    # -- discovery -------------------------------------------------------
+
+    def _discover(self) -> None:
+        """Fix the partition set: lexicographically sorted ``part-*``
+        files under the directory (a single plain file is one-partition
+        degenerate). Validated against --ingest-partitions when set."""
+        if self._discovered and self._order:
+            return
+        if os.path.isdir(self.path):
+            names = sorted(
+                n for n in os.listdir(self.path)
+                if n.startswith(PARTITION_PREFIX)
+                and os.path.isfile(os.path.join(self.path, n)))
+            parts = [(n, os.path.join(self.path, n)) for n in names]
+        elif os.path.isfile(self.path):
+            parts = [(os.path.basename(self.path), self.path)]
+        else:
+            parts = []
+        if self.expected_partitions and parts and \
+                len(parts) != self.expected_partitions:
+            raise ValueError(
+                f"--ingest-partitions {self.expected_partitions} but "
+                f"{len(parts)} part-* files found under {self.path} — "
+                f"the partition count is part of the offset contract "
+                f"and cannot drift")
+        for name, p in parts:
+            if name not in self._parts:
+                self._parts[name] = _Partition(name, p)
+        self._order = sorted(self._parts)
+        self._discovered = bool(parts)
+        if self._discovered and self._restored_offsets is not None:
+            self._apply_restored_offsets()
+
+    # -- checkpoint hooks ------------------------------------------------
+
+    def checkpoint_state(self) -> dict:
+        # The cursor markers ride the offsets section (offsets_state) —
+        # this legacy hook carries only the format tag so a pre-offset
+        # restore path has something well-formed to hand back.
+        return {"format": "partitioned"}
+
+    def restore_state(self, state: dict) -> None:
+        # Nothing to restore here: without an ingest_offsets section a
+        # partitioned log can only replay from the start (the restore
+        # path warns "offsets absent, replaying from source markers").
+        return None
+
+    def offsets_state(self) -> dict:
+        """The first-class ingest-offset section: per-partition (byte
+        offset, record count, consumed head-prefix hash, quarantine
+        flag) plus the round-robin cursor — everything a restore needs
+        to resume each partition exactly once."""
+        partitions: Dict[str, dict] = {}
+        for name in self._order:
+            p = self._parts[name]
+            try:
+                digest = head_hash(p.path, p.byte_offset)
+            except OSError:
+                digest = None
+            partitions[name] = {
+                "byte_offset": int(p.byte_offset),
+                "records": int(p.records),
+                "head_hash": digest,
+                "quarantined": bool(p.quarantined),
+            }
+        offsets = {
+            "v": 1,
+            "format": "partitioned",
+            "partitions": partitions,
+            "rr_part": self._order[self._rr_pos] if self._order else None,
+            "rr_remaining": int(self._rr_remaining),
+        }
+        return offsets
+
+    def restore_offsets(self, state: dict) -> None:
+        self._restored_offsets = state
+        if self._discovered:
+            self._apply_restored_offsets()
+
+    def _apply_restored_offsets(self) -> None:
+        """Apply (and verify) a restored offsets section against the
+        discovered partition set: an append-only grown partition resumes
+        at its committed offset; a shrunk/rewritten one is quarantined
+        and lags alone while healthy partitions keep flowing."""
+        state, self._restored_offsets = self._restored_offsets, None
+        if not state:
+            return
+        if int(state.get("v", 1)) != 1:
+            LOG.warning("ingest offset section v=%s is newer than this "
+                        "reader (v=1): applying best-effort",
+                        state.get("v"))
+        fmt = state.get("format", "partitioned")
+        if fmt != "partitioned":
+            raise ValueError(
+                f"checkpoint ingest offsets carry format {fmt!r} but "
+                f"the job was launched with --source-format partitioned")
+        restored = state.get("partitions") or {}
+        for name, entry in sorted(restored.items()):
+            part = self._parts.get(name)
+            if part is None:
+                LOG.warning(
+                    "checkpointed partition %r is gone from %s — its "
+                    "committed offset (%d bytes, %d records) cannot be "
+                    "resumed", name, self.path,
+                    int(entry.get("byte_offset", 0)),
+                    int(entry.get("records", 0)))
+                continue
+            part.byte_offset = int(entry.get("byte_offset", 0))
+            part.records = int(entry.get("records", 0))
+            if entry.get("quarantined"):
+                part.quarantined = True
+                continue
+            if not self._verify_append_only(part, entry.get("head_hash")):
+                self._quarantine_partition(
+                    part, "rewritten under a checkpoint (shrunk or "
+                          "head-prefix mismatch)")
+        for name in self._order:
+            if name not in restored:
+                LOG.warning("partition %r has no checkpointed offset — "
+                            "reading it from the start", name)
+        rr_part = state.get("rr_part")
+        if rr_part in self._parts:
+            self._rr_pos = self._order.index(rr_part)
+            self._rr_remaining = int(
+                state.get("rr_remaining", self.turn_records))
+            if self._rr_remaining <= 0:
+                # Committed exactly at a turn boundary: the live reader
+                # would have rotated before reading again, so resume at
+                # the NEXT partition's fresh turn. Restoring the spent
+                # turn verbatim would read as an idle turn and could
+                # end a process-once drain before the rotation came
+                # back around.
+                self._rr_pos = (self._rr_pos + 1) % len(self._order)
+                self._rr_remaining = self.turn_records
+        else:
+            self._rr_pos = 0
+            self._rr_remaining = self.turn_records
+
+    def _verify_append_only(self, part: _Partition,
+                            digest: Optional[str]) -> bool:
+        """True when the partition file still starts with the consumed
+        prefix the checkpoint committed (size and head-prefix hash)."""
+        try:
+            if os.stat(part.path).st_size < part.byte_offset:
+                return False
+            if digest is not None and \
+                    head_hash(part.path, part.byte_offset) != digest:
+                return False
+        except OSError:
+            return False
+        return True
+
+    def _quarantine_partition(self, part: _Partition, reason: str) -> None:
+        """Dead-letter a poisoned partition and journal the event; the
+        partition is skipped from here on (it dead-letters and lags
+        alone — healthy partitions keep flowing)."""
+        part.quarantined = True
+        if part._handle is not None:
+            part._handle.close()
+            part._handle = None
+        LOG.warning("partition %s %s — quarantined (healthy partitions "
+                    "keep flowing)", part.name, reason)
+        if self._quarantine is not None:
+            self._quarantine.quarantine(part.path, part.records, "",
+                                        f"partition {reason}")
+        if self._on_event is not None:
+            self._on_event(f"ingest/partition-quarantined:{part.name}")
+
+    # -- ownership -------------------------------------------------------
+
+    def partition_owner(self, index: int) -> int:
+        """Deterministic partition ownership across the gang — the
+        ``parallel/`` modular ownership idiom (``(keys >> 32) % shards``
+        for state rows) applied to partition indices. Re-evaluating this
+        at a new topology IS the reassignment on the rescale seam."""
+        return index % self.num_processes
+
+    # -- health ----------------------------------------------------------
+
+    def ingest_health(self) -> Optional[dict]:
+        """Per-partition offset/lag/owner snapshot for /healthz, the
+        journal's per-window ingest fields and the lag gauge."""
+        if not self._order:
+            return None
+        # Deliberately NOT named ``partitions``: this dict is a health
+        # snapshot (lag/owner are derived, not committed state), not the
+        # offset codec the ingest-offset-registry lint watches.
+        snapshot: Dict[str, dict] = {}
+        quarantined = 0
+        for idx, name in enumerate(self._order):
+            p = self._parts[name]
+            try:
+                size = os.stat(p.path).st_size
+            except OSError:
+                size = p.byte_offset
+            quarantined += int(p.quarantined)
+            snapshot[name] = {
+                "byte_offset": int(p.byte_offset),
+                "records": int(p.records),
+                "lag": max(0, int(size) - int(p.byte_offset)),
+                "quarantined": bool(p.quarantined),
+                "owner": self.partition_owner(idx),
+            }
+        return {
+            "format": "partitioned",
+            "partitions": snapshot,
+            "quarantined_partitions": quarantined,
+        }
+
+    # -- provenance ------------------------------------------------------
+
+    def origin(self) -> Tuple[str, int]:
+        """``(partition path, record number)`` of the record most
+        recently yielded — per-line provenance for parse errors and the
+        dead-letter file."""
+        if self._current_name is not None:
+            p = self._parts[self._current_name]
+            return (p.path, p.records)
+        return (self.path, 0)
+
+    # -- reading ---------------------------------------------------------
+
+    def _open(self, part: _Partition):
+        if part._handle is None:
+            part._handle = open(part.path, "rb")
+            part._handle.seek(part.byte_offset)
+        return part._handle
+
+    def _read_record(self, part: _Partition) -> Optional[bytes]:
+        """One framed record (raw bytes incl. newline) or None when the
+        partition has no complete record to offer right now."""
+        try:
+            f = self._open(part)
+            raw = f.readline()
+        except OSError:
+            self._quarantine_partition(part, "unreadable")
+            return None
+        if not raw:
+            return None
+        if not raw.endswith(b"\n") and self.process_continuously:
+            # Torn tail: the writer is mid-append. Defer until the
+            # newline lands so a committed offset never splits a record.
+            f.seek(part.byte_offset)
+            return None
+        return raw
+
+    def lines(self) -> Iterator[Optional[str]]:
+        """Yield records across partitions in deterministic chunked
+        round-robin order.
+
+        Offsets advance BEFORE each yield, so a checkpoint taken at any
+        batch boundary snapshots exactly the records delivered — the
+        same contract ``FileMonitorSource`` keeps for its line cursor.
+        The rotation cursor (partition index + records left in the
+        current turn) is part of the offsets section, so a restored run
+        continues the interleave mid-turn, bit-identically.
+        """
+        self._discover()
+        since_gate = 0
+        while True:
+            idle_turns = 0
+            while self._order and idle_turns < len(self._order):
+                name = self._order[self._rr_pos]
+                part = self._parts[name]
+                took = 0
+                if not part.quarantined:
+                    if self._rr_remaining == self.turn_records:
+                        # Fresh turn on this partition: the chaos hook
+                        # and the admission gate sit at the same grain
+                        # as FileMonitorSource's split boundary.
+                        self._opens += 1
+                        if faults.PLAN is not None:
+                            faults.PLAN.fire("source_read",
+                                             seq=self._opens)
+                        if degrade.CONTROLLER is not None:
+                            degrade.CONTROLLER.admit()
+                        self.counters.add(SPLIT_READER_NUM_SPLITS, 1)
+                    while self._rr_remaining > 0:
+                        raw = self._read_record(part)
+                        if raw is None:
+                            break
+                        self._rr_remaining -= 1
+                        took += 1
+                        part.byte_offset += len(raw)
+                        part.records += 1
+                        self._current_name = name
+                        line = raw.rstrip(b"\r\n").decode(
+                            "utf-8", "replace")
+                        if line:
+                            if degrade.CONTROLLER is not None:
+                                since_gate += 1
+                                if since_gate >= ADMIT_EVERY_LINES:
+                                    since_gate = 0
+                                    degrade.CONTROLLER.admit()
+                            yield line
+                # Turn over (quota spent or nothing to read): rotate.
+                self._rr_pos = (self._rr_pos + 1) % len(self._order)
+                self._rr_remaining = self.turn_records
+                idle_turns = 0 if took else idle_turns + 1
+            if not self.process_continuously:
+                self._close_handles()
+                return
+            # Idle heartbeat: lets the downstream batcher flush an aged
+            # partial batch while no partition has a complete record.
+            yield None
+            time.sleep(self.poll_interval_s)
+            if not self._discovered:
+                self._discover()
+            self._check_append_only()
+
+    def _check_append_only(self) -> None:
+        """Continuous-mode poll-time guard: a partition whose file
+        shrank below the committed offset was rewritten — quarantine it
+        (the head-prefix check is restore-time only; mid-run the open
+        handle pins the inode, so shrink is the observable violation)."""
+        for name in self._order:
+            part = self._parts[name]
+            if part.quarantined:
+                continue
+            try:
+                if os.stat(part.path).st_size < part.byte_offset:
+                    self._quarantine_partition(
+                        part, "shrank below the committed offset")
+            except OSError:
+                self._quarantine_partition(part, "unreadable")
+
+    def _close_handles(self) -> None:
+        for part in self._parts.values():
+            if part._handle is not None:
+                part._handle.close()
+                part._handle = None

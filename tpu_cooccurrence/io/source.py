@@ -17,24 +17,106 @@ TPU-native replacement for the reference's forked file-monitoring source
 No existence pre-check is done before listing — the reference deliberately
 removed it for object-store compatibility (:196-201); we surface listing
 errors directly instead.
+
+Every source implements the :class:`Source` interface: cursor markers ride
+``meta["source"]`` (:meth:`Source.checkpoint_state`), while the first-class
+ingest-offset section rides ``meta["ingest_offsets"]``
+(:meth:`Source.offsets_state`) and commits atomically with the state under
+the epoch protocol — the checkpoint plane's exactly-once guarantee extended
+to the wire (see ``io/partitioned.py`` for the partitioned-log shape).
 """
 
 from __future__ import annotations
 
+import hashlib
+import logging
 import os
 import time
-from typing import Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..metrics import Counters, SPLIT_READER_NUM_SPLITS
 from ..robustness import degrade, faults
+
+LOG = logging.getLogger("tpu_cooccurrence.io.source")
 
 #: Lines between admission-gate checks while a degradation controller is
 #: installed: cheap enough to bound burst admission at sub-batch
 #: granularity, coarse enough to stay off the per-line hot path.
 ADMIT_EVERY_LINES = 4096
 
+#: Cap on the head-prefix hash that guards a checkpointed in-flight file
+#: (and a partitioned log's consumed prefix): enough bytes to make an
+#: accidental rewrite collision implausible, small enough that restore
+#: verification never re-reads a large log.
+HEAD_HASH_BYTES = 65536
 
-class FileMonitorSource:
+
+def head_hash(path: str, nbytes: int) -> str:
+    """SHA-256 hex digest of the first ``min(nbytes, HEAD_HASH_BYTES)``
+    bytes of ``path`` — the rewrite guard both sides of a checkpoint
+    compute over the same prefix length (append-only growth beyond the
+    checkpointed length never changes it)."""
+    limit = min(int(nbytes), HEAD_HASH_BYTES)
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        digest.update(f.read(limit))
+    return digest.hexdigest()
+
+
+class Source:
+    """Interface every ingest source implements.
+
+    Two checkpoint hooks with distinct contracts:
+
+    * :meth:`checkpoint_state` / :meth:`restore_state` — the legacy
+      cursor markers (``meta["source"]``), enough to resume an
+      unmodified input;
+    * :meth:`offsets_state` / :meth:`restore_offsets` — the first-class
+      ingest-offset section (``meta["ingest_offsets"]``), carrying the
+      rewrite guards (sizes + head-prefix hashes) and, for partitioned
+      logs, the per-partition byte/record offsets that make recovery
+      exactly-once end-to-end.
+
+    :meth:`attach` hands the source the dead-letter quarantine and the
+    journal event callback; both are optional and default inert.
+    """
+
+    _quarantine = None
+    _on_event: Optional[Callable[[str], None]] = None
+
+    def attach(self, quarantine=None,
+               on_event: Optional[Callable[[str], None]] = None) -> None:
+        """Arm the dead-letter path and the journal event hook (called
+        by the CLI after quarantine construction, before :meth:`lines`)."""
+        self._quarantine = quarantine
+        self._on_event = on_event
+
+    def checkpoint_state(self) -> dict:
+        raise NotImplementedError
+
+    def restore_state(self, state: dict) -> None:
+        raise NotImplementedError
+
+    def offsets_state(self) -> dict:
+        raise NotImplementedError
+
+    def restore_offsets(self, state: dict) -> None:
+        raise NotImplementedError
+
+    def ingest_health(self) -> Optional[dict]:
+        """Per-partition offset/lag/quarantine health for the /healthz
+        ingest block and the journal's per-window ingest fields — None
+        when the source has no partition structure to report."""
+        return None
+
+    def origin(self) -> Tuple[str, int]:
+        raise NotImplementedError
+
+    def lines(self) -> Iterator[Optional[str]]:
+        raise NotImplementedError
+
+
+class FileMonitorSource(Source):
     """Streams lines from a file or directory in modification-time order."""
 
     def __init__(
@@ -57,6 +139,11 @@ class FileMonitorSource:
         self._current_file: Optional[str] = None
         self._current_mtime: int = -1
         self._current_line: int = 0
+        # Restored in-flight rewrite guard (offsets_state's "in_flight"
+        # section), consumed once by lines(); files it condemns land here
+        # and are never re-listed.
+        self._in_flight_guard: Optional[dict] = None
+        self._dropped_paths: set = set()
 
     # -- checkpoint hooks ------------------------------------------------
 
@@ -74,6 +161,81 @@ class FileMonitorSource:
         self._current_mtime = int(state.get("current_mtime", -1))
         self._current_line = int(state.get("current_line", 0))
 
+    def offsets_state(self) -> dict:
+        offsets = {
+            "v": 1,
+            "format": "files",
+            "in_flight": self._in_flight_state(),
+        }
+        return offsets
+
+    def restore_offsets(self, state: dict) -> None:
+        state = state or {}
+        if int(state.get("v", 1)) != 1:
+            LOG.warning("ingest offset section v=%s is newer than this "
+                        "reader (v=1): applying best-effort",
+                        state.get("v"))
+        fmt = state.get("format", "files")
+        if fmt != "files":
+            raise ValueError(
+                f"checkpoint ingest offsets carry format {fmt!r} but "
+                f"the job was launched with --source-format files")
+        self._in_flight_guard = state.get("in_flight")
+
+    def _in_flight_state(self) -> Optional[dict]:
+        """Rewrite guard for the file a mid-file checkpoint is inside:
+        (mtime, size, head-prefix hash) — enough for a restore to tell
+        an append-only grown file (resume exactly) from a rewritten one
+        (dead-letter, never silently re-read whole)."""
+        if self._current_file is None:
+            return None
+        try:
+            st = os.stat(self._current_file)
+            digest = head_hash(self._current_file, st.st_size)
+        except OSError:
+            return None
+        in_flight = {
+            "path": self._current_file,
+            "mtime": int(st.st_mtime_ns),
+            "size": int(st.st_size),
+            "head_hash": digest,
+        }
+        return in_flight
+
+    def _verify_in_flight(self, guard: dict) -> str:
+        """``"ok"`` (unchanged or append-only grown), ``"rewritten"``
+        (shrunk or head-prefix mismatch) or ``"missing"`` for the
+        checkpointed in-flight file."""
+        path = guard.get("path")
+        size = int(guard.get("size", 0))
+        try:
+            st = os.stat(path)
+            if (st.st_size == size
+                    and int(st.st_mtime_ns) == int(guard.get("mtime",
+                                                             -1))):
+                # Untouched since the checkpoint — skip the hash read.
+                return "ok"
+            if st.st_size < size:
+                return "rewritten"
+            if head_hash(path, size) != guard.get("head_hash"):
+                return "rewritten"
+        except OSError:
+            return "missing"
+        return "ok"
+
+    def _dead_letter_file(self, path: str, reason: str) -> None:
+        """Divert a condemned in-flight file to the dead-letter path and
+        journal the event — the file is skipped, never re-read whole."""
+        LOG.warning("in-flight input file %s %s — dead-lettering, "
+                    "skipping (events it held beyond the checkpoint are "
+                    "not recoverable)", path, reason)
+        if self._quarantine is not None:
+            self._quarantine.quarantine(path, self._current_line, "",
+                                        f"in-flight file {reason}")
+        if self._on_event is not None:
+            self._on_event(
+                f"ingest/file-rewritten:{os.path.basename(path)}")
+
     # -- listing ---------------------------------------------------------
 
     def _list_splits(self) -> List[Tuple[int, str]]:
@@ -89,7 +251,7 @@ class FileMonitorSource:
             candidates = [self.path]
         splits = []
         for p in candidates:
-            if not os.path.isfile(p):
+            if not os.path.isfile(p) or p in self._dropped_paths:
                 continue
             mtime = os.stat(p).st_mtime_ns
             if mtime > self.global_modification_time:
@@ -107,7 +269,7 @@ class FileMonitorSource:
 
     # -- reading ---------------------------------------------------------
 
-    def lines(self) -> Iterator[str]:
+    def lines(self) -> Iterator[Optional[str]]:
         """Yield all input lines, file by file, in order.
 
         The progress marker advances only once a file is exhausted; while a
@@ -116,18 +278,34 @@ class FileMonitorSource:
         source skips the already-consumed prefix of the in-flight file (if
         it still exists unmodified) and continues.
         """
-        # Restored mid-file position (if any): resume only when the same
-        # file is re-listed with an unchanged mtime; a file modified since
-        # the checkpoint is re-read whole. Prefix events behind the
-        # restored watermark are then dropped as late, but prefix events in
-        # still-open (checkpointed, unfired) windows are NOT late and are
-        # double-counted — same exposure as the reference, which re-forwards
-        # a modified file as a whole new split
-        # (ContinuousFileMonitoringFunction.java:239-257). Don't modify an
-        # in-flight input file concurrently with a checkpointed run.
+        # Restored mid-file position (if any). With the checkpoint's
+        # in_flight guard (offsets_state) the resume is verified: an
+        # unchanged or append-only grown file resumes at the exact line
+        # even when its mtime moved, while a shrunk/rewritten file is
+        # dead-lettered and skipped instead of silently re-read whole
+        # (the pre-guard exposure: prefix events in still-open windows
+        # were double-counted, matching the reference re-forwarding a
+        # modified file as a whole new split,
+        # ContinuousFileMonitoringFunction.java:239-257). A legacy
+        # checkpoint with no guard keeps the old rule — resume only on
+        # an unchanged mtime, re-read whole otherwise.
         skip_file = self._current_file
         skip_mtime = self._current_mtime
         skip_lines = self._current_line
+        resume_any_mtime = False
+        guard, self._in_flight_guard = self._in_flight_guard, None
+        if (skip_file is not None and guard is not None
+                and guard.get("path") == skip_file):
+            verdict = self._verify_in_flight(guard)
+            if verdict == "ok":
+                resume_any_mtime = True
+            elif verdict == "rewritten":
+                self._dead_letter_file(skip_file, "rewritten under a "
+                                       "checkpoint (shrunk or head-prefix "
+                                       "mismatch)")
+                self._dropped_paths.add(skip_file)
+                # The (mtime, path) floor below still hides the consumed
+                # same-mtime siblings; only the condemned file is dropped.
         files_opened = 0
         since_gate = 0
         while True:
@@ -148,8 +326,8 @@ class FileMonitorSource:
                     degrade.CONTROLLER.admit()
                 self.counters.add(SPLIT_READER_NUM_SPLITS, 1)
                 to_skip = skip_lines if (p == skip_file
-                                         and mtime == skip_mtime) else 0
-                skip_file = None
+                                         and (mtime == skip_mtime
+                                              or resume_any_mtime)) else 0
                 self._current_file = p
                 self._current_mtime = mtime
                 self._current_line = to_skip

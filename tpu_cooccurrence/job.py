@@ -298,6 +298,11 @@ class CooccurrenceJob:
         # Optional file source attached by the CLI so periodic checkpoints
         # snapshot the input offset too (crash recovery resumes mid-stream).
         self.source = None
+        # Per-window ingest snapshots (partitioned source only): captured
+        # on the sampling thread at window fire — the only thread driving
+        # the line generator — then read by _record_window on whichever
+        # thread scores that seq (distinct keys; no lock needed).
+        self._ingest_by_seq: Dict[int, dict] = {}
         # One in-process feedback channel (the reference counts one queue
         # handshake per subtask open,
         # UserInteractionCounterOneInputStreamOperator.java:109). Sliding
@@ -591,6 +596,14 @@ class CooccurrenceJob:
     def _drain(self, final: bool) -> None:
         for ts, users, items in self.engine.fire_ready(final=final):
             self.windows_fired += 1
+            if self.source is not None:
+                # Wire position at the fire boundary (sampling thread —
+                # the generator is suspended, so the snapshot is exact):
+                # the journal's per-window ingest fields, matched by the
+                # checkpoint this same boundary commits.
+                health = self.source.ingest_health()
+                if health is not None:
+                    self._ingest_by_seq[self.windows_fired] = health
             if self._ckpt_dirty is not None:
                 # Incremental-checkpoint user feed: the reservoir only
                 # mutates for this window's users, so they are exactly
@@ -827,6 +840,17 @@ class CooccurrenceJob:
                 self.degrade.last_overloaded
                 if self.degrade is not None else False)
         spans = self._build_spans(stats, admit_seconds)
+        # Ingest plane (partitioned source only): the wire position the
+        # sampling thread snapshotted when this seq fired — per-partition
+        # offsets + lag into the journal, the worst lag onto the gauge.
+        ingest = self._ingest_by_seq.pop(seq, None)
+        if ingest is not None:
+            REGISTRY.gauge(
+                "cooc_ingest_partition_lag",
+                help="worst per-partition unread bytes on disk at the "
+                     "last fired window").set(max(
+                         (p["lag"] for p in ingest["partitions"].values()),
+                         default=0))
         # /healthz last_window block (observability/http.py): the same
         # stage carve, visible without pulling the journal. One dict
         # reassignment — HTTP-thread readers see whole snapshots only.
@@ -854,6 +878,17 @@ class CooccurrenceJob:
             }
             self._stamp(rec)
             rec["spans"] = spans
+            if ingest is not None:
+                # The exactly-once ledger: the restored checkpoint's
+                # ingest_offsets section must match the last committed
+                # window's fields here (the chaos capstone asserts it).
+                rec["ingest_offsets"] = {
+                    name: {"byte_offset": p["byte_offset"],
+                           "records": p["records"]}
+                    for name, p in sorted(ingest["partitions"].items())}
+                rec["ingest_lag"] = {
+                    name: p["lag"]
+                    for name, p in sorted(ingest["partitions"].items())}
             if level is not None:
                 rec["degradation_level"] = level
                 if degrade_events:
@@ -894,6 +929,20 @@ class CooccurrenceJob:
     def _journal_degrade_event(self, event: str) -> None:
         """Append one out-of-band degradation event record (the
         admission-side transition path — see journal.EVENT_SCHEMA)."""
+        from .observability.journal import VERSION
+
+        self.journal.record(self._stamp(
+            {"v": VERSION, "event": event,
+             "wall_unix": round(time.time(), 3),
+             "window_seq": self.windows_fired}))
+
+    def _journal_ingest_event(self, event: str) -> None:
+        """Append one out-of-band ingest event record (a rewritten
+        in-flight file dead-lettered, a partition quarantined, a
+        partition reassignment on the rescale seam — journal
+        EVENT_SCHEMA; cooc-trace annotates the reassign seams)."""
+        if self.journal is None:
+            return
         from .observability.journal import VERSION
 
         self.journal.record(self._stamp(

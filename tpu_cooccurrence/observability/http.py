@@ -90,7 +90,7 @@ class MetricsServer:
                  stale_after_s: float = 300.0,
                  supervisor_info: Optional[dict] = None,
                  serving=None, serve_stale_after_s: float = 0.0,
-                 peers=None, last_window=None) -> None:
+                 peers=None, last_window=None, ingest=None) -> None:
         self.registry = registry
         self.counters = counters
         self.ledger = ledger
@@ -110,6 +110,10 @@ class MetricsServer:
         # stage breakdown (job.last_window_health) — /healthz shows a
         # wedged stage without anyone pulling the journal.
         self.last_window = last_window
+        # Ingest plane: a callable returning the source's partition
+        # offset/lag snapshot (Source.ingest_health) — None for the
+        # plain files source, a per-partition dict for partitioned logs.
+        self.ingest = ingest
         self._started_unix = time.time()
         # Per-route request-latency histograms, registered up front so
         # they render on /metrics (at zero) from the first scrape.
@@ -261,6 +265,14 @@ class MetricsServer:
             lw = self.last_window()
             if lw is not None:
                 payload["last_window"] = lw
+        if self.ingest is not None:
+            # Partitioned-log sources only: per-partition byte offsets,
+            # record counts, on-disk lag, quarantine flags and the
+            # deterministic owner index. The plain files source returns
+            # None here and the block is simply absent.
+            ing = self.ingest()
+            if ing is not None:
+                payload["ingest"] = ing
         return payload, status not in ("stale", "paused", "snapshot_stale",
                                        "peer_stale")
 

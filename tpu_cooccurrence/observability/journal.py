@@ -168,6 +168,14 @@ SCHEMA = {
     # written — restart forensics show which epoch the gang resumed
     # from.
     "epoch": (False, int),
+    # Ingest plane (io/partitioned.py, --source-format partitioned):
+    # per-partition wire position when this window fired — the journal
+    # side of the exactly-once contract (the restored checkpoint's
+    # ingest_offsets section must match the last committed window's).
+    "ingest_offsets": (False, dict),  # partition -> {byte_offset,
+                                      # records} at window fire
+    "ingest_lag": (False, dict),      # partition -> unread bytes on
+                                      # disk at window fire
     # Tracing plane (this module + trace.py): fleet-wide correlation
     # trio, uniform across every record type.
     "run_id": (False, str),      # fleet run id (RUN_ID_ENV)

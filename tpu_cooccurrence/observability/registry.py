@@ -166,6 +166,11 @@ CANONICAL_METRICS = frozenset({
     "cooc_replica_generation_lag",
     "cooc_replica_deltas_applied_total",
     "cooc_replica_resyncs_total",
+    # ingest plane (io/partitioned.py offsets committed by
+    # state/checkpoint.py): worst per-partition unread bytes at the last
+    # fired window, and offset sections committed with the state
+    "cooc_ingest_partition_lag",
+    "cooc_ingest_offset_commits_total",
 })
 
 #: TransferLedger snapshot key -> exposition series name. Explicit

@@ -244,8 +244,18 @@ def annotations(windows: List[dict], events: List[dict],
         reason = rec.get("fallback_reason")
         if reason:
             fallbacks[reason] = fallbacks.get(reason, 0) + 1
+    # Ingest-plane seams: partition-ownership reassignment events the
+    # rescaled restore journals ("ingest/partition-reassign:N->M") —
+    # each marks the gang topology boundary where the merged offset
+    # sections were re-derived under new ownership.
+    partition_reassigns = [
+        {"event": r["event"], "window": r.get("window_seq")}
+        for r in sorted(events, key=lambda r: float(r["wall_unix"]))
+        if str(r.get("event", "")).startswith("ingest/partition-reassign")]
     degrade_transitions = sum(
-        len(r.get("degrade_events", [])) for r in windows) + len(events)
+        len(r.get("degrade_events", [])) for r in windows) + sum(
+        1 for r in events
+        if not str(r.get("event", "")).startswith("ingest/"))
     # Restarts: attempts observed per (run_id, process_id) beyond the
     # first — the supervisor threads the ordinal through the env
     # exactly so this census works post-hoc.
@@ -278,6 +288,7 @@ def annotations(windows: List[dict], events: List[dict],
              "trigger": r["trigger"], "window": r["window"]}
             for r in sorted(autoscales,
                             key=lambda r: float(r["wall_unix"]))],
+        "partition_reassigns": partition_reassigns,
         "restarts": restarts,
         "dropped_duplicate_windows": dropped_duplicates,
         "replica_resyncs": resyncs,
@@ -442,6 +453,9 @@ def render_text(analysis: dict) -> str:
             f"  autoscale {drain['decision']} {drain['from']}->"
             f"{drain['to']} ({drain['trigger']}) @window "
             f"{drain['window']}")
+    for seam in an.get("partition_reassigns", []):
+        lines.append(
+            f"  {seam['event']} @window {seam['window']}")
     if not an["replica_generation_monotone"]:
         lines.append("  WARNING: replica generation stream stepped "
                      "backwards (corrupt merge or clock skew)")

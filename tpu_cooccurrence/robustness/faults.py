@@ -109,6 +109,15 @@ SITES = {
                         "the whole gang drain voluntarily and is about "
                         "to relaunch it at the new topology (seq = "
                         "1-based rescale ordinal)",
+    "offset_commit": "state/checkpoint.py — the ingest offset section "
+                     "is in the committed generation and the state is "
+                     "durable, before the gang epoch commit (seq = "
+                     "generation number); a crash here must replay "
+                     "the wire and the state from the SAME boundary",
+    "partition_reassign": "state/checkpoint.py — the rescaled restore "
+                          "merged the per-writer offset sections and "
+                          "is re-deriving partition ownership at the "
+                          "new topology (seq = restored generation)",
 }
 
 KINDS = ("crash", "exception", "delay_ms", "torn_write")

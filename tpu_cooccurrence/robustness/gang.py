@@ -84,8 +84,13 @@ GANG_DIR_ENV = "TPU_COOC_GANG_DIR"
 #: tuple to the registry and to live fire() call sites). The two
 #: ``rescale_*`` sites bracket the autoscaler's rescale seam
 #: (robustness/autoscale.py): drain-commit → voluntary exit → relaunch.
+#: The two ingest sites cover the exactly-once wire plane:
+#: ``offset_commit`` fires when a generation's ingest offset section is
+#: durable, ``partition_reassign`` when a rescaled restore re-derives
+#: partition ownership at the new topology.
 GANG_SITES = ("barrier_enter", "ckpt_commit", "peer_heartbeat",
-              "rescale_drain", "rescale_relaunch")
+              "rescale_drain", "rescale_relaunch", "offset_commit",
+              "partition_reassign")
 
 #: Stale-peer gauge refreshed by :meth:`PeerTable.snapshot` (the
 #: /healthz scrape): peers whose heartbeat age exceeded the threshold.

@@ -146,6 +146,11 @@ CHAIN_LEN_GAUGE = "cooc_checkpoint_delta_chain_len"
 #: Ratio-triggered base rewrites (--checkpoint-compact-ratio).
 COMPACTIONS_GAUGE = "cooc_checkpoint_compactions_total"
 
+#: Ingest offset sections committed with checkpoint generations (the
+#: wire side of the exactly-once boundary; incremented at the
+#: ``offset_commit`` fault site).
+OFFSET_COMMITS_GAUGE = "cooc_ingest_offset_commits_total"
+
 #: Stats of this process's most recent :func:`save` — the journal
 #: checkpoint record's source (read by ``job.checkpoint`` right after
 #: the save returns; single writer thread per process).
@@ -751,6 +756,12 @@ def save(job, directory: str, source=None) -> str:
 
     if source is not None:
         meta["source"] = source.checkpoint_state()
+        # First-class ingest-offset section (io/source.Source
+        # .offsets_state): per-partition byte/record offsets plus the
+        # rewrite guards, committed atomically with the state under the
+        # same epoch protocol — the wire and the state recover from the
+        # SAME boundary (the reference's core exactly-once guarantee).
+        meta["ingest_offsets"] = source.offsets_state()
 
     # Latest emitted top-K (the consumable result state).
     lat_items, lat_offsets, lat_others, lat_scores = [], [0], [], []
@@ -866,6 +877,11 @@ def save(job, directory: str, source=None) -> str:
                 job.item_vocab.to_external_batch(dirty),
                 gen=gen, prev=prev, base=base,
                 n_shards=getattr(job.scorer, "n_shards", 0), aux=aux)
+            # The ingest-offset section rides the delta header too: a
+            # consumer tailing the delta log (read_delta_stream) sees
+            # the wire position each generation committed, without
+            # opening the npz meta.
+            rec.ingest_offsets = meta.get("ingest_offsets")
             delta_bytes = deltalog.encode_delta(rec)
             chain_len = len(chain) + 1
             meta["ckpt_delta"] = {
@@ -968,6 +984,17 @@ def save(job, directory: str, source=None) -> str:
     # pointer is advisory, never load-bearing. Quarantine and step-back
     # refresh it so it never names a gone file.
     _update_latest(directory, suffix)
+    # The offset_commit site marks the wire side of the same boundary:
+    # the generation (ingest offsets included) is renamed into place —
+    # a crash here must replay the wire and the state from the SAME
+    # point, which the chaos capstone pins bit-identically.
+    if source is not None:
+        if faults.PLAN is not None:
+            faults.PLAN.fire("offset_commit", seq=gen)
+        REGISTRY.gauge(
+            OFFSET_COMMITS_GAUGE,
+            help="ingest offset sections committed with checkpoint "
+                 "generations this run").add(1)
     # The ckpt_commit site sits exactly inside the torn-pointer window:
     # the generation file is renamed into place but neither the
     # directory entry nor the gang's epoch marker is durable yet — a
@@ -1253,8 +1280,24 @@ def _apply_restored(job, data: "dict[str, np.ndarray]", restored_gen: int,
             data["latest_scores"][lo:hi].tolist()))
         job.latest.set_row(dense, top)
 
-    if source is not None and "source" in meta:
-        source.restore_state(meta["source"])
+    if source is not None:
+        # Offsets first: the section's format tag is the cross-format
+        # guard, and a checkpoint written by the other --source-format
+        # must fail with the clean launch error before the legacy
+        # marker restore trips over the foreign marker shape.
+        if "ingest_offsets" in meta:
+            # The wire resumes from the same committed boundary as the
+            # state: per-partition offsets, rewrite guards and the
+            # rotation cursor (io/source.Source.restore_offsets).
+            source.restore_offsets(meta["ingest_offsets"])
+        else:
+            LOG.warning(
+                "checkpoint generation %d predates the ingest-offset "
+                "section: offsets absent, replaying from source markers "
+                "(resume is marker-exact but unguarded against in-flight "
+                "file rewrites)", restored_gen)
+        if "source" in meta:
+            source.restore_state(meta["source"])
     # Anchor the incremental dirty log at the restored generation: the
     # in-memory state now equals that generation exactly, so rows
     # touched from here on are precisely "dirty since restored_gen" and
@@ -1279,6 +1322,59 @@ def _apply_restored(job, data: "dict[str, np.ndarray]", restored_gen: int,
         GENERATION_GAUGE,
         help="checkpoint generation last written or restored").set(
             restored_gen)
+
+
+def merge_ingest_offsets(sections: "list", writers: int) -> "dict | None":
+    """Merge per-writer ``ingest_offsets`` sections across a rescale —
+    the wire-plane analogue of :func:`~.store.merge_mh_cells`: each
+    partition's authoritative copy comes from its OWNING writer under
+    the old topology (``index % writers``, the ``parallel/`` modular
+    ownership idiom), and every other writer's replicated copy is
+    cross-checked against it. Ingest is deterministic and replicated,
+    so agreement is the invariant; on disagreement the conservative
+    minimum entry wins (re-reading a suffix beats skipping one) with a
+    loud warning. The round-robin cursor is replicated too — a cursor
+    disagreement resets the rotation alongside the same warning."""
+    sections = [s for s in sections if s]
+    if not sections:
+        return None
+    merged = dict(sections[0])
+    if merged.get("format") != "partitioned":
+        # Files-format (or unknown) sections are replicated whole;
+        # writer 0's copy stands for the gang.
+        return merged
+    all_names = sorted(set().union(
+        *[set(s.get("partitions") or {}) for s in sections]))
+    partitions = {}
+    for idx, name in enumerate(all_names):
+        entries = [e for e in ((s.get("partitions") or {}).get(name)
+                               for s in sections) if e is not None]
+        owner = idx % max(1, writers)
+        chosen = ((sections[owner].get("partitions") or {}).get(name)
+                  if owner < len(sections) else None) or entries[0]
+        if any(int(e.get("byte_offset", 0)) != int(
+                chosen.get("byte_offset", 0))
+               or int(e.get("records", 0)) != int(chosen.get("records", 0))
+               for e in entries):
+            chosen = min(entries,
+                         key=lambda e: int(e.get("byte_offset", 0)))
+            LOG.warning(
+                "rescale restore: ingest offset sections disagree for "
+                "partition %r — replicated ingest should have kept them "
+                "identical; taking the conservative minimum "
+                "(%d bytes, %d records)", name,
+                int(chosen.get("byte_offset", 0)),
+                int(chosen.get("records", 0)))
+        partitions[name] = chosen
+    merged["partitions"] = partitions
+    if any(s.get("rr_part") != merged.get("rr_part")
+           or s.get("rr_remaining") != merged.get("rr_remaining")
+           for s in sections[1:]):
+        LOG.warning("rescale restore: round-robin ingest cursors "
+                    "disagree across writers — resetting the rotation")
+        merged["rr_part"] = None
+        merged["rr_remaining"] = 0
+    return merged
 
 
 def restore_rescaled(job, directory: str, gen: int, writers: int,
@@ -1362,6 +1458,23 @@ def restore_rescaled(job, directory: str, gen: int, writers: int,
     meta0 = dict(metas[0])
     meta0.pop("ckpt_codec", None)
     meta0.pop("ckpt_delta", None)
+    # Partition reassignment (the wire side of the seam): merge the
+    # per-writer ingest offset sections under the OLD topology's
+    # ownership, then let the relaunched topology re-derive ownership
+    # from the same modular formula — the drain checkpoint carried the
+    # offsets, so every partition resumes exactly once at M workers.
+    ing_offsets = merge_ingest_offsets(
+        [m.get("ingest_offsets") for m in metas], writers)
+    if ing_offsets is not None:
+        meta0["ingest_offsets"] = ing_offsets
+    if faults.PLAN is not None:
+        faults.PLAN.fire("partition_reassign", seq=int(gen))
+    if ing_offsets is not None \
+            and ing_offsets.get("format") == "partitioned" \
+            and getattr(job, "journal", None) is not None:
+        job._journal_ingest_event(
+            f"ingest/partition-reassign:{int(writers)}->"
+            f"{int(job.config.num_processes or 1)}")
     base["meta_json"] = np.frombuffer(
         json.dumps(meta0).encode(), dtype=np.uint8)
     # Merge the emitted top-K across writers (disjoint partitions),

@@ -312,6 +312,11 @@ class DeltaGeneration:
     #                         has no per-user reservoir state)
     item_vocab_len: int = 0  # len(item_vocab) at this generation
     user_vocab_len: int = 0  # len(user_vocab) at this generation
+    ingest_offsets: Optional[dict] = None  # the generation's committed
+    #                         ingest-offset section (io/source.Source
+    #                         .offsets_state) — the wire position a
+    #                         delta-log consumer sees without opening
+    #                         the npz meta; None on pre-ingest files
 
     def iter_rows(self) -> Iterator[dict]:
         """Per-row state records (dense-id domain): ``{"gen", "row",
@@ -425,6 +430,7 @@ def encode_delta(d: DeltaGeneration) -> bytes:
         "hist_k": int(d.hist_k),
         "item_vocab_len": int(d.item_vocab_len),
         "user_vocab_len": int(d.user_vocab_len),
+        "ingest_offsets": d.ingest_offsets,
         "payload": ["zlib", len(payload)],
         "sections": sections,
     }
@@ -502,6 +508,7 @@ def decode_delta(data: bytes) -> DeltaGeneration:
         hist_k=int(header.get("hist_k", 0)),
         item_vocab_len=int(header.get("item_vocab_len", 0)),
         user_vocab_len=int(header.get("user_vocab_len", 0)),
+        ingest_offsets=header.get("ingest_offsets"),
         **fields)
     if not (len(d.rows) == len(d.row_sums) == len(d.cell_lens)
             == int(header["n_rows"])):
