@@ -1,0 +1,628 @@
+"""Per-rule positive/negative fixture registry for the meta-rule test.
+
+``FIXTURES`` maps every registered cooclint rule name to at least one
+``bad`` fixture (a mini repo the rule MUST flag) and one ``good``
+fixture (a mini repo the rule MUST pass). Each fixture is a dict of
+repo-relative path -> source text; ``tests/test_meta_rules.py``
+materialises it under ``tmp_path`` and runs the one rule over it.
+
+The point is structural: a rule with no positive fixture could rot into
+a no-op without any test noticing, and a rule with no negative fixture
+could grow false positives the repo-clean gate only reports once they
+hit real code. The meta test fails the moment a new rule registers
+without an entry here.
+
+This file's raw text necessarily quotes bad fault-site spec strings
+(the same reason tests/test_cooclint.py opts out), so:
+# cooclint: disable-file=fault-site
+"""
+
+from typing import Dict, List
+
+from tpu_cooccurrence.robustness.gang import GANG_SITES
+
+_FIRE_ALL_GANG_SITES = "def drive(plan):\n" + "".join(
+    f'    plan.fire("{site}")\n' for site in sorted(GANG_SITES))
+
+#: rule name -> {"bad": [files-dict, ...], "good": [files-dict, ...]}
+FIXTURES: Dict[str, Dict[str, List[Dict[str, str]]]] = {
+    "ckpt-format-roundtrip": {
+        "bad": [{
+            "tpu_cooccurrence/state/checkpoint.py": (
+                "def save():\n"
+                "    meta = {\"windows\": 1, \"orphan\": 2}\n\n\n"
+                "def restore(meta):\n"
+                "    return meta[\"windows\"]\n"),
+            "tpu_cooccurrence/state/delta.py": (
+                "def encode():\n"
+                "    header = {\"gen\": 1}\n\n\n"
+                "def decode(header):\n"
+                "    return header[\"gen\"]\n"),
+            "tests/test_fmt_fixture.py":
+                "KEYS = {\"windows\", \"orphan\", \"gen\"}\n",
+        }],
+        "good": [{
+            "tpu_cooccurrence/state/checkpoint.py": (
+                "def save():\n"
+                "    meta = {\"windows\": 1}\n\n\n"
+                "def restore(meta):\n"
+                "    return meta[\"windows\"]\n"),
+            "tpu_cooccurrence/state/delta.py": (
+                "def encode():\n"
+                "    header = {\"gen\": 1}\n\n\n"
+                "def decode(header):\n"
+                "    return header[\"gen\"]\n"),
+            "tests/test_fmt_fixture.py":
+                "KEYS = {\"windows\", \"gen\"}\n",
+        }],
+    },
+    "cli-flag": {
+        "bad": [{
+            "tpu_cooccurrence/config.py": (
+                "import argparse\n"
+                "import dataclasses\n\n\n"
+                "@dataclasses.dataclass\n"
+                "class Config:\n"
+                "    top_k: int = 10\n\n\n"
+                "def from_args():\n"
+                "    p = argparse.ArgumentParser()\n"
+                '    p.add_argument("--top-k", type=int, dest="top_k")\n'
+                '    p.add_argument("--mystery-flag", type=int,'
+                ' dest="mystery")\n'
+                "    return p\n"),
+            "README.md": "Flags: `--top-k`.\n",
+        }],
+        "good": [{
+            "tpu_cooccurrence/config.py": (
+                "import argparse\n"
+                "import dataclasses\n\n\n"
+                "@dataclasses.dataclass\n"
+                "class Config:\n"
+                "    top_k: int = 10\n\n\n"
+                "def from_args():\n"
+                "    p = argparse.ArgumentParser()\n"
+                '    p.add_argument("--top-k", type=int, dest="top_k")\n'
+                "    return p\n"),
+            "README.md": "Flags: `--top-k`.\n",
+        }],
+    },
+    "collective-watchdog": {
+        "bad": [{
+            "tpu_cooccurrence/sampling/multihost.py": (
+                "from jax.experimental import multihost_utils\n\n\n"
+                "def exchange(vec):\n"
+                "    return multihost_utils.process_allgather(vec)\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/sampling/multihost.py": (
+                "from tpu_cooccurrence.parallel.distributed import (\n"
+                "    gang_barrier, guarded_allgather)\n\n\n"
+                "def exchange(vec):\n"
+                '    gang_barrier("x")\n'
+                "    return guarded_allgather(vec)\n"),
+        }],
+    },
+    "degrade-registry": {
+        "bad": [{
+            "tpu_cooccurrence/robustness/degrade.py": (
+                "import enum\n\n\n"
+                "class DegradationLevel(enum.IntEnum):\n"
+                "    NORMAL = 0\n"
+                "    SHED_SAMPLING = 1\n\n\n"
+                "TRANSITION_RULES = {\n"
+                '    "NORMAL": "healthy",\n'
+                "}\n"
+                "LEVEL_EVENTS = {\n"
+                '    "NORMAL": "degrade/enter_normal",\n'
+                '    "SHED_SAMPLING": "degrade/enter_shed_sampling",\n'
+                "}\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/robustness/degrade.py": (
+                "import enum\n\n\n"
+                "class DegradationLevel(enum.IntEnum):\n"
+                "    NORMAL = 0\n"
+                "    SHED_SAMPLING = 1\n\n\n"
+                "TRANSITION_RULES = {\n"
+                '    "NORMAL": "healthy",\n'
+                '    "SHED_SAMPLING": "overloaded",\n'
+                "}\n"
+                "LEVEL_EVENTS = {\n"
+                '    "NORMAL": "degrade/enter_normal",\n'
+                '    "SHED_SAMPLING": "degrade/enter_shed_sampling",\n'
+                "}\n"),
+        }],
+    },
+    "donation-reuse": {
+        "bad": [{
+            "tpu_cooccurrence/scorer.py": (
+                "import functools\n"
+                "import jax\n"
+                "from .ops.donation import donate_argnums\n\n\n"
+                "@functools.partial(jax.jit,"
+                " donate_argnums=donate_argnums(0))\n"
+                "def update(c, d):\n"
+                "    return c + d\n\n\n"
+                "class Scorer:\n"
+                "    def step(self, d):\n"
+                "        out = update(self.cnt, d)\n"
+                "        return self.cnt.sum()\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/scorer.py": (
+                "import functools\n"
+                "import jax\n"
+                "from .ops.donation import donate_argnums\n\n\n"
+                "@functools.partial(jax.jit,"
+                " donate_argnums=donate_argnums(0))\n"
+                "def update(c, d):\n"
+                "    return c + d\n\n\n"
+                "class Scorer:\n"
+                "    def step(self, d):\n"
+                "        self.cnt = update(self.cnt, d)\n"
+                "        return self.cnt.sum()\n"),
+        }],
+    },
+    "fault-site": {
+        "bad": [{
+            "tpu_cooccurrence/chaos_caller.py": (
+                "def f(plan):\n"
+                '    plan.fire("not_a_site", seq=1)\n'),
+        }],
+        "good": [{
+            "tpu_cooccurrence/chaos_caller.py": (
+                "def f(plan):\n"
+                '    plan.fire("window_fire", seq=1)\n'),
+        }],
+    },
+    "fold-dtype-guard": {
+        "bad": [{
+            "tpu_cooccurrence/ops/aggregate.py": (
+                "import numpy as np\n"
+                "def aggregate_window_coo(src, dst, delta,"
+                " return_key=False):\n"
+                "    return src, dst, delta\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/ops/aggregate.py": (
+                "import numpy as np\n"
+                "def aggregate_window_coo(src, dst, delta,"
+                " return_key=False):\n"
+                "    if not np.issubdtype(delta.dtype, np.integer):\n"
+                '        raise TypeError("delta dtype")\n'
+                "    return src, dst, delta\n"),
+        }],
+    },
+    "fused-fallback-registry": {
+        "bad": [{
+            "tpu_cooccurrence/parallel/sharded_sparse.py": (
+                "class S:\n"
+                "    def _fallback_chained(self, reason):\n"
+                "        self.last_fallback_reason = reason\n\n"
+                "    def window(self, cold):\n"
+                "        if cold:\n"
+                "            self._fallback_chained('plan-rebuild')\n"),
+            "docs/ARCHITECTURE.md": "no fallback table here\n",
+            "tests/test_fb_fixture.py":
+                "def test_nothing():\n    pass\n",
+        }],
+        "good": [{
+            "tpu_cooccurrence/parallel/sharded_sparse.py": (
+                "class S:\n"
+                "    def _fallback_chained(self, reason):\n"
+                "        self.last_fallback_reason = reason\n\n"
+                "    def window(self, cold):\n"
+                "        if cold:\n"
+                "            self._fallback_chained('plan-rebuild')\n"),
+            "docs/ARCHITECTURE.md": "| `plan-rebuild` | cold plans |\n",
+            "tests/test_fb_fixture.py": (
+                "def test_cold():\n"
+                "    assert reason == 'plan-rebuild'\n"),
+        }],
+    },
+    "gang-fault-sites": {
+        "bad": [{
+            # faults.py present but nothing fires any gang site: every
+            # GANG_SITES member is an unplugged chaos site.
+            "tpu_cooccurrence/robustness/faults.py": "SITES = {}\n",
+        }],
+        "good": [{
+            "tpu_cooccurrence/robustness/faults.py": "SITES = {}\n",
+            "tpu_cooccurrence/robustness/gang_driver.py":
+                _FIRE_ALL_GANG_SITES,
+        }],
+    },
+    "ingest-offset-registry": {
+        "bad": [{
+            "tpu_cooccurrence/io/source.py": (
+                "def offsets_state(self):\n"
+                "    offsets = {\"v\": 1, \"orphan\": 2}\n"
+                "    return offsets\n\n\n"
+                "def restore_offsets(self, state):\n"
+                "    self.v = state.get(\"v\")\n"),
+            "tpu_cooccurrence/io/partitioned.py": (
+                "def offsets_state(self):\n"
+                "    partitions = {}\n"
+                "    partitions[name] = {\"byte_offset\": 0}\n"
+                "    offsets = {\"v\": 1, \"partitions\": partitions}\n"
+                "    return offsets\n\n\n"
+                "def restore_offsets(self, state):\n"
+                "    self.v = state.get(\"v\")\n"
+                "    for e in state[\"partitions\"].values():\n"
+                "        self.b = e[\"byte_offset\"]\n"),
+            "tests/test_ingest_fixture.py": (
+                "KEYS = {\"v\", \"orphan\", \"partitions\","
+                " \"byte_offset\"}\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/io/source.py": (
+                "def offsets_state(self):\n"
+                "    in_flight = {\"path\": self.p}\n"
+                "    offsets = {\"v\": 1, \"in_flight\": in_flight}\n"
+                "    return offsets\n\n\n"
+                "def restore_offsets(self, state):\n"
+                "    self.v = state.get(\"v\")\n"
+                "    guard = state.get(\"in_flight\")\n"
+                "    self.p = guard[\"path\"]\n"),
+            "tpu_cooccurrence/io/partitioned.py": (
+                "def offsets_state(self):\n"
+                "    partitions = {}\n"
+                "    partitions[name] = {\"byte_offset\": 0}\n"
+                "    offsets = {\"v\": 1, \"partitions\": partitions}\n"
+                "    return offsets\n\n\n"
+                "def restore_offsets(self, state):\n"
+                "    self.v = state.get(\"v\")\n"
+                "    for e in state[\"partitions\"].values():\n"
+                "        self.b = e[\"byte_offset\"]\n"),
+            "tests/test_ingest_fixture.py": (
+                "KEYS = {\"v\", \"in_flight\", \"path\","
+                " \"partitions\", \"byte_offset\"}\n"),
+        }],
+    },
+    "jit-purity": {
+        "bad": [{
+            # Host RNG two hops below the jit entry: only visible to
+            # the whole-program call-graph pass.
+            "tpu_cooccurrence/job.py": (
+                "import jax\n"
+                "import numpy as np\n\n\n"
+                "def noise(shape):\n"
+                "    return np.random.standard_normal(shape)\n\n\n"
+                "def helper(x):\n"
+                "    return x + noise(x.shape)\n\n\n"
+                "@jax.jit\n"
+                "def entry(x):\n"
+                "    return helper(x)\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/job.py": (
+                "import functools\n"
+                "import jax\n"
+                "import numpy as np\n\n\n"
+                "@functools.partial(jax.jit,"
+                " static_argnames=(\"k\",))\n"
+                "def topk(vals, k):\n"
+                "    return int(k) + vals.sum()\n\n\n"
+                "def host_helper(x):\n"
+                "    return float(np.asarray(x).sum())\n"),
+        }],
+    },
+    "journal-schema-registry": {
+        "bad": [{
+            "tpu_cooccurrence/writer.py": (
+                "class J:\n"
+                "    def emit(self):\n"
+                "        self.journal.record({'v': 1, 'seq': 1,\n"
+                "                             'warp_factor': 9})\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/writer.py": (
+                "class J:\n"
+                "    def emit(self):\n"
+                "        self.journal.record({'v': 1, 'seq': 1})\n"),
+        }],
+    },
+    "lock-annotation": {
+        "bad": [{
+            "tpu_cooccurrence/pipeline.py":
+                "import threading\nLOCK = threading.Lock()\n",
+        }],
+        "good": [{
+            "tpu_cooccurrence/pipeline.py": (
+                "import threading\n"
+                "# lock-ordering: leaf lock, never held across "
+                "registry locks\n"
+                "LOCK = threading.Lock()\n"),
+        }],
+    },
+    "lock-discipline": {
+        "bad": [{
+            "tpu_cooccurrence/pipeline.py": (
+                "class PipelineWorker:\n"
+                "    def record_upload(self, ledger, arrays):\n"
+                "        n = sum(int(a.nbytes) for a in arrays)\n"
+                "        ledger.h2d_bytes += n\n"
+                "        ledger.h2d_calls += 1\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/pipeline.py": (
+                "class PipelineWorker:\n"
+                "    def record_upload(self, ledger, n):\n"
+                "        with ledger._lock:\n"
+                "            ledger.h2d_bytes += n\n"
+                "            ledger.h2d_calls += 1\n"),
+        }],
+    },
+    "metric-name": {
+        "bad": [{
+            "tpu_cooccurrence/worker.py": (
+                "from .registry import REGISTRY\n"
+                'g = REGISTRY.gauge("cooc_bogus_thing", help="x")\n'),
+        }],
+        "good": [{
+            "tpu_cooccurrence/worker.py": (
+                "from .registry import REGISTRY\n"
+                'g = REGISTRY.gauge("cooc_windows_fired", help="x")\n'),
+        }],
+    },
+    "narrow-cast-guard": {
+        "bad": [{
+            "tpu_cooccurrence/state/packing.py": (
+                "import numpy as np\n\n\n"
+                "def shrink(deltas):\n"
+                "    return deltas.astype(np.int16)\n"),
+        }],
+        "good": [{
+            # Guard evidence in the enclosing function (iinfo bound).
+            "tpu_cooccurrence/state/packing.py": (
+                "import numpy as np\n\n\n"
+                "def shrink(deltas):\n"
+                "    lim = np.iinfo(np.int16).max\n"
+                "    if deltas.max() > lim:\n"
+                "        raise OverflowError\n"
+                "    return deltas.astype(np.int16)\n"),
+        }, {
+            # The immediate sign-extend idiom never stores narrow.
+            "tpu_cooccurrence/state/packing.py": (
+                "import numpy as np\n\n\n"
+                "def widen(vals):\n"
+                "    return vals.astype(np.int16).astype(np.int32)\n"),
+        }],
+    },
+    "native-dtype": {
+        "bad": [{
+            "tpu_cooccurrence/native/__init__.py": (
+                "import numpy as np\n"
+                "def call(x):\n"
+                "    lib.kernel(_ptr64(x), 3)\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/native/__init__.py": (
+                "import numpy as np\n"
+                "def call(x):\n"
+                "    x = np.ascontiguousarray(x, dtype=np.int64)\n"
+                "    lib.kernel(_ptr64(x), 3)\n"),
+        }],
+    },
+    "pallas-kernel-registry": {
+        "bad": [{
+            "tpu_cooccurrence/ops/pallas_score.py": (
+                "from jax.experimental import pallas as pl\n\n\n"
+                "def _my_kernel_core(x):\n"
+                "    return pl.pallas_call(None)(x)\n\n\n"
+                "def my_kernel_wrapper(x):\n"
+                "    return _my_kernel_core(x)\n"),
+            "tests/test_parity_fixture.py":
+                "def test_nothing():\n    pass\n",
+            "docs/ARCHITECTURE.md":
+                "| `_my_kernel_core` | streaming thing |\n",
+        }],
+        "good": [{
+            "tpu_cooccurrence/ops/pallas_score.py": (
+                "from jax.experimental import pallas as pl\n\n\n"
+                "def _my_kernel_core(x):\n"
+                "    return pl.pallas_call(None)(x)\n\n\n"
+                "def my_kernel_wrapper(x):\n"
+                "    return _my_kernel_core(x)\n"),
+            "tests/test_parity_fixture.py":
+                "def test_parity():\n    assert my_kernel_wrapper\n",
+            "docs/ARCHITECTURE.md":
+                "| `_my_kernel_core` | streaming thing |\n",
+        }],
+    },
+    "replica-generation-tag": {
+        "bad": [{
+            "tpu_cooccurrence/serving/replica.py": (
+                "from ..observability.http import MetricsServer\n\n\n"
+                "class ReplicaServer(MetricsServer):\n"
+                "    def recommend(self, query):\n"
+                '        return 200, {"items": []}\n'),
+        }],
+        "good": [{
+            "tpu_cooccurrence/serving/replica.py": (
+                "from ..observability.http import MetricsServer\n\n\n"
+                "class ReplicaServer(MetricsServer):\n"
+                "    def recommend(self, query):\n"
+                '        return 200, {"items": [], "generation": 1}\n'),
+        }],
+    },
+    "scale-policy-registry": {
+        "bad": [{
+            "tpu_cooccurrence/robustness/autoscale.py": (
+                "class ScalePolicy:\n"
+                "    def decide(self, *a):\n"
+                "        raise NotImplementedError\n\n\n"
+                "class MyLadderPolicy(ScalePolicy):\n"
+                "    pass\n\n\n"
+                "class MySteppedPolicy(MyLadderPolicy):\n"
+                "    pass\n"),
+            "tests/test_policy_fixture.py": (
+                "def test_hysteresis():\n"
+                "    assert MyLadderPolicy\n"),
+            "docs/ARCHITECTURE.md": (
+                "| `MyLadderPolicy` | ladder |\n"
+                "| `MySteppedPolicy` | stepped |\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/robustness/autoscale.py": (
+                "class ScalePolicy:\n"
+                "    def decide(self, *a):\n"
+                "        raise NotImplementedError\n\n\n"
+                "class MyLadderPolicy(ScalePolicy):\n"
+                "    pass\n\n\n"
+                "class MySteppedPolicy(MyLadderPolicy):\n"
+                "    pass\n"),
+            "tests/test_policy_fixture.py": (
+                "def test_hysteresis():\n"
+                "    assert MyLadderPolicy and MySteppedPolicy\n"),
+            "docs/ARCHITECTURE.md": (
+                "| `MyLadderPolicy` | ladder |\n"
+                "| `MySteppedPolicy` | stepped |\n"),
+        }],
+    },
+    "serving-route": {
+        "bad": [{
+            "tpu_cooccurrence/observability/http.py": (
+                "ROUTE_METRICS = {\n"
+                '    "/metrics": "cooc_scrape_seconds",\n'
+                "}\n\n\n"
+                "def do_GET(path):\n"
+                '    if path == "/secret":\n'
+                '        return "ok"\n'),
+            "README.md": "curl /metrics\n",
+            "tests/test_routes_fixture.py":
+                'R = ["/metrics"]\n',
+        }],
+        "good": [{
+            "tpu_cooccurrence/observability/http.py": (
+                "ROUTE_METRICS = {\n"
+                '    "/metrics": "cooc_scrape_seconds",\n'
+                '    "/healthz": "cooc_healthz_seconds",\n'
+                "}\n"),
+            "README.md": "curl /metrics /healthz\n",
+            "tests/test_routes_fixture.py":
+                'R = ["/metrics", "/healthz"]\n',
+        }],
+    },
+    "state-store-registry": {
+        "bad": [{
+            "tpu_cooccurrence/state/store.py": (
+                "class StateStore:\n"
+                "    def checkpoint_state(self):\n"
+                "        raise NotImplementedError\n\n\n"
+                "class MyDirectStore(StateStore):\n"
+                "    pass\n\n\n"
+                "class MyTieredStore(MyDirectStore):\n"
+                "    pass\n"),
+            "tests/test_store_fixture.py": (
+                "def test_round_trip():\n"
+                "    assert MyDirectStore\n"),
+            "docs/ARCHITECTURE.md": (
+                "| `MyDirectStore` | direct |\n"
+                "| `MyTieredStore` | tiered |\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/state/store.py": (
+                "class StateStore:\n"
+                "    def checkpoint_state(self):\n"
+                "        raise NotImplementedError\n\n\n"
+                "class MyDirectStore(StateStore):\n"
+                "    pass\n\n\n"
+                "class MyTieredStore(MyDirectStore):\n"
+                "    pass\n"),
+            "tests/test_store_fixture.py": (
+                "def test_round_trip():\n"
+                "    assert MyDirectStore and MyTieredStore\n"),
+            "docs/ARCHITECTURE.md": (
+                "| `MyDirectStore` | direct |\n"
+                "| `MyTieredStore` | tiered |\n"),
+        }],
+    },
+    "thread-ownership": {
+        "bad": [{
+            # The pre-fix PR-2 shape: spawned worker and main thread
+            # both write the ledger's byte totals, no lock anywhere.
+            "tpu_cooccurrence/job.py": (
+                "import threading\n\n\n"
+                "class TransferLedger:\n"
+                "    def __init__(self):\n"
+                "        self.h2d_bytes = 0\n\n"
+                "    def add(self, n):\n"
+                "        self.h2d_bytes += n\n\n\n"
+                "def scorer_worker(ledger):\n"
+                "    ledger.h2d_bytes += 4\n\n\n"
+                "def main():\n"
+                "    ledger = TransferLedger()\n"
+                "    threading.Thread(target=scorer_worker,\n"
+                '                     name="scorer").start()\n'
+                "    ledger.add(3)\n"),
+        }],
+        "good": [{
+            "tpu_cooccurrence/job.py": (
+                "import threading\n\n\n"
+                "class TransferLedger:\n"
+                "    def __init__(self):\n"
+                "        self.h2d_bytes = 0\n\n"
+                "    def add(self, n):\n"
+                "        with self._lock:\n"
+                "            self.h2d_bytes += n\n\n\n"
+                "def scorer_worker(ledger):\n"
+                "    with ledger._lock:\n"
+                "        ledger.h2d_bytes += 4\n\n\n"
+                "def main():\n"
+                "    ledger = TransferLedger()\n"
+                "    threading.Thread(target=scorer_worker,\n"
+                '                     name="scorer").start()\n'
+                "    ledger.add(3)\n"),
+        }],
+    },
+    "tuning-magic-number": {
+        "bad": [{
+            "tpu_cooccurrence/ops/plan.py": (
+                "def plan(rows):\n"
+                "    if rows < 256:\n"
+                "        return None\n"
+                "    return rows\n"),
+        }],
+        "good": [{
+            # Same literal outside the hot-path prefixes is style, not
+            # a smuggled tuning default.
+            "tpu_cooccurrence/config.py": (
+                "def plan(rows):\n"
+                "    if rows < 256:\n"
+                "        return None\n"
+                "    return rows\n"),
+        }],
+    },
+    "tuning-registry": {
+        "bad": [{
+            "tpu_cooccurrence/worker.py": (
+                "import os\n"
+                'budget = os.environ.get("TPU_COOC_NOT_A_KNOB", "0")\n'),
+        }],
+        "good": [{
+            "tpu_cooccurrence/worker.py": (
+                "from tpu_cooccurrence import tuning\n"
+                'rid = tuning.env_read("TPU_COOC_RUN_ID")\n'),
+        }],
+    },
+    "wire-codec-roundtrip": {
+        "bad": [{
+            "tpu_cooccurrence/state/wire.py": (
+                "def encode_slab(x):\n"
+                "    return bytes(x)\n"),
+            "tests/test_wire_fixture.py":
+                "def test_rt():\n    assert encode_slab\n",
+        }],
+        "good": [{
+            "tpu_cooccurrence/state/wire.py": (
+                "def encode_slab(x):\n"
+                "    return bytes(x)\n\n\n"
+                "def decode_slab(b):\n"
+                "    return list(b)\n"),
+            "tests/test_wire_fixture.py": (
+                "def test_rt():\n"
+                "    assert encode_slab and decode_slab\n"),
+        }],
+    },
+}

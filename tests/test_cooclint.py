@@ -71,6 +71,7 @@ def test_runner_json_schema_and_exit_code():
         capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
+    assert payload["schema"] == "cooclint-findings/2"
     assert payload["exit_code"] == 0
     assert payload["files_scanned"] > 50
     assert payload["elapsed_seconds"] < RUNTIME_BUDGET_S
@@ -228,8 +229,10 @@ def noisy(x):
     assert "block_until_ready" in msgs and "host RNG" in msgs
 
 
-def test_jit_purity_one_hop_closure_in_ops():
-    """A helper called from a jitted function in ops/ is hot-path too."""
+def test_jit_purity_transitive_closure_any_module():
+    """A helper reached from a jitted entry is hot-path in *every*
+    module — the old rule special-cased one hop inside ops/ and missed
+    everything else."""
     src = '''
 import jax
 import numpy as np
@@ -244,8 +247,53 @@ def entry(x):
     findings = analyze_source(src, path="tpu_cooccurrence/ops/llr.py",
                               rules=["jit-purity"])
     assert _rules(findings) == ["jit-purity"]
-    # Outside ops/ the closure hop is off (host modules wrap jits in
-    # plain orchestration functions all the time).
+    # Same bug outside ops/ — the graph pass does not care which module
+    # the trace walks through.
+    job = analyze_source(src, path="tpu_cooccurrence/job.py",
+                         rules=["jit-purity"])
+    assert _rules(job) == ["jit-purity"]
+    assert "traced from `entry`" in job[0].message
+
+
+def test_jit_purity_two_hops_below_entry():
+    """Host RNG two calls below the jit entry — provably invisible to
+    the old one-hop rule, caught by call-graph reachability."""
+    src = '''
+import jax
+import numpy as np
+
+def noise(shape):
+    return np.random.standard_normal(shape)
+
+def helper(x):
+    return x + noise(x.shape)
+
+@jax.jit
+def entry(x):
+    return helper(x)
+'''
+    findings = analyze_source(src, path="tpu_cooccurrence/job.py",
+                              rules=["jit-purity"])
+    assert _rules(findings) == ["jit-purity"]
+    f = findings[0]
+    assert "host RNG" in f.message
+    assert "entry -> helper -> noise" in f.message
+
+
+def test_jit_purity_uncalled_helper_not_flagged():
+    """Reachability, not co-location: a host-sync helper in the same
+    file that no jitted code calls stays silent."""
+    src = '''
+import jax
+import numpy as np
+
+def orchestrate(x):
+    return np.asarray(x)
+
+@jax.jit
+def entry(x):
+    return x * 2
+'''
     assert analyze_source(src, path="tpu_cooccurrence/job.py",
                           rules=["jit-purity"]) == []
 
@@ -1667,3 +1715,332 @@ def test_ingest_registry_clean_on_repo():
     result = Analyzer(REPO, rules=[RULES["ingest-offset-registry"]],
                       baseline=[]).run()
     assert result.findings == []
+
+
+# ---------------------------------------------------------------------------
+# thread-ownership rule (whole-program graph, PR 19)
+
+PR2_THREAD_RACE = '''
+import threading
+
+class TransferLedger:
+    def __init__(self):
+        self.h2d_bytes = 0
+        self.h2d_calls = 0
+
+    def add(self, n):
+        self.h2d_bytes += n
+        self.h2d_calls += 1
+
+def scorer_worker(ledger):
+    ledger.h2d_bytes += 4
+
+def main():
+    ledger = TransferLedger()
+    threading.Thread(target=scorer_worker, name="scorer").start()
+    ledger.add(3)
+'''
+
+
+def test_thread_ownership_rediscovers_pr2_ledger_race():
+    """The pre-fix PR-2 shape, no class list involved: the spawned
+    scorer worker and the main thread both write the ledger's byte
+    totals with no lock — derived purely from the call graph's thread
+    roots."""
+    findings = analyze_source(PR2_THREAD_RACE,
+                              rules=["thread-ownership"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert "TransferLedger.h2d_bytes" in f.message
+    assert "scorer" in f.message and "main" in f.message
+    # Anchored on the spawned-writer side (the actionable site).
+    assert f.line == 14
+
+
+PR2_COUNTERS_RACE = '''
+import threading
+
+class Counters:
+    def __init__(self):
+        self._counts = {}
+
+    def increment(self, key):
+        self._counts[key] = self._counts.get(key, 0) + 1
+
+    def merge(self, other):
+        for k, v in other._counts.items():
+            self._counts[k] = self._counts.get(k, 0) + v
+
+def scorer_worker(counters):
+    counters.increment("windows_scored")
+
+def main():
+    counters = Counters()
+    threading.Thread(target=scorer_worker).start()
+    counters.merge(Counters())
+'''
+
+
+def test_thread_ownership_rediscovers_pr2_counters_race():
+    """The second PR-2 race: the worker folds counts into the shared
+    Counters while the main thread's merge rewrites the same dict."""
+    findings = analyze_source(PR2_COUNTERS_RACE,
+                              rules=["thread-ownership"])
+    assert len(findings) == 1
+    assert "Counters._counts" in findings[0].message
+
+
+def test_thread_ownership_lock_and_annotation_exempt():
+    locked = PR2_THREAD_RACE.replace(
+        "    ledger.h2d_bytes += 4",
+        "    with ledger._lock:\n        ledger.h2d_bytes += 4").replace(
+        "        self.h2d_bytes += n\n        self.h2d_calls += 1",
+        "        with self._lock:\n"
+        "            self.h2d_bytes += n\n"
+        "            self.h2d_calls += 1")
+    assert analyze_source(locked, rules=["thread-ownership"]) == []
+    annotated = PR2_THREAD_RACE.replace(
+        "    ledger.h2d_bytes += 4",
+        "    # thread-owner: handoff precedes the scorer's first write\n"
+        "    ledger.h2d_bytes += 4")
+    assert analyze_source(annotated, rules=["thread-ownership"]) == []
+
+
+def test_thread_ownership_mode_dependent_sharing_is_clean():
+    """job.py's shape: one write site reachable from main (serial mode)
+    AND the pipeline worker (pipelined mode). The root sets are equal,
+    not mutually exclusive — no single run has two threads in that
+    write, so it must not flag."""
+    src = '''
+import threading
+
+class Ledger:
+    def __init__(self):
+        self.h2d_bytes = 0
+
+def step(ledger):
+    ledger.h2d_bytes += 1
+
+def worker():
+    step(Ledger())
+
+def main():
+    threading.Thread(target=worker).start()
+    step(Ledger())
+'''
+    assert analyze_source(src, rules=["thread-ownership"]) == []
+
+
+def test_thread_ownership_flags_self_concurrent_handler():
+    """An HTTP handler runs one thread per request: a single unlocked
+    write inside do_* races with itself, no second site needed."""
+    src = '''
+import http.server
+
+class MetricsHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.hits = getattr(self, "hits", 0) + 1
+'''
+    findings = analyze_source(src, rules=["thread-ownership"])
+    assert len(findings) == 1
+    assert "self-concurrent" in findings[0].message
+    assert "MetricsHandler.hits" in findings[0].message
+
+
+def test_thread_ownership_clean_on_repo():
+    result = Analyzer(REPO, rules=[RULES["thread-ownership"]],
+                      baseline=[]).run()
+    assert result.findings == []
+
+
+# ---------------------------------------------------------------------------
+# tuning registry (PR 19 tentpole: tpu_cooccurrence/tuning.py + rules)
+
+def test_tuning_registry_flags_unregistered_knob():
+    src = ('import os\n'
+           'budget = os.environ.get("TPU_COOC_NOT_A_KNOB", "0")\n')
+    findings = analyze_source(src, rules=["tuning-registry"])
+    msgs = [f.message for f in findings]
+    assert any("not a registered" in m for m in msgs)
+    assert any("tuning.env_read" in m for m in msgs)
+
+
+def test_tuning_registry_flags_direct_read_of_registered_knob():
+    """Even a registered knob must be read via tuning.env_read (the
+    registry has to see the live read surface)."""
+    for src in (
+            'import os\nrid = os.environ.get("TPU_COOC_RUN_ID")\n',
+            'import os\nrid = os.getenv("TPU_COOC_RUN_ID")\n',
+            'import os\nrid = os.environ["TPU_COOC_RUN_ID"]\n',
+            # an aliased module-level constant is seen through
+            'import os\nK = "TPU_COOC_RUN_ID"\nrid = os.environ.get(K)\n'):
+        findings = analyze_source(src, rules=["tuning-registry"])
+        assert len(findings) == 1, src
+        assert "tuning.env_read" in findings[0].message
+
+
+def test_tuning_registry_env_read_is_clean():
+    src = ('from tpu_cooccurrence import tuning\n'
+           'rid = tuning.env_read("TPU_COOC_RUN_ID")\n')
+    assert analyze_source(src, rules=["tuning-registry"]) == []
+
+
+def test_tuning_env_read_rejects_unregistered_at_runtime():
+    from tpu_cooccurrence import tuning
+    with pytest.raises(KeyError, match="TPU_COOC_BOGUS"):
+        tuning.env_read("TPU_COOC_BOGUS")
+    assert tuning.env_read("TPU_COOC_RUN_ID",
+                           environ={"TPU_COOC_RUN_ID": "r7"}) == "r7"
+
+
+def test_tuning_parameter_validate_bounds_and_choices():
+    from tpu_cooccurrence import tuning
+    tuning.get("pipeline_depth").validate(2)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        tuning.get("pipeline_depth").validate(3)
+    with pytest.raises(ValueError, match="wire_format"):
+        tuning.get("wire_format").validate("gzip")
+    assert tuning.bounds("score_ladder") == (2, None)
+
+
+def test_tuning_magic_number_flags_inlined_default():
+    src = ('def plan(rows):\n'
+           '    if rows < 256:\n'
+           '        return None\n'
+           '    return rows\n')
+    findings = analyze_source(src, path="tpu_cooccurrence/ops/plan.py",
+                              rules=["tuning-magic-number"])
+    assert len(findings) == 1
+    assert findings[0].severity == "warning"
+    assert "256" in findings[0].message
+    # Outside the hot-path prefixes the same literal is style, not perf.
+    assert analyze_source(src, path="tpu_cooccurrence/config.py",
+                          rules=["tuning-magic-number"]) == []
+
+
+def test_every_env_knob_in_package_is_registered():
+    """Acceptance: every TPU_COOC_* token in package source resolves
+    through the registry (grep-level, independent of the analyzer)."""
+    import re
+    from tpu_cooccurrence import tuning
+    registered = set(tuning.by_env())
+    pkg = os.path.join(REPO, "tpu_cooccurrence")
+    offenders = []
+    for dirpath, dirnames, files in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname),
+                      encoding="utf-8") as fh:
+                for tok in set(re.findall(r"TPU_COOC_[A-Z0-9_]+",
+                                          fh.read())):
+                    if tok not in registered:
+                        offenders.append((fname, tok))
+    assert not offenders
+
+
+def test_readme_tuning_table_is_generated_and_pinned():
+    """The README "Tuning parameters" table is the literal output of
+    tuning.markdown_table() — docs cannot drift from the registry."""
+    from tpu_cooccurrence import tuning
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as fh:
+        readme = fh.read()
+    assert tuning.markdown_table("perf") in readme
+    assert tuning.markdown_table("infra") in readme
+
+
+def test_config_reads_defaults_from_registry():
+    """config.py field defaults come from tuning.default(...) — the
+    registry is the single source of truth for knob defaults."""
+    from tpu_cooccurrence import config as cfg
+    from tpu_cooccurrence import tuning
+    c = cfg.Config()
+    assert c.pipeline_depth == tuning.default("pipeline_depth")
+    assert c.checkpoint_compact_ratio == tuning.default(
+        "checkpoint_compact_ratio")
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + --changed (PR 19 satellites)
+
+def test_findings_carry_symbol_severity_and_rule_doc():
+    findings = analyze_source(
+        PR2_RACE_FIXTURE, path="tpu_cooccurrence/pipeline.py",
+        rules=["lock-discipline"])
+    f = findings[0]
+    assert f.symbol == "PipelineWorker.record_upload"
+    assert f.severity == "error"
+    assert f.rule_doc == RULES["lock-discipline"].description
+    d = f.to_dict()
+    assert d["symbol"] and d["severity"] and d["rule_doc"]
+
+
+def test_baseline_symbol_fingerprint_survives_line_drift(tmp_path):
+    """A {rule, file, symbol} baseline entry keeps matching after lines
+    above the finding shift (the legacy line form would go stale)."""
+    root = _mini_repo_with_race(tmp_path)
+    baseline = [{"rule": "lock-discipline",
+                 "file": "tpu_cooccurrence/pipeline.py",
+                 "symbol": "PipelineWorker.record_upload",
+                 "justification": "fingerprint form"}]
+    result = Analyzer(str(root), rules=[RULES["lock-discipline"]],
+                      baseline=baseline).run()
+    assert not result.findings and not result.stale_baseline
+    assert len(result.baselined) == 2
+    # Same entry still matches with ten blank lines pushed above it.
+    (root / "tpu_cooccurrence" / "pipeline.py").write_text(
+        "\n" * 10 + PR2_RACE_FIXTURE)
+    result = Analyzer(str(root), rules=[RULES["lock-discipline"]],
+                      baseline=baseline).run()
+    assert not result.findings and not result.stale_baseline
+
+
+def test_prune_baseline_upgrades_legacy_entries_to_fingerprints(tmp_path):
+    """--prune-baseline rewrites matched legacy {rule, file, line}
+    entries into the stable {rule, file, symbol} form."""
+    from tpu_cooccurrence.analysis.__main__ import main
+
+    root = _mini_repo_with_race(tmp_path)
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline([
+        {"rule": "lock-discipline",
+         "file": "tpu_cooccurrence/pipeline.py", "line": 5,
+         "justification": "kept"},
+        {"rule": "lock-discipline",
+         "file": "tpu_cooccurrence/pipeline.py", "line": 6,
+         "justification": "kept"},
+    ], bl_path)
+    rc = main(["--root", str(root), "--baseline", bl_path,
+               "--prune-baseline"])
+    assert rc == 0
+    kept = load_baseline(bl_path)
+    assert all(e.get("symbol") == "PipelineWorker.record_upload"
+               and "line" not in e for e in kept)
+    assert all(e["justification"] == "kept" for e in kept)
+
+
+def test_changed_mode_falls_back_to_full_run_without_git(tmp_path):
+    from tpu_cooccurrence.analysis.__main__ import main
+
+    root = _mini_repo_with_race(tmp_path)
+    rc = main(["--root", str(root), "--changed"])
+    assert rc == 1  # no git: full-run fallback still sees the race
+
+
+def test_changed_mode_scopes_and_caches_on_real_repo():
+    """--changed on the checkout: exits 0 (clean repo), reports its
+    scope, and persists the sha-keyed pass-1 cache for the next run."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cooccurrence.analysis",
+         "--root", REPO, "--changed"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    if "changed)" in proc.stdout:  # git + main ref available
+        cache = os.path.join(REPO, ".cooclint-cache.json")
+        assert os.path.exists(cache)
+        with open(cache, encoding="utf-8") as fh:
+            data = json.load(fh)
+        assert data["schema"] == "cooclint-pass1/1"
+        assert data["modules"]

@@ -1,0 +1,62 @@
+"""Meta-rule test: every registered cooclint rule proves itself.
+
+Parametrised over the live rule registry: each rule must have at least
+one positive fixture (a mini repo it flags) and one negative fixture (a
+mini repo it passes) in ``tests/rule_fixtures.py``. A rule added
+without fixtures fails here by construction — the registry can never
+grow a rule whose detection is untested (silent no-op) or whose
+precision is untested (false-positive generator).
+"""
+
+import pytest
+
+from tpu_cooccurrence.analysis import Analyzer, RULES
+
+from rule_fixtures import FIXTURES
+
+
+def _materialize(root, files):
+    for rel, src in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return root
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_has_fixture_entry(rule):
+    entry = FIXTURES.get(rule)
+    assert entry is not None, (
+        f"rule {rule!r} has no entry in tests/rule_fixtures.py — every "
+        f"registered rule needs at least one positive and one negative "
+        f"fixture")
+    assert entry.get("bad"), f"rule {rule!r} has no positive fixture"
+    assert entry.get("good"), f"rule {rule!r} has no negative fixture"
+
+
+def test_no_orphan_fixture_entries():
+    """Fixture entries for rules that no longer exist are stale."""
+    assert not set(FIXTURES) - set(RULES)
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_flags_its_positive_fixtures(rule, tmp_path):
+    for i, files in enumerate(FIXTURES[rule]["bad"]):
+        root = _materialize(tmp_path / f"bad{i}", files)
+        result = Analyzer(str(root), rules=[RULES[rule]],
+                          baseline=[]).run()
+        assert result.findings, (
+            f"rule {rule!r} missed its positive fixture #{i} — the "
+            f"violation it exists to catch went undetected")
+        assert all(f.rule == rule for f in result.findings)
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_passes_its_negative_fixtures(rule, tmp_path):
+    for i, files in enumerate(FIXTURES[rule]["good"]):
+        root = _materialize(tmp_path / f"good{i}", files)
+        result = Analyzer(str(root), rules=[RULES[rule]],
+                          baseline=[]).run()
+        assert not result.findings, (
+            f"rule {rule!r} false-positived on its negative fixture "
+            f"#{i}: {result.findings}")

@@ -12,12 +12,35 @@ in sync. Each of these already caused a real bug (the PR-2
 brittle test. This package makes them fail in tier-1 at commit time,
 not on a TPU mid-soak.
 
+Since PR 19 the analyzer is a two-pass whole-program engine: pass 1
+(:mod:`.graph`) builds a project-wide symbol table, call graph and
+thread-root table once (cached per file by content sha256 in
+``.cooclint-cache.json``, which is what makes ``--changed`` runs
+sub-second); pass 2 is the rules, which query those cross-module facts
+instead of re-deriving them per file. Findings carry a stable
+fingerprint (rule + qualified enclosing symbol) so baseline entries
+survive line drift.
+
 Layout:
 
 * :mod:`.core` — the ``ast``-based framework: file walker, rule
   registry, :class:`~.core.Finding`, per-line
   ``# cooclint: disable=<rule>`` suppressions and the checked-in
   ``baseline.json`` for grandfathered findings;
+* :mod:`.graph` — pass 1: the project symbol table, call graph
+  (attribute calls resolved by receiver class, denylisted duck edges),
+  thread-root labelling (``threading.Thread`` spawn sites, HTTP
+  ``do_*`` self-concurrent handlers, ``main``), and per-class
+  attribute-write-site extraction;
+* :mod:`.rules_threads` — graph-backed thread-ownership analysis (an
+  attribute written from two mutually exclusive thread roots with no
+  lock and no ``# thread-owner:`` annotation is a race; rediscovers
+  both PR-2 races from the pre-fix code);
+* :mod:`.rules_tuning` — the typed ``TuningParameter`` registry
+  (``tpu_cooccurrence/tuning.py``) enforcement: every ``TPU_COOC_*``
+  env read goes through ``tuning.env_read``, unregistered knobs and
+  dead registry rows are findings, and distinctive registered defaults
+  re-inlined as literals in hot-path modules are warnings;
 * :mod:`.rules_lock` — lock discipline on the shared-state classes and
   annotation requirements for new locks in worker code paths;
 * :mod:`.rules_jit` — jit/device hygiene (host syncs inside jitted
@@ -104,6 +127,8 @@ from . import rules_native  # noqa: F401,E402
 from . import rules_registry  # noqa: F401,E402
 from . import rules_serving  # noqa: F401,E402
 from . import rules_state  # noqa: F401,E402
+from . import rules_threads  # noqa: F401,E402
+from . import rules_tuning  # noqa: F401,E402
 from . import rules_wire  # noqa: F401,E402
 
 __all__ = [

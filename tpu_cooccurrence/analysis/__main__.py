@@ -4,26 +4,124 @@ Exit codes: 0 = clean (baseline-covered findings allowed), 1 = new
 findings, 2 = usage error. The run summary always records the
 analyzer's own runtime — the tier-1 lane budget is <10 s and a slow
 rule should fail loudly in review, not quietly tax every commit.
+
+``--changed`` is the pre-commit path: per-file rules run only over
+files that differ from ``git merge-base HEAD main`` (plus untracked
+files), and the whole-program pass-1 index is restored from a
+sha256-keyed cache (``.cooclint-cache.json``, git-ignored) so the
+cross-module rules still see the full project without re-walking every
+unchanged AST. Findings are reported only in the changed files — the
+"what did MY edit break" contract.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
+import subprocess
 import sys
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence, Set
 
 from . import Analyzer, load_baseline
 from .core import default_baseline_path, save_baseline
+
+_CACHE_NAME = ".cooclint-cache.json"
+_CACHE_SCHEMA = "cooclint-pass1/1"
+
+
+def _git(root: str, *args: str) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, *args], capture_output=True, text=True,
+            timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout if out.returncode == 0 else None
+
+
+def _changed_files(root: str) -> Optional[Set[str]]:
+    """Repo-relative paths differing from ``merge-base HEAD main``
+    (committed + staged + worktree) plus untracked files, or None when
+    git/merge-base is unavailable (caller falls back to a full run)."""
+    base = None
+    for ref in ("main", "origin/main"):
+        out = _git(root, "merge-base", "HEAD", ref)
+        if out:
+            base = out.strip()
+            break
+    if base is None:
+        return None
+    diff = _git(root, "diff", "--name-only", base)
+    untracked = _git(root, "ls-files", "--others", "--exclude-standard")
+    if diff is None or untracked is None:
+        return None
+    return {p.strip() for p in (diff + untracked).splitlines()
+            if p.strip()}
+
+
+def _sha256(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def _load_pass1_cache(root: str) -> Dict[str, dict]:
+    """path -> module index, for files whose content sha still matches
+    (the stale majority of a pre-commit run)."""
+    try:
+        with open(os.path.join(root, _CACHE_NAME),
+                  encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or data.get("schema") != _CACHE_SCHEMA:
+        return {}
+    cache: Dict[str, dict] = {}
+    for path, rec in data.get("modules", {}).items():
+        full = os.path.join(root, path)
+        try:
+            with open(full, encoding="utf-8", errors="replace") as f:
+                if _sha256(f.read()) == rec.get("sha256"):
+                    cache[path] = rec["index"]
+        except OSError:
+            continue
+    if isinstance(data.get("test_refs"), dict):
+        # Joint-sha-validated inside RepoContext.test_referenced_names.
+        cache["__test_refs__"] = data["test_refs"]
+    return cache
+
+
+def _save_pass1_cache(root: str, analyzer: Analyzer) -> None:
+    repo = getattr(analyzer, "last_repo", None)
+    if repo is None or repo._graph is None:
+        return
+    source_by_path = {c.path: c.source for c in repo.files}
+    modules = {}
+    for idx in repo.graph.modules.values():
+        src = source_by_path.get(idx["path"])
+        if src is not None:
+            modules[idx["path"]] = {"sha256": _sha256(src),
+                                    "index": idx}
+    data = {"schema": _CACHE_SCHEMA, "modules": modules}
+    if repo._test_refs is not None:
+        data["test_refs"] = {"sha256": repo.test_refs_sha,
+                             "refs": sorted(repo._test_refs),
+                             "strings": sorted(repo._test_strings or ())}
+    try:
+        with open(os.path.join(root, _CACHE_NAME), "w",
+                  encoding="utf-8") as f:
+            json.dump(data, f)
+            f.write("\n")
+    except OSError:
+        pass  # a read-only checkout just loses the speedup
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tpu_cooccurrence.analysis",
-        description=("cooclint: AST-based invariant checker (lock "
-                     "discipline, jit purity, registry drift, native "
-                     "dtype boundaries)"))
+        description=("cooclint: whole-program AST invariant checker "
+                     "(thread ownership, transitive jit purity, tuning "
+                     "registry, lock discipline, registry drift)"))
     p.add_argument("--root", default=None,
                    help="repo root to scan (default: the checkout "
                         "containing this package)")
@@ -34,8 +132,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "analysis/baseline.json)")
     p.add_argument("--prune-baseline", action="store_true",
                    dest="prune_baseline",
-                   help="rewrite the baseline dropping entries no "
-                        "current finding matches (stale entries)")
+                   help="rewrite the baseline: drop stale entries and "
+                        "upgrade matched legacy line-keyed entries to "
+                        "the stable rule+symbol fingerprint form")
+    p.add_argument("--changed", action="store_true",
+                   help="check only files changed vs git merge-base "
+                        "with main (pass-1 index restored from the "
+                        "sha-keyed cache); falls back to a full run "
+                        "outside a git checkout")
     args = p.parse_args(argv)
 
     root = args.root or os.path.dirname(os.path.dirname(
@@ -53,14 +157,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
-    result = Analyzer(root, baseline=baseline).run()
 
-    if args.prune_baseline and result.stale_baseline:
-        stale_keys = {(e["rule"], e["file"], int(e["line"]))
-                      for e in result.stale_baseline}
-        kept = [e for e in baseline
-                if (e["rule"], e["file"], int(e["line"]))
-                not in stale_keys]
+    changed_only = pass1_cache = None
+    if args.changed:
+        changed_only = _changed_files(root)
+        if changed_only is not None:
+            pass1_cache = _load_pass1_cache(root)
+
+    analyzer = Analyzer(root, baseline=baseline,
+                        changed_only=changed_only,
+                        pass1_cache=pass1_cache)
+    result = analyzer.run()
+    if args.changed:
+        _save_pass1_cache(root, analyzer)
+
+    if args.prune_baseline and baseline:
+        # Upgrade-in-place: a legacy {rule, file, line} entry a current
+        # finding matched becomes {rule, file, symbol} (line drift can
+        # no longer orphan it); stale entries are dropped.
+        by_line_key = {("line", f.rule, f.file, f.line): f
+                       for f in result.baselined}
+        stale_keys = set()
+        for e in result.stale_baseline:
+            if e.get("symbol"):
+                stale_keys.add(("symbol", e["rule"], e["file"],
+                                e["symbol"]))
+            else:
+                stale_keys.add(("line", e["rule"], e["file"],
+                                int(e["line"])))
+        kept = []
+        for e in baseline:
+            if e.get("symbol"):
+                key = ("symbol", e["rule"], e["file"], e["symbol"])
+            else:
+                key = ("line", e["rule"], e["file"], int(e["line"]))
+            if key in stale_keys:
+                continue
+            match = by_line_key.get(key)
+            if match is not None and match.symbol:
+                e = {k: v for k, v in e.items() if k != "line"}
+                e["symbol"] = match.symbol
+            kept.append(e)
         save_baseline(kept, baseline_path)
 
     if args.fmt == "json":
@@ -72,11 +209,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             tag = ("pruned" if args.prune_baseline
                    else "stale baseline entry (--prune-baseline "
                         "candidate)")
-            print(f"{e['file']}:{e['line']}: {e['rule']}: {tag}")
+            print(f"{e['file']}:{e.get('line', e.get('symbol'))}: "
+                  f"{e['rule']}: {tag}")
+        scope = (f" ({len(changed_only)} changed)"
+                 if changed_only is not None else "")
         print(f"cooclint: {len(result.findings)} new finding(s), "
               f"{len(result.baselined)} baselined, "
               f"{len(result.stale_baseline)} stale baseline entr(y/ies) "
-              f"across {result.files_scanned} files in "
+              f"across {result.files_scanned} files{scope} in "
               f"{result.elapsed_seconds:.2f}s")
     return 1 if result.findings else 0
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -50,25 +51,45 @@ _SKIP_SUFFIXES = ("/tpu_cooccurrence/analysis",)
 
 @dataclasses.dataclass
 class Finding:
-    """One rule violation, anchored to ``file:line``."""
+    """One rule violation, anchored to ``file:line``.
+
+    ``symbol`` is the qualified symbol path of the enclosing def
+    (``PipelineDriver._run``, ``<module>`` for top-level code, ``""``
+    for non-Python files) — the stable half of the fingerprint:
+    baseline entries match on ``(rule, file, symbol)`` so unrelated
+    line drift above a grandfathered finding does not resurrect it.
+    ``severity`` / ``rule_doc`` ride into ``--format json`` for
+    downstream tooling; neither participates in identity.
+    """
 
     rule: str
     file: str  # repo-relative, forward slashes
     line: int
     message: str
+    symbol: str = ""
+    severity: str = "error"
+    rule_doc: str = ""
 
     def key(self) -> Tuple[str, str, int]:
-        """Identity for baseline/suppression matching."""
+        """Exact identity for dedup/suppression matching."""
         return (self.rule, self.file, self.line)
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        """Line-drift-stable identity for baseline matching."""
+        return (self.rule, self.file, self.symbol)
 
     def to_dict(self) -> Dict[str, object]:
         return {"rule": self.rule, "file": self.file, "line": self.line,
-                "message": self.message}
+                "symbol": self.symbol, "severity": self.severity,
+                "rule_doc": self.rule_doc, "message": self.message}
 
     @classmethod
     def from_dict(cls, d: Dict[str, object]) -> "Finding":
         return cls(rule=str(d["rule"]), file=str(d["file"]),
-                   line=int(d["line"]), message=str(d.get("message", "")))
+                   line=int(d["line"]), message=str(d.get("message", "")),
+                   symbol=str(d.get("symbol", "")),
+                   severity=str(d.get("severity", "error")),
+                   rule_doc=str(d.get("rule_doc", "")))
 
     def __str__(self) -> str:
         return f"{self.file}:{self.line}: {self.rule}: {self.message}"
@@ -90,6 +111,8 @@ class FileContext:
         self._parse_error: Optional[SyntaxError] = None
         self._suppress: Optional[Dict[int, Optional[set]]] = None
         self._file_suppress: Optional[set] = None
+        self._node_index: Optional[Dict[type, list]] = None
+        self._symbol_spans: Optional[list] = None
 
     @property
     def is_python(self) -> bool:
@@ -105,6 +128,64 @@ class FileContext:
             except SyntaxError as exc:
                 self._parse_error = exc
         return self._tree
+
+    def nodes(self, *types: type) -> list:
+        """Every AST node of the given types, in one shared walk.
+
+        Twenty-three rules each re-walking every file's full AST was
+        the analyzer's whole runtime; the tree is walked once per file
+        and bucketed by node type, and rules query the buckets.
+        """
+        if self._node_index is None:
+            self._node_index = {}
+            tree = self.tree
+            if tree is not None:
+                for node in ast.walk(tree):
+                    self._node_index.setdefault(type(node), []).append(
+                        node)
+        out: list = []
+        for t in types:
+            out.extend(self._node_index.get(t, ()))
+        return out
+
+    def strings(self) -> list:
+        """``(line, value)`` for every string literal, off the shared
+        node index (use instead of ``string_constants(tree)`` whenever
+        a FileContext is in hand)."""
+        return [(n.lineno, n.value) for n in self.nodes(ast.Constant)
+                if isinstance(n.value, str)]
+
+    def symbol_at(self, line: int) -> str:
+        """Qualified symbol path of the innermost def containing
+        ``line`` (``Cls.method`` / ``fn`` / ``<module>``) — the stable
+        fingerprint component for findings in this file."""
+        if not self.is_python or self.tree is None:
+            return ""
+        if self._symbol_spans is None:
+            spans = []
+
+            def walk(node, prefix):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        name = (f"{prefix}.{child.name}" if prefix
+                                else child.name)
+                        spans.append((child.lineno,
+                                      child.end_lineno or child.lineno,
+                                      name))
+                        walk(child, name)
+                    else:
+                        walk(child, prefix)
+
+            walk(self.tree, "")
+            self._symbol_spans = spans
+        best = None
+        for lo, hi, name in self._symbol_spans:
+            if lo <= line <= hi and (
+                    best is None or hi - lo < best[0]):
+                best = (hi - lo, name)
+        return best[1] if best else "<module>"
 
     def suppressions(self) -> Dict[int, Optional[set]]:
         """``{lineno: None (all rules) | {rule names}}`` for this file."""
@@ -141,9 +222,14 @@ class FileContext:
 class RepoContext:
     """Every scanned file, for repo-scoped ``finalize`` checks."""
 
-    def __init__(self, root: str, files: List[FileContext]) -> None:
+    def __init__(self, root: str, files: List[FileContext],
+                 pass1_cache: Optional[Dict[str, dict]] = None) -> None:
         self.root = root
         self.files = files
+        self._graph = None
+        self._pass1_cache = pass1_cache
+        self._test_refs: Optional[set] = None
+        self._test_strings: Optional[set] = None
 
     def python_files(self) -> Iterator[FileContext]:
         return (f for f in self.files if f.is_python)
@@ -155,13 +241,78 @@ class RepoContext:
         return (f for f in self.python_files()
                 if f.path.startswith("tpu_cooccurrence/"))
 
+    @property
+    def graph(self):
+        """The pass-1 :class:`~.graph.ProjectGraph` over the package
+        files, built lazily (and from the sha-keyed cache under
+        ``--changed``) — the cross-module facts pass-2 rules query."""
+        if self._graph is None:
+            from .graph import build_graph
+            self._graph = build_graph(self.package_files(),
+                                      cached=self._pass1_cache)
+        return self._graph
+
+    def _test_evidence(self) -> None:
+        """Compute (or restore from the pass-1 cache) the two test-
+        evidence sets several registry rules share: every identifier
+        tests/ mentions, and every string constant tests/ contains.
+        One pass over the tests/ trees; under ``--changed`` both are
+        restored when the tests/ tree is byte-identical (parsing ~100
+        test files costs more than the changed files themselves)."""
+        tests = [c for c in self.python_files()
+                 if c.path.startswith("tests/")]
+        joint = hashlib.sha256("".join(
+            c.path + "\0" + c.source for c in tests).encode(
+            "utf-8", "replace")).hexdigest()
+        rec = (self._pass1_cache or {}).get("__test_refs__")
+        if (isinstance(rec, dict) and rec.get("sha256") == joint
+                and "strings" in rec):
+            self._test_refs = set(rec.get("refs", ()))
+            self._test_strings = set(rec.get("strings", ()))
+            self.test_refs_sha = joint
+            return
+        refs: set = set()
+        strings: set = set()
+        for ctx in tests:
+            if ctx.tree is None:
+                continue
+            for node in ctx.nodes(ast.Name):
+                refs.add(node.id)
+            for node in ctx.nodes(ast.Attribute):
+                refs.add(node.attr)
+            for node in ctx.nodes(ast.Import, ast.ImportFrom):
+                for alias in node.names:
+                    refs.add(alias.name.rsplit(".", 1)[-1])
+            for _line, value in ctx.strings():
+                strings.add(value)
+        self._test_refs = refs
+        self._test_strings = strings
+        self.test_refs_sha = joint
+
+    def test_referenced_names(self) -> set:
+        """Every identifier tests/ mentions (names, attributes,
+        imported aliases) — the "registered test" evidence."""
+        if self._test_refs is None:
+            self._test_evidence()
+        return self._test_refs
+
+    def test_string_constants(self) -> set:
+        """Every string constant under tests/ — the "asserted by a
+        test" evidence (journal keys, fallback reasons, ckpt keys)."""
+        if self._test_strings is None:
+            self._test_evidence()
+        return self._test_strings
+
 
 class Rule:
     """Base rule. Subclasses set ``name`` (kebab-case, the suppression /
-    baseline key) and implement ``check`` and/or ``finalize``."""
+    baseline key) and implement ``check`` and/or ``finalize``.
+    ``severity`` ("error" | "warning") is metadata carried into the
+    JSON output; both severities gate commits."""
 
     name = ""
     description = ""
+    severity = "error"
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         return ()
@@ -195,10 +346,17 @@ class AnalysisResult:
     files_scanned: int
     elapsed_seconds: float
 
+    #: ``--format json`` envelope version. 2 added the schema field
+    #: itself plus per-finding ``symbol`` / ``severity`` / ``rule_doc``
+    #: — downstream tooling (cooc-trace-style consumers) should reject
+    #: majors it does not know.
+    SCHEMA = "cooclint-findings/2"
+
     def to_dict(self) -> Dict[str, object]:
         """The ``--format json`` schema (round-trips through
         ``Finding.from_dict`` for the findings list)."""
         return {
+            "schema": self.SCHEMA,
             "findings": [f.to_dict() for f in self.findings],
             "baselined": len(self.baselined),
             "stale_baseline": self.stale_baseline,
@@ -213,8 +371,14 @@ def default_baseline_path() -> str:
 
 
 def load_baseline(path: Optional[str] = None) -> List[dict]:
-    """Baseline entries (``[{rule, file, line, justification}]``).
-    Missing file = empty baseline."""
+    """Baseline entries. Missing file = empty baseline.
+
+    Two entry formats coexist: the fingerprint form
+    ``{rule, file, symbol, justification}`` (stable across line drift)
+    and the legacy ``{rule, file, line, ...}`` form, which
+    ``--prune-baseline`` rewrites in place once a current finding
+    matches it.
+    """
     path = path or default_baseline_path()
     try:
         with open(path, encoding="utf-8") as f:
@@ -223,9 +387,11 @@ def load_baseline(path: Optional[str] = None) -> List[dict]:
         return []
     entries = data.get("findings", []) if isinstance(data, dict) else data
     for e in entries:
-        if not isinstance(e, dict) or not {"rule", "file", "line"} <= set(e):
+        if not isinstance(e, dict) or "rule" not in e or "file" not in e \
+                or ("line" not in e and "symbol" not in e):
             raise ValueError(
-                f"malformed baseline entry (need rule/file/line): {e!r}")
+                f"malformed baseline entry (need rule/file and "
+                f"symbol or line): {e!r}")
     return entries
 
 
@@ -248,17 +414,52 @@ def _walk_files(root: str) -> Iterator[str]:
                 yield os.path.join(dirpath, name)
 
 
+def annotate_finding(f: Finding, ctx: Optional[FileContext]) -> Finding:
+    """Fill the derived fields rules do not set themselves: the
+    enclosing-symbol fingerprint component and the owning rule's
+    severity/doc."""
+    if not f.symbol and ctx is not None:
+        f.symbol = ctx.symbol_at(f.line)
+    rule = RULES.get(f.rule)
+    if rule is not None:
+        if f.severity == "error":
+            f.severity = rule.severity
+        if not f.rule_doc:
+            f.rule_doc = rule.description
+    return f
+
+
+def _baseline_entry_key(e: dict):
+    """A baseline entry's match key: fingerprint form if it carries a
+    symbol, legacy exact-line form otherwise."""
+    if e.get("symbol"):
+        return ("symbol", e["rule"], e["file"], e["symbol"])
+    return ("line", e["rule"], e["file"], int(e["line"]))
+
+
 class Analyzer:
     """Walk ``root``, run every registered rule, fold in suppressions
-    and the baseline."""
+    and the baseline.
+
+    ``changed_only`` (a set of repo-relative paths) scopes pass 2's
+    per-file ``check`` to those files — the ``--changed`` pre-commit
+    path. Repo-scoped ``finalize`` rules still see the whole repo (the
+    pass-1 index is what the sha-keyed cache accelerates); findings
+    they raise in unchanged files are filtered out, matching the
+    "what did MY edit break" contract of an incremental run.
+    """
 
     def __init__(self, root: str,
                  rules: Optional[Iterable[Rule]] = None,
-                 baseline: Optional[List[dict]] = None) -> None:
+                 baseline: Optional[List[dict]] = None,
+                 changed_only: Optional[set] = None,
+                 pass1_cache: Optional[Dict[str, dict]] = None) -> None:
         self.root = os.path.abspath(root)
         self.rules = list(rules) if rules is not None else list(
             RULES.values())
         self.baseline = baseline if baseline is not None else []
+        self.changed_only = changed_only
+        self.pass1_cache = pass1_cache
 
     def _contexts(self) -> List[FileContext]:
         out = []
@@ -274,11 +475,17 @@ class Analyzer:
     def run(self) -> AnalysisResult:
         t0 = time.perf_counter()
         contexts = self._contexts()
-        repo = RepoContext(self.root, contexts)
+        repo = RepoContext(self.root, contexts,
+                           pass1_cache=self.pass1_cache)
+        # Exposed for the runner: ``--changed`` persists the pass-1
+        # module indexes (sha-keyed) out of the repo it just analyzed.
+        self.last_repo = repo
         raw: List[Finding] = []
         by_path = {c.path: c for c in contexts}
+        check_ctxs = contexts if self.changed_only is None else [
+            c for c in contexts if c.path in self.changed_only]
         for rule in self.rules:
-            for ctx in contexts:
+            for ctx in check_ctxs:
                 raw.extend(rule.check(ctx))
             raw.extend(rule.finalize(repo))
         # Dedup (two scan shapes can anchor to the same line), then
@@ -290,24 +497,31 @@ class Analyzer:
             if ident in seen:
                 continue
             seen.add(ident)
+            if self.changed_only is not None and \
+                    f.file not in self.changed_only:
+                continue
             ctx = by_path.get(f.file)
             if ctx is not None and ctx.is_suppressed(f):
                 continue
-            kept.append(f)
-        baseline_keys = {(e["rule"], e["file"], int(e["line"]))
-                         for e in self.baseline}
+            kept.append(annotate_finding(f, ctx))
+        baseline_keys = {_baseline_entry_key(e) for e in self.baseline}
         matched_keys = set()
         new: List[Finding] = []
         baselined: List[Finding] = []
         for f in kept:
-            if f.key() in baseline_keys:
-                matched_keys.add(f.key())
+            fp = ("symbol", *f.fingerprint())
+            exact = ("line", *f.key())
+            hit = next((k for k in (fp, exact) if k in baseline_keys),
+                       None)
+            if hit is not None:
+                matched_keys.add(hit)
                 baselined.append(f)
             else:
                 new.append(f)
         stale = [e for e in self.baseline
-                 if (e["rule"], e["file"], int(e["line"]))
-                 not in matched_keys]
+                 if _baseline_entry_key(e) not in matched_keys
+                 and (self.changed_only is None
+                      or e["file"] in self.changed_only)]
         new.sort(key=lambda f: (f.file, f.line, f.rule))
         return AnalysisResult(
             findings=new, baselined=baselined, stale_baseline=stale,
@@ -332,7 +546,8 @@ def analyze_source(source: str, path: str = "tpu_cooccurrence/_fixture.py",
             ident = (*f.key(), f.message)
             if ident not in seen:
                 seen.add(ident)
-                out.append(f)
+                out.append(annotate_finding(
+                    f, ctx if f.file == ctx.path else None))
     return [f for f in out if not ctx.is_suppressed(f)]
 
 

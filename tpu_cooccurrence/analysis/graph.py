@@ -1,0 +1,605 @@
+"""Pass 1 of the two-pass engine: project symbol table + call graph.
+
+cooclint grew up as a per-file AST pattern matcher; the rules that need
+to know *who calls whom across modules* (transitive jit purity,
+thread-ownership of shared state, tuning-knob dataflow) are structurally
+impossible in that shape — a helper two hops below a ``jit`` doing host
+I/O looks identical to any other function when its file is scanned
+alone. This module is the whole-program half: one cheap extraction walk
+per file (:func:`extract_module`, JSON-serializable so the ``--changed``
+pre-commit path can cache it keyed on the file's sha256), then a link
+step (:class:`ProjectGraph`) that resolves names into edges:
+
+* **symbol table** — every module / class / function def and every
+  assignment to a module-level name, under qualified names of the form
+  ``tpu_cooccurrence.pipeline:PipelineDriver._run`` (module-level code
+  is the pseudo-function ``<module>``);
+* **call graph** — intra-project call edges. ``self.m()`` resolves
+  through the enclosing class and its bases; bare names resolve through
+  module scope then imports (``from .x import f``); ``alias.f()``
+  resolves through module imports. Attribute calls on unresolvable
+  receivers (``job.scorer.process_window()``) become *duck edges* to
+  every project method of that (sufficiently distinctive) name — used
+  for thread reachability, where missing an edge hides a race, and
+  excluded from jit tracing, where inventing one invents a bug;
+* **thread roots** — entry points that run on a thread of their own:
+  ``threading.Thread(target=...)`` / ``threading.Timer`` spawn sites
+  (the pipeline scorer worker, the gang monitor, the metrics server
+  loop), ``do_*`` methods of ``BaseHTTPRequestHandler`` subclasses
+  (ThreadingHTTPServer runs each request on a fresh thread, so these
+  are additionally *self-concurrent*), and ``main`` — the union of
+  functions no thread entry reaches first (zero strong in-edges).
+  :meth:`ProjectGraph.roots_of` answers "which threads can be executing
+  this function", the fact the thread-ownership pack queries.
+
+The extraction also records attribute/global *write sites* (receiver,
+attr, enclosing function, and whether the write sits inside a
+``with *._lock:`` span, inside ``__init__``, or under a
+``# thread-owner:`` annotation) so pass-2 rules never re-walk ASTs.
+
+Stdlib only, no jax — same constraints as the rest of the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import FileContext, dotted_name
+
+#: Method names too generic to duck-type on: an edge to every class
+#: defining ``get`` would connect the whole program to itself and
+#: flatten the thread-root partition the ownership rule depends on.
+_DUCK_DENYLIST = {
+    "get", "put", "set", "add", "pop", "close", "join", "start", "run",
+    "read", "write", "append", "extend", "update", "clear", "items",
+    "keys", "values", "copy", "flush", "send", "recv", "next", "result",
+    "observe", "inc",
+}
+
+#: Annotation token: a write site carrying it (same or preceding line,
+#: or on its enclosing ``def``) declares single-threaded ownership and
+#: is exempt from the thread-ownership rule — the justification lives
+#: in the diff, like ``lock-ordering:``.
+OWNER_TOKEN = "thread-owner:"
+
+_HANDLER_BASES = {"BaseHTTPRequestHandler",
+                  "http.server.BaseHTTPRequestHandler"}
+
+
+def module_name_for(path: str) -> str:
+    """``tpu_cooccurrence/state/results.py`` → dotted module name."""
+    mod = path[:-3] if path.endswith(".py") else path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _lock_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+    """Line spans of ``with <expr>._lock`` bodies (object-insensitive —
+    the ownership rule only needs "some lock is held here"; the
+    object-sensitive form stays in rules_lock)."""
+    spans = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            target = expr.func if isinstance(expr, ast.Call) else expr
+            name = dotted_name(target) or ""
+            if name.endswith("._lock") or "._lock." in name:
+                spans.append((node.lineno, node.end_lineno or node.lineno))
+                break
+    return spans
+
+
+def _has_owner_annotation(lines: List[str], lineno: int,
+                          def_line: Optional[int]) -> bool:
+    for ln in (lineno, lineno - 1, def_line):
+        if ln and 1 <= ln <= len(lines) and OWNER_TOKEN in lines[ln - 1]:
+            return True
+    return False
+
+
+def extract_module(ctx: FileContext) -> Optional[dict]:
+    """One file → a JSON-serializable symbol/call/write summary."""
+    tree = ctx.tree
+    if tree is None:
+        return None
+    mod = module_name_for(ctx.path)
+    package = mod.rsplit(".", 1)[0] if "." in mod else ""
+    index: dict = {
+        "path": ctx.path, "module": mod,
+        "functions": {},       # qual -> {line,end,params,cls}
+        "classes": {},         # name -> {bases,line,end,methods}
+        "imports": {},         # local name -> dotted target
+        "module_names": [],    # module-level assigned names
+        "calls": {},           # caller qual -> [[callee_str, line], ...]
+        "threads": [],         # [target_str, caller, line, label]
+        "attr_writes": [],     # [recv, attr, caller, line, flags]
+        "global_writes": [],   # [name, caller, line, flags]
+        "handlers": [],        # request-handler class names
+    }
+    locks = _lock_spans(tree)
+
+    def locked(lineno: int) -> bool:
+        return any(lo <= lineno <= hi for lo, hi in locks)
+
+    # -- imports ---------------------------------------------------------
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                index["imports"][alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative: anchor at this file's package
+                up = package.split(".")
+                if node.level > 1:
+                    up = up[: -(node.level - 1)] or [""]
+                base = ".".join(up)
+                base = base + "." + node.module if node.module else base
+            else:
+                base = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                index["imports"][alias.asname or alias.name] = (
+                    f"{base}.{alias.name}" if base else alias.name)
+
+    # -- defs, calls, writes: one recursive walk tracking scope ----------
+    _in_init = [False]
+    _def_line: List[Optional[int]] = [None]
+
+    def _write_flags(lineno: int) -> str:
+        flags = ""
+        if locked(lineno):
+            flags += "L"
+        if _has_owner_annotation(ctx.lines, lineno, _def_line[0]):
+            flags += "A"
+        if _in_init[0]:
+            flags += "I"
+        return flags
+
+    def qual(stack: List[str]) -> str:
+        return stack[-1] if stack else "<module>"
+
+    def visit(node: ast.AST, fn_stack: List[str],
+              cls: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = (f"{cls}.{node.name}" if cls else node.name)
+            if name not in index["functions"]:
+                index["functions"][name] = {
+                    "line": node.lineno,
+                    "end": node.end_lineno or node.lineno,
+                    "params": [a.arg for a in node.args.args],
+                    "cls": cls,
+                }
+            # decorators execute at def time in the *enclosing* scope,
+            # not inside the function they wrap
+            for dec in node.decorator_list:
+                visit(dec, fn_stack, cls)
+            prev_init, prev_def = _in_init[0], _def_line[0]
+            _in_init[0] = prev_init or node.name in (
+                "__init__", "__post_init__", "__new__")
+            _def_line[0] = node.lineno
+            for child in ast.iter_child_nodes(node):
+                if child in node.decorator_list:
+                    continue
+                visit(child, fn_stack + [name], cls)
+            _in_init[0], _def_line[0] = prev_init, prev_def
+            return
+        if isinstance(node, ast.ClassDef):
+            bases = [dotted_name(b) or "" for b in node.bases]
+            crec = index["classes"].setdefault(node.name, {
+                "bases": bases, "line": node.lineno,
+                "end": node.end_lineno or node.lineno, "methods": []})
+            if any(b in _HANDLER_BASES or b.endswith("RequestHandler")
+                   for b in bases):
+                index["handlers"].append(node.name)
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn_stack, node.name)
+            crec["methods"] = [
+                f.split(".", 1)[1]
+                for f in index["functions"]
+                if f.startswith(node.name + ".") and "." not in
+                f.split(".", 1)[1]]
+            return
+
+        caller = qual(fn_stack)
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee:
+                index["calls"].setdefault(caller, []).append(
+                    [callee, node.lineno])
+                if callee in ("threading.Thread", "Thread",
+                              "threading.Timer", "Timer"):
+                    target = label = None
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = dotted_name(kw.value)
+                        elif kw.arg == "name" and isinstance(
+                                kw.value, ast.Constant):
+                            label = str(kw.value.value)
+                    if target is None and callee.endswith("Timer") and \
+                            len(node.args) >= 2:
+                        target = dotted_name(node.args[1])
+                    if target:
+                        index["threads"].append(
+                            [target, caller, node.lineno, label])
+        elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            recv = dotted_name(node.value)
+            if recv:
+                index["attr_writes"].append(
+                    [recv, node.attr, caller, node.lineno,
+                     _write_flags(node.lineno)])
+        elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)) and isinstance(
+                node.value, ast.Attribute):
+            # ``self._counters[k] += v`` mutates the container held in
+            # the attribute — a write for ownership purposes.
+            recv = dotted_name(node.value.value)
+            if recv:
+                index["attr_writes"].append(
+                    [recv, node.value.attr, caller, node.lineno,
+                     _write_flags(node.lineno)])
+        elif isinstance(node, ast.Global) and fn_stack:
+            for name in node.names:
+                index["global_writes"].append(
+                    [name, caller, node.lineno,
+                     _write_flags(node.lineno)])
+        elif isinstance(node, ast.Assign) and not fn_stack and \
+                cls is None:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    index["module_names"].append(tgt.id)
+        elif isinstance(node, ast.AnnAssign) and not fn_stack and \
+                cls is None and isinstance(node.target, ast.Name):
+            index["module_names"].append(node.target.id)
+
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn_stack, cls)
+
+    for top in tree.body:
+        visit(top, [], None)
+    return index
+
+
+class ProjectGraph:
+    """The linked whole-program view pass-2 rules query."""
+
+    #: Root label for code only the process's original thread runs.
+    MAIN = "main"
+
+    def __init__(self, indexes: Iterable[dict]) -> None:
+        self.modules: Dict[str, dict] = {}
+        for idx in indexes:
+            if idx is not None:
+                self.modules[idx["module"]] = idx
+        # symbol table: qualified function name -> record
+        self.functions: Dict[str, dict] = {}
+        # class name -> [(module, record)] (bare names: cross-module
+        # base resolution works on how code spells the base)
+        self.classes: Dict[str, List[Tuple[str, dict]]] = {}
+        # method name -> {qualnames} for duck edges
+        self._methods: Dict[str, Set[str]] = {}
+        for mod, idx in self.modules.items():
+            for fname, rec in idx["functions"].items():
+                q = f"{mod}:{fname}"
+                self.functions[q] = {**rec, "module": mod, "name": fname}
+                if rec["cls"]:
+                    self._methods.setdefault(
+                        fname.split(".")[-1], set()).add(q)
+            for cname, crec in idx["classes"].items():
+                self.classes.setdefault(cname, []).append((mod, crec))
+        self._edges: Dict[str, Set[str]] = {}       # strong call edges
+        self._duck_edges: Dict[str, Set[str]] = {}
+        self._link()
+        self._roots: Optional[Dict[str, Set[str]]] = None
+        self._strong_roots: Dict[str, Set[str]] = {}
+        self._root_meta: Dict[str, dict] = {}
+
+    # -- linking ---------------------------------------------------------
+
+    def _class_methods(self, cls: str, seen: Optional[Set[str]] = None
+                       ) -> Dict[str, str]:
+        """method name -> qualname for ``cls`` including its bases."""
+        seen = seen or set()
+        if cls in seen:
+            return {}
+        seen.add(cls)
+        out: Dict[str, str] = {}
+        for mod, crec in self.classes.get(cls, ()):  # later defs lose
+            for base in crec["bases"]:
+                base = base.split(".")[-1]
+                for name, q in self._class_methods(base, seen).items():
+                    out.setdefault(name, q)
+            for m in crec["methods"]:
+                out[m] = f"{mod}:{cls}.{m}"
+        return out
+
+    def resolve(self, callee: str, module: str,
+                cls: Optional[str]) -> Tuple[Optional[str], bool]:
+        """``(qualname, is_strong)`` for a callee string, or (None, _).
+
+        Strong resolutions: self-methods (through bases), module-local
+        names, imported names, ``alias.f`` through module imports, and
+        class constructors (edge to ``__init__``). Everything else
+        falls back to a duck edge handled by the caller.
+        """
+        idx = self.modules.get(module)
+        if idx is None:
+            return None, False
+        parts = callee.split(".")
+        if parts[0] in ("self", "cls") and cls and len(parts) == 2:
+            q = self._class_methods(cls).get(parts[1])
+            if q:
+                return q, True
+            return None, False
+        if len(parts) == 1:
+            name = parts[0]
+            if name in idx["functions"]:
+                return f"{module}:{name}", True
+            if name in idx["classes"]:
+                ctor = self._class_methods(name).get("__init__")
+                return ctor, True
+            target = idx["imports"].get(name)
+            if target:
+                tmod, _, tname = target.rpartition(".")
+                if tmod in self.modules:
+                    if tname in self.modules[tmod]["functions"]:
+                        return f"{tmod}:{tname}", True
+                    if tname in self.modules[tmod]["classes"]:
+                        ctor = self._class_methods(tname).get("__init__")
+                        return ctor, True
+            return None, False
+        head, rest = parts[0], parts[1:]
+        target = idx["imports"].get(head)
+        if target and len(rest) == 1:
+            # ``alias.f()`` — alias imported as a module
+            for cand in (target, ):
+                if cand in self.modules:
+                    sub = self.modules[cand]
+                    if rest[0] in sub["functions"]:
+                        return f"{cand}:{rest[0]}", True
+                    if rest[0] in sub["classes"]:
+                        ctor = self._class_methods(rest[0]).get("__init__")
+                        return ctor, True
+        if target and len(rest) == 2 and f"{target}.{rest[0]}" \
+                in self.modules:
+            sub = self.modules[f"{target}.{rest[0]}"]
+            if rest[1] in sub["functions"]:
+                return f"{target}.{rest[0]}:{rest[1]}", True
+        if head in idx["classes"] and len(rest) == 1:
+            q = self._class_methods(head).get(rest[0])
+            if q:
+                return q, True
+        return None, False
+
+    def _link(self) -> None:
+        for mod, idx in self.modules.items():
+            for caller, calls in idx["calls"].items():
+                cq = f"{mod}:{caller}"
+                cls = caller.split(".")[0] if "." in caller else (
+                    idx["functions"].get(caller, {}).get("cls"))
+                if caller in idx["functions"]:
+                    cls = idx["functions"][caller]["cls"]
+                for callee, _line in calls:
+                    q, strong = self.resolve(callee, mod, cls)
+                    if q:
+                        self._edges.setdefault(cq, set()).add(q)
+                        continue
+                    # duck edge: unresolvable receiver, distinctive
+                    # method name defined by few project classes
+                    mname = callee.split(".")[-1]
+                    if mname in _DUCK_DENYLIST or \
+                            mname.startswith("__"):
+                        continue
+                    cands = self._methods.get(mname, ())
+                    if 0 < len(cands) <= 4:
+                        self._duck_edges.setdefault(
+                            cq, set()).update(cands)
+
+    # -- queries ---------------------------------------------------------
+
+    def reachable(self, starts: Iterable[str], duck: bool = False
+                  ) -> Dict[str, Optional[str]]:
+        """BFS over call edges: ``{qualname: parent}`` for every
+        function reachable from ``starts`` (parents give rules a
+        printable trace path)."""
+        parents: Dict[str, Optional[str]] = {}
+        frontier = []
+        for s in starts:
+            if s not in parents:
+                parents[s] = None
+                frontier.append(s)
+        while frontier:
+            nxt = []
+            for q in frontier:
+                outs = set(self._edges.get(q, ()))
+                if duck:
+                    outs |= self._duck_edges.get(q, set())
+                for o in outs:
+                    if o not in parents:
+                        parents[o] = q
+                        nxt.append(o)
+            frontier = nxt
+        return parents
+
+    def trace(self, parents: Dict[str, Optional[str]], q: str
+              ) -> List[str]:
+        path = [q]
+        while parents.get(q):
+            q = parents[q]
+            path.append(q)
+        return list(reversed(path))
+
+    def thread_roots(self) -> Dict[str, dict]:
+        """root label -> {"entries": [qualnames], "concurrent": bool}.
+
+        ``concurrent`` marks roots that can run several instances at
+        once (one thread per HTTP request).
+        """
+        self._compute_roots()
+        return self._root_meta
+
+    def _thread_entry_quals(self) -> Dict[str, Tuple[str, bool]]:
+        """thread-entry qualname -> (root label, self-concurrent)."""
+        entries: Dict[str, Tuple[str, bool]] = {}
+        for mod, idx in self.modules.items():
+            for target, caller, _line, label in idx["threads"]:
+                cls = None
+                if caller in idx["functions"]:
+                    cls = idx["functions"][caller]["cls"]
+                q, _ = self.resolve(target, mod, cls)
+                if q is None and "." not in target:
+                    # closure target: nested ``def worker()`` inside a
+                    # method is recorded as ``Cls.worker``
+                    for fname in idx["functions"]:
+                        if fname == target or \
+                                fname.endswith("." + target):
+                            q = f"{mod}:{fname}"
+                            break
+                if q:
+                    entries[q] = (label or f"thread:{q}", False)
+            for hname in idx["handlers"]:
+                crec = idx["classes"].get(hname)
+                if crec:
+                    for m in crec["methods"]:
+                        if m.startswith("do_"):
+                            entries[f"{mod}:{hname}.{m}"] = (
+                                "http-handler", True)
+        return entries
+
+    def _compute_roots(self) -> None:
+        if self._roots is not None:
+            return
+        entries = self._thread_entry_quals()
+        in_deg: Set[str] = set()
+        for q, outs in self._edges.items():
+            in_deg.update(outs)
+        for q, outs in self._duck_edges.items():
+            in_deg.update(outs)
+        roots: Dict[str, Set[str]] = {}
+        strong: Dict[str, Set[str]] = {}
+        self._root_meta = {}
+        for q, (label, concurrent) in entries.items():
+            meta = self._root_meta.setdefault(
+                label, {"entries": [], "concurrent": concurrent})
+            meta["entries"].append(q)
+            for reached in self.reachable([q], duck=True):
+                roots.setdefault(reached, set()).add(label)
+            for reached in self.reachable([q], duck=False):
+                strong.setdefault(reached, set()).add(label)
+        main_entries = [
+            q for q in self.functions
+            if q not in entries and (
+                q not in in_deg
+                or self.functions[q]["name"] == "main")]
+        # module-level code is always a main entry
+        for mod, idx in self.modules.items():
+            if "<module>" in idx["calls"]:
+                main_entries.append(f"{mod}:<module>")
+        self._root_meta[self.MAIN] = {
+            "entries": sorted(main_entries), "concurrent": False}
+        for reached in self.reachable(main_entries, duck=True):
+            roots.setdefault(reached, set()).add(self.MAIN)
+        for reached in self.reachable(main_entries, duck=False):
+            strong.setdefault(reached, set()).add(self.MAIN)
+        self._roots = roots
+        self._strong_roots = strong
+
+    def roots_of(self, qualname: str) -> Set[str]:
+        """Which thread roots can be executing this function."""
+        self._compute_roots()
+        return self._roots.get(qualname, set())
+
+    def strong_roots_of(self, qualname: str) -> Set[str]:
+        """Roots via strong (resolved) call edges only — the evidence
+        bar for indicting a *single* write site, where a speculative
+        duck edge would manufacture the whole finding rather than
+        merely widen one."""
+        self._compute_roots()
+        return self._strong_roots.get(qualname, set())
+
+    def is_concurrent_root(self, label: str) -> bool:
+        self._compute_roots()
+        return bool(self._root_meta.get(label, {}).get("concurrent"))
+
+    # -- write-site queries (thread-ownership, lock derivation) ----------
+
+    def _thread_local(self, cls: str) -> bool:
+        """Classes subclassing ``threading.local`` hold per-thread
+        state by construction — their instance writes never race."""
+        for _mod, crec in self.classes.get(cls, ()):
+            for base in crec["bases"]:
+                if base in ("threading.local", "local"):
+                    return True
+        return False
+
+    def attr_write_sites(self) -> Dict[Tuple[str, str],
+                                       List[Tuple[str, str, int, str]]]:
+        """(owner class, attr) -> [(module, caller qual, line, flags)].
+
+        ``self.x`` binds to the enclosing class. A write through any
+        other receiver (``ledger.h2d_bytes += n``) binds by attribute
+        name when exactly one project class self-writes that attribute
+        — distinctive names identify the state, ambiguous ones are
+        skipped rather than guessed.
+        """
+        self_owner: Dict[str, Set[str]] = {}  # attr -> classes
+        for mod, idx in self.modules.items():
+            for recv, attr, caller, _line, _flags in idx["attr_writes"]:
+                if recv == "self":
+                    cls = None
+                    if caller in idx["functions"]:
+                        cls = idx["functions"][caller]["cls"]
+                    if cls and not self._thread_local(cls):
+                        self_owner.setdefault(attr, set()).add(cls)
+        sites: Dict[Tuple[str, str], List[Tuple[str, str, int, str]]] = {}
+        for mod, idx in self.modules.items():
+            for recv, attr, caller, line, flags in idx["attr_writes"]:
+                if recv == "self":
+                    cls = None
+                    if caller in idx["functions"]:
+                        cls = idx["functions"][caller]["cls"]
+                    if cls and not self._thread_local(cls):
+                        sites.setdefault((cls, attr), []).append(
+                            (mod, caller, line, flags))
+                else:
+                    owners = self_owner.get(attr, set())
+                    if len(owners) == 1:
+                        sites.setdefault(
+                            (next(iter(owners)), attr), []).append(
+                            (mod, caller, line, flags))
+        return sites
+
+    def global_write_sites(self) -> Dict[Tuple[str, str],
+                                         List[Tuple[str, int, str]]]:
+        """(module, global name) -> [(caller qual, line, flags)]."""
+        sites: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+        for mod, idx in self.modules.items():
+            for name, caller, line, flags in idx["global_writes"]:
+                sites.setdefault((mod, name), []).append(
+                    (caller, line, flags))
+        return sites
+
+
+def build_graph(contexts: Iterable[FileContext],
+                cached: Optional[Dict[str, dict]] = None
+                ) -> ProjectGraph:
+    """Link a graph from file contexts; ``cached`` maps path → a
+    previously extracted (sha-validated) module index to skip the AST
+    walk for unchanged files."""
+    indexes = []
+    for ctx in contexts:
+        if not ctx.path.startswith("tpu_cooccurrence/") or \
+                not ctx.is_python:
+            continue
+        idx = (cached or {}).get(ctx.path)
+        if idx is None:
+            idx = extract_module(ctx)
+        if idx is not None:
+            indexes.append(idx)
+    return ProjectGraph(indexes)

@@ -46,19 +46,7 @@ def _policy_subclasses(tree: ast.Module) -> Dict[str, ast.ClassDef]:
 
 
 def _test_referenced_names(repo: RepoContext) -> Set[str]:
-    refs: Set[str] = set()
-    for ctx in repo.python_files():
-        if not ctx.path.startswith("tests/") or ctx.tree is None:
-            continue
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Name):
-                refs.add(node.id)
-            elif isinstance(node, ast.Attribute):
-                refs.add(node.attr)
-            elif isinstance(node, (ast.Import, ast.ImportFrom)):
-                for alias in node.names:
-                    refs.add(alias.name.rsplit(".", 1)[-1])
-    return refs
+    return repo.test_referenced_names()
 
 
 @register

@@ -49,7 +49,6 @@ from .core import (
     RepoContext,
     Rule,
     register,
-    string_constants,
 )
 
 _PALLAS_PATH = "tpu_cooccurrence/ops/pallas_score.py"
@@ -86,22 +85,7 @@ def _kernel_entry_points(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
 def _test_referenced_names(repo: RepoContext) -> Set[str]:
     """Every identifier the test suite mentions (names, attributes,
     imported aliases) — the "registered parity test" evidence."""
-    refs: Set[str] = set()
-    for ctx in repo.python_files():
-        if not ctx.path.startswith("tests/"):
-            continue
-        tree = ctx.tree
-        if tree is None:
-            continue
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Name):
-                refs.add(node.id)
-            elif isinstance(node, ast.Attribute):
-                refs.add(node.attr)
-            elif isinstance(node, (ast.Import, ast.ImportFrom)):
-                for alias in node.names:
-                    refs.add(alias.name.rsplit(".", 1)[-1])
-    return refs
+    return repo.test_referenced_names()
 
 
 @register
@@ -119,7 +103,9 @@ class FusedKernelRegistryRule(Rule):
         # package (the state-store-registry rule's vanished-ARCHITECTURE
         # precedent) — the package-wide scan below is the whole gate.
         sources = [c for c in repo.python_files()
-                   if c.path.startswith(_PKG_PREFIX) and c.tree is not None]
+                   if c.path.startswith(_PKG_PREFIX)
+                   and "pallas_call" in c.source  # cheap pre-filter
+                   and c.tree is not None]
         per_file = [(ctx, _kernel_entry_points(ctx.tree))
                     for ctx in sources]
         if not any(kernels for _ctx, kernels in per_file):
@@ -201,7 +187,7 @@ class FusedFallbackRegistryRule(Rule):
         sites: List[Tuple[FileContext, int, str]] = []
         any_call_sites = False
         for ctx in repo.package_files():
-            if ctx.tree is None:
+            if "_fallback_chained" not in ctx.source or ctx.tree is None:
                 continue
             literal, dynamic = _fallback_sites(ctx.tree)
             any_call_sites = any_call_sites or bool(literal or dynamic)
@@ -238,12 +224,7 @@ class FusedFallbackRegistryRule(Rule):
                 message=(f"{_ARCH_PATH} not found — the fused fallback "
                          f"table this rule checks reasons against is "
                          f"gone"))
-        test_literals: Set[str] = set()
-        for ctx in repo.python_files():
-            if not ctx.path.startswith("tests/") or ctx.tree is None:
-                continue
-            for _lineno, value in string_constants(ctx.tree):
-                test_literals.add(value)
+        test_literals: Set[str] = repo.test_string_constants()
         seen: Set[str] = set()
         for ctx, lineno, reason in sites:
             if reason in seen:

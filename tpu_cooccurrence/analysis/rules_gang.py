@@ -55,12 +55,11 @@ class CollectiveWatchdogRule(Rule):
         if (not ctx.path.startswith("tpu_cooccurrence/")
                 or not ctx.is_python or ctx.path == _WRAPPER_PATH):
             return
-        tree = ctx.tree
-        if tree is None:
+        if not any(c in ctx.source for c in _RAW_COLLECTIVES):
             return
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
+        if ctx.tree is None:
+            return
+        for node in ctx.nodes(ast.Call):
             func = node.func
             callee = None
             if isinstance(func, ast.Attribute):
@@ -93,10 +92,9 @@ class GangFaultSiteRule(Rule):
             return
         fired: Set[str] = set()
         for ctx in repo.package_files():
-            tree = ctx.tree
-            if tree is None:
+            if "fire(" not in ctx.source or ctx.tree is None:
                 continue
-            for node in ast.walk(tree):
+            for node in ctx.nodes(ast.Call):
                 if (isinstance(node, ast.Call)
                         and ((isinstance(node.func, ast.Attribute)
                               and node.func.attr == "fire")

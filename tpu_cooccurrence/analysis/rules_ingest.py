@@ -47,34 +47,33 @@ def _written_keys(ctx: FileContext,
     position key constants (so the read scan can exclude them)."""
     written: Dict[str, int] = {}
     write_nodes: Set[int] = set()
-    for node in ast.walk(ctx.tree):
-        if isinstance(node, ast.Assign):
-            for tgt in node.targets:
-                # offsets = {"k": ...} / in_flight = {"k": ...}
-                if (isinstance(tgt, ast.Name) and tgt.id in names
-                        and isinstance(node.value, ast.Dict)):
+    for node in ctx.nodes(ast.Assign):
+        for tgt in node.targets:
+            # offsets = {"k": ...} / in_flight = {"k": ...}
+            if (isinstance(tgt, ast.Name) and tgt.id in names
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        written.setdefault(k.value, k.lineno)
+                        write_nodes.add(id(k))
+            # offsets["k"] = ... / partitions[name] = {"k": ...}
+            if (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in names):
+                if (isinstance(tgt.slice, ast.Constant)
+                        and isinstance(tgt.slice.value, str)):
+                    written.setdefault(tgt.slice.value, tgt.lineno)
+                    write_nodes.add(id(tgt.slice))
+                # The partitioned source stores one dict PER
+                # partition name (a variable subscript): its value
+                # literal's keys are format keys too.
+                if isinstance(node.value, ast.Dict):
                     for k in node.value.keys:
                         if (isinstance(k, ast.Constant)
                                 and isinstance(k.value, str)):
                             written.setdefault(k.value, k.lineno)
                             write_nodes.add(id(k))
-                # offsets["k"] = ... / partitions[name] = {"k": ...}
-                if (isinstance(tgt, ast.Subscript)
-                        and isinstance(tgt.value, ast.Name)
-                        and tgt.value.id in names):
-                    if (isinstance(tgt.slice, ast.Constant)
-                            and isinstance(tgt.slice.value, str)):
-                        written.setdefault(tgt.slice.value, tgt.lineno)
-                        write_nodes.add(id(tgt.slice))
-                    # The partitioned source stores one dict PER
-                    # partition name (a variable subscript): its value
-                    # literal's keys are format keys too.
-                    if isinstance(node.value, ast.Dict):
-                        for k in node.value.keys:
-                            if (isinstance(k, ast.Constant)
-                                    and isinstance(k.value, str)):
-                                written.setdefault(k.value, k.lineno)
-                                write_nodes.add(id(k))
     return written, write_nodes
 
 
@@ -83,23 +82,14 @@ def _read_constants(ctx: FileContext, write_nodes: Set[int]) -> Set[str]:
     write-position keys — the reader-evidence pool (subscript loads,
     ``.get`` arguments, membership tests all surface here)."""
     out: Set[str] = set()
-    for node in ast.walk(ctx.tree):
-        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
-                and id(node) not in write_nodes):
+    for node in ctx.nodes(ast.Constant):
+        if isinstance(node.value, str) and id(node) not in write_nodes:
             out.add(node.value)
     return out
 
 
 def _tests_constants(repo: RepoContext) -> Set[str]:
-    out: Set[str] = set()
-    for ctx in repo.python_files():
-        if not ctx.path.startswith("tests/") or ctx.tree is None:
-            continue
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value,
-                                                             str):
-                out.add(node.value)
-    return out
+    return repo.test_string_constants()
 
 
 @register

@@ -1,20 +1,25 @@
-"""jit / device hygiene.
+"""jit / device hygiene — transitive over the whole-program call graph.
 
 The scorers' hot paths are jit-compiled (``@jax.jit`` /
 ``functools.partial(jax.jit, ...)`` / ``jax.jit(shard_map(...))``) and
 stay fast only while they remain *pure device programs*: a stray
 ``np.asarray``/``float()`` on a traced value forces a host sync per
 window, a ``print`` retraces, host RNG silently freezes into the traced
-constant. Separately, the state-carrying jits donate their input
-buffers (``ops/donation.py``); a donated array is dead the moment the
-dispatch is enqueued, and reading it afterwards is exactly the TFRT
+constant, and an ``os.environ`` read bakes the launch-time value into
+the compiled program. Separately, the state-carrying jits donate their
+input buffers (``ops/donation.py``); a donated array is dead the moment
+the dispatch is enqueued, and reading it afterwards is exactly the TFRT
 use-after-donate crash class the CPU backend gating exists for.
 
-* ``jit-purity`` — inside a jitted function (decorated, wrapped at
-  module level, or reachable by one intra-module call hop from one),
-  flag host syncs: ``np.asarray``/``np.array``, ``float()``/``int()``
-  on non-static traced parameters, ``.block_until_ready()``, ``print``,
-  and host RNG (``np.random.*`` / ``random.*``).
+* ``jit-purity`` — two passes. Per file: the body of every jitted
+  function (decorated, or wrapped at module level). Whole-program:
+  every function *reachable from a jit entry over strong call edges*
+  (:mod:`.graph`) — everything called while tracing runs under the
+  trace, so a host sync two modules below the entry point is the same
+  bug as one in its body. This replaced the old "one intra-module hop,
+  ops/ only" special case, which provably missed a host-RNG call two
+  hops down. Duck edges are excluded: a speculative edge would invent
+  a purity bug on code that never traces.
 * ``donation-reuse`` — after a call to a donating jit (its
   ``donate_argnums`` positions read straight from the AST), any read of
   the same argument expression before it is reassigned is a finding.
@@ -23,12 +28,16 @@ use-after-donate crash class the CPU backend gating exists for.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
-from .core import FileContext, Finding, Rule, dotted_name, register
+from .core import FileContext, Finding, RepoContext, Rule, dotted_name, \
+    register
+from .graph import module_name_for
 
 _NUMPY_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _RNG_PREFIXES = ("np.random.", "numpy.random.", "random.")
+_ENV_READS = {"os.environ.get", "os.getenv", "environ.get",
+              "tuning.env_read", "env_read"}
 
 
 def _is_jit_ref(node: ast.AST) -> bool:
@@ -81,13 +90,20 @@ class _JitInfo:
         self.static = static
 
 
-def _collect_jitted(tree: ast.Module, in_ops: bool
+def _collect_jitted(tree: ast.Module
                     ) -> Tuple[List[_JitInfo], Dict[str, Tuple[int, ...]]]:
-    """(jitted function defs, donating-callable name -> donated argnums).
+    """(jit *entry points* in this file, donating-callable name ->
+    donated argnums).
 
-    Donating callables are keyed by how call sites spell them:
-    a bare name (module-level def / assignment) or ``self.<attr>``.
+    Entry points only — transitive closure over callees lives in the
+    whole-program pass. Donating callables are keyed by how call sites
+    spell them: a bare name (module-level def / assignment) or
+    ``self.<attr>``. Memoized on the tree: both rules and the
+    whole-program pass ask for the same file's entries.
     """
+    cached = getattr(tree, "_cooclint_jitted", None)
+    if cached is not None:
+        return cached
     fns_by_name = {n.name: n for n in ast.walk(tree)
                    if isinstance(n, ast.FunctionDef)}
     jitted: Dict[str, _JitInfo] = {}
@@ -131,67 +147,126 @@ def _collect_jitted(tree: ast.Module, in_ops: bool
                     key = dotted_name(tgt)
                     if key:
                         donating[key] = pos
-    # One intra-module call hop: ops/ scorers factor their jitted bodies
-    # into helpers; a host sync inside the helper is the same bug.
-    if in_ops:
-        changed = True
-        while changed:
-            changed = False
-            for info in list(jitted.values()):
-                for node in ast.walk(info.fn):
-                    if isinstance(node, ast.Call) and isinstance(
-                            node.func, ast.Name):
-                        callee = fns_by_name.get(node.func.id)
-                        if callee is not None and callee.name not in jitted:
-                            jitted[callee.name] = _JitInfo(callee, set())
-                            changed = True
-    return list(jitted.values()), donating
+    result = (list(jitted.values()), donating)
+    tree._cooclint_jitted = result
+    return result
+
+
+def _purity_scan(calls: Iterable[ast.Call],
+                 env_subscripts: Iterable[ast.Subscript],
+                 traced: Set[str], label: str, path: str,
+                 suffix: str = "") -> Iterator[Finding]:
+    """Host-sync findings for traced code. ``calls``/``env_subscripts``
+    are the nodes inside the traced span; ``label`` names the jitted
+    function for the message; ``suffix`` carries the call-graph trace
+    for transitively reached code."""
+    for node in calls:
+        name = dotted_name(node.func) or ""
+        bad = None
+        if name in _NUMPY_SYNC:
+            bad = f"{name}() materializes the traced value on host"
+        elif name == "print":
+            bad = "print() inside a traced function (retraces)"
+        elif name.startswith(_RNG_PREFIXES):
+            bad = (f"host RNG {name}() freezes into the trace; "
+                   f"use jax.random with a threaded key")
+        elif name in _ENV_READS:
+            bad = (f"{name}() in traced code bakes the launch-time "
+                   f"environment into the compiled program")
+        elif name in ("float", "int") and len(node.args) == 1:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in traced:
+                bad = (f"{name}({arg.id}) forces a host sync on "
+                       f"a traced parameter")
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "block_until_ready":
+            bad = ("block_until_ready() inside a jitted "
+                   "function defeats async dispatch")
+        if bad is not None:
+            yield Finding(
+                rule="jit-purity", file=path, line=node.lineno,
+                message=f"in jitted `{label}`: {bad}{suffix}")
+    for node in env_subscripts:
+        if isinstance(node.ctx, ast.Load) and \
+                (dotted_name(node.value) or "") in ("os.environ",
+                                                    "environ"):
+            yield Finding(
+                rule="jit-purity", file=path, line=node.lineno,
+                message=(f"in jitted `{label}`: os.environ[...] in "
+                         f"traced code bakes the launch-time "
+                         f"environment into the compiled "
+                         f"program{suffix}"))
 
 
 @register
 class JitPurityRule(Rule):
     name = "jit-purity"
     description = ("host syncs (np.asarray, float()/int() on traced "
-                   "params, block_until_ready, print, host RNG) inside "
-                   "jit-compiled functions")
+                   "params, block_until_ready, print, host RNG, "
+                   "environ reads) inside jit entry points or any "
+                   "function they reach on the call graph")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
-        if not ctx.path.startswith("tpu_cooccurrence/"):
+        if "jit" not in ctx.source:
             return ()
         tree = ctx.tree
         if tree is None:
             return ()
-        in_ops = "/ops/" in ("/" + ctx.path)
-        jitted, _ = _collect_jitted(tree, in_ops)
+        jitted, _ = _collect_jitted(tree)
         out: List[Finding] = []
         for info in jitted:
             params = {a.arg for a in info.fn.args.args}
-            traced = params - info.static
-            for node in ast.walk(info.fn):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = dotted_name(node.func) or ""
-                bad = None
-                if name in _NUMPY_SYNC:
-                    bad = f"{name}() materializes the traced value on host"
-                elif name == "print":
-                    bad = "print() inside a traced function (retraces)"
-                elif name.startswith(_RNG_PREFIXES):
-                    bad = (f"host RNG {name}() freezes into the trace; "
-                           f"use jax.random with a threaded key")
-                elif name in ("float", "int") and len(node.args) == 1:
-                    arg = node.args[0]
-                    if isinstance(arg, ast.Name) and arg.id in traced:
-                        bad = (f"{name}({arg.id}) forces a host sync on "
-                               f"a traced parameter")
-                elif isinstance(node.func, ast.Attribute) and \
-                        node.func.attr == "block_until_ready":
-                    bad = ("block_until_ready() inside a jitted "
-                           "function defeats async dispatch")
-                if bad is not None:
-                    out.append(Finding(
-                        rule=self.name, file=ctx.path, line=node.lineno,
-                        message=(f"in jitted `{info.fn.name}`: {bad}")))
+            nodes = list(ast.walk(info.fn))
+            out.extend(_purity_scan(
+                (n for n in nodes if isinstance(n, ast.Call)),
+                (n for n in nodes if isinstance(n, ast.Subscript)),
+                params - info.static, info.fn.name, ctx.path))
+        return out
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        graph = repo.graph
+        by_path = {c.path: c for c in repo.package_files()}
+        # jit entry defs -> their graph qualnames (matched on def line)
+        entries: Dict[str, str] = {}
+        for ctx in by_path.values():
+            if "jit" not in ctx.source or ctx.tree is None:
+                continue
+            jitted, _ = _collect_jitted(ctx.tree)
+            if not jitted:
+                continue
+            idx = graph.modules.get(module_name_for(ctx.path))
+            if idx is None:
+                continue
+            lines = {info.fn.lineno for info in jitted}
+            for fname, rec in idx["functions"].items():
+                if rec["line"] in lines:
+                    entries[f"{idx['module']}:{fname}"] = fname
+        if not entries:
+            return ()
+        parents = graph.reachable(entries, duck=False)
+        out: List[Finding] = []
+        for q in sorted(parents):
+            if q in entries:
+                continue  # entry bodies are covered by check()
+            mod, _, fname = q.partition(":")
+            idx = graph.modules.get(mod)
+            rec = (idx or {}).get("functions", {}).get(fname)
+            ctx = by_path.get((idx or {}).get("path", ""))
+            if rec is None or ctx is None:
+                continue
+            lo, hi = rec["line"], rec["end"]
+            trace = graph.trace(parents, q)
+            suffix = (" (traced from `"
+                      f"{entries[trace[0]]}`: "
+                      + " -> ".join(t.partition(':')[2] for t in trace)
+                      + ")")
+            out.extend(_purity_scan(
+                (n for n in ctx.nodes(ast.Call)
+                 if lo <= n.lineno <= hi),
+                (n for n in ctx.nodes(ast.Subscript)
+                 if lo <= n.lineno <= hi),
+                set(rec["params"]),
+                fname.split(".")[-1], ctx.path, suffix))
         return out
 
 
@@ -202,12 +277,12 @@ class DonationReuseRule(Rule):
                    "read again before reassignment")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
-        if not ctx.path.startswith("tpu_cooccurrence/"):
+        if "donate_argnums" not in ctx.source:
             return ()
         tree = ctx.tree
         if tree is None:
             return ()
-        _, donating = _collect_jitted(tree, "/ops/" in ("/" + ctx.path))
+        _, donating = _collect_jitted(tree)
         if not donating:
             return ()
         out: List[Finding] = []

@@ -56,14 +56,12 @@ def _dict_keys(node: ast.Dict) -> "Iterable[Tuple[str, int]]":
             yield k.value, k.lineno
 
 
-def _name_keys(tree: ast.Module, var: str) -> "Iterable[Tuple[str, int]]":
+def _name_keys(ctx: FileContext, var: str) -> "Iterable[Tuple[str, int]]":
     """Keys flowing into a ``record(rec)``-style Name argument: dict
     literals assigned to ``var`` plus constant subscript stores on it,
     module-wide (this also catches stamping helpers whose parameter
     shares the name — ``def _stamp(self, rec): rec["run_id"] = ...``)."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
+    for node in ctx.nodes(ast.Assign):
         for tgt in node.targets:
             if (isinstance(tgt, ast.Name) and tgt.id == var
                     and isinstance(node.value, ast.Dict)):
@@ -80,10 +78,12 @@ def _emitted_keys(ctx: FileContext) -> Dict[str, int]:
     """``{key: first emission line}`` for every ``*.journal.record(...)``
     call site in one module."""
     out: Dict[str, int] = {}
-    if ctx.tree is None:
+    # Cheap substring gate: every matched call site's dotted name ends
+    # with "journal.record", so the source text must contain it.
+    if "journal.record" not in ctx.source or ctx.tree is None:
         return out
-    for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Call) and node.args):
+    for node in ctx.nodes(ast.Call):
+        if not node.args:
             continue
         name = dotted_name(node.func)
         if name is None or not name.endswith("journal.record"):
@@ -97,21 +97,13 @@ def _emitted_keys(ctx: FileContext) -> Dict[str, int]:
             for key, line in _dict_keys(arg):
                 out.setdefault(key, line)
         elif isinstance(arg, ast.Name):
-            for key, line in _name_keys(ctx.tree, arg.id):
+            for key, line in _name_keys(ctx, arg.id):
                 out.setdefault(key, line)
     return out
 
 
 def _tests_constants(repo: RepoContext) -> Set[str]:
-    out: Set[str] = set()
-    for ctx in repo.python_files():
-        if not ctx.path.startswith("tests/") or ctx.tree is None:
-            continue
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value,
-                                                             str):
-                out.add(node.value)
-    return out
+    return repo.test_string_constants()
 
 
 @register

@@ -1,20 +1,28 @@
 """Lock discipline on the pipeline's shared state.
 
 The pipelined execution mode (``pipeline.py``) runs two threads — the
-sampling/caller thread and the scorer worker — against three shared
-registries: ``metrics.Counters``, ``observability.TransferLedger`` and
-``state.results.LatestResults``. Each guards its mutable state with a
-``_lock``; the PR-2 races happened exactly where code outside those
-classes touched the raw attributes (an unlocked ``+=`` on the ledger's
-byte totals, ``Counters.merge`` folding a mid-add snapshot). These rules
-make that shape un-committable:
+sampling/caller thread and the scorer worker — against shared
+registries (``metrics.Counters``, ``observability.TransferLedger``,
+``state.results.LatestResults``, ...). Each guards its mutable state
+with a ``_lock``; the PR-2 races happened exactly where code outside
+those classes touched the raw attributes (an unlocked ``+=`` on the
+ledger's byte totals, ``Counters.merge`` folding a mid-add snapshot).
+These rules make that shape un-committable:
 
 * ``lock-discipline`` — any attribute read/write of a protected class's
   internal state outside the owning class body and outside a
-  ``with <obj>._lock:`` block is a finding. Attribute *names* identify
-  the state (``_counters``, ``h2d_bytes``, ``_ptr_batch``, ...): the
-  names are distinctive enough that a non-owner touching one is either
-  the bug we hunt or close enough to deserve a justification comment.
+  ``with <obj>._lock:`` block is a finding. The protected map is
+  **derived from the package source itself**, not hardcoded: a class
+  that creates ``self._lock`` owns every attribute it writes under
+  ``with self._lock:`` — declaring the lock *is* declaring the
+  discipline, so a new registry class is covered the moment it is
+  written, and the map can never rot the way the old three-class list
+  would have. Detection keys on attribute *names* (so a single-file
+  fixture with ``ledger.h2d_bytes += n`` is judged without seeing the
+  owning class), which is why only distinctive names participate:
+  an attr claimed by two owners, or a bare dictionary word
+  (``count``, ``max``, ``events``), is dropped as too generic to key
+  on.
 * ``lock-annotation`` — a new ``threading.Lock()``/``RLock()`` acquired
   in the worker code paths (``pipeline.py`` / ``job.py``) must carry a
   ``lock-ordering:`` annotation (same or preceding line) stating its
@@ -26,22 +34,116 @@ make that shape un-committable:
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Set
+import os
+from typing import Dict, Iterable, List, Optional, Set
 
 from .core import FileContext, Finding, Rule, dotted_name, register
 
-#: Owning class -> the internal-state attribute names only it (or a
-#: ``with x._lock`` block) may touch. Names are chosen to be distinctive
-#: (``events`` is deliberately absent: too generic to key on).
-PROTECTED_STATE = {
-    "Counters": {"_counters"},
-    "TransferLedger": {"h2d_bytes", "d2h_bytes", "h2d_calls", "d2h_calls",
-                       "uplink_raw_bytes", "uplink_enc_bytes",
-                       "basket_h2d_bytes", "basket_h2d_calls"},
-    "LatestResults": {"_batches", "_ptr_batch", "_ptr_row", "_total_rows"},
-}
+#: The package whose source the protected-state map is derived from —
+#: always the real installed tpu_cooccurrence, even when the analyzer
+#: runs over a fixture repo (fixtures exercise the *rule*, and the rule
+#: keys on the production registries' attribute names).
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_ALL_PROTECTED: Set[str] = set().union(*PROTECTED_STATE.values())
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "Lock", "RLock")
+
+
+def _creates_lock(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call) and \
+                (dotted_name(node.value.func) or "") in _LOCK_CTORS:
+            if any(isinstance(t, ast.Attribute) and t.attr == "_lock"
+                   for t in node.targets):
+                return True
+    return False
+
+
+def _locked_self_writes(cls: ast.ClassDef) -> Set[str]:
+    """Attribute names the class writes on ``self`` inside its own
+    ``with self._lock:`` spans — the state the lock exists for."""
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any((dotted_name(
+                i.context_expr.func if isinstance(i.context_expr,
+                                                  ast.Call)
+                else i.context_expr) or "").startswith("self._lock")
+                for i in node.items):
+            continue
+        for sub in ast.walk(node):
+            tgt = None
+            if isinstance(sub, ast.Attribute) and isinstance(
+                    sub.ctx, ast.Store):
+                tgt = sub
+            elif isinstance(sub, ast.Subscript) and isinstance(
+                    sub.ctx, ast.Store) and isinstance(
+                    sub.value, ast.Attribute):
+                tgt = sub.value  # self._counters[k] = v
+            if tgt is not None and isinstance(
+                    tgt.value, ast.Name) and tgt.value.id == "self":
+                attrs.add(tgt.attr)
+    return attrs
+
+
+_DERIVED: Optional[Dict[str, Set[str]]] = None
+
+
+def protected_state() -> Dict[str, Set[str]]:
+    """Owning class -> internal-state attribute names only it (or a
+    ``with x._lock`` block) may touch. Derived once per process by
+    parsing the installed package source; two distinctiveness gates
+    keep name-keyed detection sound: an attr written under lock by two
+    different owners is ambiguous, and a name without an underscore
+    (``count``, ``sum``, ``events``) is a dictionary word that
+    legitimately appears on unrelated objects everywhere."""
+    global _DERIVED
+    if _DERIVED is not None:
+        return _DERIVED
+    owners: Dict[str, Set[str]] = {}
+    for dirpath, dirnames, files in os.walk(_PKG_ROOT):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("analysis", "__pycache__")]
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fname),
+                          encoding="utf-8") as fh:
+                    src = fh.read()
+                if "_lock" not in src:
+                    continue  # cheap pre-filter: nothing to derive
+                tree = ast.parse(src)
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef) and _creates_lock(node):
+                    attrs = _locked_self_writes(node)
+                    if attrs:
+                        owners.setdefault(node.name, set()).update(attrs)
+    claims: Dict[str, int] = {}
+    for attrs in owners.values():
+        for a in attrs:
+            claims[a] = claims.get(a, 0) + 1
+    derived = {}
+    for cls, attrs in owners.items():
+        keep = {a for a in attrs if claims[a] == 1 and "_" in a}
+        if keep:
+            derived[cls] = keep
+    _DERIVED = derived
+    return derived
+
+
+_ALL: Optional[Set[str]] = None
+
+
+def _all_protected() -> Set[str]:
+    global _ALL
+    if _ALL is None:
+        state = protected_state()
+        _ALL = set().union(*state.values()) if state else set()
+    return _ALL
 
 #: Files whose module-level worker threads make a bare new lock a
 #: deadlock hazard (the ``lock-annotation`` rule's scope).
@@ -50,7 +152,7 @@ _WORKER_FILES = ("tpu_cooccurrence/pipeline.py", "tpu_cooccurrence/job.py")
 _ANNOTATION_TOKEN = "lock-ordering:"
 
 
-def _with_lock_spans(tree: ast.Module) -> List[tuple]:
+def _with_lock_spans(ctx: FileContext) -> List[tuple]:
     """``(start, end, lock_base)`` line spans of ``with <expr>._lock``
     (or ``.acquire()``-style context) bodies. ``lock_base`` is the
     dotted name of the object whose lock is held (``self``, ``ledger``,
@@ -58,9 +160,7 @@ def _with_lock_spans(tree: ast.Module) -> List[tuple]:
     nothing about ``b``'s state (the PR-2 ``Counters.merge`` race was
     exactly self's lock over *other*'s dict)."""
     spans = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.With, ast.AsyncWith)):
-            continue
+    for node in ctx.nodes(ast.With, ast.AsyncWith):
         for item in node.items:
             expr = item.context_expr
             # unwrap `with obj._lock:` and `with obj._lock.acquire_timeout(...)`
@@ -69,8 +169,7 @@ def _with_lock_spans(tree: ast.Module) -> List[tuple]:
             if name.endswith("._lock") or "._lock." in name:
                 base = name.split("._lock")[0]
                 spans.append((node.lineno,
-                              max(getattr(n, 'lineno', node.lineno)
-                                  for n in ast.walk(node)),
+                              node.end_lineno or node.lineno,
                               base))
                 break
     return spans
@@ -79,9 +178,10 @@ def _with_lock_spans(tree: ast.Module) -> List[tuple]:
 @register
 class LockDisciplineRule(Rule):
     name = "lock-discipline"
-    description = ("internal state of Counters/TransferLedger/"
-                   "LatestResults touched outside the owning class and "
-                   "outside a `with obj._lock:` block")
+    description = ("internal state of a lock-owning registry class "
+                   "(derived from the package source: writes under "
+                   "`with self._lock`) touched outside the owning "
+                   "class and outside a `with obj._lock:` block")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         if not ctx.path.startswith("tpu_cooccurrence/"):
@@ -89,21 +189,17 @@ class LockDisciplineRule(Rule):
         tree = ctx.tree
         if tree is None:
             return ()
+        PROTECTED_STATE = protected_state()
+        all_protected = _all_protected()
         # Line spans of owning-class bodies in this file.
-        owner_spans = []
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.ClassDef)
-                    and node.name in PROTECTED_STATE):
-                owner_spans.append(
-                    (node.name, node.lineno,
-                     max(getattr(n, "lineno", node.lineno)
-                         for n in ast.walk(node))))
-        lock_spans = _with_lock_spans(tree)
+        owner_spans = [
+            (node.name, node.lineno, node.end_lineno or node.lineno)
+            for node in ctx.nodes(ast.ClassDef)
+            if node.name in PROTECTED_STATE]
+        lock_spans = _with_lock_spans(ctx)
         out = []
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Attribute):
-                continue
-            if node.attr not in _ALL_PROTECTED:
+        for node in ctx.nodes(ast.Attribute):
+            if node.attr not in all_protected:
                 continue
             base = dotted_name(node.value)
             # `self._counters` inside class Counters et al. is the

@@ -89,7 +89,7 @@ class MetricNameRule(Rule):
         # cooc_* literals anywhere in package source (registration call
         # sites, constants, docstrings — a doc name that drifts is the
         # same operator-facing lie as a misregistered gauge).
-        for lineno, value in string_constants(tree):
+        for lineno, value in ctx.strings():
             for m in _METRIC_NAME_RE.finditer(value):
                 if m.group(0) not in CANONICAL_METRICS:
                     yield Finding(
@@ -99,9 +99,8 @@ class MetricNameRule(Rule):
                                  f"CANONICAL_METRICS — register it or "
                                  f"fix the spelling"))
         # Counter-name literals at counters.add/get call sites.
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
+        for node in ctx.nodes(ast.Call):
+            if not (isinstance(node.func, ast.Attribute)
                     and node.func.attr in ("add", "get")):
                 continue
             recv = dotted_name(node.func.value) or ""
@@ -156,15 +155,14 @@ class MetricNameRule(Rule):
             # inside that assignment's span in the anchor file.
             skip_spans = []
             if ctx.path == anchor:
-                for node in ast.walk(tree):
-                    if (isinstance(node, ast.Assign)
-                            and any(isinstance(t, ast.Name)
-                                    and t.id == "CANONICAL_METRICS"
-                                    for t in node.targets)):
+                for node in ctx.nodes(ast.Assign):
+                    if any(isinstance(t, ast.Name)
+                           and t.id == "CANONICAL_METRICS"
+                           for t in node.targets):
                         skip_spans.append(
                             (node.lineno,
                              node.end_lineno or node.lineno))
-            for lineno, value in string_constants(tree):
+            for lineno, value in ctx.strings():
                 if any(lo <= lineno <= hi for lo, hi in skip_spans):
                     continue
                 emitted.update(m.group(0)
@@ -190,11 +188,10 @@ class FaultSiteRule(Rule):
             if tree is None:
                 return
             flagged_lines = set()
-            for node in ast.walk(tree):
+            for node in ctx.nodes(ast.Call):
                 # fire("<site>", ...) call sites (package and tests) —
                 # both plan.fire(...) and a bare imported fire(...).
-                if (isinstance(node, ast.Call)
-                        and _is_fire_call(node)
+                if (_is_fire_call(node)
                         and node.args
                         and isinstance(node.args[0], ast.Constant)
                         and isinstance(node.args[0].value, str)):
@@ -208,7 +205,7 @@ class FaultSiteRule(Rule):
                                      f"unregistered fault site "
                                      f"(register it in faults.SITES)"))
             # Spec strings ("site[:seq]:kind") in any literal.
-            for lineno, value in string_constants(tree):
+            for lineno, value in ctx.strings():
                 m = _SPEC_RE.match(value)
                 if m and m.group(1) not in SITES:
                     flagged_lines.add(lineno)
@@ -255,9 +252,8 @@ class FaultSiteRule(Rule):
             tree = ctx.tree
             if tree is None:
                 continue
-            for node in ast.walk(tree):
-                if (isinstance(node, ast.Call)
-                        and _is_fire_call(node)
+            for node in ctx.nodes(ast.Call):
+                if (_is_fire_call(node)
                         and node.args
                         and isinstance(node.args[0], ast.Constant)
                         and isinstance(node.args[0].value, str)):

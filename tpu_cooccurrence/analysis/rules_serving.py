@@ -43,7 +43,6 @@ from .core import (
     Rule,
     dotted_name,
     register,
-    string_constants,
 )
 
 _HTTP_PATH = "tpu_cooccurrence/observability/http.py"
@@ -120,7 +119,7 @@ class ServingRouteRule(Rule):
                              f"latency or schema in CI"))
         # Reverse direction: any route-shaped literal in the module that
         # is not registered is an unmeasured endpoint (or a stale doc).
-        for ln, value in string_constants(src.tree):
+        for ln, value in src.strings():
             if _ROUTE_RE.match(value) and value not in table:
                 yield Finding(
                     rule=self.name, file=_HTTP_PATH, line=ln,
@@ -134,7 +133,7 @@ class ServingRouteRule(Rule):
         rep = next((c for c in repo.files if c.path == _REPLICA_PATH),
                    None)
         if rep is not None and rep.tree is not None:
-            for ln, value in string_constants(rep.tree):
+            for ln, value in rep.strings():
                 if _ROUTE_RE.match(value) and value not in table:
                     yield Finding(
                         rule=self.name, file=_REPLICA_PATH, line=ln,

@@ -1,0 +1,110 @@
+"""thread-ownership: shared mutable state must have one writing thread.
+
+The generalization that subsumes the old hardcoded lock-discipline
+class list: instead of enumerating which classes hold races (the PR-2
+postmortem list — ``TransferLedger``, ``Counters``), derive the race
+condition itself from the whole-program graph. Pass 1 records every
+attribute/global write site with its exemption flags; pass 2 asks, for
+each piece of state, *which thread roots can be executing each write*.
+
+A finding requires two write sites with **mutually exclusive** root
+sets — each reachable from a thread the other is not. That is the shape
+of both historical races (a spawned worker writing ledger fields the
+main thread also writes) and deliberately does *not* fire on
+mode-dependent sharing: ``job.py`` is reachable from ``main`` (serial
+mode) *and* the pipeline worker (pipelined mode), but every write site
+there has the same ``{main, worker}`` root set — the modes are
+exclusive at runtime, and no single run has two threads in those
+writes. Requiring set-difference in both directions encodes exactly
+"two different threads, same state, same run".
+
+A site is exempt when the write is inside a ``with *._lock`` span
+(``L``), carries / sits under a ``# thread-owner:`` annotation (``A``),
+or happens in ``__init__`` (``I`` — construction precedes publication).
+A single unlocked site reachable from a *self-concurrent* root (HTTP
+handlers: one thread per request) is also flagged — that root races
+with itself.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .core import Finding, RepoContext, Rule, register
+from .graph import OWNER_TOKEN  # noqa: F401  (re-export for tests)
+
+
+def _fmt_site(path: str, caller: str, line: int) -> str:
+    return f"{path}:{line} in `{caller}`"
+
+
+@register
+class ThreadOwnershipRule(Rule):
+    name = "thread-ownership"
+    description = (
+        "mutable module/instance state written from two mutually "
+        "exclusive thread roots (or one self-concurrent root) without "
+        "`with *._lock` or a `# thread-owner:` annotation")
+
+    def finalize(self, repo: RepoContext):
+        graph = repo.graph
+        path_of = {m: idx["path"] for m, idx in graph.modules.items()}
+        findings: List[Finding] = []
+        for (cls, attr), sites in sorted(graph.attr_write_sites().items()):
+            findings.extend(self._judge(
+                graph, path_of, f"{cls}.{attr}", sites))
+        for (mod, name), sites in sorted(graph.global_write_sites().items()):
+            findings.extend(self._judge(
+                graph, path_of, f"{mod}:{name}",
+                [(mod, caller, line, flags)
+                 for caller, line, flags in sites]))
+        return findings
+
+    def _judge(self, graph, path_of: Dict[str, str], state: str,
+               sites: List[Tuple[str, str, int, str]]) -> List[Finding]:
+        live = []
+        for mod, caller, line, flags in sites:
+            if "L" in flags or "A" in flags or "I" in flags:
+                continue
+            roots = graph.roots_of(f"{mod}:{caller}")
+            if roots:
+                live.append((mod, caller, line, roots))
+        for i in range(len(live)):
+            for j in range(i + 1, len(live)):
+                mi, ci, li, ri = live[i]
+                mj, cj, lj, rj = live[j]
+                only_i, only_j = ri - rj, rj - ri
+                if only_i and only_j:
+                    # anchor on the non-main side when there is one —
+                    # the spawned writer is the actionable site
+                    if graph.MAIN in only_i:
+                        (mi, ci, li, ri, only_i,
+                         mj, cj, lj, rj, only_j) = (
+                            mj, cj, lj, rj, only_j,
+                            mi, ci, li, ri, only_i)
+                    return [Finding(
+                        rule=self.name, file=path_of.get(mi, mi),
+                        line=li,
+                        message=(
+                            f"`{state}` is written from thread root(s) "
+                            f"{sorted(only_i)} here and from "
+                            f"{sorted(only_j)} at "
+                            f"{_fmt_site(path_of.get(mj, mj), cj, lj)} "
+                            f"— hold the owner's lock or annotate the "
+                            f"single writer with `# thread-owner: "
+                            f"<why>`"))]
+        # a single site needs strong-edge evidence: a duck edge is a
+        # guess, and a guess may widen a real two-site conflict but
+        # must not manufacture a one-site finding on its own
+        for mod, caller, line, roots in live:
+            conc = sorted(r for r in graph.strong_roots_of(
+                f"{mod}:{caller}") if graph.is_concurrent_root(r))
+            if conc:
+                return [Finding(
+                    rule=self.name, file=path_of.get(mod, mod),
+                    line=line,
+                    message=(
+                        f"`{state}` is written under self-concurrent "
+                        f"root(s) {conc} (one thread per request) "
+                        f"without a lock — two requests race on it"))]
+        return []

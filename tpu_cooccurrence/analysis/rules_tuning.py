@@ -1,0 +1,203 @@
+"""tuning-registry: every performance knob resolves through tuning.py.
+
+The enforcement half of the :mod:`tpu_cooccurrence.tuning` registry
+(same truth-table import idiom as the metric/fault/flag rules — the
+analyzer imports the live registry, so the rule can never drift from
+it):
+
+* **unregistered knobs** — any ``TPU_COOC_*`` token in package source
+  that is not a registered parameter's ``env`` binding is a knob
+  someone added without declaring it (the exact failure mode that
+  motivated the registry);
+* **direct environ reads** — ``os.environ.get("TPU_COOC_...")`` /
+  ``os.getenv`` / ``os.environ[...]`` outside ``tuning.py`` bypass the
+  registration check; reads go through :func:`tuning.env_read` (same
+  semantics, plus the check) so the registry always knows the live
+  read surface;
+* **dead rows** — a registered env binding no code mentions, or a
+  registered flag ``config.py`` does not define, is a row that rotted
+  out of the codebase;
+* **magic thresholds** (separate rule, ``tuning-magic-number``) — a
+  hot-path comparison against a numeric literal equal to a distinctive
+  registered perf default is an inlined copy of a knob: when the knob
+  moves, the copy does not. Only distinctive defaults participate
+  (ints with ``abs >= 16``, floats outside {0, 0.5, 1}) — flagging
+  every ``x > 0`` would be noise, not analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from .core import (FileContext, Finding, RepoContext, Rule, dotted_name,
+                   register)
+
+from tpu_cooccurrence import tuning as _tuning
+
+_ENV_TOKEN_RE = re.compile(r"TPU_COOC_[A-Z0-9_]+")
+
+#: The one module allowed to touch ``os.environ`` for knobs, and whose
+#: registrations are the ground truth the tokens are checked against.
+_REGISTRY_PATH = "tpu_cooccurrence/tuning.py"
+
+#: Where a magic copy of a knob default is a perf bug, not style.
+_HOT_PATH_PREFIXES = ("tpu_cooccurrence/ops/", "tpu_cooccurrence/state/",
+                      "tpu_cooccurrence/parallel/")
+
+_ENV_READ_FUNCS = {"os.environ.get", "os.getenv", "environ.get"}
+
+
+def _distinctive_defaults():
+    """{numeric default: parameter name} for perf knobs whose default
+    is unlikely to appear in unrelated code."""
+    out = {}
+    for p in _tuning.REGISTRY.values():
+        if p.kind != "perf":
+            continue
+        v = p.default
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if isinstance(v, int) and abs(v) >= 16:
+            out[v] = p.name
+        elif isinstance(v, float) and v not in (0.0, 0.5, 1.0):
+            out[v] = p.name
+    return out
+
+
+@register
+class TuningRegistryRule(Rule):
+    name = "tuning-registry"
+    description = (
+        "TPU_COOC_* knobs must be declared in the TuningParameter "
+        "registry and read via tuning.env_read; registered bindings "
+        "must stay live (env mentioned somewhere, flag in config.py)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.path.startswith("tpu_cooccurrence/") or \
+                not ctx.is_python or ctx.path == _REGISTRY_PATH:
+            return
+        registered = set(_tuning.by_env())
+        seen_lines = set()
+        for i, line in enumerate(ctx.lines, start=1):
+            for tok in _ENV_TOKEN_RE.findall(line):
+                if tok not in registered and (i, tok) not in seen_lines:
+                    seen_lines.add((i, tok))
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=i,
+                        message=(
+                            f"`{tok}` is not a registered "
+                            f"TuningParameter env binding — declare "
+                            f"the knob in tpu_cooccurrence/tuning.py"))
+        # module-level string constants, so `os.environ.get(RUN_ID_ENV)`
+        # with RUN_ID_ENV = "TPU_COOC_RUN_ID" is caught like a literal
+        consts = {}
+        for node in ctx.nodes(ast.Assign):
+            if isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts[tgt.id] = node.value.value
+
+        def knob_arg(arg) -> str:
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str):
+                v = arg.value
+            elif isinstance(arg, ast.Name):
+                v = consts.get(arg.id, "")
+            else:
+                return ""
+            return v if v.startswith("TPU_COOC_") else ""
+
+        for node in ctx.nodes(ast.Call):
+            name = dotted_name(node.func) or ""
+            if name in _ENV_READ_FUNCS and node.args:
+                knob = knob_arg(node.args[0])
+                if knob:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=node.lineno,
+                        message=(
+                            f"direct `{name}({knob!r})` — knob reads "
+                            f"go through tuning.env_read so the "
+                            f"registry sees every read site"))
+        for node in ctx.nodes(ast.Subscript):
+            if isinstance(node.ctx, ast.Load) and \
+                    (dotted_name(node.value) or "") in ("os.environ",
+                                                        "environ"):
+                knob = knob_arg(node.slice)
+                if knob:
+                    yield Finding(
+                        rule=self.name, file=ctx.path, line=node.lineno,
+                        message=(
+                            f"direct `os.environ[{knob!r}]` — knob "
+                            f"reads go through tuning.env_read"))
+
+    def finalize(self, repo: RepoContext) -> Iterable[Finding]:
+        reg_ctx = next((c for c in repo.files
+                        if c.path == _REGISTRY_PATH), None)
+        if reg_ctx is None:
+            return
+
+        def reg_line(pname: str) -> int:
+            needle = f'name="{pname}"'
+            for i, line in enumerate(reg_ctx.lines, start=1):
+                if needle in line:
+                    return i
+            return 1
+
+        sources = [(c.path, c.source) for c in repo.python_files()
+                   if c.path != _REGISTRY_PATH]
+        config_src = next((s for p, s in sources
+                           if p.endswith("/config.py")), "")
+        for p in _tuning.REGISTRY.values():
+            if p.env and not any(p.env in s for _, s in sources):
+                yield Finding(
+                    rule=self.name, file=_REGISTRY_PATH,
+                    line=reg_line(p.name),
+                    message=(
+                        f"registered env binding `{p.env}` "
+                        f"(`{p.name}`) is read nowhere — dead "
+                        f"registry row"))
+            if p.flag and f'"{p.flag}"' not in config_src:
+                yield Finding(
+                    rule=self.name, file=_REGISTRY_PATH,
+                    line=reg_line(p.name),
+                    message=(
+                        f"registered flag binding `{p.flag}` "
+                        f"(`{p.name}`) is not defined in config.py — "
+                        f"dead registry row"))
+
+
+@register
+class TuningMagicNumberRule(Rule):
+    name = "tuning-magic-number"
+    severity = "warning"
+    description = (
+        "hot-path comparison against a literal equal to a registered "
+        "perf knob's distinctive default — read the knob from the "
+        "tuning registry instead of inlining a copy")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.path.startswith(_HOT_PATH_PREFIXES):
+            return ()
+        distinctive = _distinctive_defaults()
+        if not distinctive:
+            return ()
+        out: List[Finding] = []
+        for node in ctx.nodes(ast.Compare):
+            for operand in [node.left, *node.comparators]:
+                if isinstance(operand, ast.Constant) and isinstance(
+                        operand.value, (int, float)) and not isinstance(
+                        operand.value, bool) and \
+                        operand.value in distinctive:
+                    out.append(Finding(
+                        rule=self.name, file=ctx.path,
+                        line=node.lineno,
+                        message=(
+                            f"threshold literal {operand.value} equals "
+                            f"registered knob "
+                            f"`{distinctive[operand.value]}`'s default "
+                            f"— use tuning.default("
+                            f"{distinctive[operand.value]!r})")))
+        return out

@@ -66,19 +66,7 @@ def _astype_to(node: ast.AST, names: Set[str]) -> bool:
 
 
 def _test_referenced_names(repo: RepoContext) -> Set[str]:
-    refs: Set[str] = set()
-    for ctx in repo.python_files():
-        if not ctx.path.startswith("tests/") or ctx.tree is None:
-            continue
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Name):
-                refs.add(node.id)
-            elif isinstance(node, ast.Attribute):
-                refs.add(node.attr)
-            elif isinstance(node, (ast.Import, ast.ImportFrom)):
-                for alias in node.names:
-                    refs.add(alias.name.rsplit(".", 1)[-1])
-    return refs
+    return repo.test_referenced_names()
 
 
 @register
@@ -136,52 +124,45 @@ class NarrowCastGuardRule(Rule):
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         if ctx.tree is None or not ctx.path.startswith("tpu_cooccurrence/"):
             return
+        calls = ctx.nodes(ast.Call)
+        narrow = [n for n in calls if _astype_to(n, _NARROW_NAMES)]
+        if not narrow:
+            return
         # Narrow casts that are immediately re-widened never store a
         # narrow value: collect the inner nodes of `.astype(narrow)
         # .astype(wide)` chains to exempt them.
-        sign_extended = set()
-        for node in ast.walk(ctx.tree):
-            if (_astype_to(node, _WIDE_NAMES)
-                    and _astype_to(node.func.value, _NARROW_NAMES)):
-                sign_extended.add(id(node.func.value))
-        # Guard evidence is function-scoped: map every node to its
-        # enclosing function, then check that function's body.
-        for fn in [n for n in ast.walk(ctx.tree)
-                   if isinstance(n, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef))] + [ctx.tree]:
-            owns = (ast.walk(fn) if isinstance(fn, ast.Module)
-                    else ast.walk(fn))
-            casts = [n for n in owns
-                     if _astype_to(n, _NARROW_NAMES)
-                     and id(n) not in sign_extended]
-            if not casts:
-                continue
-            if isinstance(fn, ast.Module):
-                # Module-level casts: only flag ones not inside any
-                # function (function-scoped pass already covered those).
-                in_fn = set()
-                for f in ast.walk(fn):
-                    if isinstance(f, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)):
-                        for sub in ast.walk(f):
-                            in_fn.add(id(sub))
-                casts = [c for c in casts if id(c) not in in_fn]
-                if not casts:
+        sign_extended = {
+            id(n.func.value) for n in calls
+            if _astype_to(n, _WIDE_NAMES)
+            and _astype_to(n.func.value, _NARROW_NAMES)}
+        casts = [n for n in narrow if id(n) not in sign_extended]
+        if not casts:
+            return
+        # Guard evidence is function-scoped: map each cast to its
+        # innermost enclosing function, then check that function's body
+        # (module-level casts have no enclosing guard scope).
+        fns = ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef)
+        guard_cache: dict = {}
+        for c in casts:
+            containing = [f for f in fns
+                          if f.lineno <= c.lineno
+                          <= (f.end_lineno or f.lineno)]
+            if containing:
+                fn = min(containing,
+                         key=lambda f: (f.end_lineno or f.lineno)
+                         - f.lineno)
+                if id(fn) not in guard_cache:
+                    guard_cache[id(fn)] = self._has_guard(fn)
+                if guard_cache[id(fn)]:
                     continue
-                guarded = False
-            else:
-                guarded = self._has_guard(fn)
-            if guarded:
-                continue
-            for c in casts:
-                yield Finding(
-                    rule=self.name, file=ctx.path, line=c.lineno,
-                    message=("narrow-dtype cast without a visible "
-                             "saturation/overflow guard — route through "
-                             "state/wire.checked_narrow or add an "
-                             "explicit bounds check in this function "
-                             "(silent wraparound is the reference's "
-                             "Java-short bug class)"))
+            yield Finding(
+                rule=self.name, file=ctx.path, line=c.lineno,
+                message=("narrow-dtype cast without a visible "
+                         "saturation/overflow guard — route through "
+                         "state/wire.checked_narrow or add an "
+                         "explicit bounds check in this function "
+                         "(silent wraparound is the reference's "
+                         "Java-short bug class)"))
 
     @staticmethod
     def _has_guard(fn: ast.AST) -> bool:

@@ -36,6 +36,8 @@ import sys
 import time
 import traceback
 
+from tpu_cooccurrence import tuning
+
 #: TPU_ROUND2_OUT overrides the artifact path — for CPU smoke tests of
 #: the measurement machinery (which must not bitrot between grants, nor
 #: pollute the tracked JSONL with CPU rows).
@@ -197,7 +199,7 @@ def _config4_events(quick: bool) -> int:
     scarce grant capture into garbage rows (grant_watch additionally
     strips it from stage env). Every row records its ``events``
     regardless."""
-    smoke = os.environ.get("TPU_COOC_SMOKE_EVENTS")
+    smoke = tuning.env_read("TPU_COOC_SMOKE_EVENTS")
     if smoke:
         import jax
 

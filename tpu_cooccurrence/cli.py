@@ -13,6 +13,7 @@ import os
 import sys
 from typing import Optional, Sequence
 
+from . import tuning
 from .config import Config
 from .io.parse import batched_lines
 from .io.source import FileMonitorSource
@@ -176,7 +177,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     heartbeat = None
     from .robustness.gang import GANG_DIR_ENV, HeartbeatWriter
 
-    gang_dir = os.environ.get(GANG_DIR_ENV)
+    gang_dir = tuning.env_read(GANG_DIR_ENV)
     if gang_dir and config.process_id is not None:
         heartbeat = HeartbeatWriter(
             gang_dir, config.process_id,
@@ -234,7 +235,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # this child process, not the parent): restart/backoff gauges on
     # /metrics, last-restart info on /healthz.
     supervisor_info = None
-    raw_state = os.environ.get(SUPERVISOR_STATE_ENV)
+    raw_state = tuning.env_read(SUPERVISOR_STATE_ENV)
     if raw_state:
         try:
             supervisor_info = json.loads(raw_state)

@@ -18,6 +18,8 @@ import sys
 import time
 from typing import List, Optional, Sequence
 
+from . import tuning
+
 
 class WindowUnit(enum.Enum):
     """Time unit for window sizes (reference: ``Configuration.java:157-179``)."""
@@ -126,7 +128,7 @@ class Config:
     # Sparse backends only (the canonical rows_key/rows_cnt blob is the
     # delta's domain); the same files are the consumable delta log
     # (state/delta.read_delta_stream) future read replicas tail.
-    checkpoint_compact_ratio: float = 0.5  # ratio trigger: once the
+    checkpoint_compact_ratio: float = tuning.default("checkpoint_compact_ratio")  # ratio trigger: once the
     # delta chain's bytes exceed this fraction of the base's, the next
     # checkpoint rewrites a fresh full base (bounds restore replay) and
     # the old chain ages out under --checkpoint-retain
@@ -218,7 +220,7 @@ class Config:
     score_ladder: Optional[int] = None  # sparse score-bucket ladder base
     # (power of two >= 2); None = env TPU_COOC_SCORE_LADDER or 4. Coarser
     # = fewer dispatches, more padding — the high-latency-link lever.
-    fixed_score: str = "auto"  # sparse fixed-shape scoring: auto|on|off
+    fixed_score: str = tuning.default("fixed_score")  # sparse fixed-shape scoring: auto|on|off
     # (auto = on for real TPUs when results are deferred; constant
     # per-bucket rectangles -> one compile + one dispatch per bucket)
     pallas: str = "auto"  # fused score/top-K kernel: auto|on|off (auto = on
@@ -238,27 +240,27 @@ class Config:
     # auto = on-chip only — the CPU fallback stays on the chained
     # scatter+score path
 
-    count_dtype: str = "int32"  # dense C cell dtype; int16 halves HBM
+    count_dtype: str = tuning.default("count_dtype")  # dense C cell dtype; int16 halves HBM
     # (reference-style short counts incl. its wraparound, doubles the
     # dense/sharded vocab ceiling)
-    cell_dtype: str = "auto"  # sparse slab cnt cell dtype: auto|int32|
+    cell_dtype: str = tuning.default("cell_dtype")  # sparse slab cnt cell dtype: auto|int32|
     # int16|int8 (state/wire.py). Narrow cells stay EXACT — a row is
     # promoted to the wide int32 side-table before any cell could
     # saturate — unlike the dense --count-dtype, which wraps like the
     # reference's Java shorts. auto = int16 on the single-process sparse
     # backend, int32 elsewhere.
-    spill_threshold_windows: int = 0  # tiered elastic state
+    spill_threshold_windows: int = tuning.default("spill_threshold_windows")  # tiered elastic state
     # (state/store.TieredSlabStore): rows untouched for this many fired
     # windows spill from the HBM slab to a host-side packed arena
     # (index keys really freed, capacity reused by hot rows) and
     # re-promote exactly on next touch, batched into the window's
     # existing uplink. 0 = tiering off (every row device-resident for
     # the whole run). Bit-identical output and checkpoints either way.
-    spill_target_hbm_frac: float = 0.5  # spilling engages only while
+    spill_target_hbm_frac: float = tuning.default("spill_target_hbm_frac")  # spilling engages only while
     # live slab cells exceed this fraction of the allocated device slab
     # capacity (0.0 = spill every eligible cold row unconditionally;
     # 1.0 = only under a full slab)
-    wire_format: str = "auto"  # sparse per-window uplink encoding:
+    wire_format: str = tuning.default("wire_format")  # sparse per-window uplink encoding:
     # auto|raw|packed. packed = per-section sorted delta + zigzag +
     # bit-pack of the update buffer, decoded on device by a jit prologue
     # (state/wire.py) — fewer uplink bytes at bit-identical results; an
@@ -266,7 +268,7 @@ class Config:
     # raw chunked path. Also selects the checkpoint blob codec
     # (raw = pre-codec layout, else delta+varint). auto = packed on the
     # single-process sparse backend, raw elsewhere.
-    pipeline_depth: int = 0  # pipelined execution: the caller thread
+    pipeline_depth: int = tuning.default("pipeline_depth")  # pipelined execution: the caller thread
     # samples window N+1 while a worker thread runs the scorer for
     # window N (pipeline.py). 0 = serial (today's behavior); 1 =
     # single-window overlap; 2 = double-buffered (absorbs stage jitter).
@@ -306,17 +308,17 @@ class Config:
     autoscale_min_workers: int = 2  # scale-down floor (a gang needs 2)
     autoscale_max_workers: int = 0  # scale-up ceiling; REQUIRED (> 0)
     # with --autoscale on — the operator owns the capacity budget
-    autoscale_trip_windows: int = 3  # consecutive gang-overloaded
+    autoscale_trip_windows: int = tuning.default("autoscale_trip_windows")  # consecutive gang-overloaded
     # windows that trigger a scale-up (hysteresis mirrors the ladder)
-    autoscale_clear_windows: int = 8  # consecutive gang-idle windows
+    autoscale_clear_windows: int = tuning.default("autoscale_clear_windows")  # consecutive gang-idle windows
     # that trigger a scale-down (asymmetric: grow fast, shrink slow)
-    autoscale_cooldown_windows: int = 8  # observed windows ignored
+    autoscale_cooldown_windows: int = tuning.default("autoscale_cooldown_windows")  # observed windows ignored
     # after every rescale decision (restore + recompile warm-up must
     # not read as a fresh signal)
     gang_stale_after_s: float = 60.0  # heartbeat age past which a peer
     # counts as dead: the gang supervisor restarts the gang, /healthz
     # 503s ("peer_stale") so a load balancer drains first; 0 = off
-    collective_timeout_s: float = 0.0  # collective-entry watchdog
+    collective_timeout_s: float = tuning.default("collective_timeout_s")  # collective-entry watchdog
     # (parallel/distributed.py): a guarded collective blocked this long
     # means a peer is gone — exit 75 for the gang supervisor to restart
     # the whole gang, instead of hanging forever; 0 = off
@@ -882,33 +884,40 @@ class Config:
                             "promotion/spill windows route chained. "
                             "(auto: on-chip only — the CPU fallback "
                             "stays on the chained path)")
-        p.add_argument("--count-dtype", choices=["int32", "int16"],
-                       default="int32", dest="count_dtype",
+        p.add_argument("--count-dtype",
+                       choices=list(tuning.get("count_dtype").choices),
+                       default=tuning.default("count_dtype"),
+                       dest="count_dtype",
                        help="Dense count-matrix cell dtype (int16 halves "
                             "device memory; counts then wrap like the "
                             "reference's Java shorts)")
         p.add_argument("--cell-dtype",
-                       choices=["auto", "int32", "int16", "int8"],
-                       default="auto", dest="cell_dtype",
+                       choices=list(tuning.get("cell_dtype").choices),
+                       default=tuning.default("cell_dtype"),
+                       dest="cell_dtype",
                        help="Sparse slab cell dtype — EXACT narrow "
                             "counts: rows promote to a wide int32 "
                             "side-table before saturation (auto: int16 "
                             "on the single-process sparse backend)")
-        p.add_argument("--spill-threshold-windows", type=int, default=0,
+        p.add_argument("--spill-threshold-windows", type=int,
+                       default=tuning.default("spill_threshold_windows"),
                        dest="spill_threshold_windows",
                        help="Tiered elastic state (sparse backend): "
                             "spill rows untouched for this many windows "
                             "from the HBM slab to a host-side arena, "
                             "re-promoting exactly on touch (0 = off; "
                             "output and checkpoints stay bit-identical)")
-        p.add_argument("--spill-target-hbm-frac", type=float, default=0.5,
+        p.add_argument("--spill-target-hbm-frac", type=float,
+                       default=tuning.default("spill_target_hbm_frac"),
                        dest="spill_target_hbm_frac",
                        help="Spill cold rows only while live slab cells "
                             "exceed this fraction of the allocated "
                             "device slab capacity (0.0 = spill every "
                             "eligible row; default: 0.5)")
-        p.add_argument("--wire-format", choices=["auto", "raw", "packed"],
-                       default="auto", dest="wire_format",
+        p.add_argument("--wire-format",
+                       choices=list(tuning.get("wire_format").choices),
+                       default=tuning.default("wire_format"),
+                       dest="wire_format",
                        help="Sparse per-window uplink + checkpoint blob "
                             "encoding: packed = sorted delta + zigzag + "
                             "bit-pack, decoded on device, bit-identical "
@@ -920,13 +929,16 @@ class Config:
                             "(power of two >= 2; default 4 or env "
                             "TPU_COOC_SCORE_LADDER). Coarser = fewer "
                             "dispatches, more padding")
-        p.add_argument("--fixed-score", choices=["auto", "on", "off"],
-                       default="auto", dest="fixed_score",
+        p.add_argument("--fixed-score",
+                       choices=list(tuning.get("fixed_score").choices),
+                       default=tuning.default("fixed_score"),
+                       dest="fixed_score",
                        help="Sparse-backend fixed-shape scoring (constant "
                             "per-bucket rectangles; auto = on for real "
                             "TPUs when results are deferred)")
         p.add_argument("--pipeline-depth", type=int, choices=[0, 1, 2],
-                       default=0, dest="pipeline_depth",
+                       default=tuning.default("pipeline_depth"),
+                       dest="pipeline_depth",
                        help="Overlap host sampling with device scoring: "
                             "sample window N+1 while the scorer runs "
                             "window N on a worker thread (0 = serial, "
@@ -951,7 +963,8 @@ class Config:
                             "commit bytes scale with churn, not vocab; "
                             "restore replays base + deltas bit-identically")
         p.add_argument("--checkpoint-compact-ratio", type=float,
-                       default=0.5, dest="checkpoint_compact_ratio",
+                       default=tuning.default("checkpoint_compact_ratio"),
+                       dest="checkpoint_compact_ratio",
                        help="Rewrite a fresh full base once the delta "
                             "chain's bytes exceed this fraction of the "
                             "base's (bounds restore replay; default: 0.5)")
@@ -1052,17 +1065,20 @@ class Config:
                        help="Scale-up ceiling; required with "
                             "--autoscale on (the operator owns the "
                             "capacity budget)")
-        p.add_argument("--autoscale-trip-windows", type=int, default=3,
+        p.add_argument("--autoscale-trip-windows", type=int,
+                       default=tuning.default("autoscale_trip_windows"),
                        dest="autoscale_trip_windows",
                        help="Consecutive gang-overloaded windows that "
                             "trigger a scale-up (default: 3)")
-        p.add_argument("--autoscale-clear-windows", type=int, default=8,
+        p.add_argument("--autoscale-clear-windows", type=int,
+                       default=tuning.default("autoscale_clear_windows"),
                        dest="autoscale_clear_windows",
                        help="Consecutive gang-idle windows that "
                             "trigger a scale-down (asymmetric on "
                             "purpose; default: 8)")
         p.add_argument("--autoscale-cooldown-windows", type=int,
-                       default=8, dest="autoscale_cooldown_windows",
+                       default=tuning.default("autoscale_cooldown_windows"),
+                       dest="autoscale_cooldown_windows",
                        help="Windows ignored by the scale policy after "
                             "every rescale decision (default: 8)")
         p.add_argument("--gang-stale-after-s", type=float, default=60.0,
@@ -1071,7 +1087,8 @@ class Config:
                             "as dead: the supervisor restarts the gang, "
                             "/healthz 503s 'peer_stale' (default: 60; "
                             "0 = off)")
-        p.add_argument("--collective-timeout-s", type=float, default=0.0,
+        p.add_argument("--collective-timeout-s", type=float,
+                       default=tuning.default("collective_timeout_s"),
                        dest="collective_timeout_s",
                        help="Collective-entry watchdog: a guarded "
                             "collective blocked this long exits 75 (a "

@@ -147,12 +147,11 @@ class CooccurrenceJob:
         # worker (gang dir env + multi-controller identity).
         self.autoscale = None
         if config.autoscale == "on" and config.coordinator is not None:
-            import os as _os
-
+            from . import tuning
             from .robustness.autoscale import AutoscaleTap
             from .robustness.gang import GANG_DIR_ENV
 
-            gang_dir = _os.environ.get(GANG_DIR_ENV)
+            gang_dir = tuning.env_read(GANG_DIR_ENV)
             if gang_dir:
                 self.autoscale = AutoscaleTap(
                     gang_dir, config.process_id, config.num_processes,
@@ -837,7 +836,7 @@ class CooccurrenceJob:
             # the controller's post-exchange gang-max bit.
             self.autoscale.observe(
                 seq, stats.seconds,
-                self.degrade.last_overloaded
+                self.degrade.overloaded_bit()
                 if self.degrade is not None else False)
         spans = self._build_spans(stats, admit_seconds)
         # Ingest plane (partitioned source only): the wire position the

@@ -65,6 +65,7 @@ import uuid
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..robustness import faults
+from .. import tuning
 
 #: Journal format version (bump on breaking schema changes).
 VERSION = 1
@@ -111,9 +112,9 @@ def run_context() -> Tuple[str, int]:
     """(run_id, attempt) for this process: inherited from the
     supervising parent's env when present, otherwise a fresh mint with
     attempt 0 (the unsupervised-run shape)."""
-    run_id = os.environ.get(RUN_ID_ENV) or mint_run_id()
+    run_id = tuning.env_read(RUN_ID_ENV) or mint_run_id()
     try:
-        attempt = int(os.environ.get(ATTEMPT_ENV, "0"))
+        attempt = int(tuning.env_read(ATTEMPT_ENV, "0"))
     except ValueError:
         attempt = 0
     return run_id, attempt

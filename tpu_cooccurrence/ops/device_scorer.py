@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from .. import tuning  # noqa: E402  (registry: stdlib-only)
 from ..observability import LEDGER
 from ..observability.registry import REGISTRY
 from ..robustness import faults
@@ -55,14 +56,20 @@ from .donation import donate_argnums
 from .llr import llr_stable
 
 
-def pad_pow2(n: int, minimum: int = 256) -> int:
+#: The pow2/pow4 plan high-water floor: every dispatch shape
+#: rounds up to at least this many rows (registry-declared so
+#: the autotune plane can move it).
+_POW2_PAD_MIN = int(tuning.default("pow2_pad_min"))
+
+
+def pad_pow2(n: int, minimum: int = _POW2_PAD_MIN) -> int:
     size = minimum
     while size < n:
         size *= 2
     return size
 
 
-def pad_pow4(n: int, minimum: int = 256) -> int:
+def pad_pow4(n: int, minimum: int = _POW2_PAD_MIN) -> int:
     """Power-of-4 bucket: ≤4x padding waste, 2x fewer compiled programs.
 
     Scatter/score work on padded slots is cheap device time; each distinct
@@ -221,7 +228,7 @@ def upload_chunks() -> int:
     ``config4-chunked``, tunnel_probe 3b) prove the split wins on real
     hardware. Shared by the sparse update and dense COO paths."""
     try:
-        return max(1, int(os.environ.get("TPU_COOC_UPLOAD_CHUNKS", "1")))
+        return max(1, int(tuning.env_read("TPU_COOC_UPLOAD_CHUNKS", "1")))
     except ValueError:
         return 1
 
@@ -260,7 +267,7 @@ def upload_chunk_kb() -> float:
     750 KB pieces). This is the shape the TPU default takes if the
     on-chip A/B proves chunking."""
     try:
-        return float(os.environ.get("TPU_COOC_UPLOAD_CHUNK_KB", "0"))
+        return float(tuning.env_read("TPU_COOC_UPLOAD_CHUNK_KB", "0"))
     except ValueError:
         return 0.0
 
@@ -272,7 +279,7 @@ def split_upload_auto(arr: np.ndarray) -> Optional[Tuple]:
     pins the monolithic arm of an A/B against an ambient CHUNK_KB (the
     same silent-contamination hazard _config4_single pins against).
     Otherwise TPU_COOC_UPLOAD_CHUNK_KB adapts K to the buffer size."""
-    if os.environ.get("TPU_COOC_UPLOAD_CHUNKS"):
+    if tuning.env_read("TPU_COOC_UPLOAD_CHUNKS"):
         return split_upload(arr, upload_chunks())
     kb = upload_chunk_kb()
     if kb <= 0:

@@ -30,6 +30,8 @@ import os
 from typing import Tuple
 
 
+from .. import tuning
+
 def donate_argnums(*argnums: int) -> Tuple[int, ...]:
     """``argnums`` on accelerator backends, ``()`` on CPU (see module doc).
 
@@ -41,7 +43,7 @@ def donate_argnums(*argnums: int) -> Tuple[int, ...]:
     *before* importing any scorer module — a scorer import that
     initialized the backend first would make distributed init raise.
     """
-    env = os.environ.get("TPU_COOC_DONATE", "").strip()
+    env = tuning.env_read("TPU_COOC_DONATE", "").strip()
     if env in ("0", "off", "false", "no"):
         return ()
     if env in ("1", "on", "true", "yes"):

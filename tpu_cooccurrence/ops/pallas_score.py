@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import tuning
 from .llr import llr_stable
 
 _K_PAD = 128     # output lane width; logical top_k occupies the first K lanes
@@ -295,6 +296,10 @@ def rect_tile(R: int) -> int:
     return min(2048, R)
 
 
+#: Narrowest rectangle the fused kernel accepts (registry-declared).
+_RECT_MIN_ROWS = int(tuning.default("rect_min_rows"))
+
+
 def rect_supported(R: int, top_k: int) -> bool:
     """Whether the fused rectangle kernel can carry this bucket.
 
@@ -302,7 +307,8 @@ def rect_supported(R: int, top_k: int) -> bool:
     are cheap for XLA anyway; ``top_k`` must fit the output lane width.
     """
     t = rect_tile(R)
-    return R >= 256 and R % t == 0 and t % 128 == 0 and top_k <= _K_PAD
+    return (R >= _RECT_MIN_ROWS and R % t == 0 and t % 128 == 0
+            and top_k <= _K_PAD)
 
 
 def rect_routed(enabled: bool, R: int, top_k: int, items_cap: int) -> bool:

@@ -50,6 +50,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..robustness import faults
+from .. import tuning
 from .mesh import ITEM_AXIS
 
 LOG = logging.getLogger("tpu_cooccurrence")
@@ -104,7 +105,7 @@ def collective_watchdog(label: str):
         seq = _collective_seq
     if faults.PLAN is not None:
         faults.PLAN.fire("barrier_enter", seq=seq)
-    timeout_s = float(os.environ.get(COLLECTIVE_TIMEOUT_ENV, "0") or 0)
+    timeout_s = float(tuning.env_read(COLLECTIVE_TIMEOUT_ENV, "0") or 0)
     if timeout_s <= 0:
         yield
         return

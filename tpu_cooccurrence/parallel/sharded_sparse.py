@@ -66,6 +66,7 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
+from .. import tuning
 from ..observability import LEDGER, StageClock
 from ..ops.aggregate import (aggregate_window_coo, distinct_sorted,
                              narrow_deltas_int32)
@@ -123,7 +124,7 @@ class ShardedSparseScorer:
         self.wire_packed = wire_format == "packed"
         self.top_k = top_k
         self.score_ladder = int(score_ladder if score_ladder is not None
-                                else os.environ.get(
+                                else tuning.env_read(
                                     "TPU_COOC_SCORE_LADDER", 4))
         ladder_bits(self.score_ladder)  # validate at construction
         self.counters = counters if counters is not None else Counters()

@@ -269,6 +269,13 @@ class DegradationController:
             events, self._pending_events = self._pending_events, []
             return int(self._level), events
 
+    def overloaded_bit(self) -> bool:
+        """Post-exchange gang-max overload bit of the most recent
+        observed window, read under the leaf lock (the observer thread
+        writes it; the autoscale vote reads it)."""
+        with self._lock:
+            return bool(self.last_overloaded)
+
     def note_queue_wait(self, seconds: float) -> None:
         """Producer-side pipeline backpressure signal: a submit that
         blocked this long marks the *next* observed window overloaded

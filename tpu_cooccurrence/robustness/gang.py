@@ -71,6 +71,7 @@ import time
 from typing import List, Optional, Sequence
 
 from ..observability.registry import REGISTRY
+from .. import tuning
 from . import autoscale, faults
 
 LOG = logging.getLogger("tpu_cooccurrence.gang")
@@ -499,7 +500,7 @@ class GangSupervisor:
         # journals under it (inherited when an outer parent already
         # minted one).
         from ..observability.journal import RUN_ID_ENV, mint_run_id
-        self.run_id = os.environ.get(RUN_ID_ENV) or mint_run_id()
+        self.run_id = tuning.env_read(RUN_ID_ENV) or mint_run_id()
         os.makedirs(gang_dir, exist_ok=True)
 
     # -- one attempt ---------------------------------------------------
@@ -904,7 +905,7 @@ class ReplicaFleetSupervisor:
         # relaunch count is its attempt ordinal (replicas restart
         # independently, so the ordinal is per-slot, not fleet-wide).
         from ..observability.journal import RUN_ID_ENV, mint_run_id
-        self.run_id = os.environ.get(RUN_ID_ENV) or mint_run_id()
+        self.run_id = tuning.env_read(RUN_ID_ENV) or mint_run_id()
         self._slot_attempts = [0] * num_replicas
         os.makedirs(gang_dir, exist_ok=True)
 

@@ -64,6 +64,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import tuning
 from ..observability.http import MetricsServer
 from ..observability.registry import REGISTRY
 from ..state import checkpoint as ckpt
@@ -624,7 +625,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     from ..robustness.gang import GANG_DIR_ENV, HeartbeatWriter
 
     heartbeat = None
-    gang_dir = os.environ.get(GANG_DIR_ENV)
+    gang_dir = tuning.env_read(GANG_DIR_ENV)
     if gang_dir and args.process_id is not None:
         heartbeat = HeartbeatWriter(gang_dir, args.process_id).start()
 

@@ -57,6 +57,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import tuning
 from ..metrics import Counters, RESCORED_ITEMS, ROW_SUM_PROCESS_WINDOW
 from ..observability import LEDGER, StageClock
 from ..observability.registry import REGISTRY
@@ -453,7 +454,7 @@ def resolve_fixed_shapes(fixed_shapes, defer_results: bool) -> bool:
     auto) and enforce the defer-only contract — shared by the
     single-device and sharded sparse scorers."""
     if fixed_shapes is None:
-        env = os.environ.get("TPU_COOC_FIXED_SCORE", "auto")
+        env = tuning.env_read("TPU_COOC_FIXED_SCORE", "auto")
         env = env.strip().lower()
         if env in ("1", "on", "true", "yes"):
             fixed_shapes = True
@@ -820,7 +821,7 @@ def make_row_registry(rows_capacity: int, kind: Optional[str] = None):
     (default bitmap — the compressed index is the production layout;
     dense remains for A/B and as the reference implementation)."""
     if kind is None:
-        kind = os.environ.get("TPU_COOC_ROW_INDEX", "bitmap").strip().lower()
+        kind = tuning.env_read("TPU_COOC_ROW_INDEX", "bitmap").strip().lower()
     if kind == "dense":
         return DenseRowRegistry(rows_capacity)
     if kind == "bitmap":
@@ -1498,7 +1499,7 @@ class SparseDeviceScorer:
         # Env-tunable so high-latency links can trade padding for fewer
         # round trips without a config/API change.
         self.score_ladder = int(score_ladder if score_ladder is not None
-                                else os.environ.get(
+                                else tuning.env_read(
                                     "TPU_COOC_SCORE_LADDER", 4))
         ladder_bits(self.score_ladder)  # validate at construction
         self.counters = counters if counters is not None else Counters()

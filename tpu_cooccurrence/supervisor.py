@@ -57,6 +57,8 @@ import tempfile
 import time
 from typing import List, Optional, Sequence
 
+from . import tuning
+
 LOG = logging.getLogger("tpu_cooccurrence.supervisor")
 
 #: Flags the supervisor strips from the child's argv (the child must run
@@ -269,7 +271,7 @@ def supervise(cmd: Sequence[str], attempts: int, delay_s: float = 1.0,
     # ordinal — a post-crash child's journal records then stitch to the
     # prior attempt's instead of starting an unrelated stream. An
     # already-present env id (outer supervisor, operator) is inherited.
-    run_id = os.environ.get(RUN_ID_ENV) or mint_run_id()
+    run_id = tuning.env_read(RUN_ID_ENV) or mint_run_id()
     while True:
         # Journal size at spawn: the crash-forensics quote below must only
         # fire for records THIS attempt wrote (append mode keeps earlier

@@ -15,6 +15,8 @@ from __future__ import annotations
 import logging
 import os
 
+
+from . import tuning
 LOG = logging.getLogger("tpu_cooccurrence")
 
 _enabled = False
@@ -46,7 +48,7 @@ def enable_compilation_cache() -> None:
     if _enabled:
         return
     _enabled = True
-    path = os.environ.get("TPU_COOC_COMPILE_CACHE")
+    path = tuning.env_read("TPU_COOC_COMPILE_CACHE")
     if path == "":
         return
     try:
